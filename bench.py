@@ -1,0 +1,74 @@
+"""Headline benchmark: LogisticRegression.fit throughput on device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: samples/sec/chip processed by the device-resident L-BFGS fit
+(counting one full data pass per outer iteration — line-search passes are
+not counted, so this undercounts true throughput). vs_baseline is the ratio
+against scikit-learn's lbfgs LogisticRegression measured the same way on a
+subsample on this host's CPU — the reference's per-block compute engine
+(SURVEY.md §6: no published in-repo numbers; BASELINE.json configs[0]).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import dask_ml_tpu  # noqa: F401
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    n_chips = len(jax.devices())
+    on_tpu = jax.default_backend() == "tpu"
+    n_rows = 4_000_000 if on_tpu else 200_000
+    n_feat = 256 if on_tpu else 64
+
+    rng = np.random.RandomState(0)
+    beta_true = rng.randn(n_feat).astype(np.float32) / np.sqrt(n_feat)
+    X = rng.randn(n_rows, n_feat).astype(np.float32)
+    logits = X @ beta_true
+    y = (rng.uniform(size=n_rows) < 1.0 / (1.0 + np.exp(-logits))).astype(
+        np.float32
+    )
+
+    max_iter = 50
+    # warm the compile cache AT FULL SHAPE (XLA programs are
+    # shape-specialized) with a 1-iteration fit
+    LogisticRegression(solver="lbfgs", max_iter=1, tol=0.0).fit(X, y)
+
+    t0 = time.perf_counter()
+    clf = LogisticRegression(solver="lbfgs", max_iter=max_iter, tol=0.0)
+    clf.fit(X, y)
+    elapsed = time.perf_counter() - t0
+    iters = clf.n_iter_ or max_iter
+    value = n_rows * iters / elapsed / n_chips
+
+    # sklearn reference on a subsample of the same data
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    sub = min(n_rows, 100_000)
+    sk = SkLR(solver="lbfgs", max_iter=max_iter, tol=0.0)
+    t0 = time.perf_counter()
+    sk.fit(X[:sub], y[:sub])
+    sk_elapsed = time.perf_counter() - t0
+    sk_iters = int(np.max(sk.n_iter_)) or max_iter
+    sk_value = sub * sk_iters / sk_elapsed
+
+    print(json.dumps({
+        "metric": "logreg_fit_samples_per_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "samples/s/chip",
+        "vs_baseline": round(value / sk_value, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+"""dask_ml_tpu — a TPU-native distributed ML framework with the
+capabilities of dask-ml (see SURVEY.md for the blueprint).
+
+Layout:
+- ``parallel/`` — mesh/sharding substrate
+- ``ops/``      — reductions, distributed linalg, pairwise kernels
+- ``models/``   — estimator implementations + GLM solver library
+- ``utils/``    — validation helpers
+- sklearn-parity namespaces currently importable: ``linear_model``,
+  ``preprocessing``, ``metrics``, ``datasets`` (more land per
+  SURVEY.md §7's build plan).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,37 @@
+"""Estimator base: the scikit-learn contract.
+
+The reference's estimators subclass sklearn bases so that ``get_params`` /
+``set_params`` / ``clone`` compose with pipelines and search (SURVEY.md §5
+config row: "estimator params stay sklearn-style (MUST)"). We do the same —
+sklearn's ``BaseEstimator`` provides the param introspection contract; the
+mixins add ``score`` defaults. Fitted state is stored as numpy on the host
+(small: coefs, centers, components) with device-resident copies created on
+demand, so estimators pickle/clone cleanly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from sklearn.base import (  # re-exported contract, verified sklearn 1.9
+    BaseEstimator,
+    ClassifierMixin,
+    ClusterMixin,
+    RegressorMixin,
+    TransformerMixin,
+    clone,
+)
+
+__all__ = [
+    "BaseEstimator",
+    "ClassifierMixin",
+    "RegressorMixin",
+    "TransformerMixin",
+    "ClusterMixin",
+    "clone",
+    "to_host",
+]
+
+
+def to_host(x):
+    """Move a fitted attribute to host numpy (fitted attrs are small)."""
+    return np.asarray(x)

@@ -1,0 +1,111 @@
+"""Chunked synthetic datasets.
+
+Reference: ``dask_ml/datasets.py`` (SURVEY.md §2a Datasets row) — per-block
+sklearn generators with per-block seeds. Here blocks = shards: each shard's
+rows are generated with a seed derived from (random_state, shard index) so
+the dataset is deterministic for a given mesh size, then placed directly
+onto the mesh — the TPU equivalent of "generate where the chunk lives".
+
+The generators run sklearn on the host per shard (generation is not the hot
+path); the returned ShardedArray is device-resident.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import sklearn.datasets as skdata
+
+from .parallel.mesh import data_shards, resolve_mesh
+from .parallel.sharded import ShardedArray
+
+
+def _per_shard(n_samples, mesh):
+    s = data_shards(mesh)
+    per = int(np.ceil(n_samples / s))
+    sizes = [min(per, n_samples - i * per) for i in range(s)]
+    return [max(sz, 0) for sz in sizes]
+
+
+def _assemble(parts_X, parts_y, mesh):
+    X = np.concatenate([p for p in parts_X if len(p)], axis=0)
+    y = np.concatenate([p for p in parts_y if len(p)], axis=0)
+    return (
+        ShardedArray.from_array(X, mesh, dtype=np.float32),
+        ShardedArray.from_array(y, mesh, dtype=np.float32),
+    )
+
+
+def make_classification(n_samples=100, n_features=20, random_state=None,
+                        chunks=None, mesh=None, **kwargs):
+    mesh = resolve_mesh(mesh)
+    rs = np.random.RandomState(random_state)
+    seeds = rs.randint(0, 2**31 - 1, size=data_shards(mesh))
+    Xs, ys = [], []
+    for sz, seed in zip(_per_shard(n_samples, mesh), seeds):
+        if sz <= 0:
+            Xs.append(np.empty((0, n_features))); ys.append(np.empty((0,)))
+            continue
+        X, y = skdata.make_classification(
+            n_samples=sz, n_features=n_features, random_state=int(seed), **kwargs
+        )
+        Xs.append(X); ys.append(y)
+    return _assemble(Xs, ys, mesh)
+
+
+def make_regression(n_samples=100, n_features=100, random_state=None,
+                    chunks=None, mesh=None, **kwargs):
+    mesh = resolve_mesh(mesh)
+    rs = np.random.RandomState(random_state)
+    seeds = rs.randint(0, 2**31 - 1, size=data_shards(mesh))
+    Xs, ys = [], []
+    for sz, seed in zip(_per_shard(n_samples, mesh), seeds):
+        if sz <= 0:
+            Xs.append(np.empty((0, n_features))); ys.append(np.empty((0,)))
+            continue
+        X, y = skdata.make_regression(
+            n_samples=sz, n_features=n_features, random_state=int(seed), **kwargs
+        )
+        Xs.append(X); ys.append(y)
+    return _assemble(Xs, ys, mesh)
+
+
+def make_blobs(n_samples=100, n_features=2, centers=None, random_state=None,
+               chunks=None, mesh=None, **kwargs):
+    mesh = resolve_mesh(mesh)
+    rs = np.random.RandomState(random_state)
+    if centers is None:
+        centers = 3
+    if np.isscalar(centers):
+        # fix center locations once so every shard draws from the same blobs
+        centers = rs.uniform(-10, 10, size=(centers, n_features))
+    seeds = rs.randint(0, 2**31 - 1, size=data_shards(mesh))
+    Xs, ys = [], []
+    for sz, seed in zip(_per_shard(n_samples, mesh), seeds):
+        if sz <= 0:
+            Xs.append(np.empty((0, n_features))); ys.append(np.empty((0,)))
+            continue
+        X, y = skdata.make_blobs(
+            n_samples=sz, n_features=n_features, centers=centers,
+            random_state=int(seed), **kwargs
+        )
+        Xs.append(X); ys.append(y)
+    return _assemble(Xs, ys, mesh)
+
+
+def make_counts(n_samples=100, n_features=20, random_state=None, scale=1.0,
+                chunks=None, mesh=None):
+    """Poisson-target regression data (ref: dask_ml/datasets.py::make_counts)."""
+    mesh = resolve_mesh(mesh)
+    rs = np.random.RandomState(random_state)
+    beta = rs.normal(0, 1, size=n_features) * scale / np.sqrt(n_features)
+    seeds = rs.randint(0, 2**31 - 1, size=data_shards(mesh))
+    Xs, ys = [], []
+    for sz, seed in zip(_per_shard(n_samples, mesh), seeds):
+        if sz <= 0:
+            Xs.append(np.empty((0, n_features))); ys.append(np.empty((0,)))
+            continue
+        r = np.random.RandomState(int(seed))
+        X = r.normal(0, 1, size=(sz, n_features))
+        y = r.poisson(np.exp(X @ beta))
+        Xs.append(X); ys.append(y.astype(np.float64))
+    return _assemble(Xs, ys, mesh)

@@ -1,0 +1,7 @@
+"""Ref: dask_ml/metrics/__init__.py."""
+from .classification import accuracy_score, log_loss
+from .regression import (mean_absolute_error, mean_squared_error,
+                         mean_squared_log_error, r2_score)
+from ..ops.pairwise import (euclidean_distances, linear_kernel,
+                            pairwise_distances_argmin_min, polynomial_kernel,
+                            rbf_kernel, sigmoid_kernel)

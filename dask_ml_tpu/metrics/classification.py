@@ -1,0 +1,50 @@
+"""Classification metrics over (possibly sharded) arrays.
+
+Reference: ``dask_ml/metrics/classification.py`` (SURVEY.md §2a Metrics
+row) — blocked reductions with per-block sklearn kernels. Here each metric
+is one jitted masked reduction; XLA inserts the psum when inputs are
+sharded.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.sharded import ShardedArray, as_sharded
+
+
+def _canon(y_true, y_pred, sample_weight=None):
+    """Co-shard the pair (and sample_weight, padded alike); returns
+    (a, b, weights, n) where weights = row-validity mask * sample_weight."""
+    if isinstance(y_true, ShardedArray) or isinstance(y_pred, ShardedArray):
+        mesh = (y_true.mesh if isinstance(y_true, ShardedArray) else y_pred.mesh)
+        t = as_sharded(y_true, mesh=mesh)
+        p = as_sharded(y_pred, mesh=mesh)
+        w = t.row_mask()
+        if sample_weight is not None:
+            w = w * as_sharded(sample_weight, mesh=mesh).data
+        return t.data, p.data, w, t.n_rows
+    t = np.asarray(y_true)
+    p = np.asarray(y_pred)
+    w = np.ones(t.shape[0], np.float32)
+    if sample_weight is not None:
+        w = w * np.asarray(sample_weight)
+    return t, p, w, t.shape[0]
+
+
+def accuracy_score(y_true, y_pred, normalize=True, sample_weight=None):
+    t, p, w, n = _canon(y_true, y_pred, sample_weight)
+    hits = jnp.sum((t == p) * w)
+    if not normalize:
+        return float(hits)
+    return float(hits / jnp.sum(w))
+
+
+def log_loss(y_true, y_prob, eps=1e-15, sample_weight=None):
+    t, p, w, n = _canon(y_true, y_prob, sample_weight)
+    p = jnp.clip(p, eps, 1.0 - eps)
+    if p.ndim == 2:  # (n, 2) probabilities: take class-1 column
+        p = p[:, 1]
+    ll = -(t * jnp.log(p) + (1.0 - t) * jnp.log1p(-p))
+    return float(jnp.sum(ll * w) / jnp.sum(w))

@@ -1,0 +1,34 @@
+"""Regression metrics. Reference: ``dask_ml/metrics/regression.py``
+(SURVEY.md §2a Metrics row)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .classification import _canon
+
+
+def mean_squared_error(y_true, y_pred, sample_weight=None, squared=True):
+    t, p, w, n = _canon(y_true, y_pred, sample_weight)
+    mse = jnp.sum(((t - p) ** 2) * w) / jnp.sum(w)
+    return float(mse if squared else jnp.sqrt(mse))
+
+
+def mean_absolute_error(y_true, y_pred, sample_weight=None):
+    t, p, w, n = _canon(y_true, y_pred, sample_weight)
+    return float(jnp.sum(jnp.abs(t - p) * w) / jnp.sum(w))
+
+
+def r2_score(y_true, y_pred, sample_weight=None):
+    t, p, w, n = _canon(y_true, y_pred, sample_weight)
+    wsum = jnp.sum(w)
+    mean = jnp.sum(t * w) / wsum
+    ss_res = jnp.sum(((t - p) ** 2) * w)
+    ss_tot = jnp.sum(((t - mean) ** 2) * w)
+    return float(1.0 - ss_res / ss_tot)
+
+
+def mean_squared_log_error(y_true, y_pred, sample_weight=None):
+    t, p, w, n = _canon(y_true, y_pred, sample_weight)
+    err = (jnp.log1p(t) - jnp.log1p(p)) ** 2
+    return float(jnp.sum(err * w) / jnp.sum(w))

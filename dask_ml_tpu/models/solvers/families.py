@@ -1,0 +1,76 @@
+"""GLM families: Normal, Logistic, Poisson.
+
+Reference equivalent: ``dask_glm/families.py`` (SURVEY.md §2b row 6), which
+hand-codes loglike/gradient/hessian per family for dask arrays. TPU-native
+design: each family is just a pointwise loss + inverse link as pure jax
+functions; gradients and Hessian weights come from autodiff / closed forms
+and fuse into the surrounding XLA program — no hand-written gradient graphs.
+
+``pointwise(eta, y)`` is the per-row negative log-likelihood (up to a
+y-only constant); the global objective is the mask-weighted mean, so padded
+rows contribute nothing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class Normal:
+    name = "normal"
+
+    @staticmethod
+    def pointwise(eta, y):
+        return 0.5 * (eta - y) ** 2
+
+    @staticmethod
+    def mean(eta):  # inverse link
+        return eta
+
+    @staticmethod
+    def hess_weight(eta, y):
+        return jnp.ones_like(eta)
+
+
+class Logistic:
+    name = "logistic"
+
+    @staticmethod
+    def pointwise(eta, y):
+        # log(1 + e^eta) - y*eta, stable via softplus
+        return jax.nn.softplus(eta) - y * eta
+
+    @staticmethod
+    def mean(eta):
+        return jax.nn.sigmoid(eta)
+
+    @staticmethod
+    def hess_weight(eta, y):
+        p = jax.nn.sigmoid(eta)
+        return p * (1.0 - p)
+
+
+class Poisson:
+    name = "poisson"
+
+    @staticmethod
+    def pointwise(eta, y):
+        return jnp.exp(eta) - y * eta
+
+    @staticmethod
+    def mean(eta):
+        return jnp.exp(eta)
+
+    @staticmethod
+    def hess_weight(eta, y):
+        return jnp.exp(eta)
+
+
+FAMILIES = {f.name: f for f in (Normal, Logistic, Poisson)}
+
+
+def get_family(name: str):
+    if name not in FAMILIES:
+        raise ValueError(f"Unknown family {name!r}; options: {sorted(FAMILIES)}")
+    return FAMILIES[name]

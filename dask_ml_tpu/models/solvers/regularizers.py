@@ -1,0 +1,55 @@
+"""Regularizers with proximal operators: L1, L2, ElasticNet.
+
+Reference equivalent: ``dask_glm/regularizers.py`` (SURVEY.md §2b row 6).
+Each regularizer exposes ``value`` (penalty term for smooth objectives) and
+``prox`` (proximal map for proximal-gradient / ADMM z-updates). ``pmask``
+is 1 for penalized coordinates and 0 for the intercept column, which —
+unlike dask-glm but like sklearn — is never penalized (sklearn-parity
+contract, SURVEY.md §4). ``l1_ratio`` is threaded everywhere so the
+functions stay jit-static on regularizer *name* only.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _soft_threshold(x, t):
+    return jnp.sign(x) * jnp.maximum(jnp.abs(x) - t, 0.0)
+
+
+def value(reg: str, beta, lam, pmask, l1_ratio=0.5):
+    b = beta * pmask
+    if reg == "l2":
+        return 0.5 * lam * jnp.sum(b * b)
+    if reg == "l1":
+        return lam * jnp.sum(jnp.abs(b))
+    if reg == "elastic_net":
+        return lam * (
+            l1_ratio * jnp.sum(jnp.abs(b))
+            + 0.5 * (1.0 - l1_ratio) * jnp.sum(b * b)
+        )
+    if reg == "none":
+        return jnp.zeros((), dtype=beta.dtype)
+    raise ValueError(f"Unknown regularizer {reg!r}")
+
+
+def prox(reg: str, beta, lam, t, pmask, l1_ratio=0.5):
+    """prox_{t * lam * r}(beta), identity on unpenalized coordinates."""
+    if reg == "l2":
+        out = beta / (1.0 + t * lam)
+    elif reg == "l1":
+        out = _soft_threshold(beta, t * lam)
+    elif reg == "elastic_net":
+        out = _soft_threshold(beta, t * lam * l1_ratio) / (
+            1.0 + t * lam * (1.0 - l1_ratio)
+        )
+    elif reg == "none":
+        return beta
+    else:
+        raise ValueError(f"Unknown regularizer {reg!r}")
+    return jnp.where(pmask > 0, out, beta)
+
+
+SMOOTH = {"l2", "none"}
+KNOWN = {"l1", "l2", "elastic_net", "none"}

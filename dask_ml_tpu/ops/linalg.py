@@ -1,0 +1,118 @@
+"""Distributed linear algebra: TSQR and randomized SVD.
+
+Reference equivalent: ``dask/array/linalg.py::tsqr`` /
+``svd_compressed`` (SURVEY.md §2b row 2 and §3.3) — the backbone of
+PCA/TruncatedSVD/spectral embedding. The TPU design (SURVEY.md §7 B1):
+
+- ``tsqr``: per-shard ``jnp.linalg.qr`` inside ``shard_map``, ``all_gather``
+  of the small R factors over ICI, replicated second-stage QR. The reference
+  builds the same two-level shape as a task graph with inter-worker shuffles;
+  here it is one XLA program.
+- ``randomized_svd``: Halko range-finder with power iterations, each pass a
+  psum-reduced matmul; the final small SVD is replicated (the reference runs
+  it on the client).
+
+Inputs are *padded* row-sharded arrays whose padding rows are exactly zero
+(zero rows leave R and the spanned range unchanged), so no masks are needed
+here — callers zero padding, e.g. after mean-centering.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..parallel.mesh import DATA_AXIS
+
+from jax import shard_map as _shard_map
+
+
+def shard_map(f, mesh, in_specs, out_specs):
+    # check_vma=False: we return all_gather/pmean results with replicated
+    # out_specs, which the static replication checker cannot infer.
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+    )
+
+
+def tsqr(x: jax.Array, mesh: Mesh, axis_name: str = DATA_AXIS):
+    """Tall-skinny QR of a row-sharded (n, d) array; n >> d required.
+
+    Returns (Q, R): Q row-sharded (n, d) with orthonormal columns, R (d, d)
+    replicated and upper-triangular.
+    """
+    d = x.shape[1]
+
+    def _tsqr(xs):
+        q1, r1 = jnp.linalg.qr(xs)  # local (m, d), (d, d)
+        rs = jax.lax.all_gather(r1, axis_name)  # (S, d, d) over ICI
+        s = rs.shape[0]
+        q2, r = jnp.linalg.qr(rs.reshape(s * d, d))
+        i = jax.lax.axis_index(axis_name)
+        q2_i = jax.lax.dynamic_slice_in_dim(q2, i * d, d)
+        return q1 @ q2_i, r
+
+    return shard_map(
+        _tsqr,
+        mesh=mesh,
+        in_specs=P(axis_name, None),
+        out_specs=(P(axis_name, None), P()),
+    )(x)
+
+
+def svd_tall(x: jax.Array, mesh: Mesh):
+    """Exact SVD of a tall-skinny row-sharded (n, d) array via TSQR.
+
+    Reference: ``da.linalg.svd`` = tsqr + small SVD of R (SURVEY.md §3.3).
+    Returns (U row-sharded (n, d), s (d,), Vt (d, d) replicated).
+    """
+    q, r = tsqr(x, mesh)
+    u_r, s, vt = jnp.linalg.svd(r, full_matrices=False)
+    return q @ u_r, s, vt
+
+
+def randomized_range_finder(x, size, key, n_iter, mesh):
+    """Orthonormal basis Q (n, size) approximately spanning range(x).
+
+    Halko et al. 2011 randomized range finder with power iterations and
+    QR re-orthonormalization each half-iteration, as in
+    ``da.linalg.svd_compressed`` (SURVEY.md §3.3).
+    """
+    d = x.shape[1]
+    omega = jax.random.normal(key, (d, size), dtype=x.dtype)
+    y = x @ omega  # psum-reduced matmul pass
+    q, _ = tsqr(y, mesh)
+    for _ in range(n_iter):
+        z = x.T @ q  # (d, size); XLA inserts the ICI reduction
+        qz, _ = jnp.linalg.qr(z)  # replicated small QR
+        y = x @ qz
+        q, _ = tsqr(y, mesh)
+    return q
+
+
+def randomized_svd(x, n_components, key, mesh, n_oversamples=10, n_iter=4):
+    """Halko randomized SVD of row-sharded (n, d) x.
+
+    Returns (U (n, k) row-sharded, s (k,), Vt (k, d) replicated).
+    """
+    size = min(n_components + n_oversamples, min(x.shape))
+    q = randomized_range_finder(x, size, key, n_iter, mesh)
+    b = q.T @ x  # (size, d), psum-reduced second data pass
+    u_b, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    u = q @ u_b
+    k = n_components
+    return u[:, :k], s[:k], vt[:k]
+
+
+def svd_flip(u, vt):
+    """Deterministic SVD signs, V-based (matches sklearn's
+    ``svd_flip(u_based_decision=False)``): flip so each row of Vt has its
+    largest-|.| entry positive. V-based avoids an argmax over the sharded
+    row axis of U."""
+    max_abs = jnp.argmax(jnp.abs(vt), axis=1)
+    signs = jnp.sign(vt[jnp.arange(vt.shape[0]), max_abs])
+    signs = jnp.where(signs == 0, 1.0, signs).astype(vt.dtype)
+    return u * signs[None, :], vt * signs[:, None]

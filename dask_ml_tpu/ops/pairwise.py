@@ -1,0 +1,68 @@
+"""Pairwise distance / kernel primitives.
+
+Reference equivalent: ``dask_ml/metrics/pairwise.py``, which maps
+sklearn's Cython ``pairwise_distances_argmin_min`` over blocks (SURVEY.md
+§3.1). TPU design: one fused XLA expression — the ``x @ y.T`` term rides the
+MXU, the norm/argmin epilogue fuses into it, so the "distance + argmin"
+pattern the reference pays a Cython call per block for becomes a single
+compiled kernel over the whole sharded array.
+
+``y`` (centers / anchor points) is small and replicated; ``x`` may be the
+padded row-sharded data — callers mask invalid rows on the results.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def row_norms_sq(x):
+    return jnp.sum(x * x, axis=-1)
+
+
+def euclidean_distances_sq(x, y):
+    """Squared euclidean distances (n, m) via the MXU-friendly expansion
+    ||x||^2 - 2 x.y + ||y||^2, clamped at 0 against cancellation."""
+    d2 = (
+        row_norms_sq(x)[:, None]
+        - 2.0 * (x @ y.T)
+        + row_norms_sq(y)[None, :]
+    )
+    return jnp.maximum(d2, 0.0)
+
+
+def euclidean_distances(x, y):
+    return jnp.sqrt(euclidean_distances_sq(x, y))
+
+
+def pairwise_distances_argmin_min(x, y):
+    """(labels, min_dists) of nearest row of y for each row of x.
+
+    The KMeans hot kernel (SURVEY.md §3.1 🔥): distances + argmin fuse into
+    one program instead of the reference's per-block Cython call.
+    """
+    d2 = euclidean_distances_sq(x, y)
+    labels = jnp.argmin(d2, axis=1)
+    return labels, jnp.sqrt(jnp.min(d2, axis=1))
+
+
+def linear_kernel(x, y):
+    return x @ y.T
+
+
+def rbf_kernel(x, y, gamma=None):
+    if gamma is None:
+        gamma = 1.0 / x.shape[-1]
+    return jnp.exp(-gamma * euclidean_distances_sq(x, y))
+
+
+def polynomial_kernel(x, y, degree=3, gamma=None, coef0=1.0):
+    if gamma is None:
+        gamma = 1.0 / x.shape[-1]
+    return (gamma * (x @ y.T) + coef0) ** degree
+
+
+def sigmoid_kernel(x, y, gamma=None, coef0=1.0):
+    if gamma is None:
+        gamma = 1.0 / x.shape[-1]
+    return jnp.tanh(gamma * (x @ y.T) + coef0)

@@ -1,0 +1,52 @@
+"""Masked global reductions over row-sharded arrays.
+
+Reference equivalent: ``dask/array/reductions.py`` tree-reduce graphs
+(SURVEY.md §2b row 1). Here each reduction is a ``jnp`` expression over the
+global (padded) view; under ``jit`` with row sharding XLA lowers the sum to
+a per-shard partial + ICI all-reduce — the same two-phase shape as dask's
+tree-reduce, with zero scheduler/serialization overhead.
+
+All functions take the padded data plus a row mask (1 = logical row,
+0 = padding) so padding never biases a statistic.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def masked_sum(x, mask, axis=0):
+    """Sum over rows, ignoring padded rows. x: (n, ...), mask: (n,)."""
+    return jnp.tensordot(mask, x, axes=(0, 0)) if axis == 0 and x.ndim > 1 else jnp.sum(
+        x * _expand(mask, x), axis=axis
+    )
+
+
+def masked_mean(x, mask, n_rows):
+    return masked_sum(x, mask) / n_rows
+
+
+def masked_mean_var(x, mask, n_rows, ddof=0):
+    """Numerically-stable mean/variance in one pass (two psums under jit)."""
+    mean = masked_mean(x, mask, n_rows)
+    centered = (x - mean) * _expand(mask, x)
+    var = jnp.sum(centered * centered, axis=0) / max(n_rows - ddof, 1)
+    return mean, var
+
+
+def masked_min(x, mask, axis=0):
+    big = jnp.asarray(jnp.inf, dtype=x.dtype)
+    return jnp.min(jnp.where(_expand(mask, x) > 0, x, big), axis=axis)
+
+
+def masked_max(x, mask, axis=0):
+    small = jnp.asarray(-jnp.inf, dtype=x.dtype)
+    return jnp.max(jnp.where(_expand(mask, x) > 0, x, small), axis=axis)
+
+
+def masked_count_nonzero(x, mask):
+    return jnp.tensordot(mask, (x != 0).astype(x.dtype), axes=(0, 0))
+
+
+def _expand(mask, x):
+    return mask.reshape(mask.shape + (1,) * (x.ndim - 1)).astype(x.dtype)

@@ -1,0 +1,3 @@
+from .mesh import (DATA_AXIS, MODEL_AXIS, default_mesh, device_mesh,
+                   resolve_mesh, use_mesh)
+from .sharded import ShardedArray, as_sharded, row_mask

@@ -1,0 +1,97 @@
+"""Device-mesh management — the substrate every estimator runs on.
+
+In the reference (dask-ml), data lives as row-chunked ``dask.array`` blocks
+scheduled over workers connected by TCP (``distributed/comm``); here the
+equivalent substrate is a ``jax.sharding.Mesh`` over TPU chips, with XLA
+collectives over ICI replacing the comm layer entirely (SURVEY.md §5,
+"Distributed communication backend").
+
+The default mesh is 1-D over all visible devices with axis name ``"data"``
+(pure data-parallel — the reference's row-chunking model, SURVEY.md §2c).
+A 2-D ``("data", "model")`` mesh is supported for wide-feature problems
+where sharding the feature axis pays (the reference's nearest analog is
+dask.array 2-D blockwise matmul).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+_state = threading.local()
+
+
+def device_mesh(shape=None, axis_names=(DATA_AXIS,), devices=None) -> Mesh:
+    """Build a mesh over ``devices`` (default: all of ``jax.devices()``).
+
+    ``shape=None`` gives a 1-D mesh over every device. ``shape`` may use -1
+    for one axis (inferred), e.g. ``device_mesh((-1, 2), ("data", "model"))``.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices, dtype=object)
+    n = devices.size
+    if shape is None:
+        shape = (n,)
+    shape = tuple(shape)
+    if len(shape) != len(axis_names):
+        raise ValueError(f"shape {shape} does not match axis_names {axis_names}")
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        if n % known:
+            raise ValueError(f"cannot infer -1 in {shape} from {n} devices")
+        shape = tuple(n // known if s == -1 else s for s in shape)
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh shape {shape} needs {int(np.prod(shape))} devices, have {n}")
+    return Mesh(devices.reshape(shape), axis_names)
+
+
+def default_mesh() -> Mesh:
+    """The ambient mesh: the one set by :func:`use_mesh`, else a cached 1-D
+    data mesh over all devices."""
+    mesh = getattr(_state, "mesh", None)
+    if mesh is not None:
+        return mesh
+    cached = getattr(_state, "cached_default", None)
+    if cached is None or cached.devices.size != len(jax.devices()):
+        cached = device_mesh()
+        _state.cached_default = cached
+    return cached
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    """Context manager: make ``mesh`` the ambient mesh for estimators that
+    don't receive one explicitly."""
+    prev = getattr(_state, "mesh", None)
+    _state.mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _state.mesh = prev
+
+
+def resolve_mesh(mesh=None) -> Mesh:
+    return mesh if mesh is not None else default_mesh()
+
+
+def data_shards(mesh: Mesh) -> int:
+    """Number of shards along the data (row) axis."""
+    return mesh.shape[DATA_AXIS] if DATA_AXIS in mesh.shape else 1
+
+
+def row_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+    """NamedSharding for an array whose leading axis is row-sharded."""
+    spec = (DATA_AXIS,) + (None,) * (ndim - 1)
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

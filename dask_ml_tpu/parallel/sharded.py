@@ -1,0 +1,139 @@
+"""Row-sharded array substrate — the TPU-native replacement for the
+reference's chunked ``dask.array`` data model (SURVEY.md §2b, row 1:
+``dask/array/core.py`` blockwise collections).
+
+Design (SURVEY.md §7 B0): a :class:`ShardedArray` is a padded ``jax.Array``
+laid out with ``NamedSharding(P("data", ...))`` over a device mesh, plus the
+*logical* row count. Global-view GSPMD programming replaces dask's per-block
+task graphs: ``jnp`` ops on the padded array are traced once under ``jit``
+and XLA inserts the ICI collectives that dask would have expressed as
+tree-reduce task graphs.
+
+Padding: XLA needs equal shards, so rows are padded to a multiple of the
+data-axis size. Padded rows are zero; every reduction in ``ops/`` is
+mask-aware (``row_mask``) so they never contribute. This replaces dask's
+ragged-final-chunk handling.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS, data_shards, resolve_mesh
+
+
+def _padded_rows(n_rows: int, n_shards: int) -> int:
+    return max(n_shards, math.ceil(n_rows / n_shards) * n_shards)
+
+
+class ShardedArray:
+    """A logically (n_rows, *feature_dims) array, row-sharded over a mesh.
+
+    Parameters
+    ----------
+    data : jax.Array
+        Padded device array, leading axis divisible by the mesh's data size.
+    n_rows : int
+        Logical (unpadded) number of rows.
+    mesh : Mesh
+    """
+
+    __slots__ = ("data", "n_rows", "mesh")
+
+    def __init__(self, data: jax.Array, n_rows: int, mesh: Mesh):
+        self.data = data
+        self.n_rows = int(n_rows)
+        self.mesh = mesh
+
+    # -- construction -----------------------------------------------------
+    @classmethod
+    def from_array(cls, x, mesh: Mesh | None = None, dtype=None) -> "ShardedArray":
+        """Place a host (numpy) or device array onto the mesh, row-sharded.
+
+        Equivalent of ``da.from_array`` + scatter in the reference; here it
+        is one ``device_put`` with a NamedSharding (no serialization layer —
+        SURVEY.md §5 comm row).
+        """
+        if isinstance(x, ShardedArray):
+            return x if dtype is None else cls(x.data.astype(dtype), x.n_rows, x.mesh)
+        mesh = resolve_mesh(mesh)
+        x = np.asarray(x)
+        if dtype is not None:
+            x = x.astype(dtype, copy=False)
+        n = x.shape[0]
+        shards = data_shards(mesh)
+        n_pad = _padded_rows(n, shards)
+        if n_pad != n:
+            pad_widths = [(0, n_pad - n)] + [(0, 0)] * (x.ndim - 1)
+            x = np.pad(x, pad_widths)
+        spec = P(*((DATA_AXIS,) + (None,) * (x.ndim - 1)))
+        data = jax.device_put(x, NamedSharding(mesh, spec))
+        return cls(data, n, mesh)
+
+    # -- basic properties -------------------------------------------------
+    @property
+    def shape(self):
+        return (self.n_rows,) + tuple(self.data.shape[1:])
+
+    @property
+    def padded_shape(self):
+        return tuple(self.data.shape)
+
+    @property
+    def ndim(self):
+        return self.data.ndim
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def sharding(self) -> NamedSharding:
+        return self.data.sharding
+
+    def __len__(self):
+        return self.n_rows
+
+    def __repr__(self):
+        return (
+            f"ShardedArray(shape={self.shape}, padded={self.padded_shape}, "
+            f"dtype={self.dtype}, shards={data_shards(self.mesh)})"
+        )
+
+    # -- masks ------------------------------------------------------------
+    def row_mask(self, dtype=jnp.float32) -> jax.Array:
+        """(n_padded,) mask: 1 for logical rows, 0 for padding. Sharded the
+        same way as ``data``'s rows so masked reductions stay local."""
+        return row_mask(self.padded_shape[0], self.n_rows, self.mesh, dtype)
+
+    # -- host round-trip --------------------------------------------------
+    def to_numpy(self) -> np.ndarray:
+        return np.asarray(self.data)[: self.n_rows]
+
+    def astype(self, dtype) -> "ShardedArray":
+        return ShardedArray(self.data.astype(dtype), self.n_rows, self.mesh)
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def _row_mask(n_padded: int, n_rows: int, sharding, dtype) -> jax.Array:
+    idx = jnp.arange(n_padded)
+    return jax.lax.with_sharding_constraint((idx < n_rows).astype(dtype), sharding)
+
+
+def row_mask(n_padded: int, n_rows: int, mesh: Mesh, dtype=jnp.float32) -> jax.Array:
+    # all-static jitted helper: cache hit per (shape, mesh) instead of a
+    # fresh trace per call
+    return _row_mask(n_padded, n_rows, NamedSharding(mesh, P(DATA_AXIS)), dtype)
+
+
+def as_sharded(x, mesh: Mesh | None = None, dtype=None) -> ShardedArray:
+    """Canonicalize numpy / jax / ShardedArray input to ShardedArray."""
+    return ShardedArray.from_array(x, mesh=mesh, dtype=dtype)

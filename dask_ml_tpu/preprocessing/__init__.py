@@ -1,0 +1,3 @@
+"""Ref: dask_ml/preprocessing/__init__.py."""
+from .data import (MinMaxScaler, PolynomialFeatures, QuantileTransformer,
+                   RobustScaler, StandardScaler)

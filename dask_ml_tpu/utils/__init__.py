@@ -1,0 +1,1 @@
+from .validation import check_array, check_is_fitted, check_X_y

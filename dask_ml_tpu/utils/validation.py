@@ -1,0 +1,45 @@
+"""Input validation / canonicalization.
+
+Reference equivalent: ``dask_ml/utils.py::check_array / check_X_y /
+check_chunks`` (SURVEY.md §2a "Support" row). Here canonicalization means:
+accept numpy / jax arrays / ShardedArray, end with a row-sharded padded
+device array on the estimator's mesh.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..parallel.mesh import resolve_mesh
+from ..parallel.sharded import ShardedArray, as_sharded
+
+
+def check_array(x, mesh=None, dtype=None, ensure_2d=True, copy=False) -> ShardedArray:
+    if not isinstance(x, ShardedArray):
+        arr = np.asarray(x)
+        if arr.ndim == 1 and ensure_2d:
+            raise ValueError(
+                f"Expected 2D array, got 1D array instead: shape {arr.shape}."
+            )
+        if arr.ndim > 2:
+            raise ValueError(f"Expected <=2D array, got shape {arr.shape}.")
+        x = arr
+    return as_sharded(x, mesh=resolve_mesh(mesh), dtype=dtype)
+
+
+def check_X_y(X, y, mesh=None, dtype=None):
+    mesh = resolve_mesh(mesh)
+    n_X = X.n_rows if isinstance(X, ShardedArray) else np.asarray(X).shape[0]
+    n_y = y.n_rows if isinstance(y, ShardedArray) else np.asarray(y).shape[0]
+    if n_X != n_y:
+        raise ValueError(f"X and y have inconsistent lengths: {n_X} vs {n_y}")
+    X = check_array(X, mesh=mesh, dtype=dtype)
+    y = as_sharded(y, mesh=mesh, dtype=dtype)
+    return X, y
+
+
+def check_is_fitted(est, attr: str):
+    if not hasattr(est, attr):
+        raise AttributeError(
+            f"This {type(est).__name__} instance is not fitted yet; call 'fit' first."
+        )

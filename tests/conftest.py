@@ -1,0 +1,51 @@
+"""Test harness (SURVEY.md §4): run on a virtual 8-device CPU mesh so
+N-way sharding logic is exercised without a pod — the analog of the
+reference's in-process ``gen_cluster`` scheduler+workers."""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# The axon TPU plugin ignores JAX_PLATFORMS; force the CPU backend
+# explicitly so the 8-device virtual mesh is used for tests.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh():
+    from dask_ml_tpu.parallel import default_mesh
+
+    return default_mesh()
+
+
+@pytest.fixture(scope="session")
+def xy_classification():
+    from sklearn.datasets import make_classification
+
+    X, y = make_classification(
+        n_samples=500, n_features=10, n_informative=5, random_state=0
+    )
+    return X.astype(np.float64), y.astype(np.float64)
+
+
+@pytest.fixture(scope="session")
+def xy_regression():
+    from sklearn.datasets import make_regression
+
+    X, y = make_regression(
+        n_samples=500, n_features=10, n_informative=5, noise=5.0, random_state=0
+    )
+    return X.astype(np.float64), y.astype(np.float64)

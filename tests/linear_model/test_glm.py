@@ -1,0 +1,111 @@
+"""GLM parity tests vs scikit-learn (SURVEY.md §4: sklearn is the oracle).
+
+Mirrors the reference's ``tests/linear_model/test_glm.py`` strategy: fit the
+distributed estimator on sharded data, fit sklearn in memory, compare
+coefficients / predictions.
+"""
+
+import numpy as np
+import pytest
+import sklearn.linear_model as sklm
+
+from dask_ml_tpu.linear_model import (
+    LinearRegression,
+    LogisticRegression,
+    PoissonRegression,
+)
+
+SOLVERS_SMOOTH = ["lbfgs", "newton", "gradient_descent", "admm", "proximal_grad"]
+
+
+@pytest.mark.parametrize("solver", SOLVERS_SMOOTH)
+def test_logistic_l2_parity(xy_classification, solver):
+    X, y = xy_classification
+    ours = LogisticRegression(solver=solver, C=1.0, max_iter=500, tol=1e-7)
+    ours.fit(X, y)
+    ref = sklm.LogisticRegression(C=1.0, solver="lbfgs", max_iter=2000, tol=1e-10)
+    ref.fit(X, y)
+    atol = 0.03 if solver in ("admm", "gradient_descent", "proximal_grad") else 0.01
+    np.testing.assert_allclose(ours.coef_, ref.coef_, atol=atol)
+    np.testing.assert_allclose(ours.intercept_, ref.intercept_, atol=atol)
+    assert ours.score(X, y) == pytest.approx(ref.score(X, y), abs=0.02)
+
+
+def test_logistic_predict_api(xy_classification):
+    X, y = xy_classification
+    clf = LogisticRegression(solver="lbfgs", max_iter=200).fit(X, y)
+    proba = clf.predict_proba(X)
+    assert proba.shape == (len(y), 2)
+    np.testing.assert_allclose(proba.sum(1), 1.0, atol=1e-5)
+    pred = clf.predict(X)
+    assert set(np.unique(pred)) <= set(clf.classes_)
+    assert clf.score(X, y) > 0.8
+
+
+def test_logistic_l1_sparsity(xy_classification):
+    X, y = xy_classification
+    clf = LogisticRegression(
+        solver="proximal_grad", penalty="l1", C=0.01, max_iter=2000, tol=1e-9
+    ).fit(X, y)
+    ref = sklm.LogisticRegression(
+        penalty="l1", C=0.01, solver="saga", max_iter=5000, tol=1e-10
+    ).fit(X, y)
+    np.testing.assert_allclose(ours_zero := (np.abs(clf.coef_) < 1e-6),
+                               np.abs(ref.coef_) < 1e-6)
+    np.testing.assert_allclose(clf.coef_, ref.coef_, atol=0.02)
+
+
+def test_logistic_admm_l1(xy_classification):
+    X, y = xy_classification
+    clf = LogisticRegression(
+        solver="admm", penalty="l1", C=0.01, max_iter=400, tol=1e-5
+    ).fit(X, y)
+    ref = sklm.LogisticRegression(
+        penalty="l1", C=0.01, solver="saga", max_iter=5000, tol=1e-10
+    ).fit(X, y)
+    np.testing.assert_allclose(clf.coef_, ref.coef_, atol=0.03)
+
+
+@pytest.mark.parametrize("solver", ["lbfgs", "newton"])
+def test_linear_regression_parity(xy_regression, solver):
+    X, y = xy_regression
+    ours = LinearRegression(
+        solver=solver, penalty="none", max_iter=500, tol=1e-8
+    ).fit(X, y)
+    ref = sklm.LinearRegression().fit(X, y)
+    np.testing.assert_allclose(ours.coef_, ref.coef_, atol=0.05, rtol=1e-3)
+    np.testing.assert_allclose(ours.intercept_, ref.intercept_, atol=0.05)
+    assert ours.score(X, y) == pytest.approx(ref.score(X, y), abs=1e-3)
+
+
+def test_poisson_parity():
+    rng = np.random.RandomState(0)
+    X = rng.randn(400, 5)
+    beta = np.array([0.3, -0.2, 0.1, 0.0, 0.4])
+    y = rng.poisson(np.exp(X @ beta + 0.5)).astype(np.float64)
+    alpha = 1e-4
+    ours = PoissonRegression(
+        solver="lbfgs", C=1.0 / (alpha * len(y)), max_iter=500, tol=1e-8
+    ).fit(X, y)
+    ref = sklm.PoissonRegressor(alpha=alpha, max_iter=2000, tol=1e-10).fit(X, y)
+    np.testing.assert_allclose(ours.coef_, ref.coef_, atol=0.01)
+    np.testing.assert_allclose(ours.intercept_, ref.intercept_, atol=0.01)
+
+
+def test_clone_and_get_params():
+    from sklearn.base import clone
+
+    clf = LogisticRegression(C=2.0, solver="lbfgs")
+    p = clf.get_params()
+    assert p["C"] == 2.0
+    c2 = clone(clf)
+    assert c2.get_params()["C"] == 2.0
+
+
+def test_warm_start(xy_classification):
+    X, y = xy_classification
+    clf = LogisticRegression(solver="lbfgs", max_iter=300, warm_start=True)
+    clf.fit(X, y)
+    c1 = clf.coef_.copy()
+    clf.fit(X, y)  # warm restart from optimum: should stay there
+    np.testing.assert_allclose(clf.coef_, c1, atol=1e-3)

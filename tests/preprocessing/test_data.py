@@ -1,0 +1,91 @@
+"""Preprocessing parity vs sklearn (SURVEY.md §4 oracle pattern)."""
+
+import numpy as np
+import pytest
+import sklearn.preprocessing as skpre
+
+from dask_ml_tpu import preprocessing as pre
+
+RNG = np.random.RandomState(42)
+X = RNG.lognormal(size=(101, 4)).astype(np.float64)  # odd n → padding
+
+
+def test_standard_scaler():
+    ours = pre.StandardScaler().fit(X)
+    ref = skpre.StandardScaler().fit(X)
+    np.testing.assert_allclose(ours.mean_, ref.mean_, rtol=1e-4)
+    np.testing.assert_allclose(ours.var_, ref.var_, rtol=1e-3)
+    np.testing.assert_allclose(
+        ours.transform(X).to_numpy(), ref.transform(X), atol=1e-4
+    )
+    back = ours.inverse_transform(ours.transform(X)).to_numpy()
+    np.testing.assert_allclose(back, X, rtol=1e-3, atol=1e-4)
+
+
+def test_standard_scaler_no_mean():
+    ours = pre.StandardScaler(with_mean=False).fit(X)
+    ref = skpre.StandardScaler(with_mean=False).fit(X)
+    np.testing.assert_allclose(
+        ours.transform(X).to_numpy(), ref.transform(X), rtol=1e-4
+    )
+
+
+def test_minmax_scaler():
+    ours = pre.MinMaxScaler().fit(X)
+    ref = skpre.MinMaxScaler().fit(X)
+    np.testing.assert_allclose(ours.data_min_, ref.data_min_, rtol=1e-5)
+    np.testing.assert_allclose(ours.data_max_, ref.data_max_, rtol=1e-5)
+    np.testing.assert_allclose(
+        ours.transform(X).to_numpy(), ref.transform(X), atol=1e-5
+    )
+    back = ours.inverse_transform(ours.transform(X)).to_numpy()
+    np.testing.assert_allclose(back, X, rtol=1e-3, atol=1e-4)
+
+
+def test_robust_scaler():
+    ours = pre.RobustScaler().fit(X)
+    ref = skpre.RobustScaler().fit(X)
+    np.testing.assert_allclose(ours.center_, ref.center_, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(ours.scale_, ref.scale_, rtol=1e-3)
+    np.testing.assert_allclose(
+        ours.transform(X).to_numpy(), ref.transform(X), atol=1e-3
+    )
+
+
+@pytest.mark.parametrize("dist", ["uniform", "normal"])
+def test_quantile_transformer(dist):
+    ours = pre.QuantileTransformer(n_quantiles=50, output_distribution=dist)
+    ref = skpre.QuantileTransformer(n_quantiles=50, output_distribution=dist)
+    t_ours = ours.fit_transform(X).to_numpy()
+    t_ref = ref.fit_transform(X)
+    assert abs(t_ours - t_ref).mean() < 0.02
+
+
+def test_polynomial_features():
+    ours = pre.PolynomialFeatures(degree=2).fit(X)
+    ref = skpre.PolynomialFeatures(degree=2).fit(X)
+    assert ours.n_output_features_ == ref.n_output_features_
+    np.testing.assert_allclose(
+        ours.transform(X).to_numpy(), ref.transform(X), rtol=1e-3, atol=1e-4
+    )
+    assert list(ours.get_feature_names_out()) == list(ref.get_feature_names_out())
+
+
+def test_polynomial_interaction_only():
+    ours = pre.PolynomialFeatures(degree=2, interaction_only=True,
+                                  include_bias=False).fit(X)
+    ref = skpre.PolynomialFeatures(degree=2, interaction_only=True,
+                                   include_bias=False).fit(X)
+    np.testing.assert_allclose(
+        ours.transform(X).to_numpy(), ref.transform(X), rtol=1e-3, atol=1e-4
+    )
+
+
+def test_pipeline_scaler_logreg(xy_classification):
+    """The B3 end-to-end slice: scale + fit + score on sharded data."""
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    Xc, y = xy_classification
+    Xt = pre.StandardScaler().fit_transform(Xc)
+    clf = LogisticRegression(solver="lbfgs", max_iter=300).fit(Xt, y)
+    assert clf.score(Xt, y) > 0.85

@@ -1,0 +1,42 @@
+"""Dataset generator tests (ref: tests/test_datasets.py in the reference)."""
+
+import numpy as np
+
+from dask_ml_tpu import datasets
+from dask_ml_tpu.parallel import ShardedArray
+
+
+def test_make_classification_shapes():
+    X, y = datasets.make_classification(n_samples=103, n_features=7,
+                                        random_state=0)
+    assert isinstance(X, ShardedArray) and isinstance(y, ShardedArray)
+    assert X.shape == (103, 7)
+    assert y.shape == (103,)
+    assert set(np.unique(y.to_numpy())) == {0.0, 1.0}
+
+
+def test_make_classification_deterministic():
+    X1, _ = datasets.make_classification(n_samples=50, n_features=5,
+                                         random_state=7)
+    X2, _ = datasets.make_classification(n_samples=50, n_features=5,
+                                         random_state=7)
+    np.testing.assert_array_equal(X1.to_numpy(), X2.to_numpy())
+
+
+def test_make_regression():
+    X, y = datasets.make_regression(n_samples=64, n_features=6, random_state=1)
+    assert X.shape == (64, 6)
+    assert np.isfinite(y.to_numpy()).all()
+
+
+def test_make_blobs_centers_consistent():
+    X, y = datasets.make_blobs(n_samples=200, n_features=3, centers=4,
+                               random_state=2)
+    assert X.shape == (200, 3)
+    assert len(np.unique(y.to_numpy())) == 4
+
+
+def test_make_counts():
+    X, y = datasets.make_counts(n_samples=80, n_features=5, random_state=3)
+    yv = y.to_numpy()
+    assert (yv >= 0).all() and (yv == yv.astype(int)).all()

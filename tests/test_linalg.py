@@ -1,0 +1,71 @@
+"""Distributed linalg tests: TSQR, tall SVD, randomized SVD (SURVEY.md §7 B1).
+
+Oracle = numpy.linalg on the gathered array, the same "small-data parity"
+contract the reference uses with sklearn (SURVEY.md §4).
+"""
+
+import jax
+import numpy as np
+
+from dask_ml_tpu.ops import linalg
+from dask_ml_tpu.parallel import ShardedArray, default_mesh
+
+
+def _sharded(n, d, seed=0, dtype=np.float32):
+    x = np.random.RandomState(seed).randn(n, d).astype(dtype)
+    return x, ShardedArray.from_array(x, default_mesh())
+
+
+def test_tsqr_reconstruction_and_orthonormality():
+    x, sx = _sharded(96, 6)
+    q, r = linalg.tsqr(sx.data, sx.mesh)
+    q, r = np.asarray(q), np.asarray(r)
+    np.testing.assert_allclose(q @ r, x, atol=1e-4)
+    np.testing.assert_allclose(q.T @ q, np.eye(6), atol=1e-4)
+    assert np.allclose(r, np.triu(r))
+
+
+def test_tsqr_with_zero_padding_rows():
+    # padded rows are zero; Q rows stay zero and R is unaffected
+    mesh = default_mesh()
+    x = np.random.RandomState(3).randn(33, 4).astype(np.float32)
+    sx = ShardedArray.from_array(x, mesh)
+    q, r = linalg.tsqr(sx.data, mesh)
+    q = np.asarray(q)
+    np.testing.assert_allclose(q[:33] @ np.asarray(r), x, atol=1e-4)
+    np.testing.assert_allclose(q[33:], 0.0, atol=1e-5)
+
+
+def test_svd_tall_matches_numpy():
+    x, sx = _sharded(128, 5)
+    u, s, vt = linalg.svd_tall(sx.data, sx.mesh)
+    s_np = np.linalg.svd(x, compute_uv=False)
+    np.testing.assert_allclose(np.asarray(s), s_np, rtol=1e-4)
+    rec = np.asarray(u) @ np.diag(np.asarray(s)) @ np.asarray(vt)
+    np.testing.assert_allclose(rec, x, atol=1e-3)
+
+
+def test_randomized_svd_low_rank():
+    rng = np.random.RandomState(0)
+    base = rng.randn(200, 4) @ rng.randn(4, 16)
+    x = base.astype(np.float32)
+    sx = ShardedArray.from_array(x, default_mesh())
+    u, s, vt = linalg.randomized_svd(
+        sx.data, 4, jax.random.PRNGKey(0), sx.mesh, n_iter=4
+    )
+    s_np = np.linalg.svd(x, compute_uv=False)[:4]
+    np.testing.assert_allclose(np.asarray(s), s_np, rtol=1e-3)
+    rec = np.asarray(u) @ np.diag(np.asarray(s)) @ np.asarray(vt)
+    np.testing.assert_allclose(rec, x, atol=2e-2)
+
+
+def test_svd_flip_deterministic():
+    x, sx = _sharded(64, 4, seed=5)
+    u, s, vt = linalg.svd_tall(sx.data, sx.mesh)
+    u2, vt2 = linalg.svd_flip(u, vt)
+    u2, vt2 = np.asarray(u2), np.asarray(vt2)
+    # flipped decomposition still reconstructs
+    np.testing.assert_allclose(u2 @ np.diag(np.asarray(s)) @ vt2, x, atol=1e-3)
+    # largest-|.| entry of each row of Vt is positive
+    mx = np.argmax(np.abs(vt2), axis=1)
+    assert (vt2[np.arange(4), mx] > 0).all()

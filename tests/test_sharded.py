@@ -1,0 +1,72 @@
+"""Substrate tests: padding, masks, masked reductions (SURVEY.md §7 B0)."""
+
+import jax
+import numpy as np
+import pytest
+
+from dask_ml_tpu.ops import reductions
+from dask_ml_tpu.parallel import ShardedArray, default_mesh
+
+
+@pytest.mark.parametrize("n", [7, 8, 16, 33, 100])
+def test_roundtrip_and_padding(n):
+    mesh = default_mesh()
+    x = np.random.RandomState(0).randn(n, 3)
+    sx = ShardedArray.from_array(x, mesh)
+    assert sx.shape == (n, 3)
+    assert sx.padded_shape[0] % mesh.devices.size == 0
+    np.testing.assert_allclose(sx.to_numpy(), x.astype(np.float64), rtol=1e-6)
+
+
+def test_row_mask():
+    mesh = default_mesh()
+    sx = ShardedArray.from_array(np.ones((10, 2)), mesh)
+    m = np.asarray(sx.row_mask())
+    assert m.sum() == 10
+    assert m[:10].all()
+
+
+def test_masked_reductions_match_numpy():
+    mesh = default_mesh()
+    rng = np.random.RandomState(1)
+    x = rng.randn(37, 5).astype(np.float32)
+    sx = ShardedArray.from_array(x, mesh)
+    mask = sx.row_mask()
+    np.testing.assert_allclose(
+        np.asarray(reductions.masked_sum(sx.data, mask)), x.sum(0), rtol=1e-5
+    )
+    mean, var = reductions.masked_mean_var(sx.data, mask, sx.n_rows)
+    np.testing.assert_allclose(np.asarray(mean), x.mean(0), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(var), x.var(0), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(reductions.masked_min(sx.data, mask)), x.min(0), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(reductions.masked_max(sx.data, mask)), x.max(0), rtol=1e-6
+    )
+
+
+def test_sharding_is_row_wise():
+    mesh = default_mesh()
+    sx = ShardedArray.from_array(np.zeros((64, 4)), mesh)
+    n_dev = mesh.devices.size
+    assert len(sx.data.sharding.device_set) == n_dev
+    shard_shapes = {s.data.shape for s in sx.data.addressable_shards}
+    assert shard_shapes == {(64 // n_dev, 4)}
+
+
+def test_metrics_sample_weight_with_padding():
+    # regression test: sample_weight must be padded like the data
+    from dask_ml_tpu import metrics
+
+    mesh = default_mesh()
+    y = np.r_[np.zeros(50), np.ones(51)]  # 101 rows → padded on 8 devices
+    p = y.copy(); p[:10] = 1.0
+    sy = ShardedArray.from_array(y, mesh)
+    w = np.linspace(0.5, 1.5, 101)
+    got = metrics.accuracy_score(sy, p, sample_weight=w)
+    from sklearn.metrics import accuracy_score as sk_acc
+    assert got == pytest.approx(sk_acc(y, p, sample_weight=w), abs=1e-6)
+    got_r2 = metrics.r2_score(sy, p, sample_weight=w)
+    from sklearn.metrics import r2_score as sk_r2
+    assert got_r2 == pytest.approx(sk_r2(y, p, sample_weight=w), abs=1e-5)
