@@ -1,0 +1,5 @@
+"""Ref: dask_ml/cluster/__init__.py."""
+from ..models.kmeans import KMeans
+from ..models.spectral import SpectralClustering
+
+__all__ = ["KMeans", "SpectralClustering"]

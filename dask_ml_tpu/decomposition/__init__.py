@@ -1,0 +1,4 @@
+"""Ref: dask_ml/decomposition/__init__.py."""
+from ..models.pca import PCA, IncrementalPCA, TruncatedSVD
+
+__all__ = ["PCA", "TruncatedSVD", "IncrementalPCA"]

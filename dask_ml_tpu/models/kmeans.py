@@ -1,0 +1,261 @@
+"""KMeans with k-means‖ (scalable k-means++) initialization.
+
+Reference: ``dask_ml/cluster/k_means.py`` (SURVEY.md §2a KMeans row, §3.1
+call stack): Lloyd's iterations over row-chunked arrays with a global
+barrier per iteration, k-means‖ init (Bahmani 2012) with
+``oversampling_factor``, plus ``init='k-means++'`` (on a sample) and
+``'random'``.
+
+TPU design (SURVEY.md §3.1 "boundary pattern" + §7 hard parts):
+
+- The ENTIRE Lloyd loop is one jitted program (``lax.while_loop``):
+  distance+argmin fuses into the MXU matmul, centroid sums/counts are
+  ``segment_sum`` (memory-light — no (n, k) one-hot materialized), centers
+  stay replicated, the tol test runs on device. The reference pays a
+  cluster round-trip per iteration; here the host is only touched once.
+- k-means‖ sampling draws a FIXED ``l = oversampling_factor * k`` points
+  per round via Gumbel top-l with weights ∝ d² (weighted sampling without
+  replacement), writing into a static-shape candidate buffer — XLA-friendly
+  static shapes instead of the reference's variable-size Bernoulli draws
+  (expected size l), same distribution in spirit.
+- The final "cluster the candidates" step runs sklearn's k-means++ on the
+  ≤(1 + l·rounds) weighted candidates on host, exactly the reference's
+  pattern of running a local solver on the tiny candidate set.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import BaseEstimator, ClusterMixin, TransformerMixin, to_host
+from ..ops.pairwise import euclidean_distances, euclidean_distances_sq
+from ..ops.reductions import masked_mean_var
+from ..parallel.sharded import ShardedArray
+from ..utils.validation import check_array, check_is_fitted
+
+
+# -- jitted kernels ---------------------------------------------------------
+
+@jax.jit
+def _lloyd_run(X, mask, centers0, max_iter, tol2):
+    """Full Lloyd loop on device. Returns (centers, n_iter, final_shift2)."""
+    k = centers0.shape[0]
+
+    def assign(centers):
+        d2 = euclidean_distances_sq(X, centers)
+        return jnp.argmin(d2, axis=1), jnp.min(d2, axis=1)
+
+    def cond(carry):
+        centers, it, shift2 = carry
+        return (it < max_iter) & (shift2 > tol2)
+
+    def body(carry):
+        centers, it, _ = carry
+        labels, _ = assign(centers)
+        sums = jax.ops.segment_sum(X * mask[:, None], labels, num_segments=k)
+        counts = jax.ops.segment_sum(mask, labels, num_segments=k)
+        new = jnp.where(counts[:, None] > 0, sums / counts[:, None], centers)
+        shift2 = jnp.sum((new - centers) ** 2)
+        return new, it + 1, shift2
+
+    inf = jnp.asarray(jnp.inf, X.dtype)
+    centers, it, shift2 = jax.lax.while_loop(cond, body, (centers0, 0, inf))
+    return centers, it, shift2
+
+
+@jax.jit
+def _labels_inertia(X, mask, centers):
+    d2 = euclidean_distances_sq(X, centers)
+    labels = jnp.argmin(d2, axis=1)
+    inertia = jnp.sum(jnp.min(d2, axis=1) * mask)
+    return labels, inertia
+
+
+@jax.jit
+def _cost_to_candidates(X, mask, cands, cand_valid):
+    d2 = euclidean_distances_sq(X, cands)
+    d2 = jnp.where(cand_valid[None, :] > 0, d2, jnp.inf)
+    dmin = jnp.min(d2, axis=1) * mask
+    return dmin, jnp.sum(dmin)
+
+
+@partial(jax.jit, static_argnames=("l",))
+def _gumbel_top_l(weights, key, l):
+    """Indices of l draws without replacement with prob ∝ weights."""
+    g = jax.random.gumbel(key, weights.shape, dtype=jnp.float32)
+    keys = jnp.where(weights > 0, jnp.log(weights) + g, -jnp.inf)
+    _, idx = jax.lax.top_k(keys, l)
+    return idx
+
+
+@jax.jit
+def _candidate_weights(X, mask, cands, cand_valid):
+    d2 = euclidean_distances_sq(X, cands)
+    d2 = jnp.where(cand_valid[None, :] > 0, d2, jnp.inf)
+    labels = jnp.argmin(d2, axis=1)
+    return jax.ops.segment_sum(mask, labels, num_segments=cands.shape[0])
+
+
+def init_scalable(X: ShardedArray, n_clusters, random_state, max_iter=None,
+                  oversampling_factor=2):
+    """k-means‖ candidate harvesting; ref
+    dask_ml/cluster/k_means.py::init_scalable."""
+    from sklearn.cluster import KMeans as SkKMeans
+
+    data, mask = X.data, X.row_mask(X.dtype)
+    n, d = X.shape
+    l = max(int(oversampling_factor * n_clusters), 1)
+    key = jax.random.PRNGKey(0 if random_state is None else int(random_state))
+
+    # step 1: one uniform-random valid row
+    key, k0 = jax.random.split(key)
+    first = data[_gumbel_top_l(mask, k0, 1)[0]]
+
+    # candidate buffer with static shape (SURVEY.md §7 hard parts)
+    if max_iter is None:
+        # rounds ≈ log(phi); phi ≤ n * max_dist² — 5 is the practical
+        # regime for sane data, matching the reference's few-round behavior
+        rounds = 5
+    else:
+        rounds = max(int(max_iter), 1)
+    c_max = 1 + rounds * l
+    cands = jnp.zeros((c_max, d), data.dtype).at[0].set(first)
+    cand_valid = jnp.zeros((c_max,), jnp.float32).at[0].set(1.0)
+
+    for r in range(rounds):
+        dmin, phi = _cost_to_candidates(data, mask, cands, cand_valid)
+        if float(phi) <= 0.0:
+            break
+        key, kr = jax.random.split(key)
+        idx = _gumbel_top_l(dmin, kr, l)
+        rows = jnp.take(data, idx, axis=0)
+        start = 1 + r * l
+        cands = jax.lax.dynamic_update_slice(cands, rows, (start, 0))
+        cand_valid = jax.lax.dynamic_update_slice(
+            cand_valid, jnp.ones((l,), jnp.float32), (start,)
+        )
+
+    weights = _candidate_weights(data, mask, cands, cand_valid)
+    cands_h = to_host(cands)
+    valid_h = to_host(cand_valid) > 0
+    w_h = to_host(weights)[valid_h]
+    pts = cands_h[valid_h]
+    w_h = np.where(w_h > 0, w_h, 1e-6)
+    local = SkKMeans(
+        n_clusters=n_clusters, init="k-means++", n_init=1,
+        random_state=None if random_state is None else int(random_state),
+    ).fit(pts, sample_weight=w_h)
+    return jnp.asarray(local.cluster_centers_, data.dtype)
+
+
+def init_pp(X: ShardedArray, n_clusters, random_state):
+    """k-means++ on a device-drawn uniform sample (ref ::init_pp)."""
+    from sklearn.cluster import kmeans_plusplus
+
+    data, mask = X.data, X.row_mask(X.dtype)
+    m = min(X.n_rows, max(10 * n_clusters, 500))
+    key = jax.random.PRNGKey(1 if random_state is None else int(random_state))
+    idx = _gumbel_top_l(mask, key, m)
+    sample = to_host(jnp.take(data, idx, axis=0))
+    centers, _ = kmeans_plusplus(
+        sample, n_clusters,
+        random_state=None if random_state is None else int(random_state),
+    )
+    return jnp.asarray(centers, data.dtype)
+
+
+def init_random(X: ShardedArray, n_clusters, random_state):
+    data, mask = X.data, X.row_mask(X.dtype)
+    key = jax.random.PRNGKey(2 if random_state is None else int(random_state))
+    idx = _gumbel_top_l(mask, key, n_clusters)
+    return jnp.take(data, idx, axis=0)
+
+
+class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
+    """Ref: dask_ml/cluster/k_means.py::KMeans."""
+
+    def __init__(self, n_clusters=8, init="k-means||", oversampling_factor=2,
+                 max_iter=300, tol=1e-4, precompute_distances="auto",
+                 random_state=None, copy_x=True, n_jobs=1, algorithm="full",
+                 init_max_iter=None):
+        self.n_clusters = n_clusters
+        self.init = init
+        self.oversampling_factor = oversampling_factor
+        self.max_iter = max_iter
+        self.tol = tol
+        self.precompute_distances = precompute_distances
+        self.random_state = random_state
+        self.copy_x = copy_x
+        self.n_jobs = n_jobs
+        self.algorithm = algorithm
+        self.init_max_iter = init_max_iter
+
+    def _init_centers(self, X: ShardedArray):
+        if isinstance(self.init, np.ndarray) or isinstance(
+            self.init, jnp.ndarray
+        ):
+            centers = jnp.asarray(self.init, X.dtype)
+            if centers.shape != (self.n_clusters, X.shape[1]):
+                raise ValueError(
+                    f"init array has shape {centers.shape}, expected "
+                    f"{(self.n_clusters, X.shape[1])}"
+                )
+            return centers
+        if self.init == "k-means||":
+            return init_scalable(X, self.n_clusters, self.random_state,
+                                 self.init_max_iter, self.oversampling_factor)
+        if self.init == "k-means++":
+            return init_pp(X, self.n_clusters, self.random_state)
+        if self.init == "random":
+            return init_random(X, self.n_clusters, self.random_state)
+        raise ValueError(f"Unknown init {self.init!r}")
+
+    def fit(self, X, y=None):
+        X = check_array(X, dtype=np.float32)
+        if self.n_clusters > X.n_rows:
+            raise ValueError(
+                f"n_clusters={self.n_clusters} > n_samples={X.n_rows}"
+            )
+        mask = X.row_mask(X.dtype)
+        centers0 = self._init_centers(X)
+        # sklearn-style tol scaling: tol * mean per-feature variance
+        _, var = masked_mean_var(X.data, mask, X.n_rows)
+        tol2 = jnp.asarray(self.tol, X.dtype) * jnp.mean(var)
+        centers, n_iter, _ = _lloyd_run(
+            X.data, mask, centers0, jnp.asarray(self.max_iter), tol2
+        )
+        labels, inertia = _labels_inertia(X.data, mask, centers)
+        self.cluster_centers_ = to_host(centers)
+        self.labels_ = ShardedArray(labels, X.n_rows, X.mesh)
+        self.inertia_ = float(inertia)
+        self.n_iter_ = int(n_iter)
+        self.n_features_in_ = X.shape[1]
+        return self
+
+    def predict(self, X):
+        check_is_fitted(self, "cluster_centers_")
+        X = check_array(X, dtype=np.float32)
+        centers = jnp.asarray(self.cluster_centers_, X.dtype)
+        labels, _ = _labels_inertia(X.data, X.row_mask(X.dtype), centers)
+        return ShardedArray(labels, X.n_rows, X.mesh)
+
+    def fit_predict(self, X, y=None):
+        return self.fit(X).labels_
+
+    def transform(self, X):
+        check_is_fitted(self, "cluster_centers_")
+        X = check_array(X, dtype=np.float32)
+        centers = jnp.asarray(self.cluster_centers_, X.dtype)
+        d = euclidean_distances(X.data, centers)
+        return ShardedArray(d, X.n_rows, X.mesh)
+
+    def score(self, X, y=None):
+        check_is_fitted(self, "cluster_centers_")
+        X = check_array(X, dtype=np.float32)
+        centers = jnp.asarray(self.cluster_centers_, X.dtype)
+        _, inertia = _labels_inertia(X.data, X.row_mask(X.dtype), centers)
+        return -float(inertia)

@@ -1,0 +1,295 @@
+"""PCA / TruncatedSVD / IncrementalPCA via distributed SVD.
+
+Reference: ``dask_ml/decomposition/{pca,truncated_svd,incremental_pca}.py``
+(SURVEY.md §2a rows PCA/TruncatedSVD/IncrementalPCA, §3.3 call stack).
+The reference lowers to ``da.linalg.svd`` (TSQR task graph) or
+``svd_compressed`` (Halko); here those are the single-program TSQR /
+randomized SVD kernels in ``ops/linalg.py`` — per-shard QR + ICI
+all-gather, psum-reduced matmul passes, small replicated SVD.
+
+Centering: padded rows must stay exactly zero after ``X - mean_``, so the
+centered matrix is re-masked before the SVD (zero rows leave R/range
+unchanged).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import BaseEstimator, TransformerMixin, to_host
+from ..ops import linalg
+from ..ops.reductions import masked_mean_var
+from ..parallel.sharded import ShardedArray
+from ..utils.validation import check_array, check_is_fitted
+
+
+def _resolve_n_components(n_components, n, d):
+    if n_components is None:
+        return min(n, d)
+    if not 0 < n_components <= min(n, d):
+        raise ValueError(
+            f"n_components={n_components} must be in (0, {min(n, d)}]"
+        )
+    return int(n_components)
+
+
+class PCA(TransformerMixin, BaseEstimator):
+    """Ref: dask_ml/decomposition/pca.py::PCA."""
+
+    def __init__(self, n_components=None, copy=True, whiten=False,
+                 svd_solver="auto", tol=0.0, iterated_power=0,
+                 random_state=None):
+        self.n_components = n_components
+        self.copy = copy
+        self.whiten = whiten
+        self.svd_solver = svd_solver
+        self.tol = tol
+        self.iterated_power = iterated_power
+        self.random_state = random_state
+
+    def _solver(self, k, n, d):
+        if self.svd_solver == "auto":
+            # randomized when asking for a small fraction of a wide matrix
+            # (sklearn-style heuristic); exact TSQR otherwise
+            return "randomized" if k < 0.8 * min(n, d) and min(n, d) > 200 \
+                else "full"
+        if self.svd_solver in ("full", "tsqr"):
+            return "full"
+        if self.svd_solver == "randomized":
+            return "randomized"
+        raise ValueError(f"Unknown svd_solver {self.svd_solver!r}")
+
+    def fit(self, X, y=None):
+        self._fit(X)
+        return self
+
+    def _fit(self, X):
+        X = check_array(X, dtype=np.float32)
+        n, d = X.shape
+        if n < d:
+            raise ValueError(
+                "PCA requires tall data (n_samples >= n_features); got "
+                f"{n} x {d}"
+            )
+        k = _resolve_n_components(self.n_components, n, d)
+        mask = X.row_mask(X.dtype)
+        mean, var = masked_mean_var(X.data, mask, n, ddof=1)
+        xc = (X.data - mean) * mask[:, None]
+        solver = self._solver(k, n, d)
+        if solver == "full":
+            u, s, vt = linalg.svd_tall(xc, X.mesh)
+        else:
+            key = jax.random.PRNGKey(
+                0 if self.random_state is None else int(self.random_state)
+            )
+            u, s, vt = linalg.randomized_svd(
+                xc, k, key, X.mesh,
+                n_iter=max(int(self.iterated_power), 2),
+            )
+        u, vt = linalg.svd_flip(u, vt)
+
+        total_var = float(jnp.sum(var))
+        ev = to_host(s).astype(np.float64) ** 2 / (n - 1)
+        self.n_components_ = k
+        self.components_ = to_host(vt)[:k].astype(np.float64)
+        self.explained_variance_ = ev[:k]
+        self.explained_variance_ratio_ = ev[:k] / total_var
+        self.singular_values_ = to_host(s)[:k].astype(np.float64)
+        self.mean_ = to_host(mean).astype(np.float64)
+        if k < min(n, d):
+            self.noise_variance_ = (total_var - ev[:k].sum()) / (min(n, d) - k)
+        else:
+            self.noise_variance_ = 0.0
+        self.n_features_in_ = d
+        self.n_samples_ = n
+        return X, u, s, vt, mask
+
+    def fit_transform(self, X, y=None):
+        X, u, s, vt, mask = self._fit(X)
+        k = self.n_components_
+        scores = u[:, :k] * s[None, :k]
+        if self.whiten:
+            scores = scores * jnp.sqrt(jnp.asarray(self.n_samples_ - 1,
+                                                   scores.dtype)) / s[None, :k]
+        return ShardedArray(scores * mask[:, None], X.n_rows, X.mesh)
+
+    def transform(self, X):
+        check_is_fitted(self, "components_")
+        X = check_array(X, dtype=np.float32)
+        mask = X.row_mask(X.dtype)
+        comp = jnp.asarray(self.components_, X.dtype)
+        xc = (X.data - jnp.asarray(self.mean_, X.dtype)) * mask[:, None]
+        scores = xc @ comp.T
+        if self.whiten:
+            scores = scores / jnp.sqrt(
+                jnp.asarray(self.explained_variance_, X.dtype)
+            )
+        return ShardedArray(scores, X.n_rows, X.mesh)
+
+    def inverse_transform(self, X):
+        check_is_fitted(self, "components_")
+        X = check_array(X, dtype=np.float32)
+        comp = jnp.asarray(self.components_, X.dtype)
+        scores = X.data
+        if self.whiten:
+            scores = scores * jnp.sqrt(
+                jnp.asarray(self.explained_variance_, X.dtype)
+            )
+        out = scores @ comp + jnp.asarray(self.mean_, X.dtype)
+        out = out * X.row_mask(out.dtype)[:, None]
+        return ShardedArray(out, X.n_rows, X.mesh)
+
+
+class TruncatedSVD(TransformerMixin, BaseEstimator):
+    """Ref: dask_ml/decomposition/truncated_svd.py::TruncatedSVD — same SVD
+    backends as PCA, no centering (sparse-friendly semantics)."""
+
+    def __init__(self, n_components=2, algorithm="tsqr", n_iter=5,
+                 random_state=None, tol=0.0, compute=True):
+        self.n_components = n_components
+        self.algorithm = algorithm
+        self.n_iter = n_iter
+        self.random_state = random_state
+        self.tol = tol
+        self.compute = compute
+
+    def fit(self, X, y=None):
+        self.fit_transform(X)
+        return self
+
+    def fit_transform(self, X, y=None):
+        X = check_array(X, dtype=np.float32)
+        n, d = X.shape
+        k = self.n_components
+        if not 0 < k < d:
+            raise ValueError(f"n_components={k} must be in (0, {d})")
+        mask = X.row_mask(X.dtype)
+        data = X.data * mask[:, None]
+        if self.algorithm == "tsqr":
+            if n < d:
+                raise ValueError("tsqr algorithm requires n_samples >= n_features")
+            u, s, vt = linalg.svd_tall(data, X.mesh)
+        elif self.algorithm == "randomized":
+            key = jax.random.PRNGKey(
+                0 if self.random_state is None else int(self.random_state)
+            )
+            u, s, vt = linalg.randomized_svd(
+                data, k, key, X.mesh, n_iter=self.n_iter
+            )
+        else:
+            raise ValueError(f"Unknown algorithm {self.algorithm!r}")
+        u, vt = linalg.svd_flip(u, vt)
+        u, s, vt = u[:, :k], s[:k], vt[:k]
+        scores = u * s[None, :]
+
+        # explained variance of the scores (sklearn semantics)
+        sc_mean = jnp.sum(scores * mask[:, None], axis=0) / n
+        ev = jnp.sum(((scores - sc_mean) ** 2) * mask[:, None], axis=0) / n
+        _, full_var = masked_mean_var(X.data, mask, n, ddof=0)
+        self.components_ = to_host(vt).astype(np.float64)
+        self.explained_variance_ = to_host(ev).astype(np.float64)
+        self.explained_variance_ratio_ = self.explained_variance_ / float(
+            jnp.sum(full_var)
+        )
+        self.singular_values_ = to_host(s).astype(np.float64)
+        self.n_features_in_ = d
+        return ShardedArray(scores, X.n_rows, X.mesh)
+
+    def transform(self, X):
+        check_is_fitted(self, "components_")
+        X = check_array(X, dtype=np.float32)
+        comp = jnp.asarray(self.components_, X.dtype)
+        return ShardedArray(X.data @ comp.T, X.n_rows, X.mesh)
+
+    def inverse_transform(self, X):
+        check_is_fitted(self, "components_")
+        X = check_array(X, dtype=np.float32)
+        comp = jnp.asarray(self.components_, X.dtype)
+        return ShardedArray(X.data @ comp, X.n_rows, X.mesh)
+
+
+@jax.jit
+def _ipca_update(components, singular, mean, n_seen, xb):
+    """One incremental-PCA block update (Ross et al. 2008, as used by
+    sklearn's IncrementalPCA): SVD of [S·Vt ; Xb - mean_b ; mean-correction]."""
+    m = xb.shape[0]
+    col_mean = jnp.mean(xb, axis=0)
+    n_total = n_seen + m
+    new_mean = (n_seen * mean + m * col_mean) / n_total
+    corr = jnp.sqrt(n_seen * m / n_total) * (mean - col_mean)
+    stack = jnp.concatenate(
+        [singular[:, None] * components, xb - col_mean, corr[None, :]], axis=0
+    )
+    u, s, vt = jnp.linalg.svd(stack, full_matrices=False)
+    return vt, s, new_mean, n_total
+
+
+class IncrementalPCA(PCA):
+    """Ref: dask_ml/decomposition/incremental_pca.py::IncrementalPCA —
+    sequential partial_fit over blocks. Here each block update is one jitted
+    program; ``fit`` streams the shards of a ShardedArray in order."""
+
+    def __init__(self, n_components=None, whiten=False, copy=True,
+                 batch_size=None, svd_solver="auto", iterated_power=0,
+                 random_state=None):
+        self.n_components = n_components
+        self.whiten = whiten
+        self.copy = copy
+        self.batch_size = batch_size
+        self.svd_solver = svd_solver
+        self.iterated_power = iterated_power
+        self.random_state = random_state
+
+    def _blocks(self, X):
+        if isinstance(X, ShardedArray):
+            host = X.to_numpy()
+        else:
+            host = np.asarray(X)
+        bs = self.batch_size or max(len(host) // 10, 5 * (host.shape[1]))
+        for i in range(0, len(host), bs):
+            b = host[i:i + bs]
+            if len(b):
+                yield b.astype(np.float32)
+
+    def partial_fit(self, X, y=None, check_input=True):
+        xb = np.asarray(X, dtype=np.float32)
+        d = xb.shape[1]
+        k = self.n_components or d
+        if not hasattr(self, "n_samples_seen_") or self.n_samples_seen_ == 0:
+            self._components = jnp.zeros((k, d), jnp.float32)
+            self._singular = jnp.zeros((k,), jnp.float32)
+            self._mean = jnp.zeros((d,), jnp.float32)
+            self.n_samples_seen_ = 0
+        vt, s, mean, n_total = _ipca_update(
+            self._components, self._singular, self._mean,
+            jnp.asarray(self.n_samples_seen_, jnp.float32), jnp.asarray(xb),
+        )
+        self._components, self._singular, self._mean = vt[:k], s[:k], mean
+        self.n_samples_seen_ = int(n_total)
+        self._finalize(d, k)
+        return self
+
+    def _finalize(self, d, k):
+        n = self.n_samples_seen_
+        self.components_ = to_host(self._components).astype(np.float64)
+        self.singular_values_ = to_host(self._singular).astype(np.float64)
+        self.mean_ = to_host(self._mean).astype(np.float64)
+        self.explained_variance_ = self.singular_values_ ** 2 / max(n - 1, 1)
+        self.n_components_ = k
+        self.n_features_in_ = d
+
+    def fit(self, X, y=None):
+        if hasattr(self, "n_samples_seen_"):
+            del self.n_samples_seen_
+        for block in self._blocks(X):
+            self.partial_fit(block)
+        # ratio needs the global variance, computed over the full pass
+        X = check_array(X, dtype=np.float32)
+        _, var = masked_mean_var(X.data, X.row_mask(X.dtype), X.n_rows, ddof=1)
+        self.explained_variance_ratio_ = self.explained_variance_ / float(
+            jnp.sum(var)
+        )
+        self.n_samples_ = X.n_rows
+        return self

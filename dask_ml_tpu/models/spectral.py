@@ -1,0 +1,116 @@
+"""SpectralClustering via Nyström approximation.
+
+Reference: ``dask_ml/cluster/spectral.py`` (SURVEY.md §2a
+SpectralClustering row): exact affinity on an ``n_components``-row sample,
+cross-affinity to the rest, orthogonalize, embed, then KMeans on the
+embedding.
+
+TPU formulation: with inducing set Z (c rows, uniform sample) and
+B = affinity(X, Z) (n × c, row-sharded), the Nyström normalized affinity is
+D^{-1/2} B A⁺ Bᵀ D^{-1/2} = G Gᵀ for G = D^{-1/2} B A^{-1/2} — so the
+spectral embedding is the top-k left singular vectors of the TALL matrix G,
+computed with the distributed TSQR SVD (``ops/linalg.py``). One psum-matvec
+for the approximate degrees, one TSQR — no n×n affinity ever materialized,
+matching the reference's algorithmic complexity with single-program
+execution.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import BaseEstimator, ClusterMixin, to_host
+from ..ops import linalg, pairwise
+from ..parallel.sharded import ShardedArray
+from ..utils.validation import check_array, check_is_fitted
+from .kmeans import KMeans, _gumbel_top_l
+
+
+def _affinity(name, x, z, gamma, degree, coef0):
+    if name == "rbf":
+        return pairwise.rbf_kernel(x, z, gamma=gamma)
+    if name == "polynomial":
+        return pairwise.polynomial_kernel(x, z, degree=degree, gamma=gamma,
+                                          coef0=coef0)
+    if name == "sigmoid":
+        return pairwise.sigmoid_kernel(x, z, gamma=gamma, coef0=coef0)
+    if name == "linear":
+        return pairwise.linear_kernel(x, z)
+    raise ValueError(f"Unknown affinity {name!r}")
+
+
+class SpectralClustering(ClusterMixin, BaseEstimator):
+    """Ref: dask_ml/cluster/spectral.py::SpectralClustering."""
+
+    def __init__(self, n_clusters=8, eigen_solver=None, random_state=None,
+                 n_init=10, gamma=1.0, affinity="rbf", n_neighbors=10,
+                 eigen_tol=0.0, assign_labels="kmeans", degree=3, coef0=1,
+                 kernel_params=None, n_jobs=1, n_components=100,
+                 persist_embedding=False, kmeans_params=None):
+        self.n_clusters = n_clusters
+        self.eigen_solver = eigen_solver
+        self.random_state = random_state
+        self.n_init = n_init
+        self.gamma = gamma
+        self.affinity = affinity
+        self.n_neighbors = n_neighbors
+        self.eigen_tol = eigen_tol
+        self.assign_labels = assign_labels
+        self.degree = degree
+        self.coef0 = coef0
+        self.kernel_params = kernel_params
+        self.n_jobs = n_jobs
+        self.n_components = n_components
+        self.persist_embedding = persist_embedding
+        self.kmeans_params = kmeans_params
+
+    def fit(self, X, y=None):
+        X = check_array(X, dtype=np.float32)
+        n, d = X.shape
+        c = min(self.n_components, n)
+        if self.assign_labels != "kmeans":
+            raise ValueError("only assign_labels='kmeans' is supported")
+        mask = X.row_mask(X.dtype)
+        key = jax.random.PRNGKey(
+            0 if self.random_state is None else int(self.random_state)
+        )
+        idx = _gumbel_top_l(mask, key, c)  # uniform inducing sample
+        Z = jnp.take(X.data, idx, axis=0)  # (c, d) replicated
+
+        B = _affinity(self.affinity, X.data, Z, self.gamma, self.degree,
+                      self.coef0) * mask[:, None]          # (n, c) sharded
+        A = _affinity(self.affinity, Z, Z, self.gamma, self.degree,
+                      self.coef0)                          # (c, c) replicated
+
+        # A^{-1/2} via eigh with jitter (A is a PSD Gram matrix)
+        w, V = jnp.linalg.eigh(A + 1e-6 * jnp.eye(c, dtype=A.dtype))
+        inv_sqrt = V @ jnp.diag(1.0 / jnp.sqrt(jnp.maximum(w, 1e-6))) @ V.T
+        a_pinv = V @ jnp.diag(1.0 / jnp.maximum(w, 1e-6)) @ V.T
+
+        # approximate degrees: d = B A⁺ (Bᵀ 1) — two psum matvecs
+        colsum = B.T @ mask
+        deg = B @ (a_pinv @ colsum)
+        deg = jnp.where(deg > 1e-12, deg, 1.0)
+        G = (B / jnp.sqrt(deg)[:, None]) @ inv_sqrt     # (n, c) sharded
+
+        u, s, _ = linalg.svd_tall(G, X.mesh)
+        emb = u[:, : self.n_clusters]
+        norms = jnp.linalg.norm(emb, axis=1, keepdims=True)
+        emb = emb / jnp.where(norms > 1e-12, norms, 1.0)
+        emb = emb * mask[:, None]
+        embedding = ShardedArray(emb, X.n_rows, X.mesh)
+
+        km_params = dict(self.kmeans_params or {})
+        km_params.setdefault("random_state", self.random_state)
+        km = KMeans(n_clusters=self.n_clusters, **km_params)
+        km.fit(embedding)
+        self.assign_labels_ = km
+        self.labels_ = km.labels_
+        self.eigenvalues_ = to_host(s[: self.n_clusters]).astype(np.float64)
+        self.n_features_in_ = d
+        return self
+
+    def fit_predict(self, X, y=None):
+        return self.fit(X).labels_

@@ -47,13 +47,16 @@ def tsqr(x: jax.Array, mesh: Mesh, axis_name: str = DATA_AXIS):
     d = x.shape[1]
 
     def _tsqr(xs):
-        q1, r1 = jnp.linalg.qr(xs)  # local (m, d), (d, d)
-        rs = jax.lax.all_gather(r1, axis_name)  # (S, d, d) over ICI
+        # reduced QR: local R is (r, d) with r = min(m, d), so shards with
+        # fewer rows than columns still compose correctly
+        q1, r1 = jnp.linalg.qr(xs)  # (m, r), (r, d)
+        r = r1.shape[0]
+        rs = jax.lax.all_gather(r1, axis_name)  # (S, r, d) over ICI
         s = rs.shape[0]
-        q2, r = jnp.linalg.qr(rs.reshape(s * d, d))
+        q2, r_final = jnp.linalg.qr(rs.reshape(s * r, d))
         i = jax.lax.axis_index(axis_name)
-        q2_i = jax.lax.dynamic_slice_in_dim(q2, i * d, d)
-        return q1 @ q2_i, r
+        q2_i = jax.lax.dynamic_slice_in_dim(q2, i * r, r)
+        return q1 @ q2_i, r_final
 
     return shard_map(
         _tsqr,

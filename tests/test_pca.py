@@ -1,0 +1,112 @@
+"""PCA/TruncatedSVD/IncrementalPCA parity vs sklearn (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+import sklearn.decomposition as skdec
+
+from dask_ml_tpu.decomposition import PCA, IncrementalPCA, TruncatedSVD
+
+RNG = np.random.RandomState(0)
+X = (RNG.randn(203, 8) @ RNG.randn(8, 8) + RNG.randn(8)).astype(np.float64)
+
+
+@pytest.mark.parametrize("solver", ["full", "randomized"])
+def test_pca_parity(solver):
+    k = 4
+    ours = PCA(n_components=k, svd_solver=solver, random_state=0,
+               iterated_power=4).fit(X)
+    ref = skdec.PCA(n_components=k, svd_solver="full").fit(X)
+    np.testing.assert_allclose(ours.mean_, ref.mean_, atol=1e-4)
+    np.testing.assert_allclose(
+        ours.singular_values_, ref.singular_values_, rtol=1e-3
+    )
+    np.testing.assert_allclose(
+        ours.explained_variance_, ref.explained_variance_, rtol=1e-3
+    )
+    np.testing.assert_allclose(
+        ours.explained_variance_ratio_, ref.explained_variance_ratio_,
+        rtol=1e-3,
+    )
+    np.testing.assert_allclose(
+        np.abs(ours.components_), np.abs(ref.components_), atol=2e-3
+    )
+    np.testing.assert_allclose(ours.noise_variance_, ref.noise_variance_,
+                               rtol=1e-2)
+
+
+def test_pca_transform_roundtrip():
+    ours = PCA(n_components=8, svd_solver="full").fit(X)
+    t = ours.transform(X)
+    back = ours.inverse_transform(t).to_numpy()
+    np.testing.assert_allclose(back, X, atol=1e-2)
+
+
+def test_pca_fit_transform_matches_transform():
+    p = PCA(n_components=3, svd_solver="full")
+    t1 = p.fit_transform(X).to_numpy()
+    t2 = p.transform(X).to_numpy()
+    np.testing.assert_allclose(t1, t2, atol=1e-3)
+
+
+def test_pca_whiten():
+    ours = PCA(n_components=4, whiten=True, svd_solver="full").fit(X)
+    t = ours.transform(X).to_numpy()
+    np.testing.assert_allclose(t.std(axis=0, ddof=1), 1.0, rtol=5e-2)
+
+
+def test_pca_errors():
+    with pytest.raises(ValueError, match="n_components"):
+        PCA(n_components=100).fit(X)
+    with pytest.raises(ValueError, match="tall"):
+        PCA().fit(X[:4])
+
+
+def test_truncated_svd_parity():
+    ours = TruncatedSVD(n_components=4, algorithm="tsqr").fit(X)
+    ref = skdec.TruncatedSVD(n_components=4, algorithm="arpack").fit(X)
+    np.testing.assert_allclose(
+        ours.singular_values_, ref.singular_values_, rtol=1e-3
+    )
+    np.testing.assert_allclose(
+        ours.explained_variance_, ref.explained_variance_, rtol=1e-2
+    )
+    np.testing.assert_allclose(
+        np.abs(ours.components_), np.abs(ref.components_), atol=2e-3
+    )
+
+
+def test_truncated_svd_randomized():
+    ours = TruncatedSVD(n_components=4, algorithm="randomized",
+                        random_state=0).fit(X)
+    ref = skdec.TruncatedSVD(n_components=4, algorithm="arpack").fit(X)
+    np.testing.assert_allclose(
+        ours.singular_values_, ref.singular_values_, rtol=1e-2
+    )
+
+
+def test_truncated_svd_transform():
+    svd = TruncatedSVD(n_components=3, algorithm="tsqr")
+    t1 = svd.fit_transform(X).to_numpy()
+    t2 = svd.transform(X).to_numpy()
+    np.testing.assert_allclose(t1, t2, atol=1e-3)
+
+
+def test_incremental_pca_close_to_pca():
+    ours = IncrementalPCA(n_components=4, batch_size=50).fit(X)
+    ref = skdec.PCA(n_components=4, svd_solver="full").fit(X)
+    np.testing.assert_allclose(ours.mean_, ref.mean_, atol=1e-3)
+    np.testing.assert_allclose(
+        ours.singular_values_, ref.singular_values_, rtol=5e-2
+    )
+    np.testing.assert_allclose(
+        np.abs(ours.components_ @ ref.components_.T),
+        np.eye(4), atol=0.05,
+    )
+
+
+def test_incremental_pca_partial_fit():
+    ipca = IncrementalPCA(n_components=3)
+    for i in range(0, 200, 50):
+        ipca.partial_fit(X[i:i + 50])
+    assert ipca.n_samples_seen_ == 200
+    assert ipca.components_.shape == (3, 8)

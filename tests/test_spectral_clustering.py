@@ -1,0 +1,51 @@
+"""SpectralClustering tests (ref: tests/test_spectral_clustering.py)."""
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_circles
+from sklearn.metrics import adjusted_rand_score
+
+from dask_ml_tpu.cluster import KMeans, SpectralClustering
+from dask_ml_tpu.datasets import make_blobs
+
+
+def test_spectral_blobs():
+    X, y = make_blobs(n_samples=300, n_features=4, centers=3, random_state=0,
+                      cluster_std=0.5)
+    sc = SpectralClustering(n_clusters=3, n_components=80, gamma=0.5,
+                            random_state=0).fit(X)
+    ari = adjusted_rand_score(y.to_numpy(), sc.labels_.to_numpy())
+    assert ari > 0.9, ari
+
+
+def test_spectral_circles_beats_kmeans():
+    """Non-convex clusters: spectral must separate what kmeans cannot."""
+    Xh, y = make_circles(n_samples=400, factor=0.4, noise=0.04,
+                         random_state=0)
+    sc = SpectralClustering(n_clusters=2, n_components=150, gamma=40.0,
+                            random_state=0).fit(Xh)
+    ari_spectral = adjusted_rand_score(y, sc.labels_.to_numpy())
+    ari_kmeans = adjusted_rand_score(
+        y, KMeans(n_clusters=2, random_state=0).fit(Xh).labels_.to_numpy()
+    )
+    assert ari_spectral > 0.85, ari_spectral
+    assert ari_spectral > ari_kmeans
+
+
+def test_spectral_assign_labels_validation():
+    X, _ = make_blobs(n_samples=50, n_features=3, centers=2, random_state=1)
+    with pytest.raises(ValueError, match="assign_labels"):
+        SpectralClustering(n_clusters=2, assign_labels="discretize").fit(X)
+
+
+def test_spectral_affinity_validation():
+    X, _ = make_blobs(n_samples=50, n_features=3, centers=2, random_state=1)
+    with pytest.raises(ValueError, match="affinity"):
+        SpectralClustering(n_clusters=2, affinity="bogus").fit(X)
+
+
+def test_spectral_linear_affinity_runs():
+    X, y = make_blobs(n_samples=120, n_features=4, centers=2, random_state=2)
+    sc = SpectralClustering(n_clusters=2, affinity="rbf", gamma=0.3,
+                            n_components=60, random_state=0).fit(X)
+    assert len(np.unique(sc.labels_.to_numpy())) == 2
