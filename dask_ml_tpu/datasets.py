@@ -35,36 +35,73 @@ def _assemble(parts_X, parts_y, mesh):
     )
 
 
-def make_classification(n_samples=100, n_features=20, random_state=None,
-                        chunks=None, mesh=None, **kwargs):
+def make_classification(n_samples=100, n_features=20, n_informative=5,
+                        n_classes=2, class_sep=1.0, flip_y=0.01,
+                        random_state=None, chunks=None, mesh=None):
+    """Consistent global problem across shards: class centers (hypercube
+    vertices in the informative subspace) and the feature permutation are
+    drawn ONCE from random_state; shards draw only their rows. (The
+    reference seeds sklearn's whole generator per block, so each block is
+    a *different* problem — a known quirk we deliberately fix.)"""
     mesh = resolve_mesh(mesh)
     rs = np.random.RandomState(random_state)
+    n_informative = min(n_informative, n_features)
+    if n_classes > 2 ** n_informative:
+        raise ValueError(
+            f"n_classes={n_classes} > 2**n_informative={2**n_informative} "
+            "distinct hypercube vertices"
+        )
+    # distinct hypercube vertices per class (sampling with replacement can
+    # hand two classes the same center → zero class signal)
+    chosen = rs.choice(2 ** min(n_informative, 62), size=n_classes,
+                       replace=False)
+    bits = ((chosen[:, None] >> np.arange(min(n_informative, 62))) & 1)
+    if n_informative > 62:  # pad extra dims with fixed signs
+        bits = np.concatenate(
+            [bits, np.ones((n_classes, n_informative - 62), int)], axis=1
+        )
+    centers = class_sep * (2.0 * bits - 1.0)
+    perm = rs.permutation(n_features)
     seeds = rs.randint(0, 2**31 - 1, size=data_shards(mesh))
     Xs, ys = [], []
     for sz, seed in zip(_per_shard(n_samples, mesh), seeds):
         if sz <= 0:
             Xs.append(np.empty((0, n_features))); ys.append(np.empty((0,)))
             continue
-        X, y = skdata.make_classification(
-            n_samples=sz, n_features=n_features, random_state=int(seed), **kwargs
-        )
-        Xs.append(X); ys.append(y)
+        r = np.random.RandomState(int(seed))
+        y = r.randint(0, n_classes, size=sz)
+        X = r.normal(size=(sz, n_features))
+        X[:, :n_informative] += centers[y]
+        X = X[:, perm]
+        flip = r.uniform(size=sz) < flip_y
+        y = np.where(flip, r.randint(0, n_classes, size=sz), y)
+        Xs.append(X); ys.append(y.astype(np.float64))
     return _assemble(Xs, ys, mesh)
 
 
-def make_regression(n_samples=100, n_features=100, random_state=None,
-                    chunks=None, mesh=None, **kwargs):
+def make_regression(n_samples=100, n_features=100, n_informative=10,
+                    noise=0.0, bias=0.0, random_state=None, chunks=None,
+                    mesh=None):
+    """Fixed ground-truth coefficients across shards (see
+    make_classification note on the reference's per-block quirk)."""
     mesh = resolve_mesh(mesh)
     rs = np.random.RandomState(random_state)
+    n_informative = min(n_informative, n_features)
+    coef = np.zeros(n_features)
+    coef[rs.permutation(n_features)[:n_informative]] = 100.0 * rs.uniform(
+        size=n_informative
+    )
     seeds = rs.randint(0, 2**31 - 1, size=data_shards(mesh))
     Xs, ys = [], []
     for sz, seed in zip(_per_shard(n_samples, mesh), seeds):
         if sz <= 0:
             Xs.append(np.empty((0, n_features))); ys.append(np.empty((0,)))
             continue
-        X, y = skdata.make_regression(
-            n_samples=sz, n_features=n_features, random_state=int(seed), **kwargs
-        )
+        r = np.random.RandomState(int(seed))
+        X = r.normal(size=(sz, n_features))
+        y = X @ coef + bias
+        if noise > 0:
+            y = y + r.normal(scale=noise, size=sz)
         Xs.append(X); ys.append(y)
     return _assemble(Xs, ys, mesh)
 

@@ -1,0 +1,115 @@
+"""Blockwise ensembles: one sub-estimator per data block, vote/average to
+predict.
+
+Reference: ``dask_ml/ensemble/_blockwise.py`` (SURVEY.md §2a Blockwise
+ensembles row). Blocks map to mesh shards: each member trains on one
+shard's rows. Members are host estimators (sklearn contract); voting /
+averaging of their predictions is a host reduction over the (small)
+per-member outputs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import BaseEstimator, ClassifierMixin, RegressorMixin, clone
+from ..metrics import accuracy_score, r2_score
+from ..parallel.mesh import data_shards
+from ..parallel.sharded import ShardedArray, as_sharded
+
+
+class _BlockwiseBase(BaseEstimator):
+    def __init__(self, estimator):
+        self.estimator = estimator
+
+    def _shard_blocks(self, X, y):
+        Xh = X.to_numpy() if isinstance(X, ShardedArray) else np.asarray(X)
+        yh = y.to_numpy() if isinstance(y, ShardedArray) else np.asarray(y)
+        n_blocks = (
+            data_shards(X.mesh) if isinstance(X, ShardedArray) else 8
+        )
+        bs = int(np.ceil(len(Xh) / n_blocks))
+        for i in range(0, len(Xh), bs):
+            yield Xh[i:i + bs], yh[i:i + bs]
+
+    def _fit(self, X, y, **kwargs):
+        self.estimators_ = []
+        for Xb, yb in self._shard_blocks(X, y):
+            if len(Xb) == 0:
+                continue
+            est = clone(self.estimator)
+            est.fit(Xb, yb, **kwargs)
+            self.estimators_.append(est)
+        if not self.estimators_:
+            raise ValueError("no non-empty blocks to fit on")
+        return self
+
+    def _member_predictions(self, X, method="predict"):
+        Xh = X.to_numpy() if isinstance(X, ShardedArray) else np.asarray(X)
+        return np.stack(
+            [getattr(est, method)(Xh) for est in self.estimators_], axis=0
+        )
+
+    def _wrap_like(self, out, X):
+        if isinstance(X, ShardedArray):
+            return as_sharded(out, mesh=X.mesh)
+        return out
+
+
+class BlockwiseVotingClassifier(ClassifierMixin, _BlockwiseBase):
+    """Ref: dask_ml/ensemble/_blockwise.py::BlockwiseVotingClassifier."""
+
+    def __init__(self, estimator, voting="hard", classes=None):
+        self.estimator = estimator
+        self.voting = voting
+        self.classes = classes
+
+    def fit(self, X, y, **kwargs):
+        if self.voting not in ("hard", "soft"):
+            raise ValueError(f"voting must be 'hard' or 'soft', got "
+                             f"{self.voting!r}")
+        self._fit(X, y, **kwargs)
+        if self.classes is not None:
+            self.classes_ = np.asarray(self.classes)
+        else:
+            self.classes_ = np.unique(
+                y.to_numpy() if isinstance(y, ShardedArray) else np.asarray(y)
+            )
+        return self
+
+    def predict(self, X):
+        if self.voting == "soft":
+            proba = self._member_predictions(X, "predict_proba").mean(axis=0)
+            out = self.classes_[np.argmax(proba, axis=1)]
+        else:
+            preds = self._member_predictions(X)  # (members, n)
+            # majority vote via per-class counts
+            votes = np.stack(
+                [(preds == c).sum(axis=0) for c in self.classes_], axis=1
+            )
+            out = self.classes_[np.argmax(votes, axis=1)]
+        return self._wrap_like(out, X)
+
+    def predict_proba(self, X):
+        if self.voting != "soft":
+            raise AttributeError(
+                "predict_proba is only available when voting='soft'"
+            )
+        proba = self._member_predictions(X, "predict_proba").mean(axis=0)
+        return self._wrap_like(proba, X)
+
+    def score(self, X, y):
+        return accuracy_score(y, self.predict(X))
+
+
+class BlockwiseVotingRegressor(RegressorMixin, _BlockwiseBase):
+    """Ref: dask_ml/ensemble/_blockwise.py::BlockwiseVotingRegressor."""
+
+    def fit(self, X, y, **kwargs):
+        return self._fit(X, y, **kwargs)
+
+    def predict(self, X):
+        return self._wrap_like(self._member_predictions(X).mean(axis=0), X)
+
+    def score(self, X, y):
+        return r2_score(y, self.predict(X))

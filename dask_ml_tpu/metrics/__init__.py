@@ -5,3 +5,4 @@ from .regression import (mean_absolute_error, mean_squared_error,
 from ..ops.pairwise import (euclidean_distances, linear_kernel,
                             pairwise_distances_argmin_min, polynomial_kernel,
                             rbf_kernel, sigmoid_kernel)
+from .scorer import SCORERS, check_scoring, get_scorer

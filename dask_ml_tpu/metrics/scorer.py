@@ -1,0 +1,57 @@
+"""Dask-aware scorers. Ref: ``dask_ml/metrics/scorer.py`` (SURVEY.md §2a
+Metrics row): SCORERS / get_scorer / check_scoring working on sharded
+inputs."""
+
+from __future__ import annotations
+
+from .classification import accuracy_score, log_loss
+from .regression import (
+    mean_absolute_error,
+    mean_squared_error,
+    r2_score,
+)
+
+
+def _make_scorer(metric, greater_is_better=True, needs_proba=False):
+    sign = 1.0 if greater_is_better else -1.0
+
+    def scorer(estimator, X, y):
+        pred = (estimator.predict_proba(X) if needs_proba
+                else estimator.predict(X))
+        return sign * metric(y, pred)
+
+    return scorer
+
+
+SCORERS = {
+    "accuracy": _make_scorer(accuracy_score),
+    "neg_mean_squared_error": _make_scorer(mean_squared_error,
+                                           greater_is_better=False),
+    "neg_mean_absolute_error": _make_scorer(mean_absolute_error,
+                                            greater_is_better=False),
+    "neg_log_loss": _make_scorer(log_loss, greater_is_better=False,
+                                 needs_proba=True),
+    "r2": _make_scorer(r2_score),
+}
+
+
+def get_scorer(scoring, compute=True):
+    if callable(scoring):
+        return scoring
+    try:
+        return SCORERS[scoring]
+    except KeyError:
+        raise ValueError(
+            f"{scoring!r} is not a valid scoring value; options: "
+            f"{sorted(SCORERS)}"
+        )
+
+
+def check_scoring(estimator, scoring=None, **kwargs):
+    if scoring is None:
+        if not hasattr(estimator, "score"):
+            raise TypeError(
+                f"estimator {estimator!r} has no score method; pass scoring="
+            )
+        return lambda est, X, y: est.score(X, y)
+    return get_scorer(scoring)

@@ -1,0 +1,6 @@
+"""Ref: dask_ml/model_selection/__init__.py."""
+from ._hyperband import HyperbandSearchCV
+from ._incremental import IncrementalSearchCV, InverseDecaySearchCV
+from ._search import GridSearchCV, RandomizedSearchCV, check_cv
+from ._split import KFold, ShuffleSplit, train_test_split
+from ._successive_halving import SuccessiveHalvingSearchCV

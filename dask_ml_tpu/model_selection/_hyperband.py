@@ -1,0 +1,161 @@
+"""HyperbandSearchCV.
+
+Reference: ``dask_ml/model_selection/_hyperband.py`` (SURVEY.md §2a, §3.5
+call stack): computes Hyperband brackets from (max_iter, aggressiveness)
+and runs a SuccessiveHalving sweep per bracket, then aggregates history
+and picks the global best. Brackets run sequentially here (the reference
+interleaves them over the cluster; on TPU, trials within a bracket are the
+parallel unit — SURVEY.md §3.5 TPU note).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..base import clone
+from ._incremental import BaseIncrementalSearchCV
+from ._successive_halving import SuccessiveHalvingSearchCV
+
+
+def _brackets(max_iter, eta):
+    """Hyperband bracket table: [(bracket, n_models, n_initial_iter)]."""
+    s_max = int(math.floor(math.log(max_iter, eta)))
+    B = (s_max + 1) * max_iter
+    out = []
+    for s in range(s_max, -1, -1):
+        n = int(math.ceil(B / max_iter * (eta ** s) / (s + 1)))
+        r = max(1, int(max_iter * (eta ** -s)))
+        out.append((s, n, r))
+    return out
+
+
+class HyperbandSearchCV(BaseIncrementalSearchCV):
+    """Ref: _hyperband.py::HyperbandSearchCV."""
+
+    def __init__(self, estimator, parameters, max_iter=81, aggressiveness=3,
+                 patience=False, tol=1e-3, test_size=None, random_state=None,
+                 scoring=None, verbose=False, prefix=""):
+        super().__init__(estimator, parameters,
+                         test_size=test_size, patience=patience, tol=tol,
+                         max_iter=max_iter, random_state=random_state,
+                         scoring=scoring, verbose=verbose, prefix=prefix)
+        self.max_iter = max_iter
+        self.aggressiveness = aggressiveness
+
+    def metadata(self):
+        """Expected work BEFORE fitting (ref: HyperbandSearchCV.metadata)."""
+        brackets = _brackets(self.max_iter, self.aggressiveness)
+        bracket_info = []
+        total_models = 0
+        total_calls = 0
+        for s, n, r in brackets:
+            calls = self._bracket_calls(n, r)
+            bracket_info.append({
+                "bracket": s, "n_models": n,
+                "partial_fit_calls": calls,
+            })
+            total_models += n
+            total_calls += calls
+        return {
+            "n_models": total_models,
+            "partial_fit_calls": total_calls,
+            "brackets": bracket_info,
+        }
+
+    def _bracket_calls(self, n, r):
+        eta = self.aggressiveness
+        calls = 0
+        while True:
+            calls += n * r if calls == 0 else 0
+            # successive rungs: top n/eta models train to r*eta
+            nk = max(1, math.floor(n / eta))
+            rk = r * eta
+            if nk <= 1 or rk > self.max_iter:
+                break
+            calls += nk * (rk - r)
+            n, r = nk, rk
+        return calls
+
+    def fit(self, X, y=None, **fit_params):
+        rng_seed = self.random_state
+        brackets = _brackets(self.max_iter, self.aggressiveness)
+        self.history_ = []
+        self.model_history_ = {}
+        all_results = []
+        best = (-np.inf, None, None, None)  # score, params, est, bracket
+        meta_brackets = []
+        offset = 0
+        for s, n, r in brackets:
+            sha = SuccessiveHalvingSearchCV(
+                clone(self.estimator), self.parameters,
+                n_initial_parameters=n, n_initial_iter=r,
+                max_iter=self.max_iter, aggressiveness=self.aggressiveness,
+                test_size=self.test_size, patience=self.patience,
+                tol=self.tol,
+                random_state=None if rng_seed is None else rng_seed + s,
+                scoring=self.scoring, verbose=self.verbose,
+                prefix=f"{self.prefix}bracket={s}",
+            )
+            sha.fit(X, y, **fit_params)
+            for rec in sha.history_:
+                rec = dict(rec)
+                rec["bracket"] = s
+                rec["model_id"] = rec["model_id"] + offset
+                self.history_.append(rec)
+            for mid, recs in sha.model_history_.items():
+                self.model_history_[mid + offset] = recs
+            res = sha.cv_results_
+            n_models = len(res["params"])
+            res = dict(res)
+            res["bracket"] = np.full(n_models, s)
+            res["model_id"] = res["model_id"] + offset
+            all_results.append(res)
+            meta_brackets.append({
+                "bracket": s, "n_models": n_models,
+                "partial_fit_calls": int(res["partial_fit_calls"].sum()),
+            })
+            if sha.best_score_ > best[0]:
+                best = (sha.best_score_, sha.best_params_,
+                        sha.best_estimator_, s)
+            offset += n_models
+
+        # merge bracket cv_results_
+        keys = set().union(*(r.keys() for r in all_results))
+        merged = {}
+        for k in keys:
+            vals = [
+                r.get(k, np.ma.masked_all(len(r["params"]), dtype=object))
+                for r in all_results
+            ]
+            if k == "params":
+                merged[k] = [p for r in all_results for p in r["params"]]
+            elif isinstance(vals[0], np.ma.MaskedArray):
+                merged[k] = np.ma.concatenate(vals)
+            else:
+                merged[k] = np.concatenate(vals)
+        scores = merged["test_score"]
+        order = np.argsort(-scores, kind="stable")
+        ranks = np.empty(len(scores), np.int32)
+        ranks[order] = np.arange(1, len(scores) + 1)
+        merged["rank_test_score"] = ranks
+        self.cv_results_ = merged
+
+        self.best_score_ = float(best[0])
+        self.best_params_ = best[1]
+        self.best_estimator_ = best[2]
+        self.best_index_ = int(np.argmax(scores))
+        self.scorer_ = None
+        from ..metrics.scorer import check_scoring
+
+        self.scorer_ = check_scoring(self.estimator, self.scoring)
+        self.multimetric_ = False
+        self.metadata_ = {
+            "n_models": sum(b["n_models"] for b in meta_brackets),
+            "partial_fit_calls": sum(
+                b["partial_fit_calls"] for b in meta_brackets
+            ),
+            "brackets": meta_brackets,
+        }
+        return self

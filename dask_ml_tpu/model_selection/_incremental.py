@@ -1,0 +1,302 @@
+"""Adaptive incremental hyperparameter search.
+
+Reference: ``dask_ml/model_selection/_incremental.py`` (SURVEY.md §2a
+adaptive row, §3.5 call stack): an async controller over distributed
+futures submits ``partial_fit``/``score`` block-by-block and adaptively
+stops/keeps models via an ``additional_calls`` hook.
+
+TPU mapping (SURVEY.md §3.5): the controller is a synchronous host loop
+(trials are pinned work, not stolen futures); models train one data block
+per call and are scored on a held-out split. The ``additional_calls``
+protocol is preserved exactly: it receives ``{model_id: [history
+records]}`` and returns ``{model_id: n_more_partial_fit_calls}`` — an
+empty dict (or all-zero dict) stops the search. SuccessiveHalving and
+Hyperband reuse this engine, as in the reference.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+from sklearn.model_selection import ParameterSampler
+
+from ..base import BaseEstimator, clone
+from ..metrics.scorer import check_scoring
+from ..parallel.sharded import ShardedArray
+from ._split import train_test_split
+
+
+def _to_host(a):
+    return a.to_numpy() if isinstance(a, ShardedArray) else np.asarray(a)
+
+
+def _blocks_of(X, y, n_blocks):
+    """Host-side row blocks; blocks = the unit of one partial_fit call."""
+    Xh, yh = _to_host(X), _to_host(y)
+    n = len(Xh)
+    bs = max(int(np.ceil(n / n_blocks)), 1)
+    return [(Xh[i:i + bs], yh[i:i + bs]) for i in range(0, n, bs)
+            if len(Xh[i:i + bs])]
+
+
+def fit(model_factory, params_list, train_blocks, X_test, y_test, scorer,
+        additional_calls, fit_params=None, patience=False, tol=1e-3,
+        max_iter=None, prefix="", verbose=False):
+    """Core controller (ref: _incremental.py::_fit). Returns
+    (info, models, history)."""
+    fit_params = fit_params or {}
+    models = {}
+    meta = {}
+    history = []
+    info = {}
+    start = time.time()
+    n_blocks = len(train_blocks)
+
+    for mid, params in enumerate(params_list):
+        models[mid] = model_factory(params)
+        meta[mid] = {
+            "model_id": mid, "params": params, "partial_fit_calls": 0,
+            "score": None, "block_cursor": 0,
+        }
+        info[mid] = []
+
+    def train_one(mid, n_calls):
+        m = meta[mid]
+        model = models[mid]
+        t0 = time.time()
+        for _ in range(n_calls):
+            Xb, yb = train_blocks[m["block_cursor"] % n_blocks]
+            model.partial_fit(Xb, yb, **fit_params)
+            m["block_cursor"] += 1
+            m["partial_fit_calls"] += 1
+        fit_time = time.time() - t0
+        t0 = time.time()
+        score = scorer(model, X_test, y_test)
+        score_time = time.time() - t0
+        m["score"] = score
+        record = {
+            "model_id": mid,
+            "params": m["params"],
+            "partial_fit_calls": m["partial_fit_calls"],
+            "partial_fit_time": fit_time,
+            "score": score,
+            "score_time": score_time,
+            "elapsed_wall_time": time.time() - start,
+        }
+        history.append(record)
+        info[mid].append(record)
+
+    # first round: one call each
+    for mid in list(models):
+        train_one(mid, 1)
+
+    active = set(models)
+    while active:
+        instructions = additional_calls(
+            {mid: info[mid] for mid in active}
+        )
+        instructions = {
+            mid: c for mid, c in instructions.items() if mid in active
+        }
+        active = set(instructions)
+        if not instructions or all(c == 0 for c in instructions.values()):
+            break
+        progressed = False
+        for mid, n_calls in instructions.items():
+            if n_calls <= 0:
+                continue
+            if patience and len(info[mid]) > patience:
+                recent = [r["score"] for r in info[mid][-patience:]]
+                if max(recent) < info[mid][-patience - 1]["score"] + tol:
+                    # plateaued: retire so the hook stops asking for it
+                    active.discard(mid)
+                    continue
+            if max_iter is not None and (
+                meta[mid]["partial_fit_calls"] + n_calls > max_iter
+            ):
+                n_calls = max_iter - meta[mid]["partial_fit_calls"]
+                if n_calls <= 0:
+                    active.discard(mid)
+                    continue
+            train_one(mid, n_calls)
+            progressed = True
+        if not progressed:
+            break  # every requested model was retired; nothing can advance
+
+    return info, models, meta, history
+
+
+class BaseIncrementalSearchCV(BaseEstimator):
+    """Shared plumbing of the futures-style searches."""
+
+    def __init__(self, estimator, parameters, n_initial_parameters=10,
+                 test_size=None, patience=False, tol=1e-3, max_iter=100,
+                 random_state=None, scoring=None, verbose=False, prefix=""):
+        self.estimator = estimator
+        self.parameters = parameters
+        self.n_initial_parameters = n_initial_parameters
+        self.test_size = test_size
+        self.patience = patience
+        self.tol = tol
+        self.max_iter = max_iter
+        self.random_state = random_state
+        self.scoring = scoring
+        self.verbose = verbose
+        self.prefix = prefix
+
+    # -- hooks overridden by subclasses -----------------------------------
+    def _n_initial(self):
+        return self.n_initial_parameters
+
+    def _additional_calls(self, info):
+        raise NotImplementedError
+
+    def _sample_params(self, n):
+        return list(ParameterSampler(
+            self.parameters, n, random_state=self.random_state
+        ))
+
+    def fit(self, X, y=None, **fit_params):
+        test_size = self.test_size
+        if test_size is None:
+            test_size = 0.15
+        X_train, X_test, y_train, y_test = train_test_split(
+            X, y, test_size=test_size, random_state=self.random_state
+        )
+        scorer_raw = check_scoring(self.estimator, self.scoring)
+        X_test_h, y_test_h = _to_host(X_test), _to_host(y_test)
+        from ..parallel.mesh import data_shards, resolve_mesh
+
+        n_blocks = (
+            data_shards(X.mesh) if isinstance(X, ShardedArray)
+            else data_shards(resolve_mesh(None))
+        )
+        blocks = _blocks_of(X_train, y_train, n_blocks)
+        params_list = self._sample_params(self._n_initial())
+
+        def factory(params):
+            return clone(self.estimator).set_params(**params)
+
+        info, models, meta, history = fit(
+            factory, params_list, blocks, X_test_h, y_test_h, scorer_raw,
+            self._additional_calls, fit_params=fit_params,
+            patience=self.patience, tol=self.tol, max_iter=self.max_iter,
+            prefix=self.prefix, verbose=self.verbose,
+        )
+
+        self.history_ = history
+        self.model_history_ = info
+        n_models = len(params_list)
+        scores = np.array([
+            info[mid][-1]["score"] if info[mid] else np.nan
+            for mid in range(n_models)
+        ])
+        calls = np.array([meta[mid]["partial_fit_calls"]
+                          for mid in range(n_models)])
+        order = np.argsort(-scores, kind="stable")
+        ranks = np.empty(n_models, np.int32)
+        ranks[order] = np.arange(1, n_models + 1)
+        results = {
+            "params": params_list,
+            "test_score": scores,
+            "mean_test_score": scores,
+            "rank_test_score": ranks,
+            "model_id": np.arange(n_models),
+            "partial_fit_calls": calls,
+        }
+        for key in sorted({k for p in params_list for k in p}):
+            results[f"param_{key}"] = np.ma.masked_all(n_models, dtype=object)
+            for ci, p in enumerate(params_list):
+                if key in p:
+                    results[f"param_{key}"][ci] = p[key]
+        self.cv_results_ = results
+        self.best_index_ = int(np.nanargmax(scores))
+        self.best_score_ = float(scores[self.best_index_])
+        self.best_params_ = params_list[self.best_index_]
+        self.best_estimator_ = models[self.best_index_]
+        self.n_splits_ = 1
+        self.multimetric_ = False
+        self.scorer_ = scorer_raw
+        self.metadata_ = {
+            "n_models": n_models,
+            "partial_fit_calls": int(calls.sum()),
+        }
+        return self
+
+    # -- post-fit delegation ----------------------------------------------
+    def predict(self, X):
+        return self.best_estimator_.predict(_to_host(X))
+
+    def predict_proba(self, X):
+        return self.best_estimator_.predict_proba(_to_host(X))
+
+    def decision_function(self, X):
+        return self.best_estimator_.decision_function(_to_host(X))
+
+    def score(self, X, y=None):
+        return self.scorer_(self.best_estimator_, _to_host(X), _to_host(y))
+
+    @property
+    def classes_(self):
+        return self.best_estimator_.classes_
+
+
+class IncrementalSearchCV(BaseIncrementalSearchCV):
+    """Ref: dask_ml/model_selection/_incremental.py::IncrementalSearchCV —
+    inverse-decay model dropping: after scoring event k, keep the top
+    ``n_initial / (1 + decay_rate * k)`` models and give each one more
+    partial_fit call; ``decay_rate=None`` keeps all models to max_iter."""
+
+    def __init__(self, estimator, parameters, n_initial_parameters=10,
+                 decay_rate=1.0, test_size=None, patience=False, tol=1e-3,
+                 fits_per_score=1, max_iter=100, random_state=None,
+                 scoring=None, verbose=False, prefix=""):
+        super().__init__(estimator, parameters,
+                         n_initial_parameters=n_initial_parameters,
+                         test_size=test_size, patience=patience, tol=tol,
+                         max_iter=max_iter, random_state=random_state,
+                         scoring=scoring, verbose=verbose, prefix=prefix)
+        self.decay_rate = decay_rate
+        self.fits_per_score = fits_per_score
+        self._step = 0
+
+    def _n_initial(self):
+        if self.n_initial_parameters == "grid":
+            from sklearn.model_selection import ParameterGrid
+
+            return len(ParameterGrid(self.parameters))
+        return self.n_initial_parameters
+
+    def _sample_params(self, n):
+        if self.n_initial_parameters == "grid":
+            from sklearn.model_selection import ParameterGrid
+
+            return list(ParameterGrid(self.parameters))
+        return super()._sample_params(n)
+
+    def _additional_calls(self, info):
+        self._step += 1
+        scores = {mid: recs[-1]["score"] for mid, recs in info.items()}
+        calls = {mid: recs[-1]["partial_fit_calls"]
+                 for mid, recs in info.items()}
+        if self.decay_rate is None:
+            keep = list(scores)
+        else:
+            n_keep = max(
+                1, int(self._n_initial() / (1 + self.decay_rate * self._step))
+            )
+            keep = sorted(scores, key=scores.get, reverse=True)[:n_keep]
+        out = {}
+        for mid in keep:
+            if calls[mid] >= self.max_iter:
+                out[mid] = 0
+            else:
+                out[mid] = self.fits_per_score
+        if all(v == 0 for v in out.values()):
+            return {mid: 0 for mid in out}
+        return out
+
+
+class InverseDecaySearchCV(IncrementalSearchCV):
+    """Explicit-name alias used in later dask-ml versions."""

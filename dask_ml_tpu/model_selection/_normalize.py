@@ -1,0 +1,38 @@
+"""Deterministic estimator tokens for work de-duplication.
+
+Reference: ``dask_ml/model_selection/_normalize.py`` (SURVEY.md §2a,
+§3.4): dask's ``tokenize`` gives identical graph keys to identical
+(estimator, params) subtrees so shared pipeline prefixes are fit once. We
+need the same property without a task graph: a stable string token keyed
+on (class, sorted params), used by the search controller's prefix memo —
+the de-dup is explicit (a dict) instead of graph-key coincidence.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+
+def _token_piece(v):
+    if isinstance(v, np.ndarray):
+        return f"ndarray:{v.shape}:{v.dtype}:{hashlib.sha1(np.ascontiguousarray(v).tobytes()).hexdigest()[:16]}"
+    if isinstance(v, (list, tuple)):
+        return f"{type(v).__name__}({','.join(_token_piece(i) for i in v)})"
+    if isinstance(v, dict):
+        inner = ",".join(
+            f"{k}={_token_piece(v[k])}" for k in sorted(v, key=str)
+        )
+        return f"dict({inner})"
+    if hasattr(v, "get_params"):
+        return estimator_token(v)
+    return f"{type(v).__name__}:{v!r}"
+
+
+def estimator_token(est) -> str:
+    """Stable token for an (unfitted) estimator's identity + params."""
+    params = est.get_params(deep=False)
+    inner = ",".join(f"{k}={_token_piece(params[k])}" for k in sorted(params))
+    raw = f"{type(est).__module__}.{type(est).__qualname__}({inner})"
+    return hashlib.sha1(raw.encode()).hexdigest()

@@ -1,0 +1,274 @@
+"""Drop-in CV search: GridSearchCV / RandomizedSearchCV.
+
+Reference: ``dask_ml/model_selection/_search.py`` + ``methods.py``
+(SURVEY.md §2a, §3.4 call stack) — the ex-dask-searchcv engine that builds
+ONE task graph for the whole search with two key optimizations:
+
+1. ``CVCache``: each fold's train/test arrays extracted once, shared by
+   every parameter combination. Here: folds are materialized once via
+   ``take_rows`` (device gather) and reused across candidates.
+2. Pipeline prefix sharing: identical (step, params, fold) subtrees get
+   identical keys and are computed once. Here: an explicit memo dict keyed
+   on (fold, prefix estimator-token chain) caches fitted pipeline
+   prefixes AND their transformed output — same de-dup, no task graph
+   (SURVEY.md §7: "de-dup via explicit controller memo").
+
+Execution: candidates run as a host loop over jitted fits. Device
+estimators share XLA compile cache across candidates (same shapes), which
+is the jit-level analog of dask's task de-dup.
+"""
+
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+from sklearn.model_selection import ParameterGrid, ParameterSampler
+
+from ..base import BaseEstimator, clone
+from ..metrics.scorer import check_scoring
+from ..parallel.sharded import ShardedArray, take_rows
+from ._normalize import estimator_token
+from ._split import KFold
+
+
+def _is_pipeline(est):
+    return hasattr(est, "steps") and hasattr(est, "named_steps")
+
+
+def check_cv(cv=None):
+    if cv is None:
+        return KFold(n_splits=5)
+    if isinstance(cv, numbers.Integral):
+        return KFold(n_splits=int(cv))
+    if hasattr(cv, "split"):
+        return cv
+    raise ValueError(f"cannot interpret cv={cv!r}")
+
+
+def _take(a, idx):
+    if isinstance(a, ShardedArray):
+        return take_rows(a, idx)
+    return np.asarray(a)[idx]
+
+
+class _CVCache:
+    """Materialized folds, extracted once (ref methods.py::CVCache)."""
+
+    def __init__(self, X, y, cv, cache=True):
+        self.folds = []
+        for train_idx, test_idx in cv.split(X, y):
+            self.folds.append((
+                _take(X, train_idx), _take(y, train_idx),
+                _take(X, test_idx), _take(y, test_idx),
+            ))
+
+
+class _PrefixMemo:
+    """Fitted-pipeline-prefix cache (ref: tokenized graph de-dup)."""
+
+    def __init__(self):
+        self._memo = {}
+        self.hits = 0
+        self.misses = 0
+
+    def fit_pipeline(self, pipe, fold_id, X, y):
+        """Fit a pipeline reusing cached fitted prefixes + transformed data."""
+        key = (fold_id,)
+        Xt = X
+        fitted_steps = []
+        n = len(pipe.steps)
+        for i, (name, step) in enumerate(pipe.steps):
+            key = key + (estimator_token(step),)
+            last = i == n - 1
+            if last:
+                # final step fits on the (cached) transformed data
+                cached = self._memo.get(key)
+                if cached is None:
+                    self.misses += 1
+                    est = clone(step)
+                    est.fit(Xt, y)
+                    self._memo[key] = est
+                else:
+                    self.hits += 1
+                    est = cached
+                fitted_steps.append((name, est))
+            else:
+                cached = self._memo.get(key)
+                if cached is None:
+                    self.misses += 1
+                    est = clone(step)
+                    if hasattr(est, "fit_transform"):
+                        Xt_new = est.fit_transform(Xt, y)
+                    else:
+                        Xt_new = est.fit(Xt, y).transform(Xt)
+                    self._memo[key] = (est, Xt_new)
+                else:
+                    self.hits += 1
+                    est, Xt_new = cached
+                Xt = Xt_new
+                fitted_steps.append((name, est))
+        fitted = clone(pipe)
+        fitted.steps = fitted_steps
+        return fitted
+
+
+class _BaseSearchCV(BaseEstimator):
+    def __init__(self, estimator, scoring=None, cv=None, refit=True,
+                 error_score="raise", return_train_score=False,
+                 cache_cv=True, scheduler=None, n_jobs=-1):
+        self.estimator = estimator
+        self.scoring = scoring
+        self.cv = cv
+        self.refit = refit
+        self.error_score = error_score
+        self.return_train_score = return_train_score
+        self.cache_cv = cache_cv
+        self.scheduler = scheduler
+        self.n_jobs = n_jobs
+
+    def _candidates(self):
+        raise NotImplementedError
+
+    def fit(self, X, y=None, **fit_params):
+        candidates = list(self._candidates())
+        if not candidates:
+            raise ValueError("no parameter candidates")
+        cv = check_cv(self.cv)
+        scorer = check_scoring(self.estimator, self.scoring)
+        cache = _CVCache(X, y, cv, cache=self.cache_cv)
+        memo = _PrefixMemo()
+        n_folds = len(cache.folds)
+
+        scores = np.full((len(candidates), n_folds), np.nan)
+        train_scores = (
+            np.full((len(candidates), n_folds), np.nan)
+            if self.return_train_score else None
+        )
+        for ci, params in enumerate(candidates):
+            for fi, (Xtr, ytr, Xte, yte) in enumerate(cache.folds):
+                est = clone(self.estimator).set_params(**params)
+                try:
+                    if _is_pipeline(est):
+                        est = memo.fit_pipeline(est, fi, Xtr, ytr)
+                    else:
+                        est.fit(Xtr, ytr, **fit_params)
+                    scores[ci, fi] = scorer(est, Xte, yte)
+                    if self.return_train_score:
+                        train_scores[ci, fi] = scorer(est, Xtr, ytr)
+                except Exception:
+                    if self.error_score == "raise":
+                        raise
+                    scores[ci, fi] = self.error_score
+
+        mean = scores.mean(axis=1)
+        std = scores.std(axis=1)
+        order = np.argsort(-mean, kind="stable")
+        ranks = np.empty(len(candidates), np.int32)
+        ranks[order] = np.arange(1, len(candidates) + 1)
+
+        results = {
+            "params": candidates,
+            "mean_test_score": mean,
+            "std_test_score": std,
+            "rank_test_score": ranks,
+        }
+        for fi in range(n_folds):
+            results[f"split{fi}_test_score"] = scores[:, fi]
+        if self.return_train_score:
+            results["mean_train_score"] = train_scores.mean(axis=1)
+            results["std_train_score"] = train_scores.std(axis=1)
+            for fi in range(n_folds):
+                results[f"split{fi}_train_score"] = train_scores[:, fi]
+        for key in sorted({k for p in candidates for k in p}):
+            results[f"param_{key}"] = np.ma.masked_all(
+                len(candidates), dtype=object
+            )
+            for ci, p in enumerate(candidates):
+                if key in p:
+                    results[f"param_{key}"][ci] = p[key]
+        self.cv_results_ = results
+        self.best_index_ = int(np.argmax(mean))
+        self.best_score_ = float(mean[self.best_index_])
+        self.best_params_ = candidates[self.best_index_]
+        self.n_splits_ = n_folds
+        self.scorer_ = scorer
+        self.multimetric_ = False
+        self._memo_stats = (memo.hits, memo.misses)
+
+        if self.refit:
+            est = clone(self.estimator).set_params(**self.best_params_)
+            est.fit(X, y, **fit_params)
+            self.best_estimator_ = est
+        return self
+
+    # -- delegation to best_estimator_ ------------------------------------
+    def _check_refit(self, method):
+        if not self.refit:
+            raise AttributeError(
+                f"{method} is only available when refit=True"
+            )
+
+    def predict(self, X):
+        self._check_refit("predict")
+        return self.best_estimator_.predict(X)
+
+    def predict_proba(self, X):
+        self._check_refit("predict_proba")
+        return self.best_estimator_.predict_proba(X)
+
+    def transform(self, X):
+        self._check_refit("transform")
+        return self.best_estimator_.transform(X)
+
+    def decision_function(self, X):
+        self._check_refit("decision_function")
+        return self.best_estimator_.decision_function(X)
+
+    def score(self, X, y=None):
+        if hasattr(self, "scorer_") and self.scoring is not None:
+            return self.scorer_(self.best_estimator_, X, y)
+        self._check_refit("score")
+        return self.best_estimator_.score(X, y)
+
+    @property
+    def classes_(self):
+        return self.best_estimator_.classes_
+
+
+class GridSearchCV(_BaseSearchCV):
+    """Ref: dask_ml/model_selection/_search.py::GridSearchCV."""
+
+    def __init__(self, estimator, param_grid, scoring=None, cv=None,
+                 refit=True, error_score="raise", return_train_score=False,
+                 cache_cv=True, scheduler=None, n_jobs=-1):
+        super().__init__(estimator, scoring=scoring, cv=cv, refit=refit,
+                         error_score=error_score,
+                         return_train_score=return_train_score,
+                         cache_cv=cache_cv, scheduler=scheduler,
+                         n_jobs=n_jobs)
+        self.param_grid = param_grid
+
+    def _candidates(self):
+        return ParameterGrid(self.param_grid)
+
+
+class RandomizedSearchCV(_BaseSearchCV):
+    """Ref: dask_ml/model_selection/_search.py::RandomizedSearchCV."""
+
+    def __init__(self, estimator, param_distributions, n_iter=10,
+                 random_state=None, scoring=None, cv=None, refit=True,
+                 error_score="raise", return_train_score=False,
+                 cache_cv=True, scheduler=None, n_jobs=-1):
+        super().__init__(estimator, scoring=scoring, cv=cv, refit=refit,
+                         error_score=error_score,
+                         return_train_score=return_train_score,
+                         cache_cv=cache_cv, scheduler=scheduler,
+                         n_jobs=n_jobs)
+        self.param_distributions = param_distributions
+        self.n_iter = n_iter
+        self.random_state = random_state
+
+    def _candidates(self):
+        return ParameterSampler(self.param_distributions, self.n_iter,
+                                random_state=self.random_state)

@@ -1,0 +1,167 @@
+"""Data splitting: train_test_split, ShuffleSplit, KFold.
+
+Reference: ``dask_ml/model_selection/_split.py`` (SURVEY.md §2a splits
+row). ``blockwise=True`` (default, as in the reference) shuffles/splits
+WITHIN each shard — no cross-shard data motion; ``blockwise=False`` draws
+a global permutation. Either way the split materializes through
+``take_rows`` (one XLA gather) rather than the reference's slicing task
+graphs.
+
+Splitters yield host-side index arrays (the cheap part — indices are tiny
+relative to data); fold extraction gathers on device.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..parallel.mesh import data_shards
+from ..parallel.sharded import ShardedArray, take_rows
+
+
+def _validate_sizes(n, test_size, train_size):
+    if test_size is None and train_size is None:
+        test_size = 0.25
+    if test_size is None:
+        test_size = 1.0 - (
+            train_size if isinstance(train_size, float) else train_size / n
+        )
+    n_test = (
+        int(np.ceil(n * test_size)) if isinstance(test_size, float)
+        else int(test_size)
+    )
+    if train_size is None:
+        n_train = n - n_test
+    else:
+        n_train = (
+            int(np.floor(n * train_size)) if isinstance(train_size, float)
+            else int(train_size)
+        )
+    if n_test + n_train > n:
+        raise ValueError(
+            f"train_size + test_size = {n_train + n_test} > n_samples = {n}"
+        )
+    if n_test < 1 or n_train < 1:
+        raise ValueError("resulting train/test sets would be empty")
+    return n_train, n_test
+
+
+def _shard_row_ranges(x: ShardedArray):
+    """(start, stop) of logical rows per shard."""
+    per = x.padded_shape[0] // data_shards(x.mesh)
+    out = []
+    for s in range(data_shards(x.mesh)):
+        lo = min(s * per, x.n_rows)
+        hi = min((s + 1) * per, x.n_rows)
+        out.append((lo, hi))
+    return out
+
+
+def _blockwise_split_indices(x, test_size, train_size, rng, shuffle):
+    train_parts, test_parts = [], []
+    for lo, hi in _shard_row_ranges(x):
+        m = hi - lo
+        if m == 0:
+            continue
+        n_train, n_test = _validate_sizes(m, test_size, train_size)
+        idx = np.arange(lo, hi)
+        if shuffle:
+            rng.shuffle(idx)
+        test_parts.append(idx[:n_test])
+        train_parts.append(idx[n_test:n_test + n_train])
+    return np.concatenate(train_parts), np.concatenate(test_parts)
+
+
+def train_test_split(*arrays, test_size=None, train_size=None,
+                     random_state=None, shuffle=True, blockwise=True,
+                     **kwargs):
+    """Ref: dask_ml/model_selection/_split.py::train_test_split."""
+    if not arrays:
+        raise ValueError("at least one array required")
+    if not shuffle and blockwise:
+        blockwise = False  # contiguous split needs no per-block handling
+    rng = np.random.RandomState(random_state)
+    first = arrays[0]
+    n = first.n_rows if isinstance(first, ShardedArray) else len(first)
+    for a in arrays:
+        na = a.n_rows if isinstance(a, ShardedArray) else len(a)
+        if na != n:
+            raise ValueError("arrays have inconsistent lengths")
+
+    if blockwise and isinstance(first, ShardedArray):
+        train_idx, test_idx = _blockwise_split_indices(
+            first, test_size, train_size, rng, shuffle
+        )
+    else:
+        n_train, n_test = _validate_sizes(n, test_size, train_size)
+        idx = rng.permutation(n) if shuffle else np.arange(n)
+        test_idx, train_idx = idx[:n_test], idx[n_test:n_test + n_train]
+
+    out = []
+    for a in arrays:
+        if isinstance(a, ShardedArray):
+            out.extend([take_rows(a, train_idx), take_rows(a, test_idx)])
+        else:
+            a = np.asarray(a)
+            out.extend([a[train_idx], a[test_idx]])
+    return out
+
+
+class ShuffleSplit:
+    """Ref: dask_ml/model_selection/_split.py::ShuffleSplit."""
+
+    def __init__(self, n_splits=10, test_size=0.1, train_size=None,
+                 blockwise=True, random_state=None):
+        self.n_splits = n_splits
+        self.test_size = test_size
+        self.train_size = train_size
+        self.blockwise = blockwise
+        self.random_state = random_state
+
+    def split(self, X, y=None, groups=None):
+        rng = np.random.RandomState(self.random_state)
+        n = X.n_rows if isinstance(X, ShardedArray) else len(X)
+        for _ in range(self.n_splits):
+            if self.blockwise and isinstance(X, ShardedArray):
+                yield _blockwise_split_indices(
+                    X, self.test_size, self.train_size, rng, shuffle=True
+                )
+            else:
+                n_train, n_test = _validate_sizes(
+                    n, self.test_size, self.train_size
+                )
+                idx = rng.permutation(n)
+                yield idx[n_test:n_test + n_train], idx[:n_test]
+
+    def get_n_splits(self, X=None, y=None, groups=None):
+        return self.n_splits
+
+
+class KFold:
+    """Ref: dask_ml/model_selection/_split.py::KFold."""
+
+    def __init__(self, n_splits=5, shuffle=False, random_state=None):
+        self.n_splits = n_splits
+        self.shuffle = shuffle
+        self.random_state = random_state
+
+    def split(self, X, y=None, groups=None):
+        n = X.n_rows if isinstance(X, ShardedArray) else len(X)
+        if self.n_splits > n:
+            raise ValueError(
+                f"n_splits={self.n_splits} > n_samples={n}"
+            )
+        idx = np.arange(n)
+        if self.shuffle:
+            np.random.RandomState(self.random_state).shuffle(idx)
+        sizes = np.full(self.n_splits, n // self.n_splits)
+        sizes[: n % self.n_splits] += 1
+        stops = np.cumsum(sizes)
+        starts = stops - sizes
+        for lo, hi in zip(starts, stops):
+            test = idx[lo:hi]
+            train = np.concatenate([idx[:lo], idx[hi:]])
+            yield train, test
+
+    def get_n_splits(self, X=None, y=None, groups=None):
+        return self.n_splits

@@ -108,7 +108,9 @@ def init_scalable(X: ShardedArray, n_clusters, random_state, max_iter=None,
 
     data, mask = X.data, X.row_mask(X.dtype)
     n, d = X.shape
-    l = max(int(oversampling_factor * n_clusters), 1)
+    n_pad = data.shape[0]
+    # top_k needs l <= array length; tiny datasets clamp the oversample
+    l = min(max(int(oversampling_factor * n_clusters), 1), n_pad)
     key = jax.random.PRNGKey(0 if random_state is None else int(random_state))
 
     # step 1: one uniform-random valid row
@@ -157,7 +159,7 @@ def init_pp(X: ShardedArray, n_clusters, random_state):
     from sklearn.cluster import kmeans_plusplus
 
     data, mask = X.data, X.row_mask(X.dtype)
-    m = min(X.n_rows, max(10 * n_clusters, 500))
+    m = min(X.n_rows, max(10 * n_clusters, 500), data.shape[0])
     key = jax.random.PRNGKey(1 if random_state is None else int(random_state))
     idx = _gumbel_top_l(mask, key, m)
     sample = to_host(jnp.take(data, idx, axis=0))

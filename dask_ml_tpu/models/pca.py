@@ -28,11 +28,17 @@ from ..utils.validation import check_array, check_is_fitted
 def _resolve_n_components(n_components, n, d):
     if n_components is None:
         return min(n, d)
+    if isinstance(n_components, float) and not n_components.is_integer():
+        raise ValueError(
+            "float n_components means a variance fraction and requires "
+            "svd_solver='full'"
+        )
+    n_components = int(n_components)
     if not 0 < n_components <= min(n, d):
         raise ValueError(
             f"n_components={n_components} must be in (0, {min(n, d)}]"
         )
-    return int(n_components)
+    return n_components
 
 
 class PCA(TransformerMixin, BaseEstimator):
@@ -73,11 +79,23 @@ class PCA(TransformerMixin, BaseEstimator):
                 "PCA requires tall data (n_samples >= n_features); got "
                 f"{n} x {d}"
             )
-        k = _resolve_n_components(self.n_components, n, d)
+        frac = None
+        if (isinstance(self.n_components, float)
+                and 0.0 < self.n_components < 1.0):
+            # sklearn's variance-fraction API: needs the full spectrum
+            if self._solver(min(n, d), n, d) != "full" and \
+                    self.svd_solver not in ("auto", "full", "tsqr"):
+                raise ValueError(
+                    "n_components as a variance fraction requires "
+                    "svd_solver in ('auto', 'full', 'tsqr')"
+                )
+            frac, k = self.n_components, min(n, d)
+        else:
+            k = _resolve_n_components(self.n_components, n, d)
         mask = X.row_mask(X.dtype)
         mean, var = masked_mean_var(X.data, mask, n, ddof=1)
         xc = (X.data - mean) * mask[:, None]
-        solver = self._solver(k, n, d)
+        solver = "full" if frac is not None else self._solver(k, n, d)
         if solver == "full":
             u, s, vt = linalg.svd_tall(xc, X.mesh)
         else:
@@ -92,6 +110,9 @@ class PCA(TransformerMixin, BaseEstimator):
 
         total_var = float(jnp.sum(var))
         ev = to_host(s).astype(np.float64) ** 2 / (n - 1)
+        if frac is not None:
+            ratio = np.cumsum(ev / total_var)
+            k = int(np.searchsorted(ratio, frac) + 1)
         self.n_components_ = k
         self.components_ = to_host(vt)[:k].astype(np.float64)
         self.explained_variance_ = ev[:k]
@@ -279,6 +300,11 @@ class IncrementalPCA(PCA):
         self.explained_variance_ = self.singular_values_ ** 2 / max(n - 1, 1)
         self.n_components_ = k
         self.n_features_in_ = d
+
+    def fit_transform(self, X, y=None):
+        # PCA.fit_transform would run the batch SVD path; the incremental
+        # algorithm must fit block-wise then transform
+        return self.fit(X, y).transform(X)
 
     def fit(self, X, y=None):
         if hasattr(self, "n_samples_seen_"):

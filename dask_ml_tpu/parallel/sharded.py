@@ -137,3 +137,41 @@ def row_mask(n_padded: int, n_rows: int, mesh: Mesh, dtype=jnp.float32) -> jax.A
 def as_sharded(x, mesh: Mesh | None = None, dtype=None) -> ShardedArray:
     """Canonicalize numpy / jax / ShardedArray input to ShardedArray."""
     return ShardedArray.from_array(x, mesh=mesh, dtype=dtype)
+
+
+def take_rows(x: ShardedArray, idx) -> ShardedArray:
+    """New ShardedArray of x's rows at (host) integer indices ``idx``.
+
+    The resharding primitive behind train/test splits and CV fold
+    extraction — the reference's rechunk/shuffle task graphs
+    (``dask/array/rechunk.py``, SURVEY.md §5 long-context row) become one
+    gather that XLA lowers to an all-to-all over ICI."""
+    idx = np.asarray(idx)
+    if idx.ndim != 1:
+        raise ValueError(f"idx must be 1-D, got shape {idx.shape}")
+    if idx.size and ((idx < 0).any() or (idx >= x.n_rows).any()):
+        raise IndexError(
+            f"indices out of bounds for {x.n_rows} rows: "
+            f"[{idx.min()}, {idx.max()}] (jnp.take would clamp silently)"
+        )
+    n_out = idx.shape[0]
+    shards = data_shards(x.mesh)
+    n_pad = _padded_rows(n_out, shards)
+    # pad with index 0 (any valid row): padded rows are masked by n_rows
+    idx_padded = np.zeros(n_pad, np.int32)
+    idx_padded[:n_out] = idx
+    spec = P(*((DATA_AXIS,) + (None,) * (x.ndim - 1)))
+    sharding = NamedSharding(x.mesh, spec)
+    idx_dev = jax.device_put(idx_padded, NamedSharding(x.mesh, P(DATA_AXIS)))
+
+    @jax.jit
+    def gather(data, indices):
+        out = jnp.take(data, indices, axis=0)
+        return jax.lax.with_sharding_constraint(out, sharding)
+
+    out = gather(x.data, idx_dev)
+    # re-zero rows that came from padding of the source or of the output
+    out_arr = ShardedArray(out, n_out, x.mesh)
+    mask = out_arr.row_mask(out.dtype)
+    out_arr.data = out * (mask.reshape((n_pad,) + (1,) * (x.ndim - 1)))
+    return out_arr

@@ -1,0 +1,156 @@
+"""Meta-estimator wrappers: ParallelPostFit and Incremental.
+
+Reference: ``dask_ml/wrappers.py`` + ``dask_ml/_partial.py`` (SURVEY.md
+§2a Wrappers row, §3.6):
+
+- ``ParallelPostFit``: train on small in-memory data, parallelize
+  predict/transform/score over blocks.
+- ``Incremental``: out-of-core fit via a sequential ``partial_fit`` chain
+  over blocks (optionally shuffled per call).
+
+TPU mapping: "blocks" are the row ranges of a ShardedArray. A wrapped
+dask_ml_tpu estimator predicts device-parallel as-is (no wrapper machinery
+needed — GSPMD already parallelizes); the wrapper's job is interop with
+*host* (sklearn-style) estimators: post-fit ops stream blocks through the
+host estimator, and ``Incremental.fit`` is the streamed training loop the
+reference builds as a linear task chain (the model no longer hops
+worker-to-worker; blocks stream to it).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import BaseEstimator, clone
+from .metrics import accuracy_score, r2_score
+from .parallel.sharded import ShardedArray, as_sharded
+
+
+def _is_device_estimator(est):
+    return est.__class__.__module__.startswith("dask_ml_tpu")
+
+
+def _host_blocks(X, block_size=100_000):
+    """Yield host numpy row blocks of a ShardedArray / array."""
+    host = X.to_numpy() if isinstance(X, ShardedArray) else np.asarray(X)
+    for i in range(0, len(host), block_size):
+        yield host[i:i + block_size]
+
+
+class ParallelPostFit(BaseEstimator):
+    """Ref: dask_ml/wrappers.py::ParallelPostFit."""
+
+    def __init__(self, estimator=None, scoring=None):
+        self.estimator = estimator
+        self.scoring = scoring
+
+    # -- fit: plain in-memory fit of the wrapped estimator ---------------
+    def fit(self, X, y=None, **kwargs):
+        est = clone(self.estimator)
+        Xh = X.to_numpy() if isinstance(X, ShardedArray) else X
+        yh = y.to_numpy() if isinstance(y, ShardedArray) else y
+        if yh is None:
+            est.fit(Xh, **kwargs)
+        else:
+            est.fit(Xh, yh, **kwargs)
+        self.estimator_ = est
+        return self
+
+    @property
+    def _est(self):
+        # support wrapping an already-fitted estimator without fit()
+        return getattr(self, "estimator_", self.estimator)
+
+    @property
+    def classes_(self):
+        return self._est.classes_
+
+    # -- parallel post-fit ops --------------------------------------------
+    def _apply(self, X, method):
+        est = self._est
+        if _is_device_estimator(est):
+            return getattr(est, method)(X)
+        mesh = X.mesh if isinstance(X, ShardedArray) else None
+        parts = [getattr(est, method)(b) for b in _host_blocks(X)]
+        out = np.concatenate(parts, axis=0)
+        return as_sharded(out, mesh=mesh) if mesh is not None else out
+
+    def predict(self, X):
+        return self._apply(X, "predict")
+
+    def predict_proba(self, X):
+        return self._apply(X, "predict_proba")
+
+    def predict_log_proba(self, X):
+        return self._apply(X, "predict_log_proba")
+
+    def decision_function(self, X):
+        return self._apply(X, "decision_function")
+
+    def transform(self, X):
+        return self._apply(X, "transform")
+
+    def score(self, X, y, compute=True):
+        if self.scoring:
+            from .metrics.scorer import get_scorer
+
+            return get_scorer(self.scoring)(self, X, y)
+        pred = self.predict(X)
+        if hasattr(self._est, "classes_") or hasattr(self._est, "predict_proba"):
+            return accuracy_score(y, pred)
+        return r2_score(y, pred)
+
+
+class Incremental(ParallelPostFit):
+    """Ref: dask_ml/wrappers.py::Incremental +
+    dask_ml/_partial.py::fit."""
+
+    def __init__(self, estimator=None, scoring=None, shuffle_blocks=True,
+                 random_state=None, assume_equal_chunks=True):
+        self.estimator = estimator
+        self.scoring = scoring
+        self.shuffle_blocks = shuffle_blocks
+        self.random_state = random_state
+        self.assume_equal_chunks = assume_equal_chunks
+
+    def _partial_fit_pass(self, est, X, y, block_size, rng, **fit_kwargs):
+        Xh = X.to_numpy() if isinstance(X, ShardedArray) else np.asarray(X)
+        yh = y.to_numpy() if isinstance(y, ShardedArray) else np.asarray(y)
+        starts = list(range(0, len(Xh), block_size))
+        if self.shuffle_blocks:
+            rng.shuffle(starts)
+        for s in starts:
+            est.partial_fit(Xh[s:s + block_size], yh[s:s + block_size],
+                            **fit_kwargs)
+        return est
+
+    def fit(self, X, y=None, **fit_kwargs):
+        est = clone(self.estimator)
+        if not hasattr(est, "partial_fit"):
+            raise ValueError(
+                f"{type(est).__name__} has no partial_fit; Incremental "
+                "requires a partial_fit-capable estimator"
+            )
+        rng = np.random.RandomState(self.random_state)
+        self.estimator_ = self._partial_fit_pass(
+            est, X, y, self._block_size(X), rng, **fit_kwargs
+        )
+        return self
+
+    def partial_fit(self, X, y=None, **fit_kwargs):
+        est = getattr(self, "estimator_", None)
+        if est is None:
+            est = clone(self.estimator)
+        rng = np.random.RandomState(self.random_state)
+        self.estimator_ = self._partial_fit_pass(
+            est, X, y, self._block_size(X), rng, **fit_kwargs
+        )
+        return self
+
+    @staticmethod
+    def _block_size(X):
+        if isinstance(X, ShardedArray):
+            from .parallel.mesh import data_shards
+
+            return max(X.padded_shape[0] // data_shards(X.mesh), 1)
+        return max(len(X) // 8, 1)

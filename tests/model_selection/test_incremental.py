@@ -1,0 +1,114 @@
+"""Adaptive search tests (ref: tests/model_selection/test_incremental.py,
+test_hyperband.py, test_successive_halving.py)."""
+
+import numpy as np
+import pytest
+from scipy.stats import loguniform
+from sklearn.linear_model import SGDClassifier
+
+from dask_ml_tpu.datasets import make_classification
+from dask_ml_tpu.model_selection import (
+    HyperbandSearchCV,
+    IncrementalSearchCV,
+    SuccessiveHalvingSearchCV,
+)
+
+PARAMS = {"alpha": loguniform(1e-5, 1e-1), "eta0": [0.01, 0.1, 0.5]}
+
+
+def _sgd():
+    return SGDClassifier(tol=None, penalty="l2", random_state=0,
+                         learning_rate="constant")
+
+
+@pytest.fixture(scope="module")
+def data():
+    return make_classification(n_samples=600, n_features=10, random_state=1)
+
+
+def test_incremental_search(data):
+    X, y = data
+    search = IncrementalSearchCV(
+        _sgd(), PARAMS, n_initial_parameters=8, max_iter=20,
+        random_state=0, decay_rate=1.0,
+    )
+    search.fit(X, y, classes=[0.0, 1.0])
+    assert 0.5 < search.best_score_ <= 1.0
+    assert hasattr(search, "best_estimator_")
+    assert len(search.cv_results_["params"]) == 8
+    assert search.metadata_["n_models"] == 8
+    # history bookkeeping
+    assert all(
+        {"model_id", "params", "partial_fit_calls", "score"} <= set(r)
+        for r in search.history_
+    )
+    assert set(search.model_history_) == set(range(8))
+    # decay actually dropped models: later survivors are few
+    final_calls = search.cv_results_["partial_fit_calls"]
+    assert final_calls.max() > final_calls.min()
+    # post-fit API
+    pred = search.predict(X)
+    assert 0.0 <= search.score(X, y) <= 1.0
+    np.testing.assert_array_equal(search.classes_, [0.0, 1.0])
+
+
+def test_incremental_search_no_decay(data):
+    X, y = data
+    search = IncrementalSearchCV(
+        _sgd(), PARAMS, n_initial_parameters=3, max_iter=5,
+        decay_rate=None, random_state=0,
+    )
+    search.fit(X, y, classes=[0.0, 1.0])
+    calls = search.cv_results_["partial_fit_calls"]
+    assert (calls == 5).all()  # nobody dropped, everyone hits max_iter
+
+
+def test_successive_halving(data):
+    X, y = data
+    search = SuccessiveHalvingSearchCV(
+        _sgd(), PARAMS, n_initial_parameters=9, n_initial_iter=2,
+        max_iter=30, aggressiveness=3, random_state=0,
+    )
+    search.fit(X, y, classes=[0.0, 1.0])
+    calls = search.cv_results_["partial_fit_calls"]
+    # 9 models at rung0 (2 calls); 3 promoted to 6; 1 promoted to 18
+    assert (calls >= 2).all()
+    assert sorted(calls)[-1] >= 18
+    assert (calls == 2).sum() == 6  # two-thirds stopped at rung 0
+    assert search.best_score_ > 0.5
+
+
+def test_successive_halving_requires_n_initial_iter(data):
+    X, y = data
+    with pytest.raises(ValueError, match="n_initial_iter"):
+        SuccessiveHalvingSearchCV(_sgd(), PARAMS).fit(X, y)
+
+
+def test_hyperband(data):
+    X, y = data
+    search = HyperbandSearchCV(
+        _sgd(), PARAMS, max_iter=9, aggressiveness=3, random_state=0,
+    )
+    meta_planned = search.metadata()
+    search.fit(X, y, classes=[0.0, 1.0])
+    assert search.best_score_ > 0.5
+    assert search.metadata_["n_models"] == meta_planned["n_models"]
+    brackets = {b["bracket"] for b in search.metadata_["brackets"]}
+    assert brackets == {0, 1, 2}
+    assert {r["bracket"] for r in search.history_} == {0, 1, 2}
+    # cv_results_ merged across brackets with global ranks
+    n = len(search.cv_results_["params"])
+    assert n == search.metadata_["n_models"]
+    assert search.cv_results_["rank_test_score"].min() == 1
+    pred = search.predict(X)
+    assert 0.0 <= search.score(X, y) <= 1.0
+
+
+def test_hyperband_patience(data):
+    X, y = data
+    search = HyperbandSearchCV(
+        _sgd(), PARAMS, max_iter=9, aggressiveness=3, random_state=0,
+        patience=2, tol=1e-3,
+    )
+    search.fit(X, y, classes=[0.0, 1.0])
+    assert search.best_score_ > 0.5

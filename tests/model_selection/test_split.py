@@ -1,0 +1,88 @@
+"""Split tests (ref: tests/model_selection/test_split.py)."""
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu.datasets import make_classification
+from dask_ml_tpu.model_selection import KFold, ShuffleSplit, train_test_split
+from dask_ml_tpu.parallel import ShardedArray
+
+
+@pytest.fixture(scope="module")
+def data():
+    return make_classification(n_samples=500, n_features=6, random_state=0)
+
+
+def test_train_test_split_shapes(data):
+    X, y = data
+    Xtr, Xte, ytr, yte = train_test_split(X, y, test_size=0.2, random_state=0)
+    assert isinstance(Xtr, ShardedArray)
+    assert Xtr.shape[0] + Xte.shape[0] == 500
+    assert Xte.shape[0] == pytest.approx(100, abs=8)  # blockwise rounding
+    assert ytr.shape[0] == Xtr.shape[0]
+
+
+def test_train_test_split_no_overlap(data):
+    X, y = data
+    # tag each row with a unique value via the first feature
+    Xh = X.to_numpy().copy()  # to_numpy view of a jax array is read-only
+    Xh[:, 0] = np.arange(500)
+    Xs = ShardedArray.from_array(Xh, X.mesh)
+    Xtr, Xte = train_test_split(Xs, test_size=0.25, random_state=1)
+    ids_tr = set(Xtr.to_numpy()[:, 0].astype(int))
+    ids_te = set(Xte.to_numpy()[:, 0].astype(int))
+    assert not ids_tr & ids_te
+    assert len(ids_tr | ids_te) == 500
+
+
+def test_train_test_split_blockwise_false(data):
+    X, y = data
+    Xtr, Xte, ytr, yte = train_test_split(
+        X, y, test_size=0.2, blockwise=False, random_state=0
+    )
+    assert Xte.shape[0] == 100
+
+
+def test_train_test_split_numpy_arrays():
+    X = np.arange(100).reshape(50, 2)
+    y = np.arange(50)
+    Xtr, Xte, ytr, yte = train_test_split(X, y, test_size=0.2, random_state=0)
+    assert isinstance(Xtr, np.ndarray)
+    assert len(Xte) == 10
+
+
+def test_train_test_split_errors(data):
+    X, y = data
+    with pytest.raises(ValueError, match="inconsistent"):
+        train_test_split(X, np.arange(10))
+    with pytest.raises(ValueError):
+        train_test_split(X, test_size=0.9, train_size=0.9)
+
+
+def test_kfold(data):
+    X, _ = data
+    kf = KFold(n_splits=5)
+    folds = list(kf.split(X))
+    assert len(folds) == 5
+    all_test = np.concatenate([te for _, te in folds])
+    assert sorted(all_test) == list(range(500))
+    for tr, te in folds:
+        assert not set(tr) & set(te)
+        assert len(tr) + len(te) == 500
+
+
+def test_kfold_shuffle(data):
+    X, _ = data
+    f1 = list(KFold(n_splits=3, shuffle=True, random_state=0).split(X))
+    f2 = list(KFold(n_splits=3, shuffle=True, random_state=0).split(X))
+    np.testing.assert_array_equal(f1[0][1], f2[0][1])
+
+
+def test_shuffle_split(data):
+    X, _ = data
+    ss = ShuffleSplit(n_splits=3, test_size=0.2, random_state=0)
+    folds = list(ss.split(X))
+    assert len(folds) == 3
+    assert ss.get_n_splits() == 3
+    tr, te = folds[0]
+    assert not set(tr) & set(te)

@@ -40,3 +40,25 @@ def test_make_counts():
     X, y = datasets.make_counts(n_samples=80, n_features=5, random_state=3)
     yv = y.to_numpy()
     assert (yv >= 0).all() and (yv == yv.astype(int)).all()
+
+
+def test_make_classification_distinct_centers_all_seeds():
+    # regression: sampling centers with replacement could give two classes
+    # identical centers (~1/32 seeds) -> chance-level data
+    for seed in range(40):
+        X, y = datasets.make_classification(
+            n_samples=200, n_features=8, n_informative=4, random_state=seed
+        )
+        from sklearn.linear_model import LogisticRegression
+
+        acc = LogisticRegression(max_iter=500).fit(
+            X.to_numpy(), y.to_numpy()
+        ).score(X.to_numpy(), y.to_numpy())
+        assert acc > 0.8, f"seed={seed} acc={acc}"
+
+
+def test_make_classification_rejects_unknown_kwargs():
+    import pytest
+
+    with pytest.raises(TypeError):
+        datasets.make_classification(n_samples=10, weights=[0.9, 0.1])

@@ -1,0 +1,47 @@
+"""Blockwise ensemble tests (ref: tests for dask_ml/ensemble/_blockwise.py)."""
+
+import numpy as np
+import pytest
+from sklearn.linear_model import LinearRegression as SkLinear
+from sklearn.linear_model import LogisticRegression as SkLogistic
+
+from dask_ml_tpu.datasets import make_classification, make_regression
+from dask_ml_tpu.ensemble import (
+    BlockwiseVotingClassifier,
+    BlockwiseVotingRegressor,
+)
+from dask_ml_tpu.parallel import ShardedArray, default_mesh
+
+
+def test_voting_classifier_hard():
+    X, y = make_classification(n_samples=400, n_features=8, random_state=0)
+    clf = BlockwiseVotingClassifier(SkLogistic(max_iter=300)).fit(X, y)
+    assert len(clf.estimators_) == default_mesh().devices.size
+    pred = clf.predict(X)
+    assert isinstance(pred, ShardedArray)
+    assert clf.score(X, y) > 0.7
+    with pytest.raises(AttributeError, match="soft"):
+        clf.predict_proba(X)
+
+
+def test_voting_classifier_soft():
+    X, y = make_classification(n_samples=400, n_features=8, random_state=0)
+    clf = BlockwiseVotingClassifier(
+        SkLogistic(max_iter=300), voting="soft"
+    ).fit(X, y)
+    proba = clf.predict_proba(X).to_numpy()
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-6)
+    assert clf.score(X, y) > 0.7
+
+
+def test_voting_classifier_bad_voting():
+    X, y = make_classification(n_samples=100, n_features=4, random_state=0)
+    with pytest.raises(ValueError, match="voting"):
+        BlockwiseVotingClassifier(SkLogistic(), voting="mean").fit(X, y)
+
+
+def test_voting_regressor():
+    X, y = make_regression(n_samples=400, n_features=8, random_state=0)
+    reg = BlockwiseVotingRegressor(SkLinear()).fit(X, y)
+    assert len(reg.estimators_) == default_mesh().devices.size
+    assert reg.score(X, y) > 0.8

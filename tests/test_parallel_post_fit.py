@@ -1,0 +1,103 @@
+"""ParallelPostFit / Incremental wrapper tests (ref:
+tests/test_parallel_post_fit.py, tests/test_incremental.py)."""
+
+import numpy as np
+import pytest
+from sklearn.linear_model import LinearRegression as SkLinear
+from sklearn.linear_model import LogisticRegression as SkLogistic
+from sklearn.linear_model import SGDClassifier
+
+from dask_ml_tpu.datasets import make_classification
+from dask_ml_tpu.parallel import ShardedArray
+from dask_ml_tpu.wrappers import Incremental, ParallelPostFit
+
+
+@pytest.fixture(scope="module")
+def data():
+    return make_classification(n_samples=600, n_features=10, random_state=0)
+
+
+def test_parallel_post_fit_predict(data):
+    X, y = data
+    clf = ParallelPostFit(SkLogistic(max_iter=500)).fit(X, y)
+    pred = clf.predict(X)
+    assert isinstance(pred, ShardedArray)
+    # parity with running the inner estimator directly
+    inner = SkLogistic(max_iter=500).fit(X.to_numpy(), y.to_numpy())
+    np.testing.assert_array_equal(pred.to_numpy(), inner.predict(X.to_numpy()))
+    proba = clf.predict_proba(X)
+    np.testing.assert_allclose(
+        proba.to_numpy(), inner.predict_proba(X.to_numpy()), atol=1e-7
+    )
+    assert clf.score(X, y) == pytest.approx(
+        inner.score(X.to_numpy(), y.to_numpy()), abs=1e-6
+    )
+    np.testing.assert_array_equal(clf.classes_, inner.classes_)
+
+
+def test_parallel_post_fit_numpy_passthrough(data):
+    X, y = data
+    clf = ParallelPostFit(SkLogistic(max_iter=500)).fit(X, y)
+    pred = clf.predict(X.to_numpy())
+    assert isinstance(pred, np.ndarray)
+
+
+def test_parallel_post_fit_prefitted(data):
+    X, y = data
+    inner = SkLogistic(max_iter=500).fit(X.to_numpy(), y.to_numpy())
+    clf = ParallelPostFit(inner)  # no fit call
+    np.testing.assert_array_equal(
+        clf.predict(X).to_numpy(), inner.predict(X.to_numpy())
+    )
+
+
+def test_parallel_post_fit_wraps_device_estimator(data):
+    X, y = data
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    clf = ParallelPostFit(LogisticRegression(solver="lbfgs", max_iter=200))
+    clf.fit(X, y)
+    assert clf.score(X, y) > 0.7
+
+
+def test_parallel_post_fit_regressor_score(data):
+    X, y = data
+    reg = ParallelPostFit(SkLinear()).fit(X, y)
+    s = reg.score(X, y)
+    assert -1.0 <= s <= 1.0
+
+
+def test_incremental_fit(data):
+    X, y = data
+    inc = Incremental(SGDClassifier(random_state=0, max_iter=5, tol=None),
+                      shuffle_blocks=False, random_state=0)
+    inc.fit(X, y, classes=[0.0, 1.0])
+    assert hasattr(inc, "estimator_")
+    assert inc.score(X, y) > 0.6
+    pred = inc.predict(X)
+    assert isinstance(pred, ShardedArray)
+
+
+def test_incremental_partial_fit_accumulates(data):
+    X, y = data
+    inc = Incremental(SGDClassifier(random_state=0, tol=None), random_state=0)
+    inc.partial_fit(X, y, classes=[0.0, 1.0])
+    c1 = inc.estimator_.coef_.copy()
+    inc.partial_fit(X, y)
+    assert not np.allclose(c1, inc.estimator_.coef_)  # continued training
+
+
+def test_incremental_requires_partial_fit(data):
+    X, y = data
+    with pytest.raises(ValueError, match="partial_fit"):
+        Incremental(SkLinear()).fit(X, y)
+
+
+def test_incremental_scoring_param(data):
+    X, y = data
+    inc = Incremental(
+        SGDClassifier(random_state=0, tol=None), scoring="accuracy",
+        random_state=0,
+    )
+    inc.fit(X, y, classes=[0.0, 1.0])
+    assert 0.0 <= inc.score(X, y) <= 1.0

@@ -110,3 +110,40 @@ def test_incremental_pca_partial_fit():
         ipca.partial_fit(X[i:i + 50])
     assert ipca.n_samples_seen_ == 200
     assert ipca.components_.shape == (3, 8)
+
+
+def test_pca_variance_fraction():
+    ours = PCA(n_components=0.95, svd_solver="full").fit(X)
+    ref = skdec.PCA(n_components=0.95, svd_solver="full").fit(X)
+    assert ours.n_components_ == ref.n_components_
+    assert ours.components_.shape == ref.components_.shape
+
+
+def test_incremental_pca_fit_transform_uses_incremental_path():
+    ipca = IncrementalPCA(n_components=3, batch_size=50)
+    t = ipca.fit_transform(X)
+    np.testing.assert_allclose(
+        t.to_numpy(), ipca.transform(X).to_numpy(), atol=1e-5
+    )
+    assert ipca.n_samples_seen_ == len(X)
+
+
+def test_kmeans_tiny_dataset_oversampling_clamp():
+    from dask_ml_tpu.cluster import KMeans
+
+    Xs = np.random.RandomState(0).randn(10, 3)
+    km = KMeans(n_clusters=8, oversampling_factor=4, random_state=0).fit(Xs)
+    assert km.cluster_centers_.shape == (8, 3)
+
+
+def test_take_rows_bounds_check():
+    import pytest
+
+    from dask_ml_tpu.parallel import ShardedArray
+    from dask_ml_tpu.parallel.sharded import take_rows
+
+    sx = ShardedArray.from_array(np.arange(20.0).reshape(10, 2))
+    with pytest.raises(IndexError):
+        take_rows(sx, np.array([0, 10]))
+    with pytest.raises(IndexError):
+        take_rows(sx, np.array([-1]))
