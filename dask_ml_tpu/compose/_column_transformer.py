@@ -1,0 +1,139 @@
+"""ColumnTransformer / make_column_transformer.
+
+Reference: ``dask_ml/compose/`` (SURVEY.md §2a Compose row) —
+ColumnTransformer semantics over distributed frames/arrays. Columns are
+names (pandas DataFrame) or integer indices (arrays / ShardedArray);
+transformer outputs are horizontally concatenated, on device when every
+branch returns device arrays.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from ..base import BaseEstimator, TransformerMixin, clone
+from ..parallel.sharded import ShardedArray, as_sharded
+from ..utils.validation import check_is_fitted
+
+
+def _select(X, cols):
+    if isinstance(X, pd.DataFrame):
+        return X[cols] if isinstance(cols, list) else X[[cols]]
+    if isinstance(X, ShardedArray):
+        idx = np.atleast_1d(np.asarray(cols, dtype=int))
+        return ShardedArray(X.data[:, idx], X.n_rows, X.mesh)
+    X = np.asarray(X)
+    idx = np.atleast_1d(np.asarray(cols, dtype=int))
+    return X[:, idx]
+
+
+def _to_stackable(out):
+    if isinstance(out, ShardedArray):
+        return out
+    if isinstance(out, pd.DataFrame):
+        return out.to_numpy()
+    return np.asarray(out)
+
+
+class ColumnTransformer(TransformerMixin, BaseEstimator):
+    """Ref: dask_ml/compose::ColumnTransformer."""
+
+    def __init__(self, transformers, remainder="drop", sparse_threshold=0.3,
+                 n_jobs=None, transformer_weights=None, preserve_dataframe=True):
+        self.transformers = transformers
+        self.remainder = remainder
+        self.sparse_threshold = sparse_threshold
+        self.n_jobs = n_jobs
+        self.transformer_weights = transformer_weights
+        self.preserve_dataframe = preserve_dataframe
+
+    def _all_columns(self, X):
+        if isinstance(X, pd.DataFrame):
+            return list(X.columns)
+        return list(range(X.shape[1]))
+
+    def _remainder_cols(self, X):
+        used = []
+        for _, _, cols in self.transformers:
+            used.extend(cols if isinstance(cols, list) else [cols])
+        return [c for c in self._all_columns(X) if c not in used]
+
+    def fit(self, X, y=None):
+        self.fit_transform(X, y)
+        return self
+
+    def fit_transform(self, X, y=None):
+        if self.remainder not in ("drop", "passthrough"):
+            raise ValueError("remainder must be 'drop' or 'passthrough'")
+        self.transformers_ = []
+        outs = []
+        for name, trans, cols in self.transformers:
+            sub = _select(X, cols)
+            if trans == "drop":
+                self.transformers_.append((name, "drop", cols))
+                continue
+            if trans == "passthrough":
+                outs.append(_to_stackable(sub))
+                self.transformers_.append((name, "passthrough", cols))
+                continue
+            t = clone(trans)
+            out = t.fit_transform(sub, y) if hasattr(t, "fit_transform") \
+                else t.fit(sub, y).transform(sub)
+            outs.append(_to_stackable(out))
+            self.transformers_.append((name, t, cols))
+        if self.remainder == "passthrough":
+            rem = self._remainder_cols(X)
+            if rem:
+                outs.append(_to_stackable(_select(X, rem)))
+        self._rem_cols = (
+            self._remainder_cols(X) if self.remainder == "passthrough" else []
+        )
+        return self._hstack(outs, X)
+
+    def transform(self, X):
+        check_is_fitted(self, "transformers_")
+        outs = []
+        for name, t, cols in self.transformers_:
+            if t == "drop":
+                continue
+            sub = _select(X, cols)
+            if t == "passthrough":
+                outs.append(_to_stackable(sub))
+            else:
+                outs.append(_to_stackable(t.transform(sub)))
+        if self._rem_cols:
+            outs.append(_to_stackable(_select(X, self._rem_cols)))
+        return self._hstack(outs, X)
+
+    def _hstack(self, outs, X):
+        if not outs:
+            raise ValueError("no transformer outputs")
+        if all(isinstance(o, ShardedArray) for o in outs):
+            data = jnp.concatenate([o.data for o in outs], axis=1)
+            first = outs[0]
+            return ShardedArray(data, first.n_rows, first.mesh)
+        host = [
+            o.to_numpy() if isinstance(o, ShardedArray) else o for o in outs
+        ]
+        out = np.concatenate(host, axis=1)
+        if isinstance(X, ShardedArray):
+            return as_sharded(out, mesh=X.mesh)
+        return out
+
+    @property
+    def named_transformers_(self):
+        return {name: t for name, t, _ in self.transformers_}
+
+
+def make_column_transformer(*transformers, remainder="drop",
+                            sparse_threshold=0.3, n_jobs=None):
+    """Ref: dask_ml/compose::make_column_transformer."""
+    named = [
+        (f"{type(t).__name__.lower()}-{i}" if not isinstance(t, str)
+         else f"{t}-{i}", t, cols)
+        for i, (t, cols) in enumerate(transformers, 1)
+    ]
+    return ColumnTransformer(named, remainder=remainder,
+                             sparse_threshold=sparse_threshold, n_jobs=n_jobs)

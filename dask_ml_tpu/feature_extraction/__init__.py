@@ -1,0 +1,2 @@
+"""Ref: dask_ml/feature_extraction/__init__.py."""
+from . import text

@@ -1,0 +1,176 @@
+"""Text feature extraction: HashingVectorizer, FeatureHasher,
+CountVectorizer.
+
+Reference: ``dask_ml/feature_extraction/text.py`` (SURVEY.md §2a Text
+row): stateless hashing mapped per block producing scipy.sparse CSR
+blocks; CountVectorizer is embarrassingly parallel given a vocabulary,
+else builds the vocabulary distributedly.
+
+TPU design decision (SURVEY.md §7 hard parts, "Sparse"): tokenization and
+hashing are host-side string work (sklearn's C kernels per block — same
+per-block engine as the reference); the TPU-facing contract is
+``to_sharded_dense``: hash to a *modest* ``n_features`` and densify onto
+the mesh, the representation GLM/KMeans consume. Sparse CSR stays on host
+otherwise.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import scipy.sparse as sp
+import sklearn.feature_extraction.text as sktext
+from sklearn.feature_extraction import FeatureHasher as SkFeatureHasher
+
+from ..base import BaseEstimator, TransformerMixin
+from ..parallel.sharded import ShardedArray, as_sharded
+
+
+def _blocks(raw_documents, block_size=10_000):
+    docs = list(raw_documents) if not isinstance(
+        raw_documents, (list, np.ndarray)
+    ) else raw_documents
+    for i in range(0, len(docs), block_size):
+        yield docs[i:i + block_size]
+
+
+def to_sharded_dense(csr, mesh=None, dtype=np.float32) -> ShardedArray:
+    """Densify a (host) CSR matrix onto the mesh — the bridge from text
+    hashing to TPU estimators. Use a modest n_features."""
+    return as_sharded(np.asarray(csr.todense(), dtype=dtype), mesh=mesh)
+
+
+class HashingVectorizer(TransformerMixin, BaseEstimator):
+    """Ref: dask_ml/feature_extraction/text.py::HashingVectorizer."""
+
+    def __init__(self, input="content", encoding="utf-8",
+                 decode_error="strict", strip_accents=None, lowercase=True,
+                 preprocessor=None, tokenizer=None, stop_words=None,
+                 token_pattern=r"(?u)\b\w\w+\b", ngram_range=(1, 1),
+                 analyzer="word", n_features=2 ** 20, binary=False,
+                 norm="l2", alternate_sign=True, dtype=np.float64):
+        self.input = input
+        self.encoding = encoding
+        self.decode_error = decode_error
+        self.strip_accents = strip_accents
+        self.lowercase = lowercase
+        self.preprocessor = preprocessor
+        self.tokenizer = tokenizer
+        self.stop_words = stop_words
+        self.token_pattern = token_pattern
+        self.ngram_range = ngram_range
+        self.analyzer = analyzer
+        self.n_features = n_features
+        self.binary = binary
+        self.norm = norm
+        self.alternate_sign = alternate_sign
+        self.dtype = dtype
+
+    def _inner(self):
+        return sktext.HashingVectorizer(**self.get_params())
+
+    def fit(self, raw_documents, y=None):
+        return self  # stateless
+
+    def transform(self, raw_documents):
+        inner = self._inner()
+        parts = [inner.transform(b) for b in _blocks(raw_documents)]
+        return sp.vstack(parts).tocsr()
+
+    def fit_transform(self, raw_documents, y=None):
+        return self.transform(raw_documents)
+
+
+class FeatureHasher(TransformerMixin, BaseEstimator):
+    """Ref: dask_ml/feature_extraction/text.py::FeatureHasher."""
+
+    def __init__(self, n_features=2 ** 20, input_type="dict",
+                 dtype=np.float64, alternate_sign=True):
+        self.n_features = n_features
+        self.input_type = input_type
+        self.dtype = dtype
+        self.alternate_sign = alternate_sign
+
+    def fit(self, X=None, y=None):
+        return self
+
+    def transform(self, raw_X):
+        inner = SkFeatureHasher(**self.get_params())
+        parts = [inner.transform(b) for b in _blocks(list(raw_X))]
+        return sp.vstack(parts).tocsr()
+
+    def fit_transform(self, raw_X, y=None):
+        return self.transform(raw_X)
+
+
+class CountVectorizer(TransformerMixin, BaseEstimator):
+    """Ref: dask_ml/feature_extraction/text.py::CountVectorizer — with a
+    given ``vocabulary`` the transform is embarrassingly parallel; else
+    the vocabulary is the union of per-block vocabularies (the
+    reference's distributed vocabulary build, here a host reduce)."""
+
+    def __init__(self, input="content", encoding="utf-8",
+                 decode_error="strict", strip_accents=None, lowercase=True,
+                 preprocessor=None, tokenizer=None, stop_words=None,
+                 token_pattern=r"(?u)\b\w\w+\b", ngram_range=(1, 1),
+                 analyzer="word", max_df=1.0, min_df=1, max_features=None,
+                 vocabulary=None, binary=False, dtype=np.int64):
+        self.input = input
+        self.encoding = encoding
+        self.decode_error = decode_error
+        self.strip_accents = strip_accents
+        self.lowercase = lowercase
+        self.preprocessor = preprocessor
+        self.tokenizer = tokenizer
+        self.stop_words = stop_words
+        self.token_pattern = token_pattern
+        self.ngram_range = ngram_range
+        self.analyzer = analyzer
+        self.max_df = max_df
+        self.min_df = min_df
+        self.max_features = max_features
+        self.vocabulary = vocabulary
+        self.binary = binary
+        self.dtype = dtype
+
+    def fit(self, raw_documents, y=None):
+        self.fit_transform(raw_documents)
+        return self
+
+    def _build_vocabulary(self, raw_documents):
+        vocab = set()
+        for block in _blocks(raw_documents):
+            cv = sktext.CountVectorizer(**self.get_params())
+            cv.set_params(vocabulary=None, max_df=1.0, min_df=1,
+                          max_features=None)
+            cv.fit(block)
+            vocab.update(cv.vocabulary_)
+        return {t: i for i, t in enumerate(sorted(vocab))}
+
+    def fit_transform(self, raw_documents, y=None):
+        if self.vocabulary is not None:
+            vocab = self.vocabulary
+            if not isinstance(vocab, dict):
+                vocab = {t: i for i, t in enumerate(vocab)}
+        else:
+            vocab = self._build_vocabulary(raw_documents)
+        self.vocabulary_ = vocab
+        return self.transform(raw_documents)
+
+    def transform(self, raw_documents):
+        if not hasattr(self, "vocabulary_"):
+            if self.vocabulary is None:
+                raise ValueError("CountVectorizer is not fitted")
+            self.vocabulary_ = (
+                self.vocabulary if isinstance(self.vocabulary, dict)
+                else {t: i for i, t in enumerate(self.vocabulary)}
+            )
+        params = self.get_params()
+        params["vocabulary"] = self.vocabulary_
+        inner = sktext.CountVectorizer(**params)
+        parts = [inner.transform(b) for b in _blocks(raw_documents)]
+        return sp.vstack(parts).tocsr()
+
+    def get_feature_names_out(self, input_features=None):
+        return np.asarray(
+            sorted(self.vocabulary_, key=self.vocabulary_.get), dtype=object
+        )

@@ -1,0 +1,102 @@
+"""GaussianNB on sharded arrays.
+
+Reference: ``dask_ml/naive_bayes.py`` (SURVEY.md §2a Naive Bayes row) —
+per-class mean/var via masked reductions. Here the per-class statistics
+are one jitted program (class masks × masked reductions, psum under
+sharding) and the joint log-likelihood predict is a fused elementwise +
+matmul program.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import BaseEstimator, ClassifierMixin, to_host
+from .metrics import accuracy_score
+from .parallel.sharded import ShardedArray
+from .utils.validation import check_X_y, check_array, check_is_fitted
+
+
+@jax.jit
+def _class_stats(X, y, mask, classes):
+    """Per-class count/mean/var in one pass. classes: (k,) values."""
+    cmask = (y[None, :] == classes[:, None]).astype(X.dtype) * mask[None, :]
+    counts = jnp.sum(cmask, axis=1)                      # (k,)
+    sums = cmask @ X                                     # (k, d) on MXU
+    means = sums / jnp.maximum(counts[:, None], 1.0)
+    sq = cmask @ (X * X)
+    var = sq / jnp.maximum(counts[:, None], 1.0) - means ** 2
+    return counts, means, jnp.maximum(var, 0.0)
+
+
+@jax.jit
+def _joint_log_likelihood(X, theta, var, log_prior):
+    # -0.5 * sum((x-mu)^2/var) - 0.5*sum(log 2 pi var) + log prior
+    prec = 1.0 / var                                     # (k, d)
+    x2 = (X * X) @ prec.T                                # (n, k)
+    xm = X @ (theta * prec).T
+    m2 = jnp.sum(theta * theta * prec, axis=1)
+    quad = x2 - 2.0 * xm + m2[None, :]
+    logdet = jnp.sum(jnp.log(2.0 * jnp.pi * var), axis=1)
+    return -0.5 * (quad + logdet[None, :]) + log_prior[None, :]
+
+
+class GaussianNB(ClassifierMixin, BaseEstimator):
+    """Ref: dask_ml/naive_bayes.py::GaussianNB."""
+
+    def __init__(self, priors=None, var_smoothing=1e-9):
+        self.priors = priors
+        self.var_smoothing = var_smoothing
+
+    def fit(self, X, y):
+        X, y = check_X_y(X, y, dtype=np.float32)
+        mask = X.row_mask(X.dtype)
+        classes = np.unique(y.to_numpy())
+        counts, means, var = _class_stats(
+            X.data, y.data, mask, jnp.asarray(classes, X.dtype)
+        )
+        # sklearn's numerical floor on variances
+        from .ops.reductions import masked_mean_var
+
+        _, gvar = masked_mean_var(X.data, mask, X.n_rows)
+        eps = self.var_smoothing * float(jnp.max(gvar))
+        self.classes_ = classes
+        self.class_count_ = to_host(counts).astype(np.float64)
+        self.theta_ = to_host(means).astype(np.float64)
+        self.var_ = to_host(var).astype(np.float64) + eps
+        if self.priors is not None:
+            self.class_prior_ = np.asarray(self.priors, np.float64)
+        else:
+            self.class_prior_ = self.class_count_ / self.class_count_.sum()
+        self.n_features_in_ = X.shape[1]
+        return self
+
+    def _jll(self, X):
+        X = check_array(X, dtype=np.float32)
+        return X, _joint_log_likelihood(
+            X.data,
+            jnp.asarray(self.theta_, X.dtype),
+            jnp.asarray(self.var_, X.dtype),
+            jnp.asarray(np.log(self.class_prior_), X.dtype),
+        )
+
+    def predict(self, X):
+        check_is_fitted(self, "theta_")
+        X, jll = self._jll(X)
+        idx = to_host(jnp.argmax(jll, axis=1))[: X.n_rows]
+        return self.classes_[idx]
+
+    def predict_proba(self, X):
+        check_is_fitted(self, "theta_")
+        X, jll = self._jll(X)
+        p = to_host(jax.nn.softmax(jll, axis=1))[: X.n_rows]
+        return p
+
+    def predict_log_proba(self, X):
+        return np.log(self.predict_proba(X))
+
+    def score(self, X, y):
+        y = y.to_numpy() if isinstance(y, ShardedArray) else np.asarray(y)
+        return accuracy_score(y, self.predict(X))

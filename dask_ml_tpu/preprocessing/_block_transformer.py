@@ -1,0 +1,37 @@
+"""BlockTransformer: stateless per-block function application.
+
+Reference: ``dask_ml/preprocessing/_block_transformer.py`` (SURVEY.md §2a
+encoders row). Here "per block" is the whole sharded array under one jit
+when the function is jax-traceable (XLA fuses it); host numpy is the
+fallback for non-traceable functions.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import BaseEstimator, TransformerMixin
+from ..parallel.sharded import ShardedArray, as_sharded
+
+
+class BlockTransformer(TransformerMixin, BaseEstimator):
+    """Ref: _block_transformer.py::BlockTransformer."""
+
+    def __init__(self, func, validate=False, **kw_args):
+        self.func = func
+        self.validate = validate
+        self.kw_args = kw_args
+
+    def fit(self, X, y=None):
+        return self
+
+    def transform(self, X, y=None):
+        kwargs = self.kw_args or {}
+        if isinstance(X, ShardedArray):
+            try:
+                out = self.func(X.data, **kwargs)
+                return ShardedArray(out, X.n_rows, X.mesh)
+            except Exception:
+                out = self.func(X.to_numpy(), **kwargs)
+                return as_sharded(np.asarray(out), mesh=X.mesh)
+        return self.func(X, **kwargs)

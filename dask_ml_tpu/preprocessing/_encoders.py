@@ -1,0 +1,280 @@
+"""OneHotEncoder / OrdinalEncoder.
+
+Reference: ``dask_ml/preprocessing/_encoders.py`` +
+``dask_ml/preprocessing/data.py::{Categorizer, DummyEncoder,
+OrdinalEncoder}`` (SURVEY.md §2a encoders rows). The reference has two
+paths: a pandas-categorical fast path and an array path that wants known
+categories. Here:
+
+- array path: categories per column either given or derived (one host
+  pass); transform is a fused device comparison program producing dense
+  one-hot (TPU has no sparse — SURVEY.md §7 hard parts).
+- DataFrame path (Categorizer / DummyEncoder / OrdinalEncoder): pandas
+  categorical semantics on host, matching the reference's dtype-driven
+  behavior.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from ..base import BaseEstimator, TransformerMixin, to_host
+from ..parallel.sharded import ShardedArray
+from ..utils.validation import check_is_fitted
+
+
+def _column_categories(col):
+    return np.unique(col)
+
+
+class OneHotEncoder(TransformerMixin, BaseEstimator):
+    """Ref: dask_ml/preprocessing/_encoders.py::OneHotEncoder. Dense
+    output only (sparse_output=False default; True raises — no sparse on
+    TPU)."""
+
+    def __init__(self, categories="auto", drop=None, sparse_output=False,
+                 dtype=np.float32, handle_unknown="error"):
+        self.categories = categories
+        self.drop = drop
+        self.sparse_output = sparse_output
+        self.dtype = dtype
+        self.handle_unknown = handle_unknown
+
+    def fit(self, X, y=None):
+        if self.sparse_output:
+            raise ValueError(
+                "sparse_output=True is not supported on TPU; dense one-hot "
+                "only (reference requires scipy.sparse here)"
+            )
+        if self.drop is not None:
+            raise NotImplementedError("drop is not yet supported")
+        if isinstance(X, pd.DataFrame):
+            self._frame = True
+            self.categories_ = [
+                np.asarray(X[c].cat.categories)
+                if isinstance(X[c].dtype, pd.CategoricalDtype)
+                else _column_categories(X[c].to_numpy())
+                for c in X.columns
+            ]
+            self.feature_names_in_ = np.asarray(X.columns, dtype=object)
+        else:
+            self._frame = False
+            Xh = X.to_numpy() if isinstance(X, ShardedArray) else np.asarray(X)
+            if self.categories == "auto":
+                self.categories_ = [
+                    _column_categories(Xh[:, j]) for j in range(Xh.shape[1])
+                ]
+            else:
+                self.categories_ = [np.asarray(c) for c in self.categories]
+        self.n_features_in_ = len(self.categories_)
+        return self
+
+    def transform(self, X):
+        check_is_fitted(self, "categories_")
+        if isinstance(X, pd.DataFrame):
+            cols = [X[c].to_numpy() for c in X.columns]
+            mesh = None
+        elif isinstance(X, ShardedArray):
+            cols = None
+            mesh = X.mesh
+        else:
+            X = np.asarray(X)
+            cols = [X[:, j] for j in range(X.shape[1])]
+            mesh = None
+
+        if cols is not None:  # host path
+            outs = []
+            for col, cats in zip(cols, self.categories_):
+                unknown = ~np.isin(col, cats)
+                if unknown.any() and self.handle_unknown == "error":
+                    raise ValueError(
+                        f"found unknown categories {np.unique(col[unknown])}"
+                    )
+                onehot = (col[:, None] == cats[None, :]).astype(self.dtype)
+                outs.append(onehot)
+            return np.concatenate(outs, axis=1)
+
+        # device path: fused comparisons per column
+        data = X.data
+        mask = X.row_mask(data.dtype)
+        outs = []
+        for j, cats in enumerate(self.categories_):
+            cats_d = jnp.asarray(cats, data.dtype)
+            onehot = (data[:, j][:, None] == cats_d[None, :]).astype(data.dtype)
+            outs.append(onehot)
+        out = jnp.concatenate(outs, axis=1) * mask[:, None]
+        if self.handle_unknown == "error":
+            # a row with no matching category in some column is unknown
+            start = 0
+            host_check = to_host(out)
+            for cats in self.categories_:
+                seg = host_check[: X.n_rows, start:start + len(cats)]
+                if (seg.sum(axis=1) == 0).any():
+                    raise ValueError("found unknown categories in input")
+                start += len(cats)
+        return ShardedArray(out, X.n_rows, X.mesh)
+
+    def get_feature_names_out(self, input_features=None):
+        check_is_fitted(self, "categories_")
+        if input_features is None:
+            input_features = getattr(
+                self, "feature_names_in_",
+                [f"x{i}" for i in range(self.n_features_in_)],
+            )
+        return np.asarray([
+            f"{f}_{c}" for f, cats in zip(input_features, self.categories_)
+            for c in cats
+        ], dtype=object)
+
+
+class OrdinalEncoder(TransformerMixin, BaseEstimator):
+    """Ref: dask_ml/preprocessing/data.py::OrdinalEncoder — DataFrame
+    categorical-dtype based; array path maps via per-column categories."""
+
+    def __init__(self, categories="auto", dtype=np.float32):
+        self.categories = categories
+        self.dtype = dtype
+
+    def fit(self, X, y=None):
+        if isinstance(X, pd.DataFrame):
+            self.categorical_columns_ = [
+                c for c in X.columns
+                if isinstance(X[c].dtype, pd.CategoricalDtype)
+            ]
+            self.categories_ = [
+                np.asarray(X[c].cat.categories)
+                for c in self.categorical_columns_
+            ]
+            self.columns_ = np.asarray(X.columns, dtype=object)
+        else:
+            Xh = X.to_numpy() if isinstance(X, ShardedArray) else np.asarray(X)
+            if self.categories == "auto":
+                self.categories_ = [
+                    _column_categories(Xh[:, j]) for j in range(Xh.shape[1])
+                ]
+            else:
+                self.categories_ = [np.asarray(c) for c in self.categories]
+        self.n_features_in_ = (
+            len(self.columns_) if hasattr(self, "columns_")
+            else len(self.categories_)
+        )
+        return self
+
+    def transform(self, X):
+        check_is_fitted(self, "categories_")
+        if isinstance(X, pd.DataFrame):
+            out = X.copy()
+            for c in self.categorical_columns_:
+                out[c] = X[c].cat.codes
+            return out
+        Xh = X.to_numpy() if isinstance(X, ShardedArray) else np.asarray(X)
+        cols = []
+        for j, cats in enumerate(self.categories_):
+            codes = np.searchsorted(cats, Xh[:, j])
+            cols.append(codes.astype(self.dtype))
+        out = np.stack(cols, axis=1)
+        if isinstance(X, ShardedArray):
+            return ShardedArray.from_array(out, X.mesh)
+        return out
+
+
+class Categorizer(TransformerMixin, BaseEstimator):
+    """Ref: dask_ml/preprocessing/data.py::Categorizer — convert object /
+    string columns of a DataFrame to pandas categorical dtype (the dtype
+    contract DummyEncoder/OrdinalEncoder consume)."""
+
+    def __init__(self, categories=None, columns=None):
+        self.categories = categories
+        self.columns = columns
+
+    def fit(self, X, y=None):
+        if not isinstance(X, pd.DataFrame):
+            raise TypeError("Categorizer requires a pandas DataFrame")
+        columns = self.columns
+        if columns is None:
+            # object (pandas<3) or str/string (pandas>=3) or categorical
+            columns = [
+                c for c in X.columns
+                if pd.api.types.is_object_dtype(X[c].dtype)
+                or pd.api.types.is_string_dtype(X[c].dtype)
+                or isinstance(X[c].dtype, pd.CategoricalDtype)
+            ]
+        categories = {}
+        for c in columns:
+            if self.categories is not None and c in self.categories:
+                categories[c] = self.categories[c]
+            elif isinstance(X[c].dtype, pd.CategoricalDtype):
+                categories[c] = X[c].dtype
+            else:
+                categories[c] = pd.CategoricalDtype(
+                    pd.unique(X[c].dropna())
+                )
+        self.categories_ = categories
+        self.columns_ = pd.Index(columns)
+        return self
+
+    def transform(self, X, y=None):
+        check_is_fitted(self, "categories_")
+        X = X.copy()
+        for c, dtype in self.categories_.items():
+            X[c] = X[c].astype(dtype)
+        return X
+
+
+class DummyEncoder(TransformerMixin, BaseEstimator):
+    """Ref: dask_ml/preprocessing/data.py::DummyEncoder — pd.get_dummies
+    on categorical-dtype columns with stable column order."""
+
+    def __init__(self, columns=None, drop_first=False):
+        self.columns = columns
+        self.drop_first = drop_first
+
+    def fit(self, X, y=None):
+        if not isinstance(X, pd.DataFrame):
+            raise TypeError("DummyEncoder requires a pandas DataFrame")
+        columns = self.columns
+        if columns is None:
+            columns = [
+                c for c in X.columns
+                if isinstance(X[c].dtype, pd.CategoricalDtype)
+            ]
+        for c in columns:
+            if not isinstance(X[c].dtype, pd.CategoricalDtype):
+                raise ValueError(
+                    f"column {c!r} is not categorical; run Categorizer first"
+                )
+        self.columns_ = pd.Index(columns)
+        self.categorical_columns_ = self.columns_
+        self.non_categorical_columns_ = X.columns.drop(self.columns_)
+        self.transformed_columns_ = pd.Index(
+            list(self.non_categorical_columns_) + [
+                f"{c}_{cat}" for c in self.columns_
+                for cat in (
+                    X[c].cat.categories[1:] if self.drop_first
+                    else X[c].cat.categories
+                )
+            ]
+        )
+        return self
+
+    def transform(self, X, y=None):
+        check_is_fitted(self, "columns_")
+        out = pd.get_dummies(X, columns=list(self.columns_),
+                             drop_first=self.drop_first)
+        return out.reindex(columns=self.transformed_columns_, fill_value=0)
+
+    def inverse_transform(self, X):
+        check_is_fitted(self, "columns_")
+        out = X[list(self.non_categorical_columns_)].copy()
+        for c in self.columns_:
+            prefix = f"{c}_"
+            dummy_cols = [
+                col for col in X.columns if str(col).startswith(prefix)
+            ]
+            cats = [str(col)[len(prefix):] for col in dummy_cols]
+            out[c] = pd.Categorical.from_codes(
+                np.argmax(X[dummy_cols].to_numpy(), axis=1), cats
+            )
+        return out

@@ -1,0 +1,63 @@
+"""LabelEncoder.
+
+Reference: ``dask_ml/preprocessing/label.py`` (SURVEY.md §2a encoders
+row): classes from data (or a pandas categorical fast path via
+``use_categorical``), transform = map values to ordinal codes. Here the
+mapping is a device ``searchsorted`` over the sorted class vector.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from ..base import BaseEstimator, TransformerMixin, to_host
+from ..parallel.sharded import ShardedArray, as_sharded
+from ..utils.validation import check_is_fitted
+
+
+class LabelEncoder(TransformerMixin, BaseEstimator):
+    """Ref: dask_ml/preprocessing/label.py::LabelEncoder."""
+
+    def __init__(self, use_categorical=True):
+        self.use_categorical = use_categorical
+
+    def fit(self, y):
+        if isinstance(y, pd.Series) and self.use_categorical and \
+                isinstance(y.dtype, pd.CategoricalDtype):
+            self.classes_ = np.asarray(y.cat.categories)
+            self.dtype_ = y.dtype
+            return self
+        yh = y.to_numpy() if isinstance(y, ShardedArray) else np.asarray(y)
+        self.classes_ = np.unique(yh)
+        self.dtype_ = None
+        return self
+
+    def fit_transform(self, y):
+        return self.fit(y).transform(y)
+
+    def transform(self, y):
+        check_is_fitted(self, "classes_")
+        if isinstance(y, pd.Series) and self.dtype_ is not None and \
+                y.dtype == self.dtype_:
+            return np.asarray(y.cat.codes)
+        if isinstance(y, ShardedArray):
+            classes = jnp.asarray(self.classes_, y.dtype)
+            codes = jnp.searchsorted(classes, y.data)
+            self._check_membership(y.to_numpy())
+            return ShardedArray(codes, y.n_rows, y.mesh)
+        yh = np.asarray(y)
+        self._check_membership(yh)
+        return np.searchsorted(self.classes_, yh)
+
+    def _check_membership(self, yh):
+        extra = np.setdiff1d(yh, self.classes_)
+        if len(extra):
+            raise ValueError(f"y contains previously unseen labels: {extra}")
+
+    def inverse_transform(self, y):
+        check_is_fitted(self, "classes_")
+        if isinstance(y, ShardedArray):
+            y = y.to_numpy()
+        return self.classes_[np.asarray(y).astype(int)]

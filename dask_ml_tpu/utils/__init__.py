@@ -1,1 +1,17 @@
+"""Ref: dask_ml/utils.py (SURVEY.md §2a Support row)."""
+import numpy as np
+
+from .testing import assert_estimator_equal, copy_learned_attributes
 from .validation import check_array, check_is_fitted, check_X_y
+
+
+def handle_zeros_in_scale(scale):
+    """Ref: dask_ml/utils.py::handle_zeros_in_scale."""
+    return np.where(scale == 0.0, 1.0, scale)
+
+
+def slice_columns(X, columns):
+    """Ref: dask_ml/utils.py::slice_columns."""
+    from ..compose._column_transformer import _select
+
+    return _select(X, columns)

@@ -1,0 +1,19 @@
+"""XGBoost bridge.
+
+Reference: ``dask_ml/xgboost.py`` (SURVEY.md §2a xgboost row) — a thin
+re-export of dask-xgboost's train/predict and sklearn wrappers, later
+deprecated upstream in favor of ``xgboost.dask``. xgboost is not in this
+image, so the bridge is gated: importing the module works; using any
+symbol raises with the upstream guidance.
+"""
+
+
+def __getattr__(name):
+    if name in ("train", "predict", "XGBClassifier", "XGBRegressor"):
+        raise ImportError(
+            f"dask_ml_tpu.xgboost.{name} requires the 'xgboost' package, "
+            "which is not installed in this environment. Upstream dask-ml "
+            "deprecated this bridge in favor of xgboost's native "
+            "distributed API; use that with jax arrays via DMatrix."
+        )
+    raise AttributeError(name)

@@ -1,0 +1,156 @@
+"""Encoder/label/imputer/naive-bayes tests (ref:
+tests/preprocessing/test_data.py etc.; sklearn/pandas as oracles)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+import sklearn.preprocessing as skpre
+
+from dask_ml_tpu import preprocessing as pre
+from dask_ml_tpu.impute import SimpleImputer
+from dask_ml_tpu.naive_bayes import GaussianNB
+from dask_ml_tpu.parallel import ShardedArray
+
+
+def test_label_encoder_array():
+    y = np.array([3.0, 1.0, 2.0, 1.0, 3.0])
+    le = pre.LabelEncoder().fit(y)
+    ref = skpre.LabelEncoder().fit(y)
+    np.testing.assert_array_equal(le.classes_, ref.classes_)
+    np.testing.assert_array_equal(le.transform(y), ref.transform(y))
+    np.testing.assert_array_equal(le.inverse_transform(le.transform(y)), y)
+    with pytest.raises(ValueError, match="unseen"):
+        le.transform(np.array([5.0]))
+
+
+def test_label_encoder_sharded():
+    y = np.array([2.0, 0.0, 2.0, 4.0, 0.0, 2.0, 4.0])
+    sy = ShardedArray.from_array(y)
+    le = pre.LabelEncoder().fit(sy)
+    codes = le.transform(sy)
+    assert isinstance(codes, ShardedArray)
+    np.testing.assert_array_equal(
+        codes.to_numpy(), skpre.LabelEncoder().fit_transform(y)
+    )
+
+
+def test_label_encoder_categorical_fast_path():
+    s = pd.Series(["a", "b", "a", "c"], dtype="category")
+    le = pre.LabelEncoder().fit(s)
+    np.testing.assert_array_equal(le.classes_, ["a", "b", "c"])
+    np.testing.assert_array_equal(le.transform(s), [0, 1, 0, 2])
+
+
+def test_one_hot_encoder_array():
+    X = np.array([[0.0, 1.0], [1.0, 2.0], [0.0, 1.0]])
+    ohe = pre.OneHotEncoder().fit(X)
+    ref = skpre.OneHotEncoder(sparse_output=False).fit(X)
+    np.testing.assert_allclose(ohe.transform(X), ref.transform(X))
+    assert list(ohe.get_feature_names_out()) == list(
+        ref.get_feature_names_out()
+    )
+
+
+def test_one_hot_encoder_sharded_device_path():
+    X = np.array([[0.0], [1.0], [2.0], [1.0], [0.0]])
+    sx = ShardedArray.from_array(X)
+    ohe = pre.OneHotEncoder().fit(sx)
+    out = ohe.transform(sx)
+    assert isinstance(out, ShardedArray)
+    ref = skpre.OneHotEncoder(sparse_output=False).fit_transform(X)
+    np.testing.assert_allclose(out.to_numpy(), ref)
+
+
+def test_one_hot_encoder_unknown_raises():
+    X = np.array([[0.0], [1.0]])
+    ohe = pre.OneHotEncoder().fit(X)
+    with pytest.raises(ValueError, match="unknown"):
+        ohe.transform(np.array([[2.0]]))
+    with pytest.raises(ValueError, match="sparse"):
+        pre.OneHotEncoder(sparse_output=True).fit(X)
+
+
+def test_ordinal_encoder_dataframe():
+    df = pd.DataFrame({
+        "a": pd.Categorical(["x", "y", "x"]),
+        "b": [1.0, 2.0, 3.0],
+    })
+    oe = pre.OrdinalEncoder().fit(df)
+    out = oe.transform(df)
+    np.testing.assert_array_equal(out["a"], [0, 1, 0])
+    np.testing.assert_array_equal(out["b"], df["b"])
+
+
+def test_categorizer_and_dummy_encoder():
+    df = pd.DataFrame({
+        "a": ["x", "y", "x", "z"],
+        "b": [1.0, 2.0, 3.0, 4.0],
+    })
+    cat = pre.Categorizer().fit(df)
+    dfc = cat.transform(df)
+    assert isinstance(dfc["a"].dtype, pd.CategoricalDtype)
+    de = pre.DummyEncoder().fit(dfc)
+    out = de.transform(dfc)
+    assert set(out.columns) == {"b", "a_x", "a_y", "a_z"}
+    back = de.inverse_transform(out)
+    np.testing.assert_array_equal(back["a"].astype(str), df["a"])
+    with pytest.raises(ValueError, match="categorical"):
+        pre.DummyEncoder(columns=["a"]).fit(df)  # not categorized
+
+
+def test_block_transformer():
+    X = np.abs(np.random.RandomState(0).randn(40, 3)) + 1.0
+    sx = ShardedArray.from_array(X)
+    import jax.numpy as jnp
+
+    bt = pre.BlockTransformer(jnp.log)
+    out = bt.fit(sx).transform(sx)
+    np.testing.assert_allclose(out.to_numpy(), np.log(X), rtol=1e-5)
+    np.testing.assert_allclose(
+        pre.BlockTransformer(np.log1p).transform(X), np.log1p(X)
+    )
+
+
+@pytest.mark.parametrize("strategy,fill", [
+    ("mean", None), ("median", None), ("most_frequent", None),
+    ("constant", 7.0),
+])
+def test_simple_imputer(strategy, fill):
+    from sklearn.impute import SimpleImputer as SkImputer
+
+    X = np.array([
+        [1.0, 2.0], [np.nan, 3.0], [7.0, np.nan], [7.0, 6.0], [4.0, 6.0],
+    ])
+    ours = SimpleImputer(strategy=strategy, fill_value=fill).fit(X)
+    ref = SkImputer(strategy=strategy, fill_value=fill).fit(X)
+    np.testing.assert_allclose(
+        ours.statistics_, ref.statistics_.astype(float), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        ours.transform(X).to_numpy(), ref.transform(X), rtol=1e-5
+    )
+
+
+def test_simple_imputer_bad_strategy():
+    with pytest.raises(ValueError, match="strategy"):
+        SimpleImputer(strategy="mode").fit(np.zeros((3, 2)))
+
+
+def test_gaussian_nb_parity():
+    from sklearn.naive_bayes import GaussianNB as SkGNB
+
+    from dask_ml_tpu.datasets import make_classification
+
+    X, y = make_classification(n_samples=400, n_features=6, random_state=0)
+    ours = GaussianNB().fit(X, y)
+    ref = SkGNB().fit(X.to_numpy(), y.to_numpy())
+    np.testing.assert_allclose(ours.theta_, ref.theta_, atol=1e-4)
+    np.testing.assert_allclose(ours.var_, ref.var_, rtol=1e-3)
+    np.testing.assert_allclose(ours.class_prior_, ref.class_prior_, atol=1e-6)
+    np.testing.assert_array_equal(ours.predict(X), ref.predict(X.to_numpy()))
+    np.testing.assert_allclose(
+        ours.predict_proba(X), ref.predict_proba(X.to_numpy()), atol=1e-4
+    )
+    assert ours.score(X, y) == pytest.approx(
+        ref.score(X.to_numpy(), y.to_numpy()), abs=1e-6
+    )

@@ -1,0 +1,152 @@
+"""Text / compose / utils tests (ref: tests for feature_extraction,
+compose, utils in the reference)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+import scipy.sparse as sp
+
+from dask_ml_tpu.compose import ColumnTransformer, make_column_transformer
+from dask_ml_tpu.feature_extraction.text import (
+    CountVectorizer,
+    FeatureHasher,
+    HashingVectorizer,
+    to_sharded_dense,
+)
+from dask_ml_tpu.parallel import ShardedArray
+from dask_ml_tpu.preprocessing import StandardScaler
+from dask_ml_tpu.utils import (
+    assert_estimator_equal,
+    copy_learned_attributes,
+    handle_zeros_in_scale,
+)
+
+DOCS = [
+    "the quick brown fox", "jumps over the lazy dog",
+    "the dog barks", "quick quick fox",
+] * 5
+
+
+def test_hashing_vectorizer_matches_sklearn():
+    import sklearn.feature_extraction.text as sktext
+
+    ours = HashingVectorizer(n_features=256).transform(DOCS)
+    ref = sktext.HashingVectorizer(n_features=256).transform(DOCS)
+    assert sp.issparse(ours)
+    np.testing.assert_allclose(ours.toarray(), ref.toarray())
+
+
+def test_hashing_to_sharded_dense():
+    csr = HashingVectorizer(n_features=64).transform(DOCS)
+    dense = to_sharded_dense(csr)
+    assert isinstance(dense, ShardedArray)
+    assert dense.shape == (len(DOCS), 64)
+
+
+def test_feature_hasher():
+    from sklearn.feature_extraction import FeatureHasher as SkFH
+
+    data = [{"a": 1, "b": 2}, {"a": 3, "c": 1}] * 4
+    ours = FeatureHasher(n_features=32).transform(data)
+    ref = SkFH(n_features=32).transform(data)
+    np.testing.assert_allclose(ours.toarray(), ref.toarray())
+
+
+def test_count_vectorizer_auto_vocabulary():
+    import sklearn.feature_extraction.text as sktext
+
+    ours = CountVectorizer()
+    got = ours.fit_transform(DOCS)
+    ref = sktext.CountVectorizer().fit(DOCS)
+    assert ours.vocabulary_ == ref.vocabulary_
+    np.testing.assert_array_equal(
+        got.toarray(), ref.transform(DOCS).toarray()
+    )
+
+
+def test_count_vectorizer_given_vocabulary():
+    vocab = ["dog", "fox", "quick"]
+    got = CountVectorizer(vocabulary=vocab).transform(DOCS)
+    assert got.shape == (len(DOCS), 3)
+    assert list(
+        CountVectorizer(vocabulary=vocab).fit(DOCS).get_feature_names_out()
+    ) == vocab
+
+
+def test_column_transformer_sharded():
+    X = np.random.RandomState(0).lognormal(size=(60, 4))
+    sx = ShardedArray.from_array(X)
+    ct = ColumnTransformer([
+        ("scale", StandardScaler(), [0, 1]),
+        ("keep", "passthrough", [2]),
+    ])
+    out = ct.fit_transform(sx)
+    assert isinstance(out, ShardedArray)
+    assert out.shape == (60, 3)
+    got = out.to_numpy()
+    np.testing.assert_allclose(got[:, 2], X[:, 2], rtol=1e-5)
+    assert abs(got[:, 0].mean()) < 1e-4  # scaled
+    # transform path matches fit_transform
+    np.testing.assert_allclose(
+        ct.transform(sx).to_numpy(), got, atol=1e-5
+    )
+
+
+def test_column_transformer_dataframe_remainder():
+    df = pd.DataFrame({
+        "a": [1.0, 2.0, 3.0, 4.0], "b": [2.0, 4.0, 6.0, 8.0],
+        "c": [0.0, 1.0, 0.0, 1.0],
+    })
+    ct = ColumnTransformer(
+        [("scale", StandardScaler(), ["a", "b"])], remainder="passthrough"
+    )
+    out = ct.fit_transform(df)
+    assert out.shape == (4, 3)
+    np.testing.assert_allclose(np.asarray(out)[:, 2], df["c"])
+    assert "scale" in ct.named_transformers_
+
+
+def test_make_column_transformer():
+    ct = make_column_transformer(
+        (StandardScaler(), [0]), ("passthrough", [1])
+    )
+    names = [name for name, _, _ in ct.transformers]
+    assert len(names) == 2 and len(set(names)) == 2
+
+
+def test_column_transformer_bad_remainder():
+    with pytest.raises(ValueError, match="remainder"):
+        ColumnTransformer([], remainder="mean").fit_transform(
+            np.zeros((3, 2))
+        )
+
+
+def test_assert_estimator_equal():
+    from dask_ml_tpu.preprocessing import StandardScaler as Ours
+
+    X = np.random.RandomState(0).randn(50, 3)
+    a = Ours().fit(X)
+    b = Ours().fit(X)
+    assert_estimator_equal(a, b, rtol=1e-6)
+    import sklearn.preprocessing as skpre
+
+    c = skpre.StandardScaler().fit(X)
+    assert_estimator_equal(a, c, exclude={"n_samples_seen_"},
+                           rtol=1e-4, atol=1e-5)
+
+
+def test_copy_learned_attributes():
+    from sklearn.linear_model import LogisticRegression
+
+    src = LogisticRegression(max_iter=200).fit(
+        np.random.RandomState(0).randn(40, 3), np.arange(40) % 2
+    )
+    dst = LogisticRegression()
+    copy_learned_attributes(src, dst)
+    assert hasattr(dst, "coef_")
+
+
+def test_handle_zeros_in_scale():
+    np.testing.assert_array_equal(
+        handle_zeros_in_scale(np.array([0.0, 2.0])), [1.0, 2.0]
+    )
