@@ -1,0 +1,73 @@
+"""Runtime configuration.
+
+Reference: ``dask.config`` — layered YAML + ``DASK_*`` env vars + a
+``set(...)`` context manager (SURVEY.md §5 config row). Estimator
+hyperparameters stay sklearn-style (get_params/set_params — the MUST for
+clone/search compat); this module covers *runtime* knobs only: a small
+dataclass with env-var overrides (``DASK_ML_TPU_<FIELD>``) and a context
+manager, no YAML cascade.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import threading
+
+
+@dataclasses.dataclass
+class Config:
+    # default dtype for device estimators ("float32" | "bfloat16")
+    dtype: str = "float32"
+    # rows per streamed block in out-of-core paths (0 = auto: n/8)
+    stream_block_rows: int = 0
+    # prefetch depth of the block streamer (1 = double buffering)
+    stream_prefetch: int = 1
+    # JSONL metrics path ("" = disabled)
+    metrics_path: str = ""
+    # checkpoint directory for adaptive searches ("" = disabled)
+    checkpoint_dir: str = ""
+
+
+_ENV_PREFIX = "DASK_ML_TPU_"
+_state = threading.local()
+
+
+def _from_env() -> Config:
+    cfg = Config()
+    for f in dataclasses.fields(Config):
+        env = os.environ.get(_ENV_PREFIX + f.name.upper())
+        if env is not None:
+            value = f.type(env) if f.type is not str else env
+            if f.type is int:
+                value = int(env)
+            setattr(cfg, f.name, value)
+    return cfg
+
+
+def get_config() -> Config:
+    stack = getattr(_state, "stack", None)
+    if stack:
+        return stack[-1]
+    cached = getattr(_state, "base", None)
+    if cached is None:
+        cached = _from_env()
+        _state.base = cached
+    return cached
+
+
+@contextlib.contextmanager
+def set(**overrides):
+    """``with config.set(stream_block_rows=1_000_000): ...`` — the
+    dask.config.set analog."""
+    base = get_config()
+    new = dataclasses.replace(base, **overrides)
+    stack = getattr(_state, "stack", None)
+    if stack is None:
+        stack = _state.stack = []
+    stack.append(new)
+    try:
+        yield new
+    finally:
+        stack.pop()

@@ -1,0 +1,1 @@
+from .native import load_library, read_csv_f32, read_csv_sharded

@@ -1,0 +1,92 @@
+"""ctypes bindings for the native data loader (native/fast_loader.cpp).
+
+Compiled on demand with g++ (the image has the toolchain but no
+pybind11 — SURVEY.md environment notes); falls back to numpy text parsing
+when compilation is unavailable. The loader feeds
+``parallel/streaming.BlockStream`` — parse into pinned host memory, then
+stream blocks to the mesh.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_lock = threading.Lock()
+_lib = None
+_lib_failed = False
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_ROOT, "native", "fast_loader.cpp")
+_SO = os.path.join(_ROOT, "native", "_fast_loader.so")
+
+
+def _build():
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           "-o", _SO, _SRC]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def load_library():
+    """The compiled library, building it if needed; None if unavailable."""
+    global _lib, _lib_failed
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            if not os.path.exists(_SO) or (
+                os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+            ):
+                _build()
+            lib = ctypes.CDLL(_SO)
+            lib.csv_dims.restype = ctypes.c_int64
+            lib.csv_dims.argtypes = [ctypes.c_char_p,
+                                     ctypes.POINTER(ctypes.c_int64)]
+            lib.csv_parse_f32.restype = ctypes.c_int64
+            lib.csv_parse_f32.argtypes = [
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            ]
+            _lib = lib
+        except Exception:
+            _lib_failed = True
+        return _lib
+
+
+def read_csv_f32(path, n_threads=None) -> np.ndarray:
+    """Parse a numeric CSV (comma/space/tab separated, no header) into a
+    float32 array with the native multithreaded parser; numpy fallback."""
+    path = os.path.abspath(path)
+    lib = load_library()
+    if lib is None:
+        return np.loadtxt(path, delimiter=",", dtype=np.float32, ndmin=2)
+    if n_threads is None:
+        n_threads = min(os.cpu_count() or 1, 16)
+    n_cols = ctypes.c_int64(0)
+    n_rows = lib.csv_dims(path.encode(), ctypes.byref(n_cols))
+    if n_rows < 0:
+        raise IOError(f"cannot read {path!r} (code {n_rows})")
+    out = np.empty((n_rows, n_cols.value), np.float32)
+    got = lib.csv_parse_f32(
+        path.encode(), out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n_rows, n_cols.value, n_threads,
+    )
+    if got < 0:
+        raise ValueError(
+            f"malformed CSV {path!r} (code {got}); expected "
+            f"{n_cols.value} numeric columns per row"
+        )
+    return out[:got]
+
+
+def read_csv_sharded(path, mesh=None, n_threads=None):
+    """CSV straight onto the mesh: native parse -> ShardedArray."""
+    from ..parallel.sharded import as_sharded
+
+    return as_sharded(read_csv_f32(path, n_threads=n_threads), mesh=mesh)

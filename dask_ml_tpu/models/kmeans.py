@@ -67,6 +67,49 @@ def _lloyd_run(X, mask, centers0, max_iter, tol2):
     return centers, it, shift2
 
 
+@partial(jax.jit, static_argnames=("mesh", "interpret"))
+def _lloyd_run_pallas(X, mask, centers0, max_iter, tol2, mesh,
+                      interpret=False):
+    """Lloyd loop where each iteration's data pass is the fused Pallas
+    kernel (ops/pallas_fused.py): X streams through VMEM once per
+    iteration; sums/counts psum over ICI."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.linalg import shard_map
+    from ..ops.pallas_fused import fused_assign_update
+    from ..parallel.mesh import DATA_AXIS
+
+    k = centers0.shape[0]
+
+    def shard_step(xs, ms, c):
+        _, _, sums, counts, _ = fused_assign_update(
+            xs, ms, c, interpret=interpret
+        )
+        return (jax.lax.psum(sums, DATA_AXIS),
+                jax.lax.psum(counts, DATA_AXIS))
+
+    step = shard_map(
+        shard_step, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P()),
+        out_specs=(P(), P()),
+    )
+
+    def cond(carry):
+        centers, it, shift2 = carry
+        return (it < max_iter) & (shift2 > tol2)
+
+    def body(carry):
+        centers, it, _ = carry
+        sums, counts = step(X, mask, centers)
+        new = jnp.where(counts[:, None] > 0, sums / counts[:, None], centers)
+        shift2 = jnp.sum((new - centers) ** 2)
+        return new, it + 1, shift2
+
+    inf = jnp.asarray(jnp.inf, X.dtype)
+    centers, it, shift2 = jax.lax.while_loop(cond, body, (centers0, 0, inf))
+    return centers, it, shift2
+
+
 @jax.jit
 def _labels_inertia(X, mask, centers):
     d2 = euclidean_distances_sq(X, centers)
@@ -183,7 +226,7 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
     def __init__(self, n_clusters=8, init="k-means||", oversampling_factor=2,
                  max_iter=300, tol=1e-4, precompute_distances="auto",
                  random_state=None, copy_x=True, n_jobs=1, algorithm="full",
-                 init_max_iter=None):
+                 init_max_iter=None, use_pallas=None):
         self.n_clusters = n_clusters
         self.init = init
         self.oversampling_factor = oversampling_factor
@@ -195,6 +238,7 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         self.n_jobs = n_jobs
         self.algorithm = algorithm
         self.init_max_iter = init_max_iter
+        self.use_pallas = use_pallas
 
     def _init_centers(self, X: ShardedArray):
         if isinstance(self.init, np.ndarray) or isinstance(
@@ -227,9 +271,18 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         # sklearn-style tol scaling: tol * mean per-feature variance
         _, var = masked_mean_var(X.data, mask, X.n_rows)
         tol2 = jnp.asarray(self.tol, X.dtype) * jnp.mean(var)
-        centers, n_iter, _ = _lloyd_run(
-            X.data, mask, centers0, jnp.asarray(self.max_iter), tol2
-        )
+        use_pallas = self.use_pallas
+        if use_pallas is None:  # auto: fused kernel on real TPU only
+            use_pallas = jax.default_backend() == "tpu"
+        if use_pallas:
+            centers, n_iter, _ = _lloyd_run_pallas(
+                X.data, mask, centers0, jnp.asarray(self.max_iter), tol2,
+                X.mesh, interpret=jax.default_backend() != "tpu",
+            )
+        else:
+            centers, n_iter, _ = _lloyd_run(
+                X.data, mask, centers0, jnp.asarray(self.max_iter), tol2
+            )
         labels, inertia = _labels_inertia(X.data, mask, centers)
         self.cluster_centers_ = to_host(centers)
         self.labels_ = ShardedArray(labels, X.n_rows, X.mesh)

@@ -1,0 +1,218 @@
+"""Device-native SGD estimators with ``partial_fit``.
+
+The reference has no GLM partial_fit — its ``Incremental`` wrapper streams
+blocks through *sklearn's* SGDClassifier (SURVEY.md §3.6), keeping the hot
+loop on host CPU. These estimators keep the model AND the update on
+device: each ``partial_fit`` is one jitted optax step (or a few) on a
+streamed block — the TPU-resident streaming-partial_fit path of
+BASELINE.md configs[3]. Same sklearn contract, so they compose with
+``Incremental``, ``IncrementalSearchCV`` and Hyperband.
+
+Update rule: full-block gradient steps (minibatch GD), not per-sample SGD
+— per-sample loops don't map to the MXU; a block IS the minibatch.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import BaseEstimator, ClassifierMixin, RegressorMixin, to_host
+from ..metrics import accuracy_score, r2_score
+from ..parallel.sharded import ShardedArray, as_sharded
+from ..utils.validation import check_is_fitted
+
+_LOSSES = ("log_loss", "hinge", "squared_error")
+
+
+@partial(jax.jit, static_argnames=("loss",))
+def _sgd_step(X, y, mask, n_valid, w, opt_state, lr, alpha, loss):
+    def objective(w):
+        eta = X @ w[:-1] + w[-1]
+        if loss == "log_loss":
+            per = jax.nn.softplus(eta) - y * eta
+        elif loss == "hinge":
+            margins = (2.0 * y - 1.0) * eta
+            per = jnp.maximum(0.0, 1.0 - margins)
+        else:  # squared_error
+            per = 0.5 * (eta - y) ** 2
+        data_loss = jnp.sum(per * mask) / jnp.maximum(n_valid, 1.0)
+        reg = 0.5 * alpha * jnp.sum(w[:-1] ** 2)  # intercept unpenalized
+        return data_loss + reg
+
+    val, grad = jax.value_and_grad(objective)(w)
+    w = w - lr * grad
+    return w, opt_state, val
+
+
+class _SGDBase(BaseEstimator):
+    loss_default = "squared_error"
+
+    def __init__(self, loss=None, penalty="l2", alpha=1e-4, eta0=0.01,
+                 learning_rate="invscaling", power_t=0.25, max_iter=5,
+                 tol=1e-3, shuffle=True, random_state=None, warm_start=False,
+                 fit_intercept=True):
+        self.loss = loss
+        self.penalty = penalty
+        self.alpha = alpha
+        self.eta0 = eta0
+        self.learning_rate = learning_rate
+        self.power_t = power_t
+        self.max_iter = max_iter
+        self.tol = tol
+        self.shuffle = shuffle
+        self.random_state = random_state
+        self.warm_start = warm_start
+        self.fit_intercept = fit_intercept
+
+    def _loss(self):
+        loss = self.loss or self.loss_default
+        if loss not in _LOSSES:
+            raise ValueError(f"loss must be one of {_LOSSES}, got {loss!r}")
+        return loss
+
+    def _lr(self):
+        t = max(self._t, 1)
+        if self.learning_rate == "constant":
+            return self.eta0
+        if self.learning_rate == "invscaling":
+            return self.eta0 / (t ** self.power_t)
+        if self.learning_rate == "optimal":
+            return 1.0 / (self.alpha * (1e3 + t))
+        raise ValueError(f"Unknown learning_rate {self.learning_rate!r}")
+
+    def _ensure_state(self, d):
+        if not hasattr(self, "_w") or self._w is None:
+            self._w = jnp.zeros((d + 1,), jnp.float32)
+            self._opt_state = ()
+            self._t = 0
+
+    def _block(self, X, y):
+        X = as_sharded(X, dtype=np.float32)
+        y = as_sharded(self._encode_y(y), mesh=X.mesh, dtype=np.float32)
+        return X, y
+
+    def partial_fit(self, X, y, classes=None, **kwargs):
+        if classes is not None:
+            self._set_classes(np.asarray(classes))
+        X, y = self._block(X, y)
+        self._ensure_state(X.shape[1])
+        mask = X.row_mask(jnp.float32)
+        self._t += 1
+        self._w, self._opt_state, self._last_loss = _sgd_step(
+            X.data, y.data, mask, jnp.float32(X.n_rows), self._w,
+            self._opt_state, jnp.float32(self._lr()),
+            jnp.float32(self.alpha), self._loss(),
+        )
+        self._publish(X.shape[1])
+        return self
+
+    def fit(self, X, y, **kwargs):
+        if not self.warm_start:
+            self._w = None
+        n_blocks = 8
+        from ..parallel.streaming import BlockStream
+
+        Xh = X.to_numpy() if isinstance(X, ShardedArray) else np.asarray(X)
+        yh = y.to_numpy() if isinstance(y, ShardedArray) else np.asarray(y)
+        if hasattr(self, "_set_classes") and kwargs.get("classes") is None:
+            uniq = np.unique(yh)
+            if getattr(self, "classes_", None) is None or not self.warm_start:
+                self._set_classes(uniq)
+        stream = BlockStream(
+            (Xh, self._encode_y(yh)),
+            block_rows=max(len(Xh) // n_blocks, 1),
+            shuffle=self.shuffle, seed=self.random_state,
+        )
+        self._ensure_state(Xh.shape[1])
+        for block in stream.epochs(self.max_iter):
+            Xb, yb = block.arrays
+            self._t += 1
+            self._w, self._opt_state, self._last_loss = _sgd_step(
+                Xb, yb, block.mask, jnp.float32(block.n_rows), self._w,
+                self._opt_state, jnp.float32(self._lr()),
+                jnp.float32(self.alpha), self._loss(),
+            )
+        self._publish(Xh.shape[1])
+        self.n_iter_ = self.max_iter
+        return self
+
+    def _decision(self, X):
+        X = as_sharded(X, dtype=np.float32)
+        w = self._w
+        return X, X.data @ w[:-1] + w[-1]
+
+    def _encode_y(self, y):
+        return np.asarray(y)
+
+    def _publish(self, d):
+        pass
+
+
+class SGDClassifier(ClassifierMixin, _SGDBase):
+    """Binary classifier; device analog of sklearn's SGDClassifier for the
+    Incremental / adaptive-search streaming paths."""
+
+    loss_default = "log_loss"
+
+    def _set_classes(self, classes):
+        if len(classes) != 2:
+            raise ValueError("SGDClassifier supports binary targets")
+        self.classes_ = classes
+
+    def _encode_y(self, y):
+        y = np.asarray(y)
+        if getattr(self, "classes_", None) is None:
+            return y
+        return (y == self.classes_[1]).astype(np.float32)
+
+    def _publish(self, d):
+        w = to_host(self._w).astype(np.float64)
+        self.coef_ = w[:-1].reshape(1, -1)
+        self.intercept_ = np.atleast_1d(w[-1])
+
+    def decision_function(self, X):
+        check_is_fitted(self, "coef_")
+        X, eta = self._decision(X)
+        return to_host(eta)[: X.n_rows]
+
+    def predict(self, X):
+        scores = self.decision_function(X)
+        return self.classes_[(scores > 0).astype(int)]
+
+    def predict_proba(self, X):
+        if self._loss() != "log_loss":
+            raise AttributeError("predict_proba requires loss='log_loss'")
+        check_is_fitted(self, "coef_")
+        X, eta = self._decision(X)
+        p1 = to_host(jax.nn.sigmoid(eta))[: X.n_rows]
+        return np.stack([1 - p1, p1], axis=1)
+
+    def score(self, X, y):
+        return accuracy_score(
+            y.to_numpy() if isinstance(y, ShardedArray) else np.asarray(y),
+            self.predict(X),
+        )
+
+
+class SGDRegressor(RegressorMixin, _SGDBase):
+    loss_default = "squared_error"
+
+    def _publish(self, d):
+        w = to_host(self._w).astype(np.float64)
+        self.coef_ = w[:-1]
+        self.intercept_ = float(w[-1])
+
+    def predict(self, X):
+        check_is_fitted(self, "coef_")
+        X, eta = self._decision(X)
+        return to_host(eta)[: X.n_rows]
+
+    def score(self, X, y):
+        return r2_score(
+            y.to_numpy() if isinstance(y, ShardedArray) else np.asarray(y),
+            self.predict(X),
+        )

@@ -1,0 +1,100 @@
+"""Multi-host distributed runtime.
+
+Reference: the ``distributed`` scheduler/worker/comm stack — TCP frames,
+msgpack+pickle serialization, heartbeats (SURVEY.md §2b rows 4-5, §5 comm
+row). TPU replacement: intra-slice communication is XLA collectives over
+ICI compiled into programs (no serialization layer exists at all);
+cross-host control is the JAX distributed runtime over DCN. This module
+is the thin bring-up layer: ``initialize()`` wraps
+``jax.distributed.initialize`` (no-op single-host), ``global_mesh`` spans
+every process's devices, and small host-side control messages ride an
+all-gather (``broadcast_host`` / ``barrier``) instead of a socket
+protocol.
+
+Single-host sessions exercise the same code paths (process_count == 1),
+which is how the test suite covers it; a pod run only changes the
+environment variables.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .mesh import device_mesh
+
+_initialized = False
+
+
+def initialize(coordinator_address=None, num_processes=None,
+               process_id=None, local_device_ids=None):
+    """Bring up the JAX distributed runtime (DCN control plane).
+
+    No-op when single-process and no coordinator is configured — the same
+    script runs on a laptop, one TPU VM, or every host of a pod slice.
+    """
+    global _initialized
+    if _initialized:
+        return
+    if coordinator_address is None and num_processes is None and \
+            "COORDINATOR_ADDRESS" not in __import__("os").environ:
+        _initialized = True  # single-process mode
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    _initialized = True
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_coordinator() -> bool:
+    """The host that runs search controllers (SURVEY.md §3.5: 'asyncio
+    controller on host 0')."""
+    return jax.process_index() == 0
+
+
+def global_mesh(axis_names=("data",), shape=None):
+    """Mesh over ALL processes' devices (ICI within a slice, DCN across)."""
+    return device_mesh(shape=shape, axis_names=axis_names,
+                       devices=jax.devices())
+
+
+def barrier(name="barrier"):
+    """Cross-host sync point: a tiny psum over every device."""
+    x = jnp.ones((jax.device_count(),))
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = global_mesh()
+    y = jax.jit(
+        lambda v: jnp.sum(v),
+        in_shardings=NamedSharding(mesh, P("data")),
+        out_shardings=NamedSharding(mesh, P()),
+    )(x)
+    return float(y)
+
+
+def broadcast_host(value: np.ndarray, root: int = 0) -> np.ndarray:
+    """Broadcast a small host array from the coordinator to all processes
+    — replaces the reference's scheduler→worker control messages. Rides
+    the device fabric (device_put + replication), not a socket."""
+    if process_count() == 1:
+        return np.asarray(value)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(
+        multihost_utils.broadcast_one_to_all(
+            jnp.asarray(value), is_source=process_index() == root
+        )
+    )

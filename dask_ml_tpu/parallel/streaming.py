@@ -1,0 +1,114 @@
+"""Host→device block streaming for larger-than-HBM datasets.
+
+Reference equivalent: dask's chunk scheduling — blocks materialize on
+workers as tasks run (SURVEY.md §2b row 1). TPU design (SURVEY.md §7
+design stance #1, "the heart of the system"): the working set lives in
+host RAM (numpy / np.memmap); fixed-shape blocks are placed onto the mesh
+with ``jax.device_put`` ONE BLOCK AHEAD of compute (device_put is async —
+issuing the next transfer before consuming the current block overlaps DMA
+with compute, the double-buffer pattern), and jitted steps donate the
+block buffer so XLA reuses the HBM.
+
+Blocks have a fixed padded shape (static shapes for jit); the final
+partial block carries its logical row count and a mask.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS, data_shards, resolve_mesh
+
+
+class Block:
+    """One streamed block: device data + logical row count."""
+
+    __slots__ = ("arrays", "n_rows", "mask")
+
+    def __init__(self, arrays, n_rows, mask):
+        self.arrays = arrays
+        self.n_rows = n_rows
+        self.mask = mask
+
+
+class BlockStream:
+    """Double-buffered epoch iterator over host arrays.
+
+    Parameters
+    ----------
+    arrays : tuple of host arrays (np.ndarray / np.memmap), equal length.
+    block_rows : rows per block (rounded up to a multiple of the mesh's
+        data-axis size).
+    shuffle : shuffle block order each epoch (the reference's
+        ``shuffle_blocks``); rows within a block keep locality.
+    """
+
+    def __init__(self, arrays, block_rows, mesh=None, shuffle=False,
+                 seed=None, dtype=np.float32):
+        self.mesh = resolve_mesh(mesh)
+        self.arrays = tuple(arrays)
+        n = len(self.arrays[0])
+        for a in self.arrays:
+            if len(a) != n:
+                raise ValueError("arrays have inconsistent lengths")
+        self.n_rows = n
+        shards = data_shards(self.mesh)
+        self.block_rows = max(
+            int(np.ceil(block_rows / shards)) * shards, shards
+        )
+        self.shuffle = shuffle
+        self.rng = np.random.RandomState(seed)
+        self.dtype = dtype
+        self.n_blocks = int(np.ceil(n / self.block_rows))
+        self._shardings = tuple(
+            NamedSharding(self.mesh, P(*((DATA_AXIS,) + (None,) * (a.ndim - 1))))
+            for a in self.arrays
+        )
+        self._mask_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+
+    def _block_host(self, b):
+        lo = b * self.block_rows
+        hi = min(lo + self.block_rows, self.n_rows)
+        m = hi - lo
+        outs = []
+        for a in self.arrays:
+            blk = np.asarray(a[lo:hi], dtype=self.dtype)
+            if m < self.block_rows:  # fixed shape: pad the tail block
+                pad = [(0, self.block_rows - m)] + [(0, 0)] * (blk.ndim - 1)
+                blk = np.pad(blk, pad)
+            outs.append(blk)
+        mask = np.zeros(self.block_rows, self.dtype)
+        mask[:m] = 1.0
+        return outs, m, mask
+
+    def _put(self, host_block):
+        outs, m, mask = host_block
+        dev = tuple(
+            jax.device_put(a, s) for a, s in zip(outs, self._shardings)
+        )
+        return Block(dev, m, jax.device_put(mask, self._mask_sharding))
+
+    def __iter__(self):
+        order = np.arange(self.n_blocks)
+        if self.shuffle:
+            self.rng.shuffle(order)
+        # one-ahead prefetch: transfer of block i+1 overlaps compute on i
+        pending = None
+        for b in order:
+            nxt = self._put(self._block_host(b))
+            if pending is not None:
+                yield pending
+            pending = nxt
+        if pending is not None:
+            yield pending
+
+    def __len__(self):
+        return self.n_blocks
+
+    def epochs(self, n_epochs):
+        for _ in range(n_epochs):
+            yield from self

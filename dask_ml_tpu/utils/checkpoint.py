@@ -1,0 +1,82 @@
+"""Checkpoint / resume.
+
+Reference: **none** — dask-ml keeps search models as in-memory futures and
+a killed search restarts from scratch (SURVEY.md §5 checkpoint row).
+Built anyway, deliberately exceeding the reference: TPU slices fail whole
+(no lineage recompute), so recovery = checkpoint-restart at iteration
+granularity for solvers and trial granularity for searches.
+
+Device pytrees go through orbax; host objects (sklearn estimators inside
+wrappers/searches) go through pickle in the same directory.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import numpy as np
+
+
+def _orbax():
+    import orbax.checkpoint as ocp
+
+    return ocp
+
+
+def save_pytree(path, tree, force=True):
+    """Save a jax pytree (solver/optimizer state) with orbax."""
+    ocp = _orbax()
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, tree, force=force)
+
+
+def restore_pytree(path, like=None):
+    ocp = _orbax()
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        if like is not None:
+            return ckptr.restore(path, like)
+        return ckptr.restore(path)
+
+
+def save_host(path, obj):
+    """Pickle host-side state (search history, sklearn models)."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(obj, f)
+
+
+def restore_host(path):
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+class SearchCheckpoint:
+    """Controller-state persistence for adaptive searches: history,
+    per-model metadata, and model states, written every round so a killed
+    search resumes at trial granularity (SURVEY.md §5 failure row)."""
+
+    def __init__(self, directory):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+
+    def _path(self, name):
+        return os.path.join(self.directory, name)
+
+    def save_round(self, round_idx, history, meta, models):
+        state = {
+            "round": round_idx,
+            "history": history,
+            "meta": meta,
+            "models": models,
+        }
+        save_host(self._path("controller.pkl"), state)
+
+    def load(self):
+        p = self._path("controller.pkl")
+        if not os.path.exists(p):
+            return None
+        return restore_host(p)

@@ -1,0 +1,78 @@
+"""Observability: structured JSONL metrics + profiling hooks.
+
+Reference: dask's diagnostics/dashboard (SURVEY.md §5 tracing row —
+``dask/diagnostics``, bokeh task stream). TPU equivalent: per-step JSONL
+metric lines (loss, inertia, samples/s/chip) a controller can tail, and
+thin wrappers over ``jax.profiler`` for TensorBoard/Perfetto traces.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import sys
+import time
+
+import jax
+
+
+class MetricsLogger:
+    """Append one JSON object per step to a file (or stdout)."""
+
+    def __init__(self, path=None, extra=None):
+        self.path = path
+        self.extra = extra or {}
+        self._fh = None
+        self.t0 = time.time()
+
+    def _handle(self):
+        if self.path is None:
+            return sys.stdout
+        if self._fh is None:
+            self._fh = open(self.path, "a")
+        return self._fh
+
+    def log(self, step=None, **metrics):
+        rec = {"time": round(time.time() - self.t0, 6), **self.extra}
+        if step is not None:
+            rec["step"] = step
+        rec.update(metrics)
+        h = self._handle()
+        h.write(json.dumps(rec) + "\n")
+        h.flush()
+
+    def close(self):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def timed(fn, *args, **kwargs):
+    """(result, seconds) with a block_until_ready barrier — the honest way
+    to time an async-dispatch jax program."""
+    t0 = time.perf_counter()
+    out = fn(*args, **kwargs)
+    out = jax.block_until_ready(out)
+    return out, time.perf_counter() - t0
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir):
+    """jax.profiler trace context (view in TensorBoard / Perfetto)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def start_profiler_server(port=9999):
+    """Live-capture profiler endpoint (SURVEY.md §5:
+    jax.profiler.start_server)."""
+    return jax.profiler.start_server(port)

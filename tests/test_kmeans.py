@@ -71,3 +71,18 @@ def test_kmeans_errors(blobs):
         KMeans(init="bogus").fit(X)
     with pytest.raises(ValueError, match="init array"):
         KMeans(n_clusters=4, init=np.zeros((3, 5))).fit(X)
+
+
+def test_kmeans_pallas_path_matches_xla(blobs):
+    """Fused Pallas Lloyd (interpret mode on CPU) vs the XLA path."""
+    X, _ = blobs
+    init = X.to_numpy()[:4].copy()
+    xla = KMeans(n_clusters=4, init=init, max_iter=50, use_pallas=False).fit(X)
+    pls = KMeans(n_clusters=4, init=init, max_iter=50, use_pallas=True).fit(X)
+    np.testing.assert_allclose(
+        pls.cluster_centers_, xla.cluster_centers_, atol=1e-3
+    )
+    assert pls.inertia_ == pytest.approx(xla.inertia_, rel=1e-4)
+    np.testing.assert_array_equal(
+        pls.labels_.to_numpy(), xla.labels_.to_numpy()
+    )

@@ -1,0 +1,155 @@
+"""Streaming, device SGD, checkpoint, config, observability, distributed
+single-host tests (SURVEY.md §5 aux subsystems + §7 B0 streaming)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu.linear_model import SGDClassifier, SGDRegressor
+from dask_ml_tpu.parallel import BlockStream, default_mesh
+from dask_ml_tpu.parallel import distributed as dist
+
+
+def test_block_stream_covers_all_rows():
+    X = np.arange(100, dtype=np.float32).reshape(50, 2)
+    y = np.arange(50, dtype=np.float32)
+    stream = BlockStream((X, y), block_rows=16)
+    seen = []
+    total = 0
+    for block in stream:
+        Xb, yb = block.arrays
+        assert Xb.shape[0] % default_mesh().devices.size == 0
+        m = np.asarray(block.mask)
+        assert m.sum() == block.n_rows
+        seen.append(np.asarray(yb)[: block.n_rows])
+        total += block.n_rows
+    assert total == 50
+    np.testing.assert_array_equal(np.sort(np.concatenate(seen)), y)
+
+
+def test_block_stream_shuffle_epochs():
+    X = np.arange(60, dtype=np.float32).reshape(60, 1)
+    stream = BlockStream((X,), block_rows=10, shuffle=True, seed=0)
+    e1 = [b.arrays[0][0, 0].item() for b in stream]
+    e2 = [b.arrays[0][0, 0].item() for b in stream]
+    assert sorted(e1) == sorted(e2)
+    assert len(list(stream.epochs(2))) == 2 * len(stream)
+
+
+def test_block_stream_length_mismatch():
+    with pytest.raises(ValueError, match="inconsistent"):
+        BlockStream((np.zeros((5, 2)), np.zeros(4)), block_rows=2)
+
+
+def test_sgd_classifier_learns(xy_classification):
+    X, y = xy_classification
+    clf = SGDClassifier(eta0=0.5, max_iter=40, random_state=0)
+    clf.fit(X, y)
+    assert clf.score(X, y) > 0.8
+    proba = clf.predict_proba(X)
+    np.testing.assert_allclose(proba.sum(1), 1.0, atol=1e-5)
+    assert clf.coef_.shape == (1, X.shape[1])
+
+
+def test_sgd_classifier_partial_fit_contract(xy_classification):
+    X, y = xy_classification
+    clf = SGDClassifier(eta0=0.5, learning_rate="constant")
+    for i in range(0, len(X), 100):
+        clf.partial_fit(X[i:i + 100], y[i:i + 100], classes=[0.0, 1.0])
+    assert clf.score(X, y) > 0.6
+    # composes with the Incremental wrapper (device path)
+    from dask_ml_tpu.wrappers import Incremental
+
+    inc = Incremental(SGDClassifier(eta0=0.5, learning_rate="constant"),
+                      random_state=0)
+    inc.fit(X, y, classes=[0.0, 1.0])
+    assert inc.score(X, y) > 0.6
+
+
+def test_sgd_classifier_in_incremental_search(xy_classification):
+    from scipy.stats import loguniform
+
+    from dask_ml_tpu.model_selection import IncrementalSearchCV
+
+    X, y = xy_classification
+    search = IncrementalSearchCV(
+        SGDClassifier(learning_rate="constant"),
+        {"eta0": loguniform(1e-2, 1.0), "alpha": [1e-4, 1e-2]},
+        n_initial_parameters=5, max_iter=10, random_state=0,
+    )
+    search.fit(X, y, classes=[0.0, 1.0])
+    assert search.best_score_ > 0.6
+
+
+def test_sgd_regressor(xy_regression):
+    X, y = xy_regression
+    y = (y - y.mean()) / y.std()
+    reg = SGDRegressor(eta0=0.05, max_iter=60, random_state=0).fit(X, y)
+    assert reg.score(X, y) > 0.7
+
+
+def test_sgd_bad_loss():
+    with pytest.raises(ValueError, match="loss"):
+        SGDClassifier(loss="perceptron").fit(
+            np.zeros((10, 2)), np.arange(10) % 2
+        )
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    from dask_ml_tpu.utils import checkpoint as ckpt
+
+    tree = {"w": jnp.arange(6.0).reshape(2, 3), "step": jnp.asarray(7)}
+    path = str(tmp_path / "state")
+    ckpt.save_pytree(path, tree)
+    back = ckpt.restore_pytree(path, like=tree)
+    np.testing.assert_allclose(np.asarray(back["w"]), np.asarray(tree["w"]))
+    assert int(back["step"]) == 7
+
+    sc = ckpt.SearchCheckpoint(str(tmp_path / "search"))
+    assert sc.load() is None
+    sc.save_round(2, [{"score": 0.5}], {"n": 1}, {"0": b"blob"})
+    state = sc.load()
+    assert state["round"] == 2 and state["history"][0]["score"] == 0.5
+
+
+def test_metrics_logger(tmp_path):
+    from dask_ml_tpu.utils.observability import MetricsLogger, timed
+
+    p = str(tmp_path / "metrics.jsonl")
+    with MetricsLogger(p, extra={"run": "t"}) as log:
+        log.log(step=0, loss=1.5)
+        log.log(step=1, loss=1.2, samples_per_sec=1e6)
+    lines = [json.loads(l) for l in open(p)]
+    assert lines[0]["loss"] == 1.5 and lines[0]["run"] == "t"
+    assert lines[1]["step"] == 1
+
+    import jax.numpy as jnp
+
+    out, secs = timed(lambda: jnp.ones((100, 100)) @ jnp.ones((100, 100)))
+    assert secs > 0 and out.shape == (100, 100)
+
+
+def test_config():
+    from dask_ml_tpu import config
+
+    base = config.get_config()
+    assert base.dtype == "float32"
+    with config.set(stream_block_rows=123):
+        assert config.get_config().stream_block_rows == 123
+        with config.set(dtype="bfloat16"):  # nested set layers, not replaces
+            assert config.get_config().dtype == "bfloat16"
+            assert config.get_config().stream_block_rows == 123
+    assert config.get_config().stream_block_rows == base.stream_block_rows
+
+
+def test_distributed_single_host():
+    dist.initialize()  # no-op
+    assert dist.process_count() == 1
+    assert dist.is_coordinator()
+    assert dist.barrier() == len(__import__("jax").devices())
+    v = dist.broadcast_host(np.array([1.0, 2.0]))
+    np.testing.assert_array_equal(v, [1.0, 2.0])
