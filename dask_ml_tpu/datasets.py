@@ -35,15 +35,10 @@ def _assemble(parts_X, parts_y, mesh):
     )
 
 
-def make_classification(n_samples=100, n_features=20, n_informative=5,
-                        n_classes=2, class_sep=1.0, flip_y=0.01,
-                        random_state=None, chunks=None, mesh=None):
-    """Consistent global problem across shards: class centers (hypercube
-    vertices in the informative subspace) and the feature permutation are
-    drawn ONCE from random_state; shards draw only their rows. (The
-    reference seeds sklearn's whole generator per block, so each block is
-    a *different* problem — a known quirk we deliberately fix.)"""
-    mesh = resolve_mesh(mesh)
+def _classification_parts(n_samples, n_features, n_informative, n_classes,
+                          class_sep, flip_y, random_state, mesh):
+    """Per-shard host blocks of the classification problem (shared by the
+    array and DataFrame generators — the latter never touches the device)."""
     rs = np.random.RandomState(random_state)
     n_informative = min(n_informative, n_features)
     if n_classes > 2 ** n_informative:
@@ -76,6 +71,22 @@ def make_classification(n_samples=100, n_features=20, n_informative=5,
         flip = r.uniform(size=sz) < flip_y
         y = np.where(flip, r.randint(0, n_classes, size=sz), y)
         Xs.append(X); ys.append(y.astype(np.float64))
+    return Xs, ys
+
+
+def make_classification(n_samples=100, n_features=20, n_informative=5,
+                        n_classes=2, class_sep=1.0, flip_y=0.01,
+                        random_state=None, chunks=None, mesh=None):
+    """Consistent global problem across shards: class centers (hypercube
+    vertices in the informative subspace) and the feature permutation are
+    drawn ONCE from random_state; shards draw only their rows. (The
+    reference seeds sklearn's whole generator per block, so each block is
+    a *different* problem — a known quirk we deliberately fix.)"""
+    mesh = resolve_mesh(mesh)
+    Xs, ys = _classification_parts(
+        n_samples, n_features, n_informative, n_classes, class_sep, flip_y,
+        random_state, mesh,
+    )
     return _assemble(Xs, ys, mesh)
 
 
@@ -127,6 +138,37 @@ def make_blobs(n_samples=100, n_features=2, centers=None, random_state=None,
         )
         Xs.append(X); ys.append(y)
     return _assemble(Xs, ys, mesh)
+
+
+def make_classification_df(n_samples=100, n_features=20, predictability=0.1,
+                           random_state=None, chunks=None, mesh=None,
+                           dates=None, **kwargs):
+    """Classification data as (DataFrame, Series) with named feature columns
+    (ref: ``dask_ml/datasets.py::make_classification_df``). DataFrames live
+    on host (TPU consumes arrays); an optional ``dates`` (start, end) pair
+    adds a uniformly sampled ``date`` column like the reference.
+    """
+    import pandas as pd
+
+    Xs, ys = _classification_parts(
+        n_samples, n_features,
+        kwargs.pop("n_informative", min(5, n_features)),
+        kwargs.pop("n_classes", 2),
+        max(predictability, 1e-3) * 10.0,
+        kwargs.pop("flip_y", 0.01),
+        random_state, resolve_mesh(mesh),
+    )
+    if kwargs:
+        raise TypeError(f"unsupported arguments: {sorted(kwargs)}")
+    Xn = np.concatenate([p for p in Xs if len(p)], axis=0)
+    yn = np.concatenate([p for p in ys if len(p)], axis=0)
+    df = pd.DataFrame(Xn, columns=[f"feature_{i}" for i in range(n_features)])
+    if dates is not None:
+        start, end = pd.Timestamp(dates[0]), pd.Timestamp(dates[1])
+        r = np.random.RandomState(random_state)
+        offs = r.uniform(size=len(df)) * (end - start).value
+        df.insert(0, "date", start + pd.to_timedelta(offs.astype(np.int64)))
+    return df, pd.Series(yn.astype(np.int64), name="target")
 
 
 def make_counts(n_samples=100, n_features=20, random_state=None, scale=1.0,

@@ -27,6 +27,21 @@ from .solvers import regularizers
 from .solvers.solvers import solve
 
 
+def add_intercept(X):
+    """Append a ones column (ref: dask_ml/linear_model/utils.py::add_intercept).
+
+    Accepts a ShardedArray (ones are zeroed on padding rows so reductions
+    stay exact) or any 2-D array.
+    """
+    if isinstance(X, ShardedArray):
+        ones = X.row_mask(dtype=X.data.dtype)[:, None]
+        return ShardedArray(
+            jnp.concatenate([X.data, ones], axis=1), X.n_rows, X.mesh
+        )
+    arr = np.asarray(X)
+    return np.concatenate([arr, np.ones((arr.shape[0], 1), arr.dtype)], axis=1)
+
+
 class _GLMBase(BaseEstimator):
     family: str = None  # overridden per subclass
 
@@ -53,13 +68,9 @@ class _GLMBase(BaseEstimator):
 
     # -- internals --------------------------------------------------------
     def _design(self, X: ShardedArray):
-        """Append the intercept ones column (zeroed on padding rows), the
-        reference's ``add_intercept`` blockwise concat (SURVEY.md §3.2)."""
-        data = X.data
-        if self.fit_intercept:
-            ones = X.row_mask(dtype=data.dtype)[:, None]
-            data = jnp.concatenate([data, ones], axis=1)
-        return data
+        """Intercept ones column (zeroed on padding rows) via
+        ``add_intercept`` (SURVEY.md §3.2)."""
+        return add_intercept(X).data if self.fit_intercept else X.data
 
     def _encode_y(self, y: ShardedArray):
         return y.data, None

@@ -46,6 +46,18 @@ def pairwise_distances_argmin_min(x, y):
     return labels, jnp.sqrt(jnp.min(d2, axis=1))
 
 
+def manhattan_distances(x, y):
+    """L1 distances (n, m). No MXU path exists for |x-y| sums; the
+    broadcasted form below is fine because y (anchors) is small."""
+    return jnp.sum(jnp.abs(x[:, None, :] - y[None, :, :]), axis=-1)
+
+
+def cosine_distances(x, y):
+    xn = x / jnp.maximum(jnp.sqrt(row_norms_sq(x))[:, None], 1e-12)
+    yn = y / jnp.maximum(jnp.sqrt(row_norms_sq(y))[:, None], 1e-12)
+    return jnp.clip(1.0 - xn @ yn.T, 0.0, 2.0)
+
+
 def linear_kernel(x, y):
     return x @ y.T
 
@@ -66,3 +78,73 @@ def sigmoid_kernel(x, y, gamma=None, coef0=1.0):
     if gamma is None:
         gamma = 1.0 / x.shape[-1]
     return jnp.tanh(gamma * (x @ y.T) + coef0)
+
+
+_PAIRWISE_METRICS = {
+    "euclidean": euclidean_distances,
+    "l2": euclidean_distances,
+    "sqeuclidean": euclidean_distances_sq,
+    "manhattan": manhattan_distances,
+    "l1": manhattan_distances,
+    "cityblock": manhattan_distances,
+    "cosine": cosine_distances,
+}
+
+PAIRWISE_KERNEL_FUNCTIONS = {
+    "linear": linear_kernel,
+    "rbf": rbf_kernel,
+    "polynomial": polynomial_kernel,
+    "sigmoid": sigmoid_kernel,
+}
+
+
+def _unwrap_x(x):
+    """Padded row-sharded device array; callers mask padding rows of the
+    result (slicing here would force a reshard of the big operand)."""
+    return x.data if hasattr(x, "data") and hasattr(x, "n_rows") else x
+
+
+def _unwrap_y(y):
+    """y is the small in-memory operand: slice off padding rows so the
+    result has no phantom anchor columns."""
+    if hasattr(y, "data") and hasattr(y, "n_rows"):
+        return y.data[: y.n_rows]
+    return y
+
+
+def pairwise_distances(x, y, metric="euclidean", **kwargs):
+    """Distance matrix (n, m) between ``x`` and in-memory ``y``.
+
+    Ref: ``dask_ml/metrics/pairwise.py::pairwise_distances`` — the reference
+    maps sklearn's function over blocks with Y held in memory; here the whole
+    matrix is one fused XLA program (the dot term rides the MXU). ``x`` may
+    be a plain array or a ShardedArray (unwrapped to its padded device array;
+    callers mask padding rows of the result — ``y``'s padding IS sliced off).
+    ``metric`` may be a name or a callable ``f(x, y, **kwargs)``.
+    """
+    x, y = _unwrap_x(x), _unwrap_y(y)
+    if callable(metric):
+        return metric(x, y, **kwargs)
+    try:
+        fn = _PAIRWISE_METRICS[metric]
+    except KeyError:
+        raise ValueError(
+            f"unsupported metric {metric!r}; one of "
+            f"{sorted(_PAIRWISE_METRICS)} or a callable"
+        ) from None
+    return fn(x, y, **kwargs)
+
+
+def pairwise_kernels(x, y, metric="linear", **kwargs):
+    """Kernel matrix, mirroring sklearn/dask-ml ``pairwise_kernels``."""
+    x, y = _unwrap_x(x), _unwrap_y(y)
+    if callable(metric):
+        return metric(x, y, **kwargs)
+    try:
+        fn = PAIRWISE_KERNEL_FUNCTIONS[metric]
+    except KeyError:
+        raise ValueError(
+            f"unsupported kernel {metric!r}; one of "
+            f"{sorted(PAIRWISE_KERNEL_FUNCTIONS)} or a callable"
+        ) from None
+    return fn(x, y, **kwargs)

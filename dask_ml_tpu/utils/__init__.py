@@ -2,7 +2,7 @@
 import numpy as np
 
 from .testing import assert_estimator_equal, copy_learned_attributes
-from .validation import check_array, check_is_fitted, check_X_y
+from .validation import check_array, check_chunks, check_is_fitted, check_X_y
 
 
 def handle_zeros_in_scale(scale):

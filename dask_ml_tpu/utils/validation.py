@@ -38,6 +38,46 @@ def check_X_y(X, y, mesh=None, dtype=None):
     return X, y
 
 
+def check_chunks(n_samples, n_features, chunks=None, mesh=None):
+    """Normalize a dask-ml-style ``chunks`` argument to a flat
+    ``(rows_per_shard, n_features)`` tuple.
+
+    Ref: ``dask_ml/utils.py::check_chunks``. On TPU the row partitioning is
+    dictated by the mesh's data axis, so when ``chunks`` is None the default
+    is ``ceil(n_samples / data_shards)`` rows per shard with unchunked
+    columns — the layout ``ShardedArray.from_array`` produces on ``mesh``
+    (default mesh when None).
+    """
+    from ..parallel.mesh import data_shards
+
+    if chunks is None:
+        shards = data_shards(resolve_mesh(mesh))
+        rows = max(int(np.ceil(n_samples / shards)), 1)
+        return (rows, n_features)
+    if isinstance(chunks, (int, np.integer)):
+        return (max(int(chunks), 1), n_features)
+    if isinstance(chunks, (tuple, list)) and len(chunks) == 2:
+        r, c = chunks
+        # dask-ml also accepts per-dimension block-size tuples,
+        # e.g. ((500, 500), (16,))
+        if isinstance(r, (tuple, list)):
+            r = max(int(v) for v in r) if len(r) else 0
+        if isinstance(c, (tuple, list)):
+            if len(c) != 1:
+                raise AssertionError(
+                    f"Column chunks must be a single block on TPU (got {c})"
+                )
+            c = c[0]
+        if isinstance(r, (int, np.integer)) and isinstance(c, (int, np.integer)):
+            if int(c) != n_features:
+                raise AssertionError(
+                    "Column chunks must span all n_features on TPU "
+                    f"(got {c}, need {n_features})"
+                )
+            return (max(int(r), 1), n_features)
+    raise AssertionError(f"Unexpected chunks value: {chunks!r}")
+
+
 def check_is_fitted(est, attr: str):
     if not hasattr(est, attr):
         raise AttributeError(

@@ -1,0 +1,72 @@
+"""Pairwise distances/kernels vs sklearn (the §4 parity contract)."""
+
+import numpy as np
+import pytest
+import sklearn.metrics.pairwise as skpw
+
+import dask_ml_tpu.metrics as dm
+
+
+@pytest.fixture(scope="module")
+def xy():
+    rng = np.random.RandomState(0)
+    return (rng.randn(60, 7).astype(np.float64),
+            rng.randn(9, 7).astype(np.float64))
+
+
+@pytest.mark.parametrize("metric", [
+    "euclidean", "sqeuclidean", "manhattan", "cityblock", "l1", "l2",
+    "cosine",
+])
+def test_pairwise_distances_parity(xy, metric):
+    x, y = xy
+    got = np.asarray(dm.pairwise_distances(x, y, metric=metric))
+    sk_metric = metric
+    want = skpw.pairwise_distances(x, y, metric=sk_metric)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_pairwise_distances_callable(xy):
+    x, y = xy
+    got = np.asarray(dm.pairwise_distances(x, y, metric=dm.euclidean_distances))
+    want = skpw.euclidean_distances(x, y)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_pairwise_distances_bad_metric(xy):
+    with pytest.raises(ValueError, match="unsupported metric"):
+        dm.pairwise_distances(*xy, metric="nope")
+
+
+@pytest.mark.parametrize("kernel,kwargs", [
+    ("linear", {}),
+    ("rbf", {"gamma": 0.3}),
+    ("polynomial", {"degree": 2, "gamma": 0.5, "coef0": 1.0}),
+    ("sigmoid", {"gamma": 0.1, "coef0": 0.5}),
+])
+def test_pairwise_kernels_parity(xy, kernel, kwargs):
+    x, y = xy
+    got = np.asarray(dm.pairwise_kernels(x, y, metric=kernel, **kwargs))
+    want = skpw.pairwise_kernels(x, y, metric=kernel, **kwargs)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_argmin_min_parity(xy):
+    x, y = xy
+    labels, mins = dm.pairwise_distances_argmin_min(x, y)
+    want_l, want_m = skpw.pairwise_distances_argmin_min(x, y)
+    np.testing.assert_array_equal(np.asarray(labels), want_l)
+    np.testing.assert_allclose(np.asarray(mins), want_m, rtol=1e-5, atol=1e-6)
+
+
+def test_make_classification_df():
+    from dask_ml_tpu.datasets import make_classification_df
+
+    df, y = make_classification_df(
+        n_samples=200, n_features=6, random_state=0,
+        dates=("2020-01-01", "2020-06-01"),
+    )
+    assert list(df.columns) == ["date"] + [f"feature_{i}" for i in range(6)]
+    assert len(df) == 200 and len(y) == 200
+    assert df["date"].between("2020-01-01", "2020-06-01").all()
+    assert set(np.unique(y)) <= {0, 1}

@@ -150,3 +150,32 @@ def test_handle_zeros_in_scale():
     np.testing.assert_array_equal(
         handle_zeros_in_scale(np.array([0.0, 2.0])), [1.0, 2.0]
     )
+
+
+def test_check_chunks():
+    import pytest
+
+    from dask_ml_tpu.utils import check_chunks
+
+    assert check_chunks(1000, 16, chunks=50) == (50, 16)
+    assert check_chunks(1000, 16, chunks=(50, 16)) == (50, 16)
+    rows, cols = check_chunks(1000, 16)
+    assert cols == 16 and 1 <= rows <= 1000
+    with pytest.raises(AssertionError):
+        check_chunks(1000, 16, chunks=(50, 8))  # column-chunking unsupported
+    with pytest.raises(AssertionError):
+        check_chunks(1000, 16, chunks="bad")
+
+
+def test_add_intercept():
+    from dask_ml_tpu.linear_model import add_intercept
+    from dask_ml_tpu.parallel.sharded import ShardedArray
+
+    X = ShardedArray.from_array(np.random.RandomState(0).randn(37, 4))
+    out = add_intercept(X).to_numpy()
+    assert out.shape == (37, 5)
+    np.testing.assert_array_equal(out[:, 4], 1.0)
+    np.testing.assert_allclose(out[:, :4], X.to_numpy(), rtol=1e-6)
+
+    arr = add_intercept(np.zeros((3, 2)))
+    np.testing.assert_array_equal(arr[:, 2], 1.0)
