@@ -36,26 +36,31 @@ def _assemble(parts_X, parts_y, mesh):
 
 
 def _classification_parts(n_samples, n_features, n_informative, n_classes,
-                          class_sep, flip_y, random_state, mesh):
+                          class_sep, flip_y, random_state, mesh,
+                          class_weights=None):
     """Per-shard host blocks of the classification problem (shared by the
     array and DataFrame generators — the latter never touches the device)."""
     rs = np.random.RandomState(random_state)
     n_informative = min(n_informative, n_features)
-    if n_classes > 2 ** n_informative:
-        raise ValueError(
-            f"n_classes={n_classes} > 2**n_informative={2**n_informative} "
-            "distinct hypercube vertices"
-        )
-    # distinct hypercube vertices per class (sampling with replacement can
-    # hand two classes the same center → zero class signal)
-    chosen = rs.choice(2 ** min(n_informative, 62), size=n_classes,
-                       replace=False)
-    bits = ((chosen[:, None] >> np.arange(min(n_informative, 62))) & 1)
-    if n_informative > 62:  # pad extra dims with fixed signs
-        bits = np.concatenate(
-            [bits, np.ones((n_classes, n_informative - 62), int)], axis=1
-        )
-    centers = class_sep * (2.0 * bits - 1.0)
+    if n_informative == 0:
+        # pure noise: no class signal (predictability=0 baselines)
+        centers = np.zeros((n_classes, 0))
+    else:
+        if n_classes > 2 ** n_informative:
+            raise ValueError(
+                f"n_classes={n_classes} > 2**n_informative={2**n_informative} "
+                "distinct hypercube vertices"
+            )
+        # distinct hypercube vertices per class (sampling with replacement
+        # can hand two classes the same center → zero class signal)
+        chosen = rs.choice(2 ** min(n_informative, 62), size=n_classes,
+                           replace=False)
+        bits = ((chosen[:, None] >> np.arange(min(n_informative, 62))) & 1)
+        if n_informative > 62:  # pad extra dims with fixed signs
+            bits = np.concatenate(
+                [bits, np.ones((n_classes, n_informative - 62), int)], axis=1
+            )
+        centers = class_sep * (2.0 * bits - 1.0)
     perm = rs.permutation(n_features)
     seeds = rs.randint(0, 2**31 - 1, size=data_shards(mesh))
     Xs, ys = [], []
@@ -64,7 +69,10 @@ def _classification_parts(n_samples, n_features, n_informative, n_classes,
             Xs.append(np.empty((0, n_features))); ys.append(np.empty((0,)))
             continue
         r = np.random.RandomState(int(seed))
-        y = r.randint(0, n_classes, size=sz)
+        if class_weights is None:
+            y = r.randint(0, n_classes, size=sz)
+        else:
+            y = r.choice(n_classes, size=sz, p=class_weights)
         X = r.normal(size=(sz, n_features))
         X[:, :n_informative] += centers[y]
         X = X[:, perm]
@@ -141,22 +149,38 @@ def make_blobs(n_samples=100, n_features=2, centers=None, random_state=None,
 
 
 def make_classification_df(n_samples=100, n_features=20, predictability=0.1,
-                           random_state=None, chunks=None, mesh=None,
-                           dates=None, **kwargs):
+                           response_rate=0.5, random_state=None, chunks=None,
+                           mesh=None, dates=None, **kwargs):
     """Classification data as (DataFrame, Series) with named feature columns
-    (ref: ``dask_ml/datasets.py::make_classification_df``). DataFrames live
-    on host (TPU consumes arrays); an optional ``dates`` (start, end) pair
-    adds a uniformly sampled ``date`` column like the reference.
+    (ref: ``dask_ml/datasets.py::make_classification_df``). Reference
+    semantics: ``predictability`` is the FRACTION of informative features
+    (n_informative = predictability * n_features) and ``response_rate`` the
+    positive-class share. DataFrames live on host (TPU consumes arrays); an
+    optional ``dates`` (start, end) pair adds a uniformly sampled ``date``
+    column like the reference.
     """
     import pandas as pd
 
+    n_classes = kwargs.pop("n_classes", 2)
+    if not 0.0 <= predictability <= 1.0:
+        raise ValueError(f"predictability must be in [0, 1], got {predictability}")
+    if not 0.0 < response_rate <= 1.0:
+        raise ValueError(f"response_rate must be in (0, 1], got {response_rate}")
+    if n_classes == 1:
+        weights = [1.0]
+    elif n_classes == 2:
+        weights = [1.0 - response_rate, response_rate]
+    else:
+        rest = (1.0 - response_rate) / (n_classes - 1)
+        weights = [rest] * (n_classes - 1) + [response_rate]
     Xs, ys = _classification_parts(
         n_samples, n_features,
-        kwargs.pop("n_informative", min(5, n_features)),
-        kwargs.pop("n_classes", 2),
-        max(predictability, 1e-3) * 10.0,
+        kwargs.pop("n_informative", int(predictability * n_features)),
+        n_classes,
+        kwargs.pop("class_sep", 1.0),
         kwargs.pop("flip_y", 0.01),
         random_state, resolve_mesh(mesh),
+        class_weights=weights,
     )
     if kwargs:
         raise TypeError(f"unsupported arguments: {sorted(kwargs)}")

@@ -4,15 +4,25 @@ SURVEY.md §2b row 7: the reference's inner-loop math is sklearn's Cython
 ``pairwise_distances_argmin_min`` called per block; §7 B1 plans a "Pallas
 fused distance-argmin". This kernel goes further than fusing distance +
 argmin: one pass over X computes the assignment AND accumulates the
-centroid sums/counts/inertia — the entire data touch of a Lloyd iteration
-— so X streams through VMEM exactly once per iteration. The XLA fallback
-path reads X twice (distance matmul + segment_sum) and materializes the
-(n, k) distance matrix; here only (tile, k) lives on-chip.
+centroid sums/counts — the entire data touch of a Lloyd iteration — so X
+streams through VMEM exactly once per iteration. The XLA fallback path
+reads X twice (distance matmul + segment_sum) and materializes the (n, k)
+distance matrix; here only (tile, k) lives on-chip.
 
-Layout notes (pallas_guide.md): distances via the MXU matmul
-``x @ c.T`` with f32 accumulation; accumulator outputs revisit the same
-block every grid step (constant index_map) with @pl.when(first) init —
-TPU grids are sequential, so accumulation is race-free.
+Layout notes (pallas_guide.md + Mosaic lowering constraints verified on a
+real v5e chip):
+
+- distances via the MXU matmul ``x @ c.T`` with f32 accumulation;
+- every intermediate stays RANK-2 — Mosaic's vector layouts cannot
+  relayout rank-1 values produced by cross-lane reductions ("Offset
+  change" errors), so argmin is an iota-min with ``keepdims=True``,
+  center norms arrive precomputed as a (1, k) operand, and the scalar
+  inertia sum happens in XLA on the kernel's masked min-distance output;
+- accumulator outputs revisit the same block every grid step (constant
+  index_map) with @pl.when(first) init — TPU grids are sequential, so
+  accumulation is race-free;
+- rows are padded to a 128-multiple tile (Mosaic minor-tiling), with the
+  mask zeroing padded rows out of every statistic.
 """
 
 from __future__ import annotations
@@ -25,51 +35,52 @@ from jax.experimental import pallas as pl
 
 
 def _pick_tile(n):
-    for t in (1024, 512, 256, 128, 64, 32, 16, 8):
-        if n % t == 0:
-            return t
-    return n
+    """Row tile for the grid. Mosaic requires output blocks to be
+    multiples of the minor tiling (128), so tiles are always
+    128-multiples and callers pad n up to a tile multiple."""
+    if n <= 1024:
+        return -(-n // 128) * 128  # single grid step, ≤127 padded rows
+    return 1024 if n % 1024 == 0 else 512
 
 
-def _assign_update_kernel(x_ref, m_ref, c_ref, labels_ref, mind_ref,
-                          sums_ref, counts_ref, inertia_ref):
+def _assign_update_kernel(x_ref, m_ref, c_ref, c2_ref, labels_ref, mind_ref,
+                          sums_ref, counts_ref):
     i = pl.program_id(0)
     x = x_ref[:]                       # (tile, d)
     m = m_ref[:]                       # (tile, 1)
     c = c_ref[:]                       # (k, d)
+    c2 = c2_ref[:]                     # (1, k) precomputed ||c||^2
     k = c.shape[0]
     # ||x||^2 - 2 x.c + ||c||^2 ; the matmul rides the MXU, epilogue fuses
     xc = jax.lax.dot_general(
         x, c, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )                                   # (tile, k)
-    d2 = (
-        jnp.sum(x * x, axis=1, keepdims=True)
-        - 2.0 * xc
-        + jnp.sum(c * c, axis=1)[None, :]
-    )
+    d2 = jnp.sum(x * x, axis=1, keepdims=True) - 2.0 * xc + c2
     d2 = jnp.maximum(d2, 0.0)
-    labels = jnp.argmin(d2, axis=1).astype(jnp.int32)
-    mind = jnp.min(d2, axis=1)
-    labels_ref[:] = labels
-    mind_ref[:] = mind * m[:, 0]
+    mind = jnp.min(d2, axis=1, keepdims=True)          # (tile, 1)
+    iota = jax.lax.broadcasted_iota(
+        jnp.int32, (x.shape[0], k), 1
+    ).astype(jnp.float32)
+    # first-occurrence argmin, all rank-2: min over lanes of iota where
+    # the distance achieves the row minimum
+    labf = jnp.min(jnp.where(d2 <= mind, iota, float(k)), axis=1,
+                   keepdims=True)                       # (tile, 1)
+    labels_ref[:] = labf.astype(jnp.int32)
+    mind_ref[:] = mind * m
 
-    onehot = (
-        labels[:, None] == jax.lax.broadcasted_iota(jnp.int32, (1, k), 1)
-    ).astype(jnp.float32) * m           # (tile, k), padding rows zeroed
+    onehot = (iota == labf).astype(jnp.float32) * m     # (tile, k)
 
     @pl.when(i == 0)
     def _init():
         sums_ref[:] = jnp.zeros_like(sums_ref)
         counts_ref[:] = jnp.zeros_like(counts_ref)
-        inertia_ref[:] = jnp.zeros_like(inertia_ref)
 
     sums_ref[:] += jax.lax.dot_general(
         onehot, x, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )                                   # (k, d) MXU accumulation
     counts_ref[:] += jnp.sum(onehot, axis=0, keepdims=True)
-    inertia_ref[:] += jnp.sum(mind * m[:, 0]).reshape(1, 1)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -82,31 +93,40 @@ def fused_assign_update(x, mask, centers, interpret=False):
     """
     n, d = x.shape
     k = centers.shape[0]
+    x = x.astype(jnp.float32)
+    mask = mask.astype(jnp.float32)
+    centers = centers.astype(jnp.float32)
     tile = _pick_tile(n)
-    grid = (n // tile,)
-    labels, mind, sums, counts, inertia = pl.pallas_call(
+    n_pad = -(-n // tile) * tile
+    if n_pad != n:
+        # masked rows contribute nothing; labels/mind sliced back below
+        x = jnp.pad(x, ((0, n_pad - n), (0, 0)))
+        mask = jnp.pad(mask, (0, n_pad - n))
+    grid = (n_pad // tile,)
+    c2 = jnp.sum(centers * centers, axis=1)[None, :]    # (1, k) in XLA
+    labels, mind, sums, counts = pl.pallas_call(
         _assign_update_kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((tile, d), lambda i: (i, 0)),
             pl.BlockSpec((tile, 1), lambda i: (i, 0)),
             pl.BlockSpec((k, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, k), lambda i: (0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((tile,), lambda i: (i,)),
-            pl.BlockSpec((tile,), lambda i: (i,)),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
             pl.BlockSpec((k, d), lambda i: (0, 0)),
             pl.BlockSpec((1, k), lambda i: (0, 0)),
-            pl.BlockSpec((1, 1), lambda i: (0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n,), jnp.int32),
-            jax.ShapeDtypeStruct((n,), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
             jax.ShapeDtypeStruct((k, d), jnp.float32),
             jax.ShapeDtypeStruct((1, k), jnp.float32),
-            jax.ShapeDtypeStruct((1, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(x.astype(jnp.float32), mask.astype(jnp.float32)[:, None],
-      centers.astype(jnp.float32))
-    return labels, mind, sums, counts[0], inertia[0, 0]
+    )(x, mask[:, None], centers, c2)
+    mind = mind[:n, 0]
+    inertia = jnp.sum(mind)  # XLA fuses this with the kernel output
+    return labels[:n, 0], mind, sums, counts[0], inertia

@@ -55,7 +55,9 @@ def check_chunks(n_samples, n_features, chunks=None, mesh=None):
         rows = max(int(np.ceil(n_samples / shards)), 1)
         return (rows, n_features)
     if isinstance(chunks, (int, np.integer)):
-        return (max(int(chunks), 1), n_features)
+        # an integer is the NUMBER of blocks (reference semantics), with a
+        # 100-row floor per block — not a rows-per-block count
+        return (max(100, n_samples // max(int(chunks), 1)), n_features)
     if isinstance(chunks, (tuple, list)) and len(chunks) == 2:
         r, c = chunks
         # dask-ml also accepts per-dimension block-size tuples,

@@ -57,16 +57,3 @@ def test_argmin_min_parity(xy):
     want_l, want_m = skpw.pairwise_distances_argmin_min(x, y)
     np.testing.assert_array_equal(np.asarray(labels), want_l)
     np.testing.assert_allclose(np.asarray(mins), want_m, rtol=1e-5, atol=1e-6)
-
-
-def test_make_classification_df():
-    from dask_ml_tpu.datasets import make_classification_df
-
-    df, y = make_classification_df(
-        n_samples=200, n_features=6, random_state=0,
-        dates=("2020-01-01", "2020-06-01"),
-    )
-    assert list(df.columns) == ["date"] + [f"feature_{i}" for i in range(6)]
-    assert len(df) == 200 and len(y) == 200
-    assert df["date"].between("2020-01-01", "2020-06-01").all()
-    assert set(np.unique(y)) <= {0, 1}

@@ -62,3 +62,45 @@ def test_make_classification_rejects_unknown_kwargs():
 
     with pytest.raises(TypeError):
         datasets.make_classification(n_samples=10, weights=[0.9, 0.1])
+
+
+def test_make_classification_df():
+    from dask_ml_tpu.datasets import make_classification_df
+
+    df, y = make_classification_df(
+        n_samples=200, n_features=6, random_state=0,
+        dates=("2020-01-01", "2020-06-01"),
+    )
+    assert list(df.columns) == ["date"] + [f"feature_{i}" for i in range(6)]
+    assert len(df) == 200 and len(y) == 200
+    assert df["date"].between("2020-01-01", "2020-06-01").all()
+    assert set(np.unique(y)) <= {0, 1}
+
+
+def test_make_classification_df_predictability_response_rate():
+    """Reference semantics: predictability = informative-feature fraction,
+    response_rate = positive-class share (ref
+    dask_ml/datasets.py::make_classification_df)."""
+    from dask_ml_tpu.datasets import make_classification_df
+
+    df, y = make_classification_df(
+        n_samples=4000, n_features=10, predictability=0.5,
+        response_rate=0.2, random_state=0, flip_y=0.0,
+    )
+    rate = float((y == 1).mean())
+    assert abs(rate - 0.2) < 0.05, rate
+    # predictability=0.5 of 10 features -> 5 informative: a linear model
+    # must beat chance comfortably
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    acc = SkLR(max_iter=200).fit(df.values, y).score(df.values, y)
+    assert acc > 0.75, acc
+
+    import pytest
+
+    with pytest.raises(ValueError):
+        make_classification_df(predictability=1.5)
+    with pytest.raises(ValueError):
+        make_classification_df(response_rate=0.0)
+    with pytest.raises(TypeError):
+        make_classification_df(bogus_arg=1)

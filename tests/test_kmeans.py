@@ -86,3 +86,43 @@ def test_kmeans_pallas_path_matches_xla(blobs):
     np.testing.assert_array_equal(
         pls.labels_.to_numpy(), xla.labels_.to_numpy()
     )
+
+
+def test_fused_assign_update_parity():
+    """Interpret-mode parity of the fused Pallas kernel (labels/mind/sums/
+    counts/inertia) vs a NumPy reference, across padding and mask cases."""
+    from dask_ml_tpu.ops.pallas_fused import fused_assign_update
+
+    rng = np.random.RandomState(0)
+    for n, d, k, nvalid in [(256, 8, 4, 256), (137, 7, 3, 130),
+                            (1000, 13, 5, 900), (513, 3, 2, 500)]:
+        x = rng.randn(n, d).astype(np.float32)
+        mask = (np.arange(n) < nvalid).astype(np.float32)
+        c = rng.randn(k, d).astype(np.float32)
+        lab, mind, sums, counts, inertia = [
+            np.asarray(v) for v in fused_assign_update(x, mask, c, interpret=True)
+        ]
+        # reference uses the same ||x||^2 - 2xc + ||c||^2 expansion so
+        # f32 near-ties resolve identically
+        d2 = (
+            (x * x).sum(1)[:, None]
+            - 2.0 * (x @ c.T)
+            + (c * c).sum(1)[None, :]
+        ).clip(min=0)
+        lab_ref = d2.argmin(1)
+        mind_ref = d2.min(1) * mask
+        # argmin may legitimately differ on f32 near-ties (BLAS vs XLA
+        # accumulation order); require the kernel's pick to be within
+        # rounding noise of the row minimum instead of bit-equality
+        np.testing.assert_allclose(
+            d2[np.arange(n), lab], d2[np.arange(n), lab_ref],
+            rtol=1e-5, atol=1e-4,
+        )
+        np.testing.assert_allclose(mind, mind_ref, rtol=1e-4, atol=1e-4)
+        sums_ref = np.zeros((k, d), np.float32)
+        np.add.at(sums_ref, lab_ref, x * mask[:, None])
+        np.testing.assert_allclose(sums, sums_ref, rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(
+            counts, np.bincount(lab_ref, weights=mask, minlength=k)
+        )
+        np.testing.assert_allclose(inertia, mind_ref.sum(), rtol=1e-4)

@@ -157,7 +157,9 @@ def test_check_chunks():
 
     from dask_ml_tpu.utils import check_chunks
 
-    assert check_chunks(1000, 16, chunks=50) == (50, 16)
+    # integer = NUMBER of blocks (reference semantics), 100-row floor
+    assert check_chunks(1000, 16, chunks=5) == (200, 16)
+    assert check_chunks(1000, 16, chunks=50) == (100, 16)
     assert check_chunks(1000, 16, chunks=(50, 16)) == (50, 16)
     rows, cols = check_chunks(1000, 16)
     assert cols == 16 and 1 <= rows <= 1000
