@@ -8,6 +8,9 @@ not counted, so this undercounts true throughput). vs_baseline is the ratio
 against scikit-learn's lbfgs LogisticRegression measured the same way on a
 subsample on this host's CPU — the reference's per-block compute engine
 (SURVEY.md §6: no published in-repo numbers; BASELINE.json configs[0]).
+
+Data is generated ON DEVICE (jax.random) and stays there: the benchmark
+measures the compute path, not the host→device tunnel.
 """
 
 import json
@@ -17,47 +20,63 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+# persistent compile cache: repeat driver runs skip the ~40s XLA compile
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+)
+
 import numpy as np
 
 
 def main():
     import jax
+    import jax.numpy as jnp
 
     import dask_ml_tpu  # noqa: F401
     from dask_ml_tpu.linear_model import LogisticRegression
+    from dask_ml_tpu.parallel import as_sharded
 
     n_chips = len(jax.devices())
     on_tpu = jax.default_backend() == "tpu"
     n_rows = 4_000_000 if on_tpu else 200_000
     n_feat = 256 if on_tpu else 64
 
-    rng = np.random.RandomState(0)
-    beta_true = rng.randn(n_feat).astype(np.float32) / np.sqrt(n_feat)
-    X = rng.randn(n_rows, n_feat).astype(np.float32)
-    logits = X @ beta_true
-    y = (rng.uniform(size=n_rows) < 1.0 / (1.0 + np.exp(-logits))).astype(
-        np.float32
-    )
+    key = jax.random.PRNGKey(0)
+    kb, kx, ky = jax.random.split(key, 3)
+    beta_true = jax.random.normal(kb, (n_feat,)) / np.sqrt(n_feat)
+
+    @jax.jit
+    def gen():
+        X = jax.random.normal(kx, (n_rows, n_feat), jnp.float32)
+        p = jax.nn.sigmoid(X @ beta_true)
+        y = (jax.random.uniform(ky, (n_rows,)) < p).astype(jnp.float32)
+        return X, y
+
+    X, y = jax.block_until_ready(gen())
+    Xs, ys = as_sharded(X), as_sharded(y)
 
     max_iter = 50
     # warm the compile cache AT FULL SHAPE (XLA programs are
     # shape-specialized) with a 1-iteration fit
-    LogisticRegression(solver="lbfgs", max_iter=1, tol=0.0).fit(X, y)
+    LogisticRegression(solver="lbfgs", max_iter=1, tol=0.0).fit(Xs, ys)
 
     t0 = time.perf_counter()
     clf = LogisticRegression(solver="lbfgs", max_iter=max_iter, tol=0.0)
-    clf.fit(X, y)
+    clf.fit(Xs, ys)
     elapsed = time.perf_counter() - t0
     iters = clf.n_iter_ or max_iter
     value = n_rows * iters / elapsed / n_chips
 
-    # sklearn reference on a subsample of the same data
+    # sklearn reference on a host subsample of the same data
     from sklearn.linear_model import LogisticRegression as SkLR
 
     sub = min(n_rows, 100_000)
+    Xh = np.asarray(X[:sub])
+    yh = np.asarray(y[:sub])
     sk = SkLR(solver="lbfgs", max_iter=max_iter, tol=0.0)
     t0 = time.perf_counter()
-    sk.fit(X[:sub], y[:sub])
+    sk.fit(Xh, yh)
     sk_elapsed = time.perf_counter() - t0
     sk_iters = int(np.max(sk.n_iter_)) or max_iter
     sk_value = sub * sk_iters / sk_elapsed

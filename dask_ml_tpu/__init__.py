@@ -1,14 +1,27 @@
 """dask_ml_tpu — a TPU-native distributed ML framework with the
 capabilities of dask-ml (see SURVEY.md for the blueprint).
 
-Layout:
-- ``parallel/`` — mesh/sharding substrate
-- ``ops/``      — reductions, distributed linalg, pairwise kernels
+Infrastructure layers:
+- ``parallel/`` — mesh/sharding substrate (ShardedArray, streaming,
+  multi-host runtime)
+- ``ops/``      — masked reductions, distributed linalg (TSQR /
+  randomized SVD), pairwise kernels, Pallas fused kernels
 - ``models/``   — estimator implementations + GLM solver library
-- ``utils/``    — validation helpers
-- sklearn-parity namespaces currently importable: ``linear_model``,
-  ``preprocessing``, ``metrics``, ``datasets`` (more land per
-  SURVEY.md §7's build plan).
+- ``io/``       — native (C++) block loaders
+- ``utils/``    — validation, checkpointing, observability, testing
+
+sklearn/dask-ml-parity namespaces (import as ``dask_ml_tpu.<name>``):
+``cluster``, ``compose``, ``datasets``, ``decomposition``, ``ensemble``,
+``feature_extraction``, ``impute``, ``linear_model``, ``metrics``,
+``model_selection``, ``naive_bayes``, ``preprocessing``, ``wrappers``,
+``xgboost``.
 """
 
 __version__ = "0.1.0"
+
+__all__ = [
+    "cluster", "compose", "config", "datasets", "decomposition",
+    "ensemble", "feature_extraction", "impute", "linear_model", "metrics",
+    "model_selection", "naive_bayes", "ops", "parallel", "preprocessing",
+    "utils", "wrappers", "xgboost", "__version__",
+]

@@ -18,6 +18,9 @@ import sklearn.datasets as skdata
 from .parallel.mesh import data_shards, resolve_mesh
 from .parallel.sharded import ShardedArray
 
+__all__ = ["make_classification", "make_regression", "make_blobs",
+           "make_counts", "make_classification_df"]
+
 
 def _per_shard(n_samples, mesh):
     s = data_shards(mesh)

@@ -24,6 +24,9 @@ from sklearn.feature_extraction import FeatureHasher as SkFeatureHasher
 from ..base import BaseEstimator, TransformerMixin
 from ..parallel.sharded import ShardedArray, as_sharded
 
+__all__ = ["HashingVectorizer", "FeatureHasher", "CountVectorizer",
+           "to_sharded_dense"]
+
 
 def _blocks(raw_documents, block_size=10_000):
     docs = list(raw_documents) if not isinstance(

@@ -16,6 +16,8 @@ from .base import BaseEstimator, TransformerMixin, to_host
 from .parallel.sharded import ShardedArray
 from .utils.validation import check_array, check_is_fitted
 
+__all__ = ["SimpleImputer"]
+
 _STRATEGIES = ("mean", "median", "most_frequent", "constant")
 
 

@@ -66,13 +66,15 @@ class HyperbandSearchCV(BaseIncrementalSearchCV):
 
     def _bracket_calls(self, n, r):
         eta = self.aggressiveness
-        calls = 0
+        calls = n * r
         while True:
-            calls += n * r if calls == 0 else 0
-            # successive rungs: top n/eta models train to r*eta
+            # successive rungs: top n/eta models train to min(r*eta,
+            # max_iter) — the same cap the SHA controller applies
+            # (_successive_halving.py next_target), so the estimate counts
+            # the final partial rung and the survivor's run to max_iter
             nk = max(1, math.floor(n / eta))
-            rk = r * eta
-            if nk <= 1 or rk > self.max_iter:
+            rk = min(r * eta, self.max_iter)
+            if rk == r:
                 break
             calls += nk * (rk - r)
             n, r = nk, rk

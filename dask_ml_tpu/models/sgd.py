@@ -163,6 +163,15 @@ class SGDClassifier(ClassifierMixin, _SGDBase):
             raise ValueError("SGDClassifier supports binary targets")
         self.classes_ = classes
 
+    def partial_fit(self, X, y, classes=None, **kwargs):
+        # sklearn contract: classes required on the first partial_fit call
+        # (adaptive searches pass it through fit_params, as with dask-ml)
+        if classes is None and getattr(self, "classes_", None) is None:
+            raise ValueError(
+                "classes must be passed on the first call to partial_fit."
+            )
+        return super().partial_fit(X, y, classes=classes, **kwargs)
+
     def _encode_y(self, y):
         y = np.asarray(y)
         if getattr(self, "classes_", None) is None:

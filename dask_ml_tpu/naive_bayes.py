@@ -18,6 +18,8 @@ from .metrics import accuracy_score
 from .parallel.sharded import ShardedArray
 from .utils.validation import check_X_y, check_array, check_is_fitted
 
+__all__ = ["GaussianNB"]
+
 
 @jax.jit
 def _class_stats(X, y, mask, classes):

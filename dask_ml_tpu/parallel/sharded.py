@@ -62,15 +62,25 @@ class ShardedArray:
         if isinstance(x, ShardedArray):
             return x if dtype is None else cls(x.data.astype(dtype), x.n_rows, x.mesh)
         mesh = resolve_mesh(mesh)
-        x = np.asarray(x)
-        if dtype is not None:
-            x = x.astype(dtype, copy=False)
+        on_device = isinstance(x, jax.Array) and not isinstance(
+            x, jax.core.Tracer
+        )
+        if on_device:
+            # pad + reshard on device — never round-trip through host
+            # memory (the tunnel/PCIe hop dominates at scale)
+            xp = jnp
+            if dtype is not None:
+                x = x.astype(dtype)
+        else:
+            xp = np
+            x = np.asarray(x)
+            if dtype is not None:
+                x = x.astype(dtype, copy=False)
         n = x.shape[0]
-        shards = data_shards(mesh)
-        n_pad = _padded_rows(n, shards)
+        n_pad = _padded_rows(n, data_shards(mesh))
         if n_pad != n:
             pad_widths = [(0, n_pad - n)] + [(0, 0)] * (x.ndim - 1)
-            x = np.pad(x, pad_widths)
+            x = xp.pad(x, pad_widths)
         spec = P(*((DATA_AXIS,) + (None,) * (x.ndim - 1)))
         data = jax.device_put(x, NamedSharding(mesh, spec))
         return cls(data, n, mesh)

@@ -25,6 +25,8 @@ from .base import BaseEstimator, clone
 from .metrics import accuracy_score, r2_score
 from .parallel.sharded import ShardedArray, as_sharded
 
+__all__ = ["ParallelPostFit", "Incremental"]
+
 
 def _is_device_estimator(est):
     return est.__class__.__module__.startswith("dask_ml_tpu")

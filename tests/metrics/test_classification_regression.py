@@ -1,0 +1,109 @@
+"""Classification/regression metric + scorer parity vs sklearn
+(ref: dask_ml/metrics/{classification,regression,scorer}.py)."""
+
+import numpy as np
+import pytest
+import sklearn.metrics as skm
+
+from dask_ml_tpu import metrics as dm
+
+
+@pytest.fixture(scope="module")
+def preds():
+    rng = np.random.RandomState(0)
+    y_true = rng.randint(0, 2, size=400).astype(np.float64)
+    y_pred = np.where(rng.uniform(size=400) < 0.8, y_true,
+                      1 - y_true)
+    proba = np.clip(
+        y_true * 0.7 + rng.uniform(size=400) * 0.3, 1e-6, 1 - 1e-6
+    )
+    w = rng.uniform(0.5, 2.0, size=400)
+    return y_true, y_pred, proba, w
+
+
+def test_accuracy(preds):
+    y, p, _, w = preds
+    assert np.isclose(float(dm.accuracy_score(y, p)), skm.accuracy_score(y, p))
+    assert np.isclose(
+        float(dm.accuracy_score(y, p, sample_weight=w)),
+        skm.accuracy_score(y, p, sample_weight=w),
+    )
+    assert np.isclose(
+        float(dm.accuracy_score(y, p, normalize=False)),
+        skm.accuracy_score(y, p, normalize=False),
+    )
+
+
+def test_log_loss(preds):
+    y, _, proba, w = preds
+    assert np.isclose(float(dm.log_loss(y, proba)), skm.log_loss(y, proba),
+                      rtol=1e-5)
+    assert np.isclose(
+        float(dm.log_loss(y, proba, sample_weight=w)),
+        skm.log_loss(y, proba, sample_weight=w), rtol=1e-5,
+    )
+    # 2-column probability input
+    P = np.stack([1 - proba, proba], axis=1)
+    assert np.isclose(float(dm.log_loss(y, P)), skm.log_loss(y, P), rtol=1e-5)
+
+
+def test_regression_metrics():
+    rng = np.random.RandomState(1)
+    y = rng.uniform(1, 10, size=300)
+    p = y + rng.normal(scale=0.5, size=300)
+    w = rng.uniform(0.5, 2.0, size=300)
+    pairs = [
+        (dm.mean_squared_error, skm.mean_squared_error),
+        (dm.mean_absolute_error, skm.mean_absolute_error),
+        (dm.r2_score, skm.r2_score),
+        (dm.mean_squared_log_error, skm.mean_squared_log_error),
+    ]
+    for ours, ref in pairs:
+        assert np.isclose(float(ours(y, p)), ref(y, p), rtol=1e-5), ours
+        assert np.isclose(
+            float(ours(y, p, sample_weight=w)), ref(y, p, sample_weight=w),
+            rtol=1e-5,
+        ), ours
+
+
+def test_mse_squared_false():
+    rng = np.random.RandomState(2)
+    y = rng.uniform(size=100)
+    p = rng.uniform(size=100)
+    assert np.isclose(
+        float(dm.mean_squared_error(y, p, squared=False)),
+        np.sqrt(skm.mean_squared_error(y, p)), rtol=1e-5,
+    )
+
+
+def test_scorer_registry():
+    from dask_ml_tpu.metrics.scorer import SCORERS, check_scoring, get_scorer
+
+    assert "accuracy" in SCORERS and "r2" in SCORERS
+    assert "neg_mean_squared_error" in SCORERS
+    with pytest.raises((ValueError, KeyError)):
+        get_scorer("not_a_scorer")
+
+    from sklearn.linear_model import SGDClassifier
+
+    est = SGDClassifier()
+    scorer = check_scoring(est, "accuracy")
+    X = np.random.RandomState(0).randn(50, 3)
+    y = (X[:, 0] > 0).astype(int)
+    est.fit(X, y)
+    s = scorer(est, X, y)
+    assert 0.0 <= float(s) <= 1.0
+
+
+def test_scorer_greater_is_better_sign():
+    """neg_* scorers must return negated losses so search maximizes."""
+    from dask_ml_tpu.metrics.scorer import get_scorer
+
+    from sklearn.linear_model import LinearRegression
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(80, 3)
+    y = X @ np.array([1.0, -2.0, 0.5]) + rng.normal(scale=0.1, size=80)
+    est = LinearRegression().fit(X, y)
+    val = get_scorer("neg_mean_squared_error")(est, X, y)
+    assert float(val) <= 0.0

@@ -1,0 +1,140 @@
+"""Hyperband/SuccessiveHalving depth tests: bracket math vs the published
+Hyperband table, metadata()/metadata_ consistency, sklearn- and
+device-estimator integration (ref: dask_ml/model_selection/_hyperband.py,
+SURVEY.md §3.5)."""
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_classification
+from sklearn.linear_model import SGDClassifier
+
+from dask_ml_tpu.model_selection import (
+    HyperbandSearchCV,
+    SuccessiveHalvingSearchCV,
+)
+from dask_ml_tpu.model_selection._hyperband import _brackets
+
+
+def test_bracket_table_81_3():
+    """The canonical (max_iter=81, eta=3) table from Li et al. 2016."""
+    assert _brackets(81, 3) == [
+        (4, 81, 1), (3, 34, 3), (2, 15, 9), (1, 8, 27), (0, 5, 81)
+    ]
+
+
+def test_bracket_table_27_3():
+    assert _brackets(27, 3) == [(3, 27, 1), (2, 12, 3), (1, 6, 9), (0, 4, 27)]
+
+
+@pytest.fixture(scope="module")
+def data():
+    X, y = make_classification(
+        n_samples=600, n_features=10, n_informative=6, random_state=0
+    )
+    return X, y
+
+
+@pytest.mark.parametrize("max_iter", [9, 10])  # power and non-power of eta
+def test_metadata_matches_actual_work(data, max_iter):
+    """Pre-fit metadata() must predict the realized partial_fit_calls
+    exactly when patience is off (reference parity: metadata vs metadata_).
+    max_iter=10 exercises the capped final rung (min(r*eta, max_iter))."""
+    X, y = data
+    h = HyperbandSearchCV(
+        SGDClassifier(tol=1e-3), {"alpha": np.logspace(-4, -1, 30)},
+        max_iter=max_iter, random_state=0,
+    )
+    pre = h.metadata()
+    h.fit(X, y, classes=[0, 1])
+    assert pre["n_models"] == h.metadata_["n_models"]
+    assert pre["partial_fit_calls"] == h.metadata_["partial_fit_calls"]
+    for b_pre, b_post in zip(pre["brackets"], h.metadata_["brackets"]):
+        assert b_pre["bracket"] == b_post["bracket"]
+        assert b_pre["n_models"] == b_post["n_models"]
+        assert b_pre["partial_fit_calls"] == b_post["partial_fit_calls"]
+
+
+def test_hyperband_with_sklearn_estimator(data):
+    X, y = data
+    h = HyperbandSearchCV(
+        SGDClassifier(tol=1e-3), {"alpha": np.logspace(-5, 0, 30)},
+        max_iter=9, random_state=0,
+    )
+    h.fit(X, y, classes=[0, 1])
+    assert h.best_score_ > 0.7
+    assert set(h.best_params_) == {"alpha"}
+    # cv_results_ structural parity
+    res = h.cv_results_
+    n = len(res["params"])
+    for key in ("test_score", "rank_test_score", "model_id",
+                "partial_fit_calls", "bracket", "param_alpha"):
+        assert len(res[key]) == n, key
+    assert res["rank_test_score"].min() == 1
+    # history records every scoring event with the reference's fields
+    rec = h.history_[0]
+    for field in ("model_id", "params", "partial_fit_calls", "score",
+                  "elapsed_wall_time", "bracket"):
+        assert field in rec, field
+    # model_history_ groups records per model
+    assert set(h.model_history_) == set(res["model_id"])
+    # post-fit API delegates to best_estimator_
+    assert h.predict(X[:10]).shape == (10,)
+    assert 0 <= h.score(X, y) <= 1
+
+
+def test_hyperband_with_device_sgd(data):
+    """Device-resident SGD (models/sgd.py) under the adaptive search,
+    with classes passed through fit params (sklearn contract)."""
+    from dask_ml_tpu.linear_model import SGDClassifier as DevSGD
+
+    X, y = data
+    h = HyperbandSearchCV(
+        DevSGD(), {"eta0": [0.001, 0.01, 0.1, 1.0]},
+        max_iter=4, aggressiveness=2, random_state=0,
+    )
+    h.fit(X.astype(np.float32), y.astype(np.float32), classes=[0.0, 1.0])
+    assert h.best_score_ > 0.6
+
+
+def test_device_sgd_partial_fit_requires_classes(data):
+    from dask_ml_tpu.linear_model import SGDClassifier as DevSGD
+
+    X, y = data
+    with pytest.raises(ValueError, match="classes"):
+        DevSGD().partial_fit(X[:50].astype(np.float32),
+                             y[:50].astype(np.float32))
+
+
+def test_sha_promotes_best(data):
+    X, y = data
+    sha = SuccessiveHalvingSearchCV(
+        SGDClassifier(tol=1e-3, random_state=0),
+        {"alpha": np.logspace(-4, -1, 20)},
+        n_initial_parameters=8, n_initial_iter=1, max_iter=9,
+        aggressiveness=3, random_state=0,
+    )
+    sha.fit(X, y, classes=[0, 1])
+    calls = sha.cv_results_["partial_fit_calls"]
+    # halving structure: survivors trained strictly longer; exactly one
+    # model reaches the full budget, the middle rung holds eta^-1 of the
+    # initial population (8 -> 2 -> 1 with eta=3 including the survivor)
+    assert calls.max() > calls.min()
+    assert (calls == calls.max()).sum() == 1
+    assert (calls > calls.min()).sum() == 2
+    # the reported best is the argmax of final scores (reference behavior:
+    # best-by-score over ALL models, not necessarily the longest-trained)
+    assert sha.best_index_ == int(np.nanargmax(sha.cv_results_["test_score"]))
+    assert sha.best_score_ >= np.nanmax(sha.cv_results_["test_score"]) - 1e-12
+
+
+def test_reproducible_with_random_state(data):
+    X, y = data
+    kw = dict(max_iter=4, aggressiveness=2, random_state=7)
+    h1 = HyperbandSearchCV(SGDClassifier(tol=1e-3, random_state=0),
+                           {"alpha": np.logspace(-4, -1, 10)}, **kw)
+    h2 = HyperbandSearchCV(SGDClassifier(tol=1e-3, random_state=0),
+                           {"alpha": np.logspace(-4, -1, 10)}, **kw)
+    h1.fit(X, y, classes=[0, 1])
+    h2.fit(X, y, classes=[0, 1])
+    assert h1.best_params_ == h2.best_params_
+    assert h1.best_score_ == h2.best_score_

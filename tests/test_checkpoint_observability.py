@@ -1,0 +1,100 @@
+"""Checkpoint/resume + observability + config subsystems (SURVEY.md §5:
+built beyond the reference — dask-ml restarts searches from scratch)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+
+def test_pytree_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    from dask_ml_tpu.utils import checkpoint as ckpt
+
+    tree = {
+        "beta": jnp.arange(6, dtype=jnp.float32),
+        "it": jnp.asarray(3),
+        "nested": {"m": jnp.ones((2, 2))},
+    }
+    path = os.path.join(tmp_path, "state")
+    ckpt.save_pytree(path, tree)
+    got = ckpt.restore_pytree(path, like=tree)
+    np.testing.assert_allclose(np.asarray(got["beta"]), np.arange(6))
+    assert int(got["it"]) == 3
+    np.testing.assert_allclose(np.asarray(got["nested"]["m"]), 1.0)
+
+
+def test_host_roundtrip(tmp_path):
+    from sklearn.linear_model import SGDClassifier
+
+    from dask_ml_tpu.utils import checkpoint as ckpt
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(50, 4)
+    y = (X[:, 0] > 0).astype(int)
+    est = SGDClassifier(random_state=0).fit(X, y)
+    p = os.path.join(tmp_path, "est.pkl")
+    ckpt.save_host(p, est)
+    got = ckpt.restore_host(p)
+    np.testing.assert_array_equal(got.predict(X), est.predict(X))
+
+
+def test_search_checkpoint_roundtrip(tmp_path):
+    from dask_ml_tpu.utils.checkpoint import SearchCheckpoint
+
+    sc = SearchCheckpoint(os.path.join(tmp_path, "search"))
+    assert sc.load() is None
+    history = [{"model_id": 0, "score": 0.5}]
+    meta = {0: {"partial_fit_calls": 3}}
+    sc.save_round(2, history, meta, models={0: "modelblob"})
+    state = sc.load()
+    assert state["round"] == 2
+    assert state["history"] == history
+    assert state["meta"] == meta
+    assert state["models"][0] == "modelblob"
+
+
+def test_metrics_logger_jsonl(tmp_path):
+    from dask_ml_tpu.utils.observability import MetricsLogger
+
+    p = os.path.join(tmp_path, "metrics.jsonl")
+    with MetricsLogger(p, extra={"run": "t1"}) as log:
+        log.log(step=0, loss=1.5)
+        log.log(step=1, loss=0.7, samples_per_sec=123.0)
+    lines = [json.loads(l) for l in open(p)]
+    assert len(lines) == 2
+    assert lines[0]["run"] == "t1" and lines[0]["step"] == 0
+    assert lines[1]["samples_per_sec"] == 123.0
+    assert all("time" in rec for rec in lines)
+
+
+def test_timed():
+    from dask_ml_tpu.utils.observability import timed
+
+    out, secs = timed(lambda a, b: a + b, 2, b=3)
+    assert out == 5 and secs >= 0.0
+
+
+def test_config_set_overrides_and_env():
+    from dask_ml_tpu import config
+
+    base = config.get_config()
+    assert base.dtype in ("float32", "bfloat16")
+    with config.set(stream_block_rows=4096, dtype="bfloat16"):
+        cfg = config.get_config()
+        assert cfg.stream_block_rows == 4096
+        assert cfg.dtype == "bfloat16"
+        with config.set(dtype="float32"):
+            assert config.get_config().dtype == "float32"
+            assert config.get_config().stream_block_rows == 4096
+    assert config.get_config().stream_block_rows == base.stream_block_rows
+
+
+def test_config_rejects_unknown_key():
+    from dask_ml_tpu import config
+
+    with pytest.raises(TypeError):
+        with config.set(not_a_field=1):
+            pass

@@ -1,0 +1,59 @@
+"""SimpleImputer parity vs sklearn (ref: dask_ml/impute.py; SURVEY.md §2a
+Imputation row — strategies mean/median/most_frequent/constant)."""
+
+import numpy as np
+import pytest
+from sklearn.impute import SimpleImputer as SkImputer
+
+from dask_ml_tpu.impute import SimpleImputer
+
+
+@pytest.fixture(scope="module")
+def data_nan():
+    rng = np.random.RandomState(0)
+    X = rng.randn(300, 6) * 3 + 1
+    miss = rng.uniform(size=X.shape) < 0.15
+    X[miss] = np.nan
+    return X
+
+
+def _np(a):
+    return a.to_numpy() if hasattr(a, "to_numpy") else np.asarray(a)
+
+
+@pytest.mark.parametrize("strategy", ["mean", "median", "most_frequent"])
+def test_strategy_parity(data_nan, strategy):
+    X = data_nan
+    ours = SimpleImputer(strategy=strategy).fit(X)
+    sk = SkImputer(strategy=strategy).fit(X)
+    rtol = 1e-4 if strategy != "median" else 2e-2  # device quantile interp
+    np.testing.assert_allclose(
+        np.asarray(ours.statistics_), sk.statistics_, rtol=rtol, atol=1e-3
+    )
+    out = _np(ours.transform(X))
+    assert not np.isnan(out).any()
+    np.testing.assert_allclose(out, sk.transform(X), rtol=rtol, atol=1e-3)
+
+
+def test_constant_strategy(data_nan):
+    X = data_nan
+    ours = SimpleImputer(strategy="constant", fill_value=-7.0).fit(X)
+    out = _np(ours.transform(X))
+    sk_out = SkImputer(strategy="constant", fill_value=-7.0).fit_transform(X)
+    np.testing.assert_allclose(out, sk_out, rtol=1e-5)
+
+
+def test_custom_missing_value():
+    X = np.array([[1.0, -1.0], [3.0, 4.0], [-1.0, 6.0]])
+    ours = SimpleImputer(missing_values=-1.0, strategy="mean").fit(X)
+    sk = SkImputer(missing_values=-1.0, strategy="mean").fit(X)
+    np.testing.assert_allclose(
+        np.asarray(ours.statistics_), sk.statistics_, rtol=1e-5
+    )
+    np.testing.assert_allclose(_np(ours.transform(X)), sk.transform(X),
+                               rtol=1e-5)
+
+
+def test_bad_strategy_raises():
+    with pytest.raises(ValueError):
+        SimpleImputer(strategy="nope").fit(np.ones((4, 2)))

@@ -1,0 +1,63 @@
+"""GaussianNB parity vs sklearn (SURVEY.md §4 oracle pattern;
+ref: dask_ml/naive_bayes.py)."""
+
+import numpy as np
+import pytest
+from sklearn.naive_bayes import GaussianNB as SkGNB
+
+from dask_ml_tpu.naive_bayes import GaussianNB
+
+
+@pytest.fixture(scope="module")
+def data():
+    from sklearn.datasets import make_classification
+
+    X, y = make_classification(
+        n_samples=600, n_features=8, n_informative=5, n_classes=3,
+        random_state=0,
+    )
+    return X.astype(np.float64), y.astype(np.float64)
+
+
+def test_fit_attribute_parity(data):
+    X, y = data
+    ours = GaussianNB().fit(X, y)
+    sk = SkGNB().fit(X, y)
+    np.testing.assert_array_equal(np.asarray(ours.classes_), sk.classes_)
+    np.testing.assert_allclose(
+        np.asarray(ours.class_count_), sk.class_count_
+    )
+    np.testing.assert_allclose(
+        np.asarray(ours.class_prior_), sk.class_prior_, rtol=1e-6
+    )
+    np.testing.assert_allclose(np.asarray(ours.theta_), sk.theta_, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(ours.var_), sk.var_,
+                               rtol=1e-3, atol=1e-6)
+
+
+def test_predict_parity(data):
+    X, y = data
+    ours = GaussianNB().fit(X, y)
+    sk = SkGNB().fit(X, y)
+    pred = np.asarray(
+        ours.predict(X).to_numpy()
+        if hasattr(ours.predict(X), "to_numpy") else ours.predict(X)
+    )
+    agree = (pred == sk.predict(X)).mean()
+    assert agree > 0.99, agree
+    assert abs(ours.score(X, y) - sk.score(X, y)) < 0.01
+
+
+def test_predict_proba_rows_sum_to_one(data):
+    X, y = data
+    ours = GaussianNB().fit(X, y)
+    proba = ours.predict_proba(X)
+    proba = proba.to_numpy() if hasattr(proba, "to_numpy") else np.asarray(proba)
+    np.testing.assert_allclose(proba.sum(1), 1.0, rtol=1e-5)
+    assert (proba >= 0).all()
+
+
+def test_unfitted_raises(data):
+    X, _ = data
+    with pytest.raises(Exception):
+        GaussianNB().predict(X)
