@@ -16,6 +16,7 @@ Hyperband reuse this engine, as in the reference.
 
 from __future__ import annotations
 
+import os
 import time
 
 import numpy as np
@@ -42,9 +43,21 @@ def _blocks_of(X, y, n_blocks):
 
 def fit(model_factory, params_list, train_blocks, X_test, y_test, scorer,
         additional_calls, fit_params=None, patience=False, tol=1e-3,
-        max_iter=None, prefix="", verbose=False):
+        max_iter=None, prefix="", verbose=False, checkpoint=None,
+        ckpt_token=None, hook_state=None):
     """Core controller (ref: _incremental.py::_fit). Returns
-    (info, models, history)."""
+    (info, models, history).
+
+    ``checkpoint`` (utils.checkpoint.SearchCheckpoint, optional) persists
+    (history, meta, models, active set, hook state) after every adaptive
+    round; an INTERRUPTED search whose saved identity token matches
+    ``ckpt_token`` resumes at round granularity instead of restarting
+    (SURVEY.md §5 — capability the reference lacks: its killed searches
+    lose all model futures). A checkpoint is cleared on successful
+    completion, so finished searches never leak state into new ones.
+    ``hook_state`` is a (get, set) pair persisting the adaptive hook's
+    schedule position (e.g. SHA's rung) alongside the controller state.
+    """
     fit_params = fit_params or {}
     models = {}
     meta = {}
@@ -52,14 +65,44 @@ def fit(model_factory, params_list, train_blocks, X_test, y_test, scorer,
     info = {}
     start = time.time()
     n_blocks = len(train_blocks)
+    round_idx = 0
+    active = None
 
-    for mid, params in enumerate(params_list):
-        models[mid] = model_factory(params)
-        meta[mid] = {
-            "model_id": mid, "params": params, "partial_fit_calls": 0,
-            "score": None, "block_cursor": 0,
-        }
-        info[mid] = []
+    restored = checkpoint.load() if checkpoint is not None else None
+    if restored is not None and restored.get("token") == ckpt_token \
+            and ckpt_token is not None:
+        round_idx = restored["round"]
+        history = restored["history"]
+        meta = restored["meta"]
+        models = restored["models"]
+        active = set(restored["active"])
+        # keep history timestamps monotonic across the restart
+        start = time.time() - restored.get("elapsed", 0.0)
+        if hook_state is not None and restored.get("hook") is not None:
+            hook_state[1](restored["hook"])
+        info = {mid: [r for r in history if r["model_id"] == mid]
+                for mid in models}
+    else:
+        restored = None
+
+    def save_round():
+        if checkpoint is None:
+            return
+        checkpoint.save_round(round_idx, history, meta, models, extra={
+            "token": ckpt_token,
+            "active": sorted(active) if active is not None else sorted(models),
+            "hook": hook_state[0]() if hook_state is not None else None,
+            "elapsed": time.time() - start,
+        })
+
+    if restored is None:
+        for mid, params in enumerate(params_list):
+            models[mid] = model_factory(params)
+            meta[mid] = {
+                "model_id": mid, "params": params, "partial_fit_calls": 0,
+                "score": None, "block_cursor": 0,
+            }
+            info[mid] = []
 
     def train_one(mid, n_calls):
         m = meta[mid]
@@ -87,11 +130,14 @@ def fit(model_factory, params_list, train_blocks, X_test, y_test, scorer,
         history.append(record)
         info[mid].append(record)
 
-    # first round: one call each
-    for mid in list(models):
-        train_one(mid, 1)
+    # first round: one call each (skipped when resuming a checkpoint)
+    if restored is None:
+        for mid in list(models):
+            train_one(mid, 1)
+        round_idx = 1
+        active = set(models)
+        save_round()
 
-    active = set(models)
     while active:
         instructions = additional_calls(
             {mid: info[mid] for mid in active}
@@ -123,7 +169,11 @@ def fit(model_factory, params_list, train_blocks, X_test, y_test, scorer,
             progressed = True
         if not progressed:
             break  # every requested model was retired; nothing can advance
+        round_idx += 1
+        save_round()
 
+    if checkpoint is not None:
+        checkpoint.clear()  # completed: never resume into a new search
     return info, models, meta, history
 
 
@@ -152,6 +202,17 @@ class BaseIncrementalSearchCV(BaseEstimator):
     def _additional_calls(self, info):
         raise NotImplementedError
 
+    def _reset_hook(self):
+        """Reset adaptive-schedule state at the start of each fit."""
+
+    def _hook_state(self):
+        """Schedule position persisted with checkpoints (e.g. SHA rung)."""
+        return {}
+
+    def _set_hook_state(self, state):
+        for k, v in state.items():
+            setattr(self, k, v)
+
     def _sample_params(self, n):
         return list(ParameterSampler(
             self.parameters, n, random_state=self.random_state
@@ -178,11 +239,51 @@ class BaseIncrementalSearchCV(BaseEstimator):
         def factory(params):
             return clone(self.estimator).set_params(**params)
 
+        self._reset_hook()
+        from ..config import get_config
+
+        ckpt_dir = get_config().checkpoint_dir
+        checkpoint = None
+        ckpt_token = None
+        if ckpt_dir:
+            import hashlib
+
+            from ..utils.checkpoint import SearchCheckpoint
+            from ._normalize import _token_piece, estimator_token
+
+            # identity token: a stale checkpoint from a different search
+            # (estimator, candidate params, data shape, split, budget)
+            # must NOT be resumed — it would relabel old models with new
+            # params or leak a different split's training rows into test
+            # scores. random_state=None draws a fresh split every run, so
+            # resume is disabled (token None): the split cannot be
+            # reproduced.
+            if self.random_state is not None:
+                ckpt_token = hashlib.sha1("|".join([
+                    type(self).__name__, self.prefix,
+                    estimator_token(self.estimator),
+                    _token_piece(params_list),
+                    str(getattr(X, "shape", np.shape(X))),
+                    str(len(blocks)), str(self.max_iter),
+                    str(self.patience), str(self.tol),
+                    str(self.random_state), str(test_size),
+                ]).encode()).hexdigest()
+            # per-search directory: another search of the same class must
+            # not overwrite or clear this search's resumable state
+            sub = "-".join(
+                p for p in (type(self).__name__, self.prefix,
+                            ckpt_token[:12] if ckpt_token else "noresume")
+                if p
+            )
+            checkpoint = SearchCheckpoint(os.path.join(ckpt_dir, sub))
+
         info, models, meta, history = fit(
             factory, params_list, blocks, X_test_h, y_test_h, scorer_raw,
             self._additional_calls, fit_params=fit_params,
             patience=self.patience, tol=self.tol, max_iter=self.max_iter,
-            prefix=self.prefix, verbose=self.verbose,
+            prefix=self.prefix, verbose=self.verbose, checkpoint=checkpoint,
+            ckpt_token=ckpt_token,
+            hook_state=(self._hook_state, self._set_hook_state),
         )
 
         self.history_ = history
@@ -260,6 +361,13 @@ class IncrementalSearchCV(BaseIncrementalSearchCV):
         self.decay_rate = decay_rate
         self.fits_per_score = fits_per_score
         self._step = 0
+
+    def _reset_hook(self):
+        # re-fitting the same instance must restart the decay schedule
+        self._step = 0
+
+    def _hook_state(self):
+        return {"_step": self._step}
 
     def _n_initial(self):
         if self.n_initial_parameters == "grid":

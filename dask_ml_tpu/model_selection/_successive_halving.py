@@ -34,9 +34,13 @@ class SuccessiveHalvingSearchCV(BaseIncrementalSearchCV):
     def fit(self, X, y=None, **fit_params):
         if self.n_initial_iter is None:
             raise ValueError("n_initial_iter must be specified")
-        self._rung = 0
-        self._steps_done = {}
         return super().fit(X, y, **fit_params)
+
+    def _reset_hook(self):
+        self._rung = 0
+
+    def _hook_state(self):
+        return {"_rung": self._rung}
 
     def _additional_calls(self, info):
         eta = self.aggressiveness

@@ -81,6 +81,17 @@ class _GLMBase(BaseEstimator):
         if self.penalty not in regularizers.KNOWN:
             raise ValueError(f"Unknown penalty {self.penalty!r}")
         data = self._design(X)
+        from ..config import get_config
+
+        if get_config().dtype == "bfloat16" and self.solver in (
+            "lbfgs", "gradient_descent", "proximal_grad"
+        ):
+            # bf16 design matrix: the _smooth_loss matvec rides the MXU at
+            # bf16 rate with f32 accumulation; solver state / y / mask
+            # stay f32. Newton/ADMM are excluded — their Hessian matmuls
+            # would silently upcast (no speedup) and bf16 Hessians risk
+            # conditioning
+            data = data.astype(jnp.bfloat16)
         y_data, classes = self._encode_y(y)
         d = data.shape[1]
         pmask = np.ones(d, np.float32)
@@ -90,17 +101,17 @@ class _GLMBase(BaseEstimator):
         beta0 = (
             jnp.asarray(np.r_[self._coef_flat(), self.intercept_]
                         if self.fit_intercept else self._coef_flat(),
-                        dtype=data.dtype)
+                        dtype=jnp.float32)
             if self.warm_start and hasattr(self, "coef_")
-            else jnp.zeros(d, data.dtype)
+            else jnp.zeros(d, jnp.float32)
         )
         kwargs = dict(self.solver_kwargs or {})
         l1_ratio = kwargs.pop("l1_ratio", 0.5)
         beta, info = solve(
             self.solver,
-            X=data, y=y_data, mask=X.row_mask(dtype=data.dtype),
+            X=data, y=y_data, mask=X.row_mask(dtype=jnp.float32),
             n_rows=X.n_rows, beta0=beta0, family=self.family,
-            reg=self.penalty, lam=jnp.asarray(lam, data.dtype),
+            reg=self.penalty, lam=jnp.asarray(lam, jnp.float32),
             pmask=jnp.asarray(pmask), l1_ratio=l1_ratio,
             max_iter=self.max_iter, tol=self.tol, mesh=mesh, **kwargs,
         )

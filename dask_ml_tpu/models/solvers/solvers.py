@@ -36,8 +36,15 @@ from ...ops.linalg import shard_map
 
 
 def _smooth_loss(beta, X, y, mask, n_rows, lam, pmask, l1_ratio, family, reg):
-    """Mask-weighted mean NLL + smooth penalty. One psum under jit."""
-    eta = X @ beta
+    """Mask-weighted mean NLL + smooth penalty. One psum under jit.
+
+    The matvec casts beta to X's dtype with f32 accumulation, so a bf16
+    design matrix (config.dtype="bfloat16") runs the MXU at bf16 rate
+    while the loss/penalty stay f32."""
+    eta = jax.lax.dot_general(
+        X, beta.astype(X.dtype), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
     base = jnp.sum(get_family(family).pointwise(eta, y) * mask) / n_rows
     return base + regularizers.value(reg, beta, lam, pmask, l1_ratio)
 

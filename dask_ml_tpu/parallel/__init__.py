@@ -1,5 +1,5 @@
 from . import distributed
 from .mesh import (DATA_AXIS, MODEL_AXIS, default_mesh, device_mesh,
                    resolve_mesh, use_mesh)
-from .sharded import ShardedArray, as_sharded, row_mask, take_rows
+from .sharded import ShardedArray, as_sharded, reshard, row_mask, take_rows
 from .streaming import Block, BlockStream

@@ -149,6 +149,24 @@ def as_sharded(x, mesh: Mesh | None = None, dtype=None) -> ShardedArray:
     return ShardedArray.from_array(x, mesh=mesh, dtype=dtype)
 
 
+def reshard(x: ShardedArray, mesh: Mesh | None = None) -> ShardedArray:
+    """Move a ShardedArray onto a different mesh — the rechunk-parity
+    primitive (ref ``dask/array/rechunk.py``, SURVEY.md §5 long-context
+    row). The repartition lowers to XLA collective-permute/all-to-all over
+    ICI when the device sets overlap; no task graph, no serialization.
+
+    Padding is recomputed for the target mesh's data-axis size (old
+    padding rows are zero, so slicing/padding on device preserves the
+    masked-reduction invariant).
+    """
+    mesh = resolve_mesh(mesh)
+    if mesh is x.mesh or mesh == x.mesh:
+        return x
+    # slice off the old padding on device, then reuse from_array's
+    # on-device pad + placement path for the target mesh
+    return ShardedArray.from_array(x.data[: x.n_rows], mesh=mesh)
+
+
 def take_rows(x: ShardedArray, idx) -> ShardedArray:
     """New ShardedArray of x's rows at (host) integer indices ``idx``.
 

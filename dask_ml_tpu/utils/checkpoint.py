@@ -66,13 +66,15 @@ class SearchCheckpoint:
     def _path(self, name):
         return os.path.join(self.directory, name)
 
-    def save_round(self, round_idx, history, meta, models):
+    def save_round(self, round_idx, history, meta, models, extra=None):
         state = {
             "round": round_idx,
             "history": history,
             "meta": meta,
             "models": models,
         }
+        if extra:
+            state.update(extra)
         save_host(self._path("controller.pkl"), state)
 
     def load(self):
@@ -80,3 +82,10 @@ class SearchCheckpoint:
         if not os.path.exists(p):
             return None
         return restore_host(p)
+
+    def clear(self):
+        """Remove the controller state — called on successful completion so
+        a finished search is never resumed into a new one."""
+        p = self._path("controller.pkl")
+        if os.path.exists(p):
+            os.remove(p)

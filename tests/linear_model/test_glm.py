@@ -109,3 +109,17 @@ def test_warm_start(xy_classification):
     c1 = clf.coef_.copy()
     clf.fit(X, y)  # warm restart from optimum: should stay there
     np.testing.assert_allclose(clf.coef_, c1, atol=1e-3)
+
+
+def test_bfloat16_config_parity(xy_classification):
+    """config.dtype='bfloat16' (MXU fast path) must match the f32 fit to
+    within bf16 rounding on a well-conditioned problem."""
+    from dask_ml_tpu import config
+
+    X, y = xy_classification
+    f32 = LogisticRegression(solver="lbfgs", max_iter=100).fit(X, y)
+    with config.set(dtype="bfloat16"):
+        bf16 = LogisticRegression(solver="lbfgs", max_iter=100).fit(X, y)
+    assert abs(f32.score(X, y) - bf16.score(X, y)) < 0.02
+    denom = np.linalg.norm(f32.coef_) + 1e-12
+    assert np.linalg.norm(f32.coef_ - bf16.coef_) / denom < 0.15

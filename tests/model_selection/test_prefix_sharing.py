@@ -1,0 +1,110 @@
+"""Work de-duplication tests: the controller prefix memo must fit shared
+pipeline prefixes ONCE per (fold, params) — the TPU analog of the
+reference's tokenized-graph de-dup (ref: dask_ml/model_selection/
+_normalize.py + _search.py build_graph; SURVEY.md §3.4, §4
+"graph-determinism tests")."""
+
+import numpy as np
+import pytest
+from sklearn.base import BaseEstimator, TransformerMixin
+from sklearn.linear_model import Ridge
+from sklearn.pipeline import Pipeline
+
+from dask_ml_tpu.model_selection import GridSearchCV
+from dask_ml_tpu.model_selection._normalize import estimator_token
+
+FIT_CALLS = {"n": 0}
+
+
+class CountingScaler(TransformerMixin, BaseEstimator):
+    """Transformer that counts fit calls (de-dup oracle)."""
+
+    def __init__(self, with_mean=True):
+        self.with_mean = with_mean
+
+    def fit(self, X, y=None):
+        FIT_CALLS["n"] += 1
+        self.mean_ = np.asarray(X).mean(0) if self.with_mean else 0.0
+        return self
+
+    def transform(self, X):
+        return np.asarray(X) - self.mean_
+
+
+@pytest.fixture()
+def data():
+    rng = np.random.RandomState(0)
+    X = rng.randn(200, 6)
+    y = X @ rng.randn(6) + 0.1 * rng.randn(200)
+    return X, y
+
+
+def test_shared_prefix_fit_once_per_fold(data):
+    """Grid over ONLY the final step: the scaler must fit n_folds times
+    total, not n_folds * n_candidates (reference's headline optimization)."""
+    X, y = data
+    FIT_CALLS["n"] = 0
+    pipe = Pipeline([("scale", CountingScaler()), ("reg", Ridge())])
+    search = GridSearchCV(
+        pipe, {"reg__alpha": [0.01, 0.1, 1.0, 10.0]}, cv=3, refit=False
+    )
+    search.fit(X, y)
+    assert FIT_CALLS["n"] == 3, FIT_CALLS["n"]  # one per fold
+    hits, misses = search._memo_stats
+    assert hits > 0
+
+
+def test_prefix_params_partition_the_memo(data):
+    """Grid over scaler AND ridge params: scaler fits = n_folds *
+    n_scaler_settings."""
+    X, y = data
+    FIT_CALLS["n"] = 0
+    pipe = Pipeline([("scale", CountingScaler()), ("reg", Ridge())])
+    search = GridSearchCV(
+        pipe,
+        {"scale__with_mean": [True, False], "reg__alpha": [0.1, 1.0, 10.0]},
+        cv=2, refit=False,
+    )
+    search.fit(X, y)
+    assert FIT_CALLS["n"] == 2 * 2, FIT_CALLS["n"]
+
+
+def test_search_results_unaffected_by_memo(data):
+    """De-dup must not change scores: same cv_results_ as a memo-less run
+    (non-pipeline estimator takes the plain path)."""
+    X, y = data
+    pipe = Pipeline([("scale", CountingScaler()), ("reg", Ridge())])
+    grid = {"reg__alpha": [0.1, 1.0]}
+    a = GridSearchCV(pipe, grid, cv=3, refit=False).fit(X, y)
+    # plain sklearn as the no-sharing oracle
+    from sklearn.model_selection import GridSearchCV as SkGrid
+
+    b = SkGrid(pipe, grid, cv=3, refit=False).fit(X, y)
+    np.testing.assert_allclose(
+        a.cv_results_["mean_test_score"], b.cv_results_["mean_test_score"],
+        rtol=1e-10,
+    )
+    assert a.best_params_ == b.best_params_
+
+
+def test_estimator_token_stability():
+    """Same params => same token; different params / class => different.
+    (The reference's tokenize-determinism contract.)"""
+    assert estimator_token(Ridge(alpha=1.0)) == estimator_token(Ridge(alpha=1.0))
+    assert estimator_token(Ridge(alpha=1.0)) != estimator_token(Ridge(alpha=2.0))
+    assert estimator_token(Ridge()) != estimator_token(CountingScaler())
+    # ndarray-valued params hash by content
+    w1 = np.arange(4.0)
+    assert (
+        estimator_token(CountingScaler(with_mean=w1))
+        == estimator_token(CountingScaler(with_mean=np.arange(4.0)))
+    )
+    assert (
+        estimator_token(CountingScaler(with_mean=w1))
+        != estimator_token(CountingScaler(with_mean=w1 + 1))
+    )
+    # nested estimator params recurse
+    assert (
+        estimator_token(CountingScaler(with_mean=Ridge(alpha=1.0)))
+        == estimator_token(CountingScaler(with_mean=Ridge(alpha=1.0)))
+    )

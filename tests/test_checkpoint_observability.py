@@ -98,3 +98,162 @@ def test_config_rejects_unknown_key():
     with pytest.raises(TypeError):
         with config.set(not_a_field=1):
             pass
+
+
+class _FlakyClassifier:
+    """sklearn-compatible partial_fit classifier that raises after a set
+    number of partial_fit calls across ALL instances — fault injection for
+    the controller (SURVEY.md §5 failure row)."""
+
+    CALLS = {"n": 0, "fail_at": None}
+
+    def __init__(self, alpha=1e-4):
+        from sklearn.linear_model import SGDClassifier
+
+        self.alpha = alpha
+        self._est = SGDClassifier(alpha=alpha, tol=1e-3, random_state=0)
+
+    def get_params(self, deep=True):
+        return {"alpha": self.alpha}
+
+    def set_params(self, **p):
+        self.__init__(**{**self.get_params(), **p})
+        return self
+
+    def partial_fit(self, X, y, classes=None, **kw):
+        c = _FlakyClassifier.CALLS
+        c["n"] += 1
+        if c["fail_at"] is not None and c["n"] > c["fail_at"]:
+            raise RuntimeError("injected failure")
+        self._est.partial_fit(X, y, classes=classes)
+        return self
+
+    def predict(self, X):
+        return self._est.predict(X)
+
+    def score(self, X, y):
+        return self._est.score(X, y)
+
+
+def test_incremental_search_checkpoint_resume(tmp_path):
+    """A KILLED adaptive search resumes from its last round; a COMPLETED
+    one clears its checkpoint (SURVEY.md §5: beyond the reference, whose
+    killed searches restart from scratch)."""
+    from sklearn.datasets import make_classification
+
+    from dask_ml_tpu import config
+    from dask_ml_tpu.model_selection import IncrementalSearchCV
+    from dask_ml_tpu.utils.checkpoint import SearchCheckpoint
+
+    X, y = make_classification(n_samples=400, n_features=8, random_state=0)
+    params = {"alpha": list(np.logspace(-4, -1, 8))}
+    ckpt_dir = os.path.join(tmp_path, "ck")
+
+    def make_search():
+        return IncrementalSearchCV(
+            _FlakyClassifier(), params,
+            n_initial_parameters=4, max_iter=6, random_state=0,
+        )
+
+    # run 1: injected failure mid-search -> checkpoint survives
+    _FlakyClassifier.CALLS.update(n=0, fail_at=8)
+    with config.set(checkpoint_dir=ckpt_dir):
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError, match="injected"):
+            make_search().fit(X, y, classes=[0, 1])
+    # per-search subdirectory is keyed by the identity token
+    subs = os.listdir(ckpt_dir)
+    assert len(subs) == 1 and subs[0].startswith("IncrementalSearchCV-")
+    sub = os.path.join(ckpt_dir, subs[0])
+    state = SearchCheckpoint(sub).load()
+    assert state is not None and state["round"] >= 1
+    calls_before_crash = sum(
+        m["partial_fit_calls"] for m in state["meta"].values()
+    )
+    assert calls_before_crash >= 4
+
+    # run 2: same search resumes from the checkpoint and completes;
+    # the completed run clears the checkpoint
+    _FlakyClassifier.CALLS.update(n=0, fail_at=None)
+    with config.set(checkpoint_dir=ckpt_dir):
+        s2 = make_search().fit(X, y, classes=[0, 1])
+    new_calls = _FlakyClassifier.CALLS["n"]
+    assert hasattr(s2, "best_params_") and s2.best_score_ > 0.5
+    # resumed run re-used the checkpointed work: only the remaining calls
+    # were executed on fresh estimators
+    total_after = int(s2.cv_results_["partial_fit_calls"].sum())
+    assert new_calls == total_after - calls_before_crash
+    assert SearchCheckpoint(sub).load() is None  # cleared on completion
+
+
+def test_checkpoint_different_search_isolated(tmp_path):
+    """A DIFFERENT search (other budget) gets its own checkpoint dir: it
+    starts fresh AND leaves the interrupted search's state resumable."""
+    from sklearn.datasets import make_classification
+
+    from dask_ml_tpu import config
+    from dask_ml_tpu.model_selection import IncrementalSearchCV
+    from dask_ml_tpu.utils.checkpoint import SearchCheckpoint
+
+    X, y = make_classification(n_samples=300, n_features=6, random_state=0)
+    ckpt_dir = os.path.join(tmp_path, "ck2")
+
+    _FlakyClassifier.CALLS.update(n=0, fail_at=6)
+    with config.set(checkpoint_dir=ckpt_dir):
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError):
+            IncrementalSearchCV(
+                _FlakyClassifier(), {"alpha": [1e-4, 1e-3, 1e-2, 1e-1]},
+                n_initial_parameters=4, max_iter=6, random_state=0,
+            ).fit(X, y, classes=[0, 1])
+    sub_a = os.path.join(ckpt_dir, os.listdir(ckpt_dir)[0])
+    assert SearchCheckpoint(sub_a).load() is not None
+
+    # different search (different max_iter): own subdir, fresh run
+    _FlakyClassifier.CALLS.update(n=0, fail_at=None)
+    with config.set(checkpoint_dir=ckpt_dir):
+        s = IncrementalSearchCV(
+            _FlakyClassifier(), {"alpha": [1e-4, 1e-3, 1e-2, 1e-1]},
+            n_initial_parameters=4, max_iter=3, random_state=0,
+        ).fit(X, y, classes=[0, 1])
+    assert int(s.cv_results_["partial_fit_calls"].max()) <= 3
+    assert _FlakyClassifier.CALLS["n"] == int(
+        s.cv_results_["partial_fit_calls"].sum()
+    )
+    # the interrupted search's checkpoint is untouched and still resumable
+    assert SearchCheckpoint(sub_a).load() is not None
+
+
+def test_checkpoint_resume_disabled_without_random_state(tmp_path):
+    """random_state=None draws a fresh split per run — resume must be
+    disabled (a resumed model would be scored on rows it trained on)."""
+    from sklearn.datasets import make_classification
+
+    from dask_ml_tpu import config
+    from dask_ml_tpu.model_selection import IncrementalSearchCV
+
+    X, y = make_classification(n_samples=300, n_features=6, random_state=0)
+    ckpt_dir = os.path.join(tmp_path, "ck3")
+    _FlakyClassifier.CALLS.update(n=0, fail_at=6)
+    with config.set(checkpoint_dir=ckpt_dir):
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError):
+            IncrementalSearchCV(
+                _FlakyClassifier(), {"alpha": [1e-4, 1e-3, 1e-2, 1e-1]},
+                n_initial_parameters=4, max_iter=6, random_state=None,
+            ).fit(X, y, classes=[0, 1])
+    assert os.listdir(ckpt_dir) == ["IncrementalSearchCV-noresume"]
+
+    # rerun completes from scratch (no resume), using its own full budget
+    _FlakyClassifier.CALLS.update(n=0, fail_at=None)
+    with config.set(checkpoint_dir=ckpt_dir):
+        s = IncrementalSearchCV(
+            _FlakyClassifier(), {"alpha": [1e-4, 1e-3, 1e-2, 1e-1]},
+            n_initial_parameters=4, max_iter=6, random_state=None,
+        ).fit(X, y, classes=[0, 1])
+    assert _FlakyClassifier.CALLS["n"] == int(
+        s.cv_results_["partial_fit_calls"].sum()
+    )

@@ -70,3 +70,42 @@ def test_metrics_sample_weight_with_padding():
     got_r2 = metrics.r2_score(sy, p, sample_weight=w)
     from sklearn.metrics import r2_score as sk_r2
     assert got_r2 == pytest.approx(sk_r2(y, p, sample_weight=w), abs=1e-5)
+
+
+def test_reshard_between_meshes():
+    """reshard = rechunk-parity repartition (SURVEY.md §5): values survive
+    a move to a smaller mesh and back, across padding granularities."""
+    import jax
+
+    from dask_ml_tpu.parallel import as_sharded, device_mesh, reshard
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(1003, 5).astype(np.float32)  # odd rows: padding differs
+    devs = jax.devices()
+    full = device_mesh(devices=devs)
+    small = device_mesh(devices=devs[:4])
+    a = as_sharded(x, mesh=full)
+    b = reshard(a, small)
+    assert b.mesh.shape["data"] == 4
+    assert b.n_rows == 1003
+    assert b.padded_shape[0] % 4 == 0
+    np.testing.assert_array_equal(b.to_numpy(), x)
+    c = reshard(b, full)
+    assert c.mesh.shape["data"] == len(devs)
+    np.testing.assert_array_equal(c.to_numpy(), x)
+    # same-mesh reshard is a no-op (returns the same object)
+    assert reshard(c, full) is c
+    # padded region of the resharded array stays zero (mask invariant)
+    pad = np.asarray(b.data)[b.n_rows:]
+    assert (pad == 0).all()
+
+
+def test_reshard_1d_array():
+    import jax
+
+    from dask_ml_tpu.parallel import as_sharded, device_mesh, reshard
+
+    y = np.arange(37, dtype=np.float32)
+    small = device_mesh(devices=jax.devices()[:2])
+    b = reshard(as_sharded(y), small)
+    np.testing.assert_array_equal(b.to_numpy(), y)
