@@ -2,9 +2,9 @@
 from .classification import accuracy_score, log_loss
 from .regression import (mean_absolute_error, mean_squared_error,
                          mean_squared_log_error, r2_score)
-from ..ops.pairwise import (cosine_distances, euclidean_distances,
-                            linear_kernel, manhattan_distances,
-                            pairwise_distances, pairwise_distances_argmin_min,
-                            pairwise_kernels, polynomial_kernel, rbf_kernel,
-                            sigmoid_kernel)
+from .pairwise import (cosine_distances, euclidean_distances,
+                       linear_kernel, manhattan_distances,
+                       pairwise_distances, pairwise_distances_argmin_min,
+                       pairwise_kernels, polynomial_kernel, rbf_kernel,
+                       sigmoid_kernel)
 from .scorer import SCORERS, check_scoring, get_scorer

@@ -1,0 +1,60 @@
+"""Public pairwise metrics namespace (ref: dask_ml/metrics/pairwise.py).
+
+The raw primitives in ``ops/pairwise.py`` operate on padded device arrays
+(internal hot paths — KMeans, Nyström — mask padding themselves). The
+PUBLIC functions here accept ShardedArray / numpy / jax inputs and return
+results sliced to the logical rows, matching the reference's contract
+that ``pairwise_distances(X, Y)`` has exactly ``len(X)`` rows.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from ..ops import pairwise as _ops
+from ..ops.pairwise import PAIRWISE_KERNEL_FUNCTIONS  # noqa: F401
+
+
+def _logical_rows(x):
+    if hasattr(x, "data") and hasattr(x, "n_rows"):
+        return x.n_rows
+    return None
+
+
+def _public(fn, n_outputs=1):
+    @functools.wraps(fn)
+    def wrapped(X, Y=None, *args, **kwargs):
+        # sklearn/dask-ml contract: Y=None means X-vs-X, Y passed by
+        # keyword works
+        n = _logical_rows(X)
+        if Y is None:
+            Y = X
+        out = fn(_ops._unwrap_x(X), _ops._unwrap_y(Y), *args, **kwargs)
+        if n is None:
+            return out
+        if n_outputs == 1:
+            return out[:n]
+        return tuple(o[:n] for o in out)
+
+    return wrapped
+
+
+pairwise_distances = _public(_ops.pairwise_distances)
+pairwise_kernels = _public(_ops.pairwise_kernels)
+euclidean_distances = _public(_ops.euclidean_distances)
+manhattan_distances = _public(_ops.manhattan_distances)
+cosine_distances = _public(_ops.cosine_distances)
+linear_kernel = _public(_ops.linear_kernel)
+rbf_kernel = _public(_ops.rbf_kernel)
+polynomial_kernel = _public(_ops.polynomial_kernel)
+sigmoid_kernel = _public(_ops.sigmoid_kernel)
+pairwise_distances_argmin_min = _public(
+    _ops.pairwise_distances_argmin_min, n_outputs=2
+)
+
+__all__ = [
+    "cosine_distances", "euclidean_distances", "linear_kernel",
+    "manhattan_distances", "pairwise_distances",
+    "pairwise_distances_argmin_min", "pairwise_kernels",
+    "polynomial_kernel", "rbf_kernel", "sigmoid_kernel",
+]

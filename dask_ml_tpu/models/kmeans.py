@@ -220,6 +220,21 @@ def init_random(X: ShardedArray, n_clusters, random_state):
     return jnp.take(data, idx, axis=0)
 
 
+def k_means(X, n_clusters, init="k-means||", max_iter=300, tol=1e-4,
+            random_state=None, oversampling_factor=2, init_max_iter=None,
+            return_n_iter=False):
+    """Functional API (ref: dask_ml/cluster/k_means.py::k_means):
+    returns (centroids, labels, inertia[, n_iter])."""
+    est = KMeans(
+        n_clusters=n_clusters, init=init, max_iter=max_iter, tol=tol,
+        random_state=random_state, oversampling_factor=oversampling_factor,
+        init_max_iter=init_max_iter,
+    ).fit(X)
+    if return_n_iter:
+        return est.cluster_centers_, est.labels_, est.inertia_, est.n_iter_
+    return est.cluster_centers_, est.labels_, est.inertia_
+
+
 class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
     """Ref: dask_ml/cluster/k_means.py::KMeans."""
 

@@ -57,3 +57,62 @@ def test_argmin_min_parity(xy):
     want_l, want_m = skpw.pairwise_distances_argmin_min(x, y)
     np.testing.assert_array_equal(np.asarray(labels), want_l)
     np.testing.assert_allclose(np.asarray(mins), want_m, rtol=1e-5, atol=1e-6)
+
+
+def test_public_metrics_accept_sharded_and_slice_padding():
+    """Public metrics functions take ShardedArray X and return exactly
+    len(X) rows — padding must never leak (ref contract:
+    dask_ml/metrics/pairwise.py returns len(X)-row dask arrays)."""
+    from dask_ml_tpu import metrics as m
+    from dask_ml_tpu.parallel import as_sharded
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(101, 7).astype(np.float32)  # odd count forces padding
+    yc = rng.randn(5, 7).astype(np.float32)
+    xs = as_sharded(x)
+    assert xs.padded_shape[0] > 101  # padding actually present
+
+    for fn, ref in [
+        (m.euclidean_distances, skpw.euclidean_distances),
+        (m.manhattan_distances, skpw.manhattan_distances),
+        (m.cosine_distances, skpw.cosine_distances),
+        (m.rbf_kernel, skpw.rbf_kernel),
+        (m.linear_kernel, skpw.linear_kernel),
+    ]:
+        out = np.asarray(fn(xs, yc))
+        assert out.shape[0] == 101, fn.__name__
+        np.testing.assert_allclose(out, ref(x, yc), rtol=1e-4, atol=1e-4)
+
+    labels, mins = m.pairwise_distances_argmin_min(xs, yc)
+    wl, wm = skpw.pairwise_distances_argmin_min(x, yc)
+    assert len(labels) == 101 and len(mins) == 101
+    np.testing.assert_array_equal(np.asarray(labels), wl)
+    np.testing.assert_allclose(np.asarray(mins), wm, rtol=1e-4, atol=1e-4)
+
+    out = np.asarray(m.pairwise_distances(xs, yc))
+    assert out.shape == (101, 5)
+    out = np.asarray(m.pairwise_kernels(xs, yc, metric="rbf"))
+    assert out.shape == (101, 5)
+
+
+def test_pairwise_y_none_and_keyword():
+    """sklearn/dask-ml contract: Y=None means X-vs-X; Y passes by keyword."""
+    from dask_ml_tpu import metrics as m
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(20, 4)
+    # f32 device math vs sklearn's f64: near-zero distances carry
+    # expansion-cancellation noise ~sqrt(eps_f32)
+    np.testing.assert_allclose(
+        np.asarray(m.pairwise_distances(x)), skpw.pairwise_distances(x),
+        rtol=1e-4, atol=2e-3,
+    )
+    np.testing.assert_allclose(
+        np.asarray(m.euclidean_distances(x)), skpw.euclidean_distances(x),
+        rtol=1e-4, atol=2e-3,
+    )
+    yc = rng.randn(3, 4)
+    np.testing.assert_allclose(
+        np.asarray(m.rbf_kernel(x, Y=yc)), skpw.rbf_kernel(x, Y=yc),
+        rtol=1e-5,
+    )

@@ -126,3 +126,18 @@ def test_fused_assign_update_parity():
             counts, np.bincount(lab_ref, weights=mask, minlength=k)
         )
         np.testing.assert_allclose(inertia, mind_ref.sum(), rtol=1e-4)
+
+
+def test_k_means_functional(blobs):
+    """Functional API parity: ref dask_ml/cluster/k_means.py::k_means."""
+    from dask_ml_tpu.cluster import k_means
+
+    X, _ = blobs
+    centers, labels, inertia, n_iter = k_means(
+        X, 4, init="random", random_state=0, max_iter=20, return_n_iter=True
+    )
+    assert centers.shape[1] == X.shape[1]
+    assert centers.shape[0] == 4
+    assert inertia > 0 and n_iter >= 1
+    centers3 = k_means(X, 4, init="random", random_state=0, max_iter=20)
+    assert len(centers3) == 3
