@@ -52,12 +52,18 @@ class ShardedArray:
 
     # -- construction -----------------------------------------------------
     @classmethod
-    def from_array(cls, x, mesh: Mesh | None = None, dtype=None) -> "ShardedArray":
+    def from_array(cls, x, mesh: Mesh | None = None, dtype=None,
+                   shard_features: bool = False) -> "ShardedArray":
         """Place a host (numpy) or device array onto the mesh, row-sharded.
 
         Equivalent of ``da.from_array`` + scatter in the reference; here it
         is one ``device_put`` with a NamedSharding (no serialization layer —
         SURVEY.md §5 comm row).
+
+        ``shard_features=True`` additionally shards axis 1 over the mesh's
+        ``"model"`` axis (2-D tensor-parallel layout for wide-feature
+        problems, SURVEY.md §2c TP row) — GSPMD then inserts the psum for
+        feature-contracted matmuls automatically.
         """
         if isinstance(x, ShardedArray):
             return x if dtype is None else cls(x.data.astype(dtype), x.n_rows, x.mesh)
@@ -81,7 +87,13 @@ class ShardedArray:
         if n_pad != n:
             pad_widths = [(0, n_pad - n)] + [(0, 0)] * (x.ndim - 1)
             x = xp.pad(x, pad_widths)
-        spec = P(*((DATA_AXIS,) + (None,) * (x.ndim - 1)))
+        feat = (
+            MODEL_AXIS
+            if shard_features and x.ndim >= 2
+            and mesh.shape.get(MODEL_AXIS, 1) > 1
+            else None
+        )
+        spec = P(*((DATA_AXIS, feat) + (None,) * (x.ndim - 2))[: x.ndim])
         data = jax.device_put(x, NamedSharding(mesh, spec))
         return cls(data, n, mesh)
 
