@@ -1,0 +1,41 @@
+"""Platform selection workaround for the axon TPU plugin.
+
+The axon plugin IGNORES the ``JAX_PLATFORMS`` env var and can block
+indefinitely during backend init when the tunnel is down, so forcing the
+CPU platform needs both the env var (for subprocesses) and an explicit
+``jax.config.update`` — and it must happen BEFORE anything touches a
+backend. Shared by tests/conftest.py, __graft_entry__.py and bench.py so
+the invariant lives in one place.
+"""
+
+import os
+import re
+
+_COUNT_FLAG = "xla_force_host_platform_device_count"
+
+
+def force_cpu_platform(n_devices: int | None = None) -> None:
+    """Force the CPU platform, optionally with at least ``n_devices``
+    virtual devices. Must be called before any JAX backend is initialized —
+    calling it later is a silent no-op on already-cached backends.
+
+    An ambient ``--xla_force_host_platform_device_count`` in XLA_FLAGS is
+    respected when it is >= n_devices and RAISED when it is smaller, so a
+    caller that needs N devices actually gets N.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        m = re.search(rf"--{_COUNT_FLAG}=(\d+)", flags)
+        if m is None:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --{_COUNT_FLAG}={n_devices}"
+            ).strip()
+        elif int(m.group(1)) < n_devices:
+            os.environ["XLA_FLAGS"] = flags.replace(
+                m.group(0), f"--{_COUNT_FLAG}={n_devices}"
+            )
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -5,20 +5,11 @@ reference's in-process ``gen_cluster`` scheduler+workers."""
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+from dask_ml_tpu._platform import force_cpu_platform  # noqa: E402
 
-# The axon TPU plugin ignores JAX_PLATFORMS; force the CPU backend
-# explicitly so the 8-device virtual mesh is used for tests.
-jax.config.update("jax_platforms", "cpu")
+force_cpu_platform(n_devices=8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
