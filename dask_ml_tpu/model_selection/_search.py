@@ -21,12 +21,16 @@ is the jit-level analog of dask's task de-dup.
 from __future__ import annotations
 
 import numbers
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 from sklearn.model_selection import ParameterGrid, ParameterSampler
 
 from ..base import BaseEstimator, clone
 from ..metrics.scorer import check_scoring
+from ..parallel.mesh import device_mesh, resolve_mesh, use_mesh
 from ..parallel.sharded import ShardedArray, take_rows
 from ._normalize import estimator_token
 from ._split import KFold
@@ -34,6 +38,35 @@ from ._split import KFold
 
 def _is_pipeline(est):
     return hasattr(est, "steps") and hasattr(est, "named_steps")
+
+
+def _is_device_native(est):
+    """True if the estimator (or any pipeline step) runs XLA programs on
+    the mesh — those candidates must NOT be launched concurrently on
+    overlapping device sets: two GSPMD programs whose collectives
+    interleave across shared devices can deadlock or abort the runtime.
+    Concurrency for them means DISJOINT mesh subsets (SURVEY.md §3.5:
+    "trials pinned to hosts/mesh-subsets")."""
+    ests = [est]
+    if _is_pipeline(est):
+        ests += [s for _, s in est.steps]
+    return any(type(e).__module__.startswith("dask_ml_tpu") for e in ests)
+
+
+def _submeshes(mesh, k):
+    """Partition a mesh's devices into k disjoint 1-D data meshes covering
+    EVERY device: the first (n mod k) submeshes get one extra device, so
+    no chip idles when k doesn't divide the device count."""
+    devs = mesh.devices.reshape(-1)
+    n = devs.size
+    k = max(1, min(k, n))
+    per, rem = divmod(n, k)
+    out, i = [], 0
+    for j in range(k):
+        size = per + (1 if j < rem else 0)
+        out.append(device_mesh(devices=devs[i:i + size]))
+        i += size
+    return out
 
 
 def check_cv(cv=None):
@@ -53,24 +86,65 @@ def _take(a, idx):
 
 
 class _CVCache:
-    """Materialized folds, extracted once (ref methods.py::CVCache)."""
+    """Fold extraction (ref methods.py::CVCache). ``cache=True`` (the
+    reference's ``cache_cv``) materializes each fold's train/test arrays
+    once and shares them across every candidate; ``cache=False`` trades
+    compute for memory by re-extracting per use."""
 
     def __init__(self, X, y, cv, cache=True):
-        self.folds = []
-        for train_idx, test_idx in cv.split(X, y):
-            self.folds.append((
-                _take(X, train_idx), _take(y, train_idx),
-                _take(X, test_idx), _take(y, test_idx),
-            ))
+        self._X, self._y = X, y
+        self._splits = list(cv.split(X, y))
+        self._cache = {} if cache else None
+        self.n_folds = len(self._splits)
+
+    def fold(self, fi):
+        if self._cache is not None and fi in self._cache:
+            return self._cache[fi]
+        train_idx, test_idx = self._splits[fi]
+        out = (
+            _take(self._X, train_idx), _take(self._y, train_idx),
+            _take(self._X, test_idx), _take(self._y, test_idx),
+        )
+        if self._cache is not None:
+            self._cache[fi] = out
+        return out
+
+    def host_folds(self):
+        """All folds as host arrays — the data plane for mesh-subset trial
+        placement: each trial thread re-places its fold onto its OWN
+        submesh (disjoint devices), the one redistribution pattern that is
+        safe under concurrent launches. Computed once, sequentially."""
+        if getattr(self, "_host", None) is None:
+            def h(a):
+                return a.to_numpy() if isinstance(a, ShardedArray) \
+                    else np.asarray(a)
+
+            self._host = [
+                tuple(h(a) for a in self.fold(fi))
+                for fi in range(self.n_folds)
+            ]
+        return self._host
 
 
 class _PrefixMemo:
-    """Fitted-pipeline-prefix cache (ref: tokenized graph de-dup)."""
+    """Fitted-pipeline-prefix cache (ref: tokenized graph de-dup).
+
+    Pipelines always execute sequentially (their cached transformed
+    outputs live on one mesh), so no locking is needed here."""
 
     def __init__(self):
         self._memo = {}
         self.hits = 0
         self.misses = 0
+
+    def _get_or_compute(self, key, compute):
+        cached = self._memo.get(key)
+        if cached is not None:
+            self.hits += 1
+            return cached
+        self.misses += 1
+        value = self._memo[key] = compute()
+        return value
 
     def fit_pipeline(self, pipe, fold_id, X, y):
         """Fit a pipeline reusing cached fitted prefixes + transformed data."""
@@ -80,33 +154,29 @@ class _PrefixMemo:
         n = len(pipe.steps)
         for i, (name, step) in enumerate(pipe.steps):
             key = key + (estimator_token(step),)
-            last = i == n - 1
-            if last:
+            if i == n - 1:
                 # final step fits on the (cached) transformed data
-                cached = self._memo.get(key)
-                if cached is None:
-                    self.misses += 1
+                Xt_in = Xt
+
+                def fit_last(step=step, Xt_in=Xt_in):
                     est = clone(step)
-                    est.fit(Xt, y)
-                    self._memo[key] = est
-                else:
-                    self.hits += 1
-                    est = cached
+                    est.fit(Xt_in, y)
+                    return est
+
+                est = self._get_or_compute(key, fit_last)
                 fitted_steps.append((name, est))
             else:
-                cached = self._memo.get(key)
-                if cached is None:
-                    self.misses += 1
+                Xt_in = Xt
+
+                def fit_prefix(step=step, Xt_in=Xt_in):
                     est = clone(step)
                     if hasattr(est, "fit_transform"):
-                        Xt_new = est.fit_transform(Xt, y)
+                        Xt_new = est.fit_transform(Xt_in, y)
                     else:
-                        Xt_new = est.fit(Xt, y).transform(Xt)
-                    self._memo[key] = (est, Xt_new)
-                else:
-                    self.hits += 1
-                    est, Xt_new = cached
-                Xt = Xt_new
+                        Xt_new = est.fit(Xt_in, y).transform(Xt_in)
+                    return est, Xt_new
+
+                est, Xt = self._get_or_compute(key, fit_prefix)
                 fitted_steps.append((name, est))
         fitted = clone(pipe)
         fitted.steps = fitted_steps
@@ -130,6 +200,30 @@ class _BaseSearchCV(BaseEstimator):
     def _candidates(self):
         raise NotImplementedError
 
+    def _resolve_execution(self, n_tasks):
+        """Honor the ``scheduler``/``n_jobs`` knobs (reference signature:
+        dask scheduler selection). Here: 'threads'/None → a host thread
+        pool over jitted fits (threads overlap each candidate's host-side
+        Python with the others' device compute; the XLA programs
+        themselves already use every chip); 'sync'/'synchronous' → the
+        deterministic sequential loop."""
+        scheduler = self.scheduler
+        if scheduler in (None, "threads", "threading"):
+            n_jobs = self.n_jobs
+            if n_jobs in (None, -1):
+                workers = min(8, n_tasks) or 1
+            elif n_jobs < 1:
+                raise ValueError(f"n_jobs must be -1 or >=1, got {n_jobs}")
+            else:
+                workers = min(int(n_jobs), n_tasks) or 1
+            return workers
+        if scheduler in ("sync", "synchronous", "single-threaded"):
+            return 1
+        raise ValueError(
+            f"scheduler={scheduler!r} not supported; use None, 'threads' "
+            f"or 'synchronous'"
+        )
+
     def fit(self, X, y=None, **fit_params):
         candidates = list(self._candidates())
         if not candidates:
@@ -138,28 +232,88 @@ class _BaseSearchCV(BaseEstimator):
         scorer = check_scoring(self.estimator, self.scoring)
         cache = _CVCache(X, y, cv, cache=self.cache_cv)
         memo = _PrefixMemo()
-        n_folds = len(cache.folds)
+        n_folds = cache.n_folds
 
         scores = np.full((len(candidates), n_folds), np.nan)
         train_scores = (
             np.full((len(candidates), n_folds), np.nan)
             if self.return_train_score else None
         )
-        for ci, params in enumerate(candidates):
-            for fi, (Xtr, ytr, Xte, yte) in enumerate(cache.folds):
-                est = clone(self.estimator).set_params(**params)
+
+        def run_task(ci, fi, fold):
+            params = candidates[ci]
+            Xtr, ytr, Xte, yte = fold
+            est = clone(self.estimator).set_params(**params)
+            try:
+                if _is_pipeline(est):
+                    est = memo.fit_pipeline(est, fi, Xtr, ytr)
+                else:
+                    est.fit(Xtr, ytr, **fit_params)
+                scores[ci, fi] = scorer(est, Xte, yte)
+                if self.return_train_score:
+                    train_scores[ci, fi] = scorer(est, Xtr, ytr)
+            except Exception:
+                if self.error_score == "raise":
+                    raise
+                scores[ci, fi] = self.error_score
+
+        tasks = [(ci, fi) for ci in range(len(candidates))
+                 for fi in range(n_folds)]
+        # Pipelines run sequentially: the prefix memo shares fitted
+        # transformers AND their transformed (device-resident) outputs
+        # across candidates, which must stay on one mesh.
+        workers = 1 if _is_pipeline(self.estimator) \
+            else self._resolve_execution(len(tasks))
+        device_native = _is_device_native(self.estimator)
+        mesh = X.mesh if isinstance(X, ShardedArray) else resolve_mesh(None)
+        if workers > 1 and device_native:
+            if mesh.devices.size < 2:
+                workers = 1  # no disjoint subsets to place trials on
+            elif isinstance(X, ShardedArray) and self.n_jobs in (None, -1):
+                # X was sharded across the whole mesh, possibly because it
+                # only fits that way — re-placing full folds onto smaller
+                # submeshes could OOM a chip, so trial placement is
+                # opt-in (explicit n_jobs) for sharded inputs
+                workers = 1
+
+        if workers == 1:
+            for ci, fi in tasks:
+                run_task(ci, fi, cache.fold(fi))
+        elif not device_native:
+            # host estimators (e.g. raw sklearn): plain thread pool
+            with ThreadPoolExecutor(max_workers=workers) as pool:
+                futures = [
+                    pool.submit(run_task, ci, fi, cache.fold(fi))
+                    for ci, fi in tasks
+                ]
+                for f in futures:
+                    f.result()  # surface the first error_score='raise'
+        else:
+            # mesh-subset trial placement (SURVEY.md §3.4/§3.5): partition
+            # the mesh into disjoint submeshes, one per worker; each trial
+            # checks a submesh out, re-places its (host) fold onto it, and
+            # fits entirely within it — concurrent XLA programs never
+            # share devices, so their collectives cannot interleave.
+            subs = _submeshes(mesh, workers)
+            workers = len(subs)
+            folds_h = cache.host_folds()
+            free = queue.SimpleQueue()
+            for s in subs:
+                free.put(s)
+
+            def run_on_submesh(ci, fi):
+                sub = free.get()
                 try:
-                    if _is_pipeline(est):
-                        est = memo.fit_pipeline(est, fi, Xtr, ytr)
-                    else:
-                        est.fit(Xtr, ytr, **fit_params)
-                    scores[ci, fi] = scorer(est, Xte, yte)
-                    if self.return_train_score:
-                        train_scores[ci, fi] = scorer(est, Xtr, ytr)
-                except Exception:
-                    if self.error_score == "raise":
-                        raise
-                    scores[ci, fi] = self.error_score
+                    with use_mesh(sub):
+                        run_task(ci, fi, folds_h[fi])
+                finally:
+                    free.put(sub)
+
+            with ThreadPoolExecutor(max_workers=workers) as pool:
+                futures = [pool.submit(run_on_submesh, ci, fi)
+                           for ci, fi in tasks]
+                for f in futures:
+                    f.result()
 
         mean = scores.mean(axis=1)
         std = scores.std(axis=1)

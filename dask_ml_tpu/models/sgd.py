@@ -3,13 +3,23 @@
 The reference has no GLM partial_fit — its ``Incremental`` wrapper streams
 blocks through *sklearn's* SGDClassifier (SURVEY.md §3.6), keeping the hot
 loop on host CPU. These estimators keep the model AND the update on
-device: each ``partial_fit`` is one jitted optax step (or a few) on a
+device: each ``partial_fit`` is one jitted gradient(+prox) step on a
 streamed block — the TPU-resident streaming-partial_fit path of
 BASELINE.md configs[3]. Same sklearn contract, so they compose with
 ``Incremental``, ``IncrementalSearchCV`` and Hyperband.
 
 Update rule: full-block gradient steps (minibatch GD), not per-sample SGD
 — per-sample loops don't map to the MXU; a block IS the minibatch.
+Penalties follow sklearn's SGD semantics: l2 inside the objective, l1 as
+a proximal soft-threshold after the step, elasticnet as the l1_ratio mix.
+
+Batched trials: N models with the same (class, loss, classes) but
+different hyperparameters advance in ONE jitted step via ``jax.vmap``
+over a stacked (N, d+1) weight matrix — the TPU replacement for the
+reference's N concurrent model futures (``dask_ml/model_selection/
+_incremental.py::_fit``, SURVEY.md §3.5): instead of N workers each
+running one sklearn partial_fit, one XLA program advances the whole
+cohort with the data block read from HBM once.
 """
 
 from __future__ import annotations
@@ -26,38 +36,84 @@ from ..parallel.sharded import ShardedArray, as_sharded
 from ..utils.validation import check_is_fitted
 
 _LOSSES = ("log_loss", "hinge", "squared_error")
+_PENALTIES = ("l2", "l1", "elasticnet", None, "none")
 
 
 @partial(jax.jit, static_argnames=("loss",))
-def _sgd_step(X, y, mask, n_valid, w, opt_state, lr, alpha, loss):
-    def objective(w):
-        eta = X @ w[:-1] + w[-1]
-        if loss == "log_loss":
-            per = jax.nn.softplus(eta) - y * eta
-        elif loss == "hinge":
-            margins = (2.0 * y - 1.0) * eta
-            per = jnp.maximum(0.0, 1.0 - margins)
-        else:  # squared_error
-            per = 0.5 * (eta - y) ** 2
-        data_loss = jnp.sum(per * mask) / jnp.maximum(n_valid, 1.0)
-        reg = 0.5 * alpha * jnp.sum(w[:-1] ** 2)  # intercept unpenalized
-        return data_loss + reg
+def _sgd_step_many(X, y, mask, n_valid, W, lrs, alphas, l2_ws, l1_ws,
+                   int_flags, loss):
+    """Advance N models one minibatch-GD(+prox) step in one program.
 
-    val, grad = jax.value_and_grad(objective)(w)
-    w = w - lr * grad
-    return w, opt_state, val
+    W: (N, d+1) stacked weights (last column = intercept). X/y/mask are
+    SHARED across models (vmap in_axes=None) — the block is read once.
+    Per-model dynamic scalars: lr, alpha, l2/l1 penalty weights, and an
+    intercept flag (0 freezes the intercept at its current value,
+    honoring fit_intercept without a static recompile per setting).
+    """
+
+    def one(w, lr, alpha, l2w, l1w, iflag):
+        def objective(w):
+            eta = X @ w[:-1] + w[-1] * iflag
+            if loss == "log_loss":
+                per = jax.nn.softplus(eta) - y * eta
+            elif loss == "hinge":
+                margins = (2.0 * y - 1.0) * eta
+                per = jnp.maximum(0.0, 1.0 - margins)
+            else:  # squared_error
+                per = 0.5 * (eta - y) ** 2
+            data_loss = jnp.sum(per * mask) / jnp.maximum(n_valid, 1.0)
+            reg = 0.5 * alpha * l2w * jnp.sum(w[:-1] ** 2)
+            return data_loss + reg
+
+        # iflag=0 zeroes the intercept's contribution to eta, so grad[-1]
+        # is already 0 and the intercept stays frozen at its init (0)
+        val, grad = jax.value_and_grad(objective)(w)
+        w = w - lr * grad
+        # proximal soft-threshold for the l1 part (intercept unpenalized)
+        thr = lr * alpha * l1w
+        coef = jnp.sign(w[:-1]) * jnp.maximum(jnp.abs(w[:-1]) - thr, 0.0)
+        w = w.at[:-1].set(coef)
+        return w, val
+
+    return jax.vmap(one, in_axes=(0, 0, 0, 0, 0, 0))(
+        W, lrs, alphas, l2_ws, l1_ws, int_flags
+    )
+
+
+@jax.jit
+def _batched_eta(X, W):
+    """(n, N) decision values for N stacked models on one shared X."""
+    return X @ W[:, :-1].T + W[:, -1][None, :]
+
+
+@jax.jit
+def _batched_accuracy(X, y01, mask, n_valid, W):
+    eta = _batched_eta(X, W)
+    correct = (eta > 0).astype(jnp.float32) == y01[:, None]
+    return jnp.sum(correct * mask[:, None], axis=0) / jnp.maximum(n_valid, 1.0)
+
+
+@jax.jit
+def _batched_r2(X, y, mask, n_valid, W):
+    eta = _batched_eta(X, W)
+    n = jnp.maximum(n_valid, 1.0)
+    y_mean = jnp.sum(y * mask) / n
+    ss_tot = jnp.sum(((y - y_mean) * mask) ** 2)
+    ss_res = jnp.sum((((eta - y[:, None]) * mask[:, None]) ** 2), axis=0)
+    return 1.0 - ss_res / jnp.maximum(ss_tot, 1e-12)
 
 
 class _SGDBase(BaseEstimator):
     loss_default = "squared_error"
 
-    def __init__(self, loss=None, penalty="l2", alpha=1e-4, eta0=0.01,
-                 learning_rate="invscaling", power_t=0.25, max_iter=5,
-                 tol=1e-3, shuffle=True, random_state=None, warm_start=False,
-                 fit_intercept=True):
+    def __init__(self, loss=None, penalty="l2", alpha=1e-4, l1_ratio=0.15,
+                 eta0=0.01, learning_rate="invscaling", power_t=0.25,
+                 max_iter=5, tol=1e-3, shuffle=True, random_state=None,
+                 warm_start=False, fit_intercept=True):
         self.loss = loss
         self.penalty = penalty
         self.alpha = alpha
+        self.l1_ratio = l1_ratio
         self.eta0 = eta0
         self.learning_rate = learning_rate
         self.power_t = power_t
@@ -74,6 +130,19 @@ class _SGDBase(BaseEstimator):
             raise ValueError(f"loss must be one of {_LOSSES}, got {loss!r}")
         return loss
 
+    def _penalty_weights(self):
+        """(l2_weight, l1_weight) implementing sklearn SGD semantics."""
+        p = self.penalty
+        if p == "l2":
+            return 1.0, 0.0
+        if p == "l1":
+            return 0.0, 1.0
+        if p == "elasticnet":
+            return 1.0 - self.l1_ratio, self.l1_ratio
+        if p is None or p == "none":
+            return 0.0, 0.0
+        raise ValueError(f"penalty must be one of {_PENALTIES}, got {p!r}")
+
     def _lr(self):
         t = max(self._t, 1)
         if self.learning_rate == "constant":
@@ -87,8 +156,19 @@ class _SGDBase(BaseEstimator):
     def _ensure_state(self, d):
         if not hasattr(self, "_w") or self._w is None:
             self._w = jnp.zeros((d + 1,), jnp.float32)
-            self._opt_state = ()
             self._t = 0
+        self._penalty_weights()  # validate penalty eagerly
+
+    def _step_args(self):
+        """Per-model dynamic scalars for the (batched) step. The model's
+        step clock advances here."""
+        self._t += 1
+        l2w, l1w = self._penalty_weights()
+        return (
+            np.float32(self._lr()), np.float32(self.alpha),
+            np.float32(l2w), np.float32(l1w),
+            np.float32(1.0 if self.fit_intercept else 0.0),
+        )
 
     def _block(self, X, y):
         X = as_sharded(X, dtype=np.float32)
@@ -101,41 +181,101 @@ class _SGDBase(BaseEstimator):
         X, y = self._block(X, y)
         self._ensure_state(X.shape[1])
         mask = X.row_mask(jnp.float32)
-        self._t += 1
-        self._w, self._opt_state, self._last_loss = _sgd_step(
-            X.data, y.data, mask, jnp.float32(X.n_rows), self._w,
-            self._opt_state, jnp.float32(self._lr()),
-            jnp.float32(self.alpha), self._loss(),
+        lr, alpha, l2w, l1w, iflag = self._step_args()
+        W, losses = _sgd_step_many(
+            X.data, y.data, mask, jnp.float32(X.n_rows), self._w[None],
+            jnp.asarray([lr]), jnp.asarray([alpha]), jnp.asarray([l2w]),
+            jnp.asarray([l1w]), jnp.asarray([iflag]), self._loss(),
         )
+        self._w = W[0]
+        self._last_loss = losses[0]
         self._publish(X.shape[1])
         return self
+
+    # -- batched-trial protocol (consumed by model_selection._incremental) --
+    def _batch_prepare(self, fit_params):
+        """Apply first-call side effects (classes) before grouping."""
+        classes = (fit_params or {}).get("classes")
+        if classes is not None:
+            self._set_classes(np.asarray(classes))
+
+    def _batch_key(self):
+        """Models sharing a key can advance in one vmapped step. None
+        disables batching. Hyperparameters (lr schedule, alpha, penalty)
+        are DYNAMIC per-model scalars, so only structure is in the key."""
+        try:
+            loss = self._loss()
+            self._penalty_weights()
+        except ValueError:
+            return None  # invalid params: surface the error on the solo path
+        classes = getattr(self, "classes_", None)
+        return (type(self).__name__, loss,
+                tuple(np.asarray(classes).tolist()) if classes is not None
+                else None)
+
+    @classmethod
+    def _batched_partial_fit(cls, models, X, y):
+        """One shared data block, one jitted step, N models advanced.
+
+        X/y may be host arrays or ShardedArray; they are canonicalized
+        once for the whole cohort (the reference pays this once per model
+        per worker)."""
+        Xs = as_sharded(X, dtype=np.float32)
+        ys = as_sharded(models[0]._encode_y(y), mesh=Xs.mesh,
+                        dtype=np.float32)
+        d = Xs.shape[1]
+        for m in models:
+            m._ensure_state(d)
+        mask = Xs.row_mask(jnp.float32)
+        args = np.asarray([m._step_args() for m in models], np.float32)
+        W = jnp.stack([m._w for m in models])
+        W, losses = _sgd_step_many(
+            Xs.data, ys.data, mask, jnp.float32(Xs.n_rows), W,
+            jnp.asarray(args[:, 0]), jnp.asarray(args[:, 1]),
+            jnp.asarray(args[:, 2]), jnp.asarray(args[:, 3]),
+            jnp.asarray(args[:, 4]), models[0]._loss(),
+        )
+        for i, m in enumerate(models):
+            m._w = W[i]
+            m._last_loss = losses[i]
+        return models
+
+    @classmethod
+    def _batch_publish(cls, models, d):
+        """Materialize coef_/intercept_ once per round (one D2H sync for
+        the cohort, not one per model per step)."""
+        for m in models:
+            m._publish(d)
 
     def fit(self, X, y, **kwargs):
         if not self.warm_start:
             self._w = None
+            if getattr(self, "classes_", None) is not None:
+                self.classes_ = None  # fresh fit re-derives classes
         n_blocks = 8
         from ..parallel.streaming import BlockStream
 
         Xh = X.to_numpy() if isinstance(X, ShardedArray) else np.asarray(X)
         yh = y.to_numpy() if isinstance(y, ShardedArray) else np.asarray(y)
-        if hasattr(self, "_set_classes") and kwargs.get("classes") is None:
-            uniq = np.unique(yh)
-            if getattr(self, "classes_", None) is None or not self.warm_start:
-                self._set_classes(uniq)
+        if isinstance(self, ClassifierMixin) and kwargs.get("classes") is None:
+            if getattr(self, "classes_", None) is None:
+                self._set_classes(np.unique(yh))
         stream = BlockStream(
-            (Xh, self._encode_y(yh)),
+            (Xh, np.asarray(self._encode_y(yh))),
             block_rows=max(len(Xh) // n_blocks, 1),
             shuffle=self.shuffle, seed=self.random_state,
         )
         self._ensure_state(Xh.shape[1])
         for block in stream.epochs(self.max_iter):
             Xb, yb = block.arrays
-            self._t += 1
-            self._w, self._opt_state, self._last_loss = _sgd_step(
-                Xb, yb, block.mask, jnp.float32(block.n_rows), self._w,
-                self._opt_state, jnp.float32(self._lr()),
-                jnp.float32(self.alpha), self._loss(),
+            lr, alpha, l2w, l1w, iflag = self._step_args()
+            W, losses = _sgd_step_many(
+                Xb, yb, block.mask, jnp.float32(block.n_rows), self._w[None],
+                jnp.asarray([lr]), jnp.asarray([alpha]), jnp.asarray([l2w]),
+                jnp.asarray([l1w]), jnp.asarray([iflag]), self._loss(),
             )
+            self._w = W[0]
+            self._last_loss = losses[0]
         self._publish(Xh.shape[1])
         self.n_iter_ = self.max_iter
         return self
@@ -146,6 +286,8 @@ class _SGDBase(BaseEstimator):
         return X, X.data @ w[:-1] + w[-1]
 
     def _encode_y(self, y):
+        if isinstance(y, ShardedArray):
+            return y
         return np.asarray(y)
 
     def _publish(self, d):
@@ -158,9 +300,24 @@ class SGDClassifier(ClassifierMixin, _SGDBase):
 
     loss_default = "log_loss"
 
+    def _batch_key(self):
+        if getattr(self, "classes_", None) is None:
+            # solo path enforces the first-call classes contract (raises);
+            # batching without classes would train on un-encoded labels
+            return None
+        return super()._batch_key()
+
     def _set_classes(self, classes):
         if len(classes) != 2:
             raise ValueError("SGDClassifier supports binary targets")
+        have = getattr(self, "classes_", None)
+        if have is not None and not np.array_equal(classes, have):
+            # sklearn contract: classes must be identical across calls —
+            # silently re-encoding labels mid-training corrupts the model
+            raise ValueError(
+                f"classes={classes} is not the same as on last call "
+                f"to partial_fit, was: {have}"
+            )
         self.classes_ = classes
 
     def partial_fit(self, X, y, classes=None, **kwargs):
@@ -173,15 +330,34 @@ class SGDClassifier(ClassifierMixin, _SGDBase):
         return super().partial_fit(X, y, classes=classes, **kwargs)
 
     def _encode_y(self, y):
-        y = np.asarray(y)
         if getattr(self, "classes_", None) is None:
-            return y
-        return (y == self.classes_[1]).astype(np.float32)
+            return y if isinstance(y, ShardedArray) else np.asarray(y)
+        pos = self.classes_[1]
+        if isinstance(y, ShardedArray):
+            return ShardedArray(
+                (y.data == jnp.asarray(pos)).astype(jnp.float32),
+                y.n_rows, y.mesh,
+            )
+        return (np.asarray(y) == pos).astype(np.float32)
 
     def _publish(self, d):
         w = to_host(self._w).astype(np.float64)
         self.coef_ = w[:-1].reshape(1, -1)
         self.intercept_ = np.atleast_1d(w[-1])
+
+    @classmethod
+    def _batched_score_default(cls, models, X, y):
+        """Accuracy of N models on a shared (device) test split — one
+        matmul on the MXU instead of N predict calls."""
+        Xs = as_sharded(X, dtype=np.float32)
+        ys = as_sharded(models[0]._encode_y(y), mesh=Xs.mesh,
+                        dtype=np.float32)
+        W = jnp.stack([m._w for m in models])
+        acc = _batched_accuracy(
+            Xs.data, ys.data, Xs.row_mask(jnp.float32),
+            jnp.float32(Xs.n_rows), W,
+        )
+        return np.asarray(acc, np.float64)
 
     def decision_function(self, X):
         check_is_fitted(self, "coef_")
@@ -210,10 +386,27 @@ class SGDClassifier(ClassifierMixin, _SGDBase):
 class SGDRegressor(RegressorMixin, _SGDBase):
     loss_default = "squared_error"
 
+    def _set_classes(self, classes):  # pragma: no cover - defensive
+        raise AttributeError("SGDRegressor has no classes")
+
+    def _batch_prepare(self, fit_params):
+        pass
+
     def _publish(self, d):
         w = to_host(self._w).astype(np.float64)
         self.coef_ = w[:-1]
         self.intercept_ = float(w[-1])
+
+    @classmethod
+    def _batched_score_default(cls, models, X, y):
+        Xs = as_sharded(X, dtype=np.float32)
+        ys = as_sharded(y, mesh=Xs.mesh, dtype=np.float32)
+        W = jnp.stack([m._w for m in models])
+        r2 = _batched_r2(
+            Xs.data, ys.data, Xs.row_mask(jnp.float32),
+            jnp.float32(Xs.n_rows), W,
+        )
+        return np.asarray(r2, np.float64)
 
     def predict(self, X):
         check_is_fitted(self, "coef_")

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import DATA_AXIS, data_shards, resolve_mesh
+from .mesh import DATA_AXIS, MODEL_AXIS, data_shards, resolve_mesh
 
 
 def _padded_rows(n_rows: int, n_shards: int) -> int:

@@ -1,0 +1,143 @@
+"""Batched (vmapped) trial execution — the TPU replacement for the
+reference's concurrent model futures (``dask_ml/model_selection/
+_incremental.py::_fit`` async controller, SURVEY.md §3.5): N homogeneous
+models advance in ONE jitted step over a stacked weight pytree, and the
+search data plane stays device-resident for device-native estimators."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dask_ml_tpu.linear_model import SGDClassifier, SGDRegressor
+from dask_ml_tpu.model_selection import IncrementalSearchCV
+from dask_ml_tpu.models.sgd import _sgd_step_many
+from dask_ml_tpu.parallel import as_sharded
+from dask_ml_tpu.parallel.sharded import ShardedArray
+
+
+def test_one_step_advances_eight_models():
+    """One _sgd_step_many call == one XLA program advancing 8 models."""
+    rng = np.random.RandomState(0)
+    n, d, N = 256, 8, 8
+    X = jnp.asarray(rng.randn(n, d).astype(np.float32))
+    beta = rng.randn(d).astype(np.float32)
+    y = jnp.asarray((rng.rand(n) < 1 / (1 + np.exp(-X @ beta))).astype(
+        np.float32))
+    mask = jnp.ones((n,), jnp.float32)
+    W = jnp.zeros((N, d + 1), jnp.float32)
+    lrs = jnp.asarray(np.linspace(0.05, 0.5, N), jnp.float32)
+    alphas = jnp.asarray(np.logspace(-5, -1, N), jnp.float32)
+    ones = jnp.ones((N,), jnp.float32)
+    W2, losses = _sgd_step_many(
+        X, y, mask, jnp.float32(n), W, lrs, alphas, ones, 0 * ones, ones,
+        "log_loss",
+    )
+    assert W2.shape == (N, d + 1) and losses.shape == (N,)
+    # every model moved, and different lrs → different weights
+    assert (np.abs(np.asarray(W2)).sum(axis=1) > 0).all()
+    norms = np.linalg.norm(np.asarray(W2), axis=1)
+    assert len(np.unique(np.round(norms, 6))) == N
+
+
+def test_batched_step_matches_single_steps():
+    """vmapped cohort step ≡ N independent partial_fit calls."""
+    rng = np.random.RandomState(1)
+    X = rng.randn(200, 6).astype(np.float32)
+    y = (rng.rand(200) < 0.5).astype(np.float32)
+    etas = [0.05, 0.1, 0.2, 0.4]
+
+    singles = []
+    for eta in etas:
+        m = SGDClassifier(eta0=eta, learning_rate="constant")
+        m.partial_fit(X, y, classes=[0.0, 1.0])
+        m.partial_fit(X, y)
+        singles.append(m.coef_.ravel())
+
+    cohort = [SGDClassifier(eta0=eta, learning_rate="constant")
+              for eta in etas]
+    for m in cohort:
+        m._batch_prepare({"classes": [0.0, 1.0]})
+    keys = {m._batch_key() for m in cohort}
+    assert len(keys) == 1  # homogeneous: one cohort, one program
+    SGDClassifier._batched_partial_fit(cohort, X, y)
+    SGDClassifier._batched_partial_fit(cohort, X, y)
+    SGDClassifier._batch_publish(cohort, X.shape[1])
+    for single, m in zip(singles, cohort):
+        np.testing.assert_allclose(single, m.coef_.ravel(), rtol=1e-5)
+
+
+def test_batched_score_matches_single_scores():
+    rng = np.random.RandomState(2)
+    X = rng.randn(300, 5).astype(np.float32)
+    y = (rng.rand(300) < 0.5).astype(np.float64)
+    cohort = [SGDClassifier(eta0=e, learning_rate="constant")
+              for e in (0.1, 0.3)]
+    for m in cohort:
+        m.partial_fit(X, y, classes=[0.0, 1.0])
+    batched = SGDClassifier._batched_score_default(cohort, X, y)
+    for i, m in enumerate(cohort):
+        assert batched[i] == pytest.approx(m.score(X, y), abs=1e-6)
+
+    reg = [SGDRegressor(eta0=e, learning_rate="constant")
+           for e in (0.01, 0.05)]
+    yr = (X @ rng.randn(5)).astype(np.float32)
+    for m in reg:
+        m.partial_fit(X, yr)
+    batched = SGDRegressor._batched_score_default(reg, X, yr)
+    for i, m in enumerate(reg):
+        assert batched[i] == pytest.approx(m.score(X, yr), abs=1e-5)
+
+
+def test_search_uses_batched_cohorts(xy_classification):
+    """History records carry batch_size ≥ 8: the whole cohort advanced in
+    shared jitted steps, not a sequential model-at-a-time loop."""
+    X, y = xy_classification
+    search = IncrementalSearchCV(
+        SGDClassifier(learning_rate="constant"),
+        {"eta0": [0.05, 0.1, 0.2, 0.4], "alpha": [1e-4, 1e-3]},
+        n_initial_parameters="grid", decay_rate=None, max_iter=5,
+        random_state=0,
+    )
+    search.fit(X, y, classes=[0.0, 1.0])
+    first_round = [r for r in search.history_
+                   if r["partial_fit_calls"] == 1]
+    assert len(first_round) == 8
+    assert all(r["batch_size"] == 8 for r in first_round)
+    assert search.best_score_ > 0.6
+
+
+def test_search_data_plane_stays_on_device(xy_classification, monkeypatch):
+    """VERDICT r1 weak #4: no full-dataset device→host copy when the
+    input is a ShardedArray and the estimator is device-native."""
+    X, y = xy_classification
+    Xs, ys = as_sharded(X.astype(np.float32)), as_sharded(
+        y.astype(np.float32))
+
+    calls = []
+    orig = ShardedArray.to_numpy
+
+    def spy(self):
+        calls.append(self.n_rows)
+        return orig(self)
+
+    monkeypatch.setattr(ShardedArray, "to_numpy", spy)
+    search = IncrementalSearchCV(
+        SGDClassifier(learning_rate="constant"),
+        {"eta0": [0.1, 0.2]}, n_initial_parameters="grid",
+        decay_rate=None, max_iter=3, random_state=0,
+    )
+    search.fit(Xs, ys, classes=[0.0, 1.0])
+    # the (n, d) training data must never round-trip through host; only
+    # small scoring/publish pulls are allowed
+    assert not any(c >= len(X) for c in calls), calls
+    assert search.best_score_ > 0.5
+
+
+def test_heterogeneous_cohorts_split():
+    """Different losses cannot share a program: separate batch keys."""
+    a = SGDClassifier(loss="log_loss")
+    b = SGDClassifier(loss="hinge")
+    a._batch_prepare({"classes": [0, 1]})
+    b._batch_prepare({"classes": [0, 1]})
+    assert a._batch_key() != b._batch_key()
