@@ -75,7 +75,69 @@ class _GLMBase(BaseEstimator):
     def _encode_y(self, y: ShardedArray):
         return y.data, None
 
+    def _encode_y_host(self, y):
+        return np.asarray(y, np.float32), None
+
+    def _finish_fit(self, beta, classes, info, n_features):
+        beta = np.asarray(beta, np.float64)
+        if self.fit_intercept:
+            self.intercept_ = beta[-1]
+            coef = beta[:-1]
+        else:
+            self.intercept_ = 0.0
+            coef = beta
+        self._set_coef(coef, classes)
+        self.n_iter_ = info.get("n_iter")
+        self.solver_info_ = info
+        self.n_features_in_ = n_features
+        return self
+
+    def _fit_streamed(self, X, y, block_rows):
+        """Out-of-core fit: X stays host-resident (np.memmap or large
+        ndarray); blocks stream through prefetched device_put into
+        per-block loss/grad/Hessian kernels (solvers/streamed.py). The
+        reference's analog is dask-glm over host-backed chunks
+        (SURVEY.md §3.2); here the optimizer state is the only host-side
+        math. y is encoded to a host float32 vector (1/d the size of X)."""
+        if self.penalty not in regularizers.KNOWN:
+            raise ValueError(f"Unknown penalty {self.penalty!r}")
+        from ..parallel.streaming import BlockStream
+        from ..utils.observability import fit_logger
+        from .solvers.streamed import solve_streamed
+
+        y_host, classes = self._encode_y_host(y)
+        n, d_feat = X.shape[0], X.shape[1]
+        d = d_feat + (1 if self.fit_intercept else 0)
+        pmask = np.ones(d, np.float32)
+        if self.fit_intercept:
+            pmask[-1] = 0.0
+        lam = 1.0 / (self.C * n) if self.penalty != "none" else 0.0
+        beta0 = (
+            np.asarray(np.r_[self._coef_flat(), self.intercept_]
+                       if self.fit_intercept else self._coef_flat(),
+                       dtype=np.float32)
+            if self.warm_start and hasattr(self, "coef_")
+            else np.zeros(d, np.float32)
+        )
+        stream = BlockStream((X, y_host), block_rows=block_rows)
+        kwargs = dict(self.solver_kwargs or {})
+        l1_ratio = kwargs.pop("l1_ratio", 0.5)
+        with fit_logger(type(self).__name__, solver=self.solver,
+                        streamed=True, n_rows=n) as logger:
+            beta, info = solve_streamed(
+                self.solver, stream, n, beta0, self.family, self.penalty,
+                lam, pmask, l1_ratio=l1_ratio, intercept=self.fit_intercept,
+                max_iter=self.max_iter, tol=self.tol, logger=logger,
+                **kwargs,
+            )
+        return self._finish_fit(beta, classes, info, d_feat)
+
     def fit(self, X, y):
+        from ..parallel.streaming import stream_plan
+
+        block_rows = stream_plan(X)
+        if block_rows is not None:
+            return self._fit_streamed(X, y, block_rows)
         mesh = resolve_mesh(getattr(X, "mesh", None))
         X, y = check_X_y(X, y, mesh=mesh, dtype=np.float32)
         if self.penalty not in regularizers.KNOWN:
@@ -115,24 +177,30 @@ class _GLMBase(BaseEstimator):
             pmask=jnp.asarray(pmask), l1_ratio=l1_ratio,
             max_iter=self.max_iter, tol=self.tol, mesh=mesh, **kwargs,
         )
-        beta = to_host(beta).astype(np.float64)
-        if self.fit_intercept:
-            self.intercept_ = beta[-1]
-            coef = beta[:-1]
-        else:
-            self.intercept_ = 0.0
-            coef = beta
-        self._set_coef(coef, classes)
-        self.n_iter_ = info.get("n_iter")
-        self.solver_info_ = info
-        self.n_features_in_ = X.shape[1]
-        return self
+        return self._finish_fit(to_host(beta), classes, info, X.shape[1])
 
     def _coef_flat(self):
         return np.ravel(self.coef_)
 
     def _set_coef(self, coef, classes):
         self.coef_ = coef
+
+    def _eta_host(self, X):
+        """Decision values as a host (n,) array; streams block-wise for
+        out-of-core inputs instead of materializing X on device."""
+        from ..parallel.streaming import stream_plan, streamed_map
+
+        block_rows = stream_plan(X)
+        if block_rows is not None:
+            coef = jnp.asarray(self._coef_flat(), jnp.float32)
+            b0 = jnp.asarray(np.ravel(self.intercept_)[0]
+                             if np.ndim(self.intercept_) else self.intercept_,
+                             jnp.float32)
+            return streamed_map(
+                X, block_rows, lambda blk: blk.arrays[0] @ coef + b0
+            )
+        X, eta = self._decision(X)
+        return to_host(eta)[: X.n_rows]
 
     def _decision(self, X):
         X = check_array(X, dtype=np.float32)
@@ -149,8 +217,7 @@ class LinearRegression(_GLMBase):
 
     def predict(self, X):
         check_is_fitted(self, "coef_")
-        X, eta = self._decision(X)
-        return to_host(eta)[: X.n_rows]
+        return self._eta_host(X)
 
     def score(self, X, y):
         from ..metrics import r2_score
@@ -165,8 +232,7 @@ class PoissonRegression(_GLMBase):
 
     def predict(self, X):
         check_is_fitted(self, "coef_")
-        X, eta = self._decision(X)
-        return to_host(jnp.exp(eta))[: X.n_rows]
+        return np.exp(self._eta_host(X))
 
     def score(self, X, y):
         from ..metrics import r2_score
@@ -192,19 +258,30 @@ class LogisticRegression(_GLMBase):
         y01 = (y_host == classes[1]).astype(np.float32)
         return ShardedArray.from_array(y01, mesh=y.mesh).data, classes
 
+    def _encode_y_host(self, y):
+        y = np.asarray(y)
+        classes = np.unique(y)
+        if len(classes) != 2:
+            raise ValueError(
+                f"LogisticRegression supports binary targets; got "
+                f"{len(classes)} classes"
+            )
+        self.classes_ = classes
+        return (y == classes[1]).astype(np.float32), classes
+
     def _set_coef(self, coef, classes):
         self.coef_ = coef.reshape(1, -1)
         self.intercept_ = np.atleast_1d(self.intercept_)
 
     def decision_function(self, X):
         check_is_fitted(self, "coef_")
-        X, eta = self._decision(X)
-        return to_host(eta)[: X.n_rows]
+        return self._eta_host(X)
 
     def predict_proba(self, X):
+        from scipy.special import expit
+
         check_is_fitted(self, "coef_")
-        X, eta = self._decision(X)
-        p1 = to_host(jnp.asarray(1.0) / (1.0 + jnp.exp(-eta)))[: X.n_rows]
+        p1 = expit(self._eta_host(X))
         return np.stack([1.0 - p1, p1], axis=1)
 
     def predict(self, X):

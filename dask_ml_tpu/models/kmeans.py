@@ -126,12 +126,17 @@ def _cost_to_candidates(X, mask, cands, cand_valid):
     return dmin, jnp.sum(dmin)
 
 
+def _gumbel_keys(weights, key):
+    """Gumbel-perturbed log-weights: top-l of these keys IS a weighted
+    sample of l items without replacement (P ∝ weights)."""
+    g = jax.random.gumbel(key, weights.shape, dtype=jnp.float32)
+    return jnp.where(weights > 0, jnp.log(weights) + g, -jnp.inf)
+
+
 @partial(jax.jit, static_argnames=("l",))
 def _gumbel_top_l(weights, key, l):
     """Indices of l draws without replacement with prob ∝ weights."""
-    g = jax.random.gumbel(key, weights.shape, dtype=jnp.float32)
-    keys = jnp.where(weights > 0, jnp.log(weights) + g, -jnp.inf)
-    _, idx = jax.lax.top_k(keys, l)
+    _, idx = jax.lax.top_k(_gumbel_keys(weights, key), l)
     return idx
 
 
@@ -141,6 +146,132 @@ def _candidate_weights(X, mask, cands, cand_valid):
     d2 = jnp.where(cand_valid[None, :] > 0, d2, jnp.inf)
     labels = jnp.argmin(d2, axis=1)
     return jax.ops.segment_sum(mask, labels, num_segments=cands.shape[0])
+
+
+# -- streamed (out-of-core) kernels ----------------------------------------
+# Host X (np.memmap / big ndarray) streams through BlockStream; each
+# kernel returns the per-block partial sums the in-memory while_loop
+# computes on the resident array, accumulated across blocks on device.
+# The reference's analog IS its normal mode: per-chunk tasks +
+# tree-reduce (SURVEY.md §3.1). One Lloyd iteration = one pass.
+
+@jax.jit
+def _block_assign_stats(X, mask, centers):
+    """(Σ_block x per label, count per label, Σ_block min-dist²)."""
+    k = centers.shape[0]
+    d2 = euclidean_distances_sq(X, centers)
+    labels = jnp.argmin(d2, axis=1)
+    sums = jax.ops.segment_sum(X * mask[:, None], labels, num_segments=k)
+    counts = jax.ops.segment_sum(mask, labels, num_segments=k)
+    inertia = jnp.sum(jnp.min(d2, axis=1) * mask)
+    return sums, counts, inertia
+
+
+@jax.jit
+def _block_moments(X, mask):
+    return jnp.tensordot(mask, X, axes=(0, 0)), \
+        jnp.tensordot(mask, X * X, axes=(0, 0))
+
+
+@partial(jax.jit, static_argnames=("l",))
+def _block_weighted_topl(X, weights, key, l):
+    """Per-block Gumbel top-l: (keys, rows). Global weighted sampling
+    without replacement = top-l of the per-block top-l keys (the Gumbel
+    keys are independent across blocks), so blocks merge exactly."""
+    kv, idx = jax.lax.top_k(_gumbel_keys(weights, key), l)
+    return kv, jnp.take(X, idx, axis=0)
+
+
+def _streamed_sample(stream, weights_fn, key, l):
+    """Draw l rows without replacement, P ∝ weights_fn(block), across a
+    BlockStream. Returns (l, d) host-merged rows."""
+    kvs, rows = [], []
+    for b, blk in enumerate(stream):
+        Xb = blk.arrays[0]
+        w = weights_fn(blk)
+        lb = min(l, Xb.shape[0])
+        kv, r = _block_weighted_topl(Xb, w, jax.random.fold_in(key, b), lb)
+        kvs.append(np.asarray(kv))
+        rows.append(np.asarray(r))
+    kvs = np.concatenate(kvs)
+    rows = np.concatenate(rows, axis=0)
+    top = np.argsort(-kvs)[:l]
+    top = top[np.isfinite(kvs[top])]
+    return rows[top]
+
+
+def _streamed_lloyd(stream, centers0, max_iter, tol2, logger=None):
+    centers = jnp.asarray(centers0)
+    n_iter = 0
+    for it in range(int(max_iter)):
+        sums = counts = inertia = None
+        for blk in stream:
+            s, c, i = _block_assign_stats(blk.arrays[0], blk.mask, centers)
+            sums = s if sums is None else sums + s
+            counts = c if counts is None else counts + c
+            inertia = i if inertia is None else inertia + i
+        new = jnp.where(counts[:, None] > 0, sums / counts[:, None], centers)
+        shift2 = float(jnp.sum((new - centers) ** 2))
+        centers = new
+        n_iter = it + 1
+        if logger is not None:
+            logger.log(step=it, inertia=float(inertia), center_shift2=shift2)
+        if shift2 <= tol2:
+            break
+    return centers, n_iter
+
+
+def init_scalable_streamed(stream, n_clusters, random_state, max_iter=None,
+                           oversampling_factor=2):
+    """k-means‖ over streamed blocks: the same fixed-budget Gumbel top-l
+    rounds as ``init_scalable``, with each round's cost/sampling pass
+    running block-by-block and merging exactly (see _block_weighted_topl)."""
+    from sklearn.cluster import KMeans as SkKMeans
+
+    l = max(int(oversampling_factor * n_clusters), 1)
+    key = jax.random.PRNGKey(0 if random_state is None else int(random_state))
+    key, k0 = jax.random.split(key)
+    first = _streamed_sample(stream, lambda blk: blk.mask, k0, 1)
+    cands_list = [first]
+    rounds = 5 if max_iter is None else max(int(max_iter), 1)
+    for r in range(rounds):
+        cands = jnp.asarray(np.concatenate(cands_list, axis=0))
+        valid = jnp.ones((cands.shape[0],), jnp.float32)
+        key, kr = jax.random.split(key)
+        phi = 0.0
+        kvs, rows = [], []
+        for b, blk in enumerate(stream):
+            Xb = blk.arrays[0]
+            dmin, phi_b = _cost_to_candidates(Xb, blk.mask, cands, valid)
+            phi += float(phi_b)
+            lb = min(l, Xb.shape[0])
+            kv, rw = _block_weighted_topl(
+                Xb, dmin, jax.random.fold_in(kr, b), lb
+            )
+            kvs.append(np.asarray(kv))
+            rows.append(np.asarray(rw))
+        if phi <= 0.0:
+            break
+        kvs = np.concatenate(kvs)
+        rows = np.concatenate(rows, axis=0)
+        top = np.argsort(-kvs)[:l]
+        top = top[np.isfinite(kvs[top])]
+        if top.size:
+            cands_list.append(rows[top])
+    cands_h = np.concatenate(cands_list, axis=0)
+    cands = jnp.asarray(cands_h)
+    valid = jnp.ones((cands.shape[0],), jnp.float32)
+    weights = None
+    for blk in stream:
+        w = _candidate_weights(blk.arrays[0], blk.mask, cands, valid)
+        weights = w if weights is None else weights + w
+    w_h = np.asarray(weights)
+    w_h = np.where(w_h > 0, w_h, 1e-6)
+    local = SkKMeans(
+        n_clusters=n_clusters, init="k-means++", n_init=1,
+        random_state=None if random_state is None else int(random_state),
+    ).fit(cands_h, sample_weight=w_h)
+    return jnp.asarray(local.cluster_centers_, cands.dtype)
 
 
 def init_scalable(X: ShardedArray, n_clusters, random_state, max_iter=None,
@@ -275,7 +406,96 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             return init_random(X, self.n_clusters, self.random_state)
         raise ValueError(f"Unknown init {self.init!r}")
 
+    def _init_centers_streamed(self, stream, n_features):
+        if isinstance(self.init, (np.ndarray, jnp.ndarray)):
+            centers = jnp.asarray(self.init, jnp.float32)
+            if centers.shape != (self.n_clusters, n_features):
+                raise ValueError(
+                    f"init array has shape {centers.shape}, expected "
+                    f"{(self.n_clusters, n_features)}"
+                )
+            return centers
+        if self.init == "k-means||":
+            return init_scalable_streamed(
+                stream, self.n_clusters, self.random_state,
+                self.init_max_iter, self.oversampling_factor,
+            )
+        seed_base = {"k-means++": 1, "random": 2}
+        if self.init in seed_base:
+            key = jax.random.PRNGKey(
+                seed_base[self.init] if self.random_state is None
+                else int(self.random_state)
+            )
+            if self.init == "random":
+                return jnp.asarray(_streamed_sample(
+                    stream, lambda blk: blk.mask, key, self.n_clusters
+                ))
+            from sklearn.cluster import kmeans_plusplus
+
+            m = min(stream.n_rows, max(10 * self.n_clusters, 500))
+            sample = _streamed_sample(stream, lambda blk: blk.mask, key, m)
+            centers, _ = kmeans_plusplus(
+                sample, self.n_clusters,
+                random_state=None if self.random_state is None
+                else int(self.random_state),
+            )
+            return jnp.asarray(centers, jnp.float32)
+        raise ValueError(f"Unknown init {self.init!r}")
+
+    def _fit_streamed(self, X, block_rows):
+        """Out-of-core Lloyd: X stays host-resident (np.memmap / large
+        ndarray); every pass streams fixed-shape blocks through the
+        per-block assign+update kernel and accumulates (sums, counts) on
+        device — the reference's per-chunk tasks + tree-reduce shape
+        (SURVEY.md §3.1) without materializing X in HBM. ``labels_`` is a
+        host int32 array (X's own size /(4·d) — small next to X)."""
+        from ..parallel.streaming import BlockStream
+        from ..utils.observability import fit_logger
+
+        n, d = X.shape
+        if self.n_clusters > n:
+            raise ValueError(
+                f"n_clusters={self.n_clusters} > n_samples={n}"
+            )
+        stream = BlockStream((X,), block_rows=block_rows)
+        # sklearn-style tol scaling needs the global per-feature variance:
+        # one moments pass
+        s = ss = None
+        for blk in stream:
+            bs, bss = _block_moments(blk.arrays[0], blk.mask)
+            s = bs if s is None else s + bs
+            ss = bss if ss is None else ss + bss
+        mean = s / n
+        var = ss / n - mean * mean
+        tol2 = float(self.tol * jnp.mean(var))
+        centers0 = self._init_centers_streamed(stream, d)
+        with fit_logger("KMeans", streamed=True, n_rows=n,
+                        n_clusters=self.n_clusters) as logger:
+            centers, n_iter = _streamed_lloyd(
+                stream, centers0, self.max_iter, tol2, logger=logger
+            )
+        labels = np.empty(n, np.int32)
+        inertia = 0.0
+        cursor = 0
+        for blk in stream:
+            lb, ib = _labels_inertia(blk.arrays[0], blk.mask, centers)
+            m = blk.n_rows
+            labels[cursor:cursor + m] = np.asarray(lb)[:m]
+            inertia += float(ib)
+            cursor += m
+        self.cluster_centers_ = np.asarray(centers)
+        self.labels_ = labels
+        self.inertia_ = inertia
+        self.n_iter_ = int(n_iter)
+        self.n_features_in_ = d
+        return self
+
     def fit(self, X, y=None):
+        from ..parallel.streaming import stream_plan
+
+        block_rows = stream_plan(X)
+        if block_rows is not None:
+            return self._fit_streamed(X, block_rows)
         X = check_array(X, dtype=np.float32)
         if self.n_clusters > X.n_rows:
             raise ValueError(
@@ -308,6 +528,15 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
 
     def predict(self, X):
         check_is_fitted(self, "cluster_centers_")
+        from ..parallel.streaming import stream_plan, streamed_map
+
+        block_rows = stream_plan(X)
+        if block_rows is not None:
+            c = jnp.asarray(self.cluster_centers_, jnp.float32)
+            return streamed_map(
+                X, block_rows,
+                lambda blk: _labels_inertia(blk.arrays[0], blk.mask, c)[0],
+            )
         X = check_array(X, dtype=np.float32)
         centers = jnp.asarray(self.cluster_centers_, X.dtype)
         labels, _ = _labels_inertia(X.data, X.row_mask(X.dtype), centers)
@@ -318,6 +547,15 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
 
     def transform(self, X):
         check_is_fitted(self, "cluster_centers_")
+        from ..parallel.streaming import stream_plan, streamed_map
+
+        block_rows = stream_plan(X)
+        if block_rows is not None:
+            c = jnp.asarray(self.cluster_centers_, jnp.float32)
+            return streamed_map(
+                X, block_rows,
+                lambda blk: euclidean_distances(blk.arrays[0], c),
+            )
         X = check_array(X, dtype=np.float32)
         centers = jnp.asarray(self.cluster_centers_, X.dtype)
         d = euclidean_distances(X.data, centers)
@@ -325,6 +563,16 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
 
     def score(self, X, y=None):
         check_is_fitted(self, "cluster_centers_")
+        from ..parallel.streaming import stream_plan, streamed_map
+
+        block_rows = stream_plan(X)
+        if block_rows is not None:
+            c = jnp.asarray(self.cluster_centers_, jnp.float32)
+            per_block = streamed_map(
+                X, block_rows,
+                lambda blk: _labels_inertia(blk.arrays[0], blk.mask, c)[1][None],
+            )
+            return -float(per_block.sum())
         X = check_array(X, dtype=np.float32)
         centers = jnp.asarray(self.cluster_centers_, X.dtype)
         _, inertia = _labels_inertia(X.data, X.row_mask(X.dtype), centers)

@@ -41,6 +41,20 @@ def _resolve_n_components(n_components, n, d):
     return n_components
 
 
+@jax.jit
+def _block_pca_moments(X, mask, shift):
+    """Per-block (Σ(x-shift), Σ(x-shift)(x-shift)T), padded rows masked.
+    ``shift`` is a rough mean estimate: centering the accumulation keeps
+    the f32 block sums ~O(n_b·std²) instead of O(n_b·mean²), avoiding
+    catastrophic cancellation in cov = G - n·μμᵀ for data with
+    mean ≫ std (the blocks are f64-accumulated on host afterwards)."""
+    xc = X - shift
+    xm = xc * mask[:, None]
+    return (jnp.tensordot(mask, xc, axes=(0, 0)),
+            jnp.einsum("ni,nj->ij", xm, xc,
+                       preferred_element_type=jnp.float32))
+
+
 class PCA(TransformerMixin, BaseEstimator):
     """Ref: dask_ml/decomposition/pca.py::PCA."""
 
@@ -68,7 +82,76 @@ class PCA(TransformerMixin, BaseEstimator):
         raise ValueError(f"Unknown svd_solver {self.svd_solver!r}")
 
     def fit(self, X, y=None):
+        from ..parallel.streaming import stream_plan
+
+        block_rows = stream_plan(X)
+        if block_rows is not None:
+            return self._fit_streamed(X, block_rows)
         self._fit(X)
+        return self
+
+    def _fit_streamed(self, X, block_rows):
+        """Out-of-core fit via one streamed moments pass: accumulate
+        (Σx, ΣxxᵀX) per block, then eigendecompose the d×d covariance on
+        host. For the tall-skinny shapes this estimator targets
+        (d ≤ O(10³), BASELINE configs), the Gram route computes the FULL
+        spectrum in a single pass — subsuming both the TSQR and
+        randomized solvers of the resident path, with one pass where
+        Halko needs two. Ref: the reference's ``da.linalg`` reductions
+        over host-backed chunks (SURVEY.md §3.3)."""
+        from ..parallel.streaming import BlockStream
+
+        n, d = X.shape
+        if n < d:
+            raise ValueError(
+                "PCA requires tall data (n_samples >= n_features); got "
+                f"{n} x {d}"
+            )
+        frac = None
+        if (isinstance(self.n_components, float)
+                and 0.0 < self.n_components < 1.0):
+            frac, k = self.n_components, min(n, d)
+        else:
+            k = _resolve_n_components(self.n_components, n, d)
+        stream = BlockStream((X,), block_rows=block_rows)
+        # shift estimate from a small head slice (exactness not needed —
+        # any shift near the mean kills the cancellation)
+        shift = np.asarray(X[: min(4096, n)], np.float64).mean(axis=0)
+        shift_dev = jnp.asarray(shift, jnp.float32)
+        s = np.zeros(d, np.float64)
+        g = np.zeros((d, d), np.float64)
+        for blk in stream:
+            bs, bg = _block_pca_moments(blk.arrays[0], blk.mask, shift_dev)
+            s += np.asarray(bs, np.float64)
+            g += np.asarray(bg, np.float64)
+        mean_c = s / n  # mean of the SHIFTED data
+        mean = shift + mean_c
+        cov = (g - n * np.outer(mean_c, mean_c)) / (n - 1)
+        evals, evecs = np.linalg.eigh(cov)
+        order = np.argsort(evals)[::-1]
+        ev = np.maximum(evals[order], 0.0)
+        vt = evecs[:, order].T
+        # deterministic signs, V-based (linalg.svd_flip convention)
+        max_abs = np.argmax(np.abs(vt), axis=1)
+        signs = np.sign(vt[np.arange(vt.shape[0]), max_abs])
+        vt = vt * np.where(signs == 0, 1.0, signs)[:, None]
+
+        total_var = float(ev.sum())
+        if frac is not None:
+            ratio = np.cumsum(ev / total_var)
+            k = int(np.searchsorted(ratio, frac) + 1)
+        self.n_components_ = k
+        self.components_ = vt[:k]
+        self.explained_variance_ = ev[:k]
+        self.explained_variance_ratio_ = ev[:k] / total_var
+        self.singular_values_ = np.sqrt(ev[:k] * (n - 1))
+        self.mean_ = mean
+        if k < min(n, d):
+            self.noise_variance_ = (total_var - ev[:k].sum()) / (min(n, d) - k)
+        else:
+            self.noise_variance_ = 0.0
+        self.n_features_in_ = d
+        self.n_samples_ = n
         return self
 
     def _fit(self, X):
@@ -128,6 +211,13 @@ class PCA(TransformerMixin, BaseEstimator):
         return X, u, s, vt, mask
 
     def fit_transform(self, X, y=None):
+        from ..parallel.streaming import stream_plan
+
+        block_rows = stream_plan(X)
+        if block_rows is not None:
+            # out-of-core: fit via the streamed moments pass, then the
+            # streamed (block-wise) transform — X never materializes
+            return self._fit_streamed(X, block_rows).transform(X)
         X, u, s, vt, mask = self._fit(X)
         k = self.n_components_
         scores = u[:, :k] * s[None, :k]
@@ -138,6 +228,23 @@ class PCA(TransformerMixin, BaseEstimator):
 
     def transform(self, X):
         check_is_fitted(self, "components_")
+        from ..parallel.streaming import stream_plan, streamed_map
+
+        block_rows = stream_plan(X)
+        if block_rows is not None:
+            # block-wise host→device→host scores; X never materializes
+            comp = jnp.asarray(self.components_, jnp.float32)
+            mean = jnp.asarray(self.mean_, jnp.float32)
+            scale = (
+                jnp.sqrt(jnp.asarray(self.explained_variance_, jnp.float32))
+                if self.whiten else None
+            )
+
+            def block_scores(blk):
+                sc = ((blk.arrays[0] - mean) * blk.mask[:, None]) @ comp.T
+                return sc / scale if scale is not None else sc
+
+            return streamed_map(X, block_rows, block_scores)
         X = check_array(X, dtype=np.float32)
         mask = X.row_mask(X.dtype)
         comp = jnp.asarray(self.components_, X.dtype)

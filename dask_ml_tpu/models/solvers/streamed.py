@@ -1,0 +1,451 @@
+"""Out-of-core GLM solvers: gradient/loss/Hessian accumulation over
+streamed host blocks.
+
+Reference equivalent: dask's chunk scheduling under ``dask_glm`` — the
+optimizer lives on the client and every objective evaluation is a lazy
+graph over host-backed chunks (``dask_glm/algorithms.py``, SURVEY.md §3.2
+"host-resident optimizer, cluster-resident data"). TPU design (SURVEY.md
+§7 B0 / design stance #1): the dataset stays in host RAM or an
+``np.memmap``; fixed-shape blocks stream through ``BlockStream``
+(prefetched ``device_put``) into per-block jitted kernels that return
+partial (loss, gradient[, Hessian]) sums; a small host-side optimizer
+(d-vector state) consumes the accumulated totals. One objective
+evaluation = one full pass over the data — line searches pay extra
+passes, exactly as the reference pays extra cluster round-trips, so the
+pass budget per solver is explicit below.
+
+Passes per outer iteration:
+
+- ``lbfgs`` (two-loop recursion): 1 + line-search trials (Armijo)
+- ``gradient_descent``: 1 + trials
+- ``proximal_grad``: 1 + trials
+- ``newton``: 1 (grad+Hessian fused in one pass) + step-halving trials
+- ``admm``: exactly 1 (block-local prox solves; the one-pass-friendly
+  choice SURVEY.md §7 recommends at >HBM scale)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import regularizers
+from .families import get_family
+
+
+# ---------------------------------------------------------------------------
+# per-block jitted kernels. A consumed block's HBM is released when the
+# stream iterator drops its reference, so peak device footprint stays
+# ≈ (prefetch + 1) blocks.
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("family", "intercept"))
+def _block_val_grad(beta, X, y, mask, family, intercept):
+    """(Σ pointwise-NLL, Σ ∂NLL/∂β) over one block's valid rows."""
+
+    def f(b):
+        bd = b.astype(X.dtype)
+        eta = (X @ bd[:-1] + bd[-1]) if intercept else X @ bd
+        return jnp.sum(get_family(family).pointwise(eta, y) * mask)
+
+    return jax.value_and_grad(f)(beta)
+
+
+@partial(jax.jit, static_argnames=("family", "intercept"))
+def _block_val(beta, X, y, mask, family, intercept):
+    """Forward-only Σ pointwise-NLL — line-search/step-halving trials that
+    only need the value skip the backward pass entirely."""
+    bd = beta.astype(X.dtype)
+    eta = (X @ bd[:-1] + bd[-1]) if intercept else X @ bd
+    return jnp.sum(get_family(family).pointwise(eta, y) * mask)
+
+
+@partial(jax.jit, static_argnames=("family", "intercept"))
+def _block_val_grad_hess(beta, X, y, mask, family, intercept):
+    """One fused pass: (Σ NLL, Σ grad, Σ Xᵀ W X) for Newton."""
+    fam = get_family(family)
+    bd = beta.astype(X.dtype)
+    eta = (X @ bd[:-1] + bd[-1]) if intercept else X @ bd
+
+    def f(b):
+        bb = b.astype(X.dtype)
+        e = (X @ bb[:-1] + bb[-1]) if intercept else X @ bb
+        return jnp.sum(fam.pointwise(e, y) * mask)
+
+    val, grad = jax.value_and_grad(f)(beta)
+    w = fam.hess_weight(eta, y) * mask
+    Xw = X * w[:, None]
+    hess = jnp.einsum("ni,nj->ij", Xw, X, preferred_element_type=jnp.float32)
+    if intercept:
+        col = jnp.sum(Xw, axis=0)
+        hess = jnp.block([
+            [hess, col[:, None]],
+            [col[None, :], jnp.sum(w)[None, None]],
+        ])
+    return val, grad, hess
+
+
+@partial(jax.jit, static_argnames=("reg",))
+def _finish_vg(val_sum, grad_sum, beta, n_rows, lam, pmask, l1_ratio, reg):
+    """mean NLL + smooth penalty, and its gradient, from block sums."""
+    pen, pen_g = jax.value_and_grad(
+        lambda b: regularizers.value(reg, b, lam, pmask, l1_ratio)
+    )(beta)
+    return val_sum / n_rows + pen, grad_sum / n_rows + pen_g
+
+
+@partial(jax.jit, static_argnames=("family", "intercept", "local_iter"))
+def _block_admm_local(X, y, mask, b, u, z, rho, n_rows, local_iter, family,
+                      intercept):
+    """ADMM block-local Newton steps toward prox target v = z - u.
+
+    Identical math to the in-memory shard-local solve
+    (``solvers.py::_admm_run::local_newton``) with the mesh shard replaced
+    by the streamed block."""
+    fam = get_family(family)
+    v = z - u
+
+    def local_newton(_, b):
+        bd = b.astype(X.dtype)
+        eta = (X @ bd[:-1] + bd[-1]) if intercept else X @ bd
+        resid = jax.grad(lambda e: jnp.sum(fam.pointwise(e, y) * mask))(eta)
+        if intercept:
+            g = jnp.concatenate([X.T @ resid, jnp.sum(resid)[None]]) / n_rows \
+                + rho * (b - v)
+        else:
+            g = X.T @ resid / n_rows + rho * (b - v)
+        w = fam.hess_weight(eta, y) * mask
+        Xw = X * w[:, None]
+        h = jnp.einsum("ni,nj->ij", Xw, X,
+                       preferred_element_type=jnp.float32) / n_rows
+        if intercept:
+            col = jnp.sum(Xw, axis=0) / n_rows
+            h = jnp.block([
+                [h, col[:, None]],
+                [col[None, :], (jnp.sum(w) / n_rows)[None, None]],
+            ])
+        h = h + rho * jnp.eye(b.shape[0], dtype=b.dtype)
+        return b - jnp.linalg.solve(h, g)
+
+    return jax.lax.fori_loop(0, local_iter, local_newton, b)
+
+
+# ---------------------------------------------------------------------------
+# streamed objective: one call = one pass over the stream
+# ---------------------------------------------------------------------------
+
+class StreamedObjective:
+    """value_and_grad over a BlockStream; counts data passes."""
+
+    def __init__(self, stream, n_rows, lam, pmask, l1_ratio, family, reg,
+                 intercept, logger=None):
+        self.stream = stream
+        self.n_rows = float(n_rows)
+        self.lam = lam
+        self.pmask = pmask
+        self.l1_ratio = l1_ratio
+        self.family = family
+        self.reg = reg
+        self.intercept = intercept
+        self.passes = 0
+        self.logger = logger
+
+    def value_and_grad(self, beta):
+        self.passes += 1
+        beta = jnp.asarray(beta, jnp.float32)
+        vs, gs = None, None
+        for blk in self.stream:
+            Xb, yb = blk.arrays
+            v, g = _block_val_grad(beta, Xb, yb, blk.mask, self.family,
+                                   self.intercept)
+            vs = v if vs is None else vs + v
+            gs = g if gs is None else gs + g
+        val, grad = _finish_vg(vs, gs, beta, self.n_rows, self.lam,
+                               self.pmask, self.l1_ratio, self.reg)
+        return float(val), np.asarray(grad, np.float64)
+
+    def value(self, beta):
+        self.passes += 1
+        beta = jnp.asarray(beta, jnp.float32)
+        vs = None
+        for blk in self.stream:
+            Xb, yb = blk.arrays
+            v = _block_val(beta, Xb, yb, blk.mask, self.family,
+                           self.intercept)
+            vs = v if vs is None else vs + v
+        pen = regularizers.value(self.reg, beta, self.lam, self.pmask,
+                                 self.l1_ratio)
+        return float(vs / self.n_rows + pen)
+
+    def value_and_grad_and_hess(self, beta):
+        self.passes += 1
+        beta = jnp.asarray(beta, jnp.float32)
+        vs, gs, hs = None, None, None
+        for blk in self.stream:
+            Xb, yb = blk.arrays
+            v, g, h = _block_val_grad_hess(beta, Xb, yb, blk.mask,
+                                           self.family, self.intercept)
+            vs = v if vs is None else vs + v
+            gs = g if gs is None else gs + g
+            hs = h if hs is None else hs + h
+        val, grad = _finish_vg(vs, gs, beta, self.n_rows, self.lam,
+                               self.pmask, self.l1_ratio, self.reg)
+        return (float(val), np.asarray(grad, np.float64),
+                np.asarray(hs, np.float64) / self.n_rows)
+
+    def log(self, it, val, gnorm):
+        if self.logger is not None:
+            self.logger.log(step=it, loss=float(val), grad_norm=float(gnorm),
+                            passes=self.passes)
+
+
+def _armijo(obj, beta, val, grad, direction, t0=1.0, c=1e-4, backtrack=0.5,
+            max_trials=30):
+    """Backtracking line search; each trial is one data pass. Returns
+    (t, new_val, new_grad) at the accepted point."""
+    dg = float(grad @ direction)
+    if dg >= 0:  # numerical non-descent: fall back to steepest descent
+        direction = -grad
+        dg = -float(grad @ grad)
+    t = t0
+    for _ in range(max_trials):
+        nv, ng = obj.value_and_grad(beta + t * direction)
+        if nv <= val + c * t * dg or t <= 1e-20:
+            return t, direction, nv, ng
+        t *= backtrack
+    return t, direction, nv, ng
+
+
+# ---------------------------------------------------------------------------
+# solvers (host optimizer state — a handful of d-vectors — over streamed
+# device evaluation)
+# ---------------------------------------------------------------------------
+
+def lbfgs(obj: StreamedObjective, beta0, max_iter=100, tol=1e-6, memory=10,
+          **_):
+    if obj.reg not in regularizers.SMOOTH:
+        raise ValueError(
+            "streamed lbfgs handles smooth penalties only (l2/none); use "
+            "solver='proximal_grad' or 'admm' for l1/elastic_net"
+        )
+    beta = np.asarray(beta0, np.float64)
+    val, grad = obj.value_and_grad(beta)
+    S, Y = [], []
+    n_iter = 0
+    for it in range(int(max_iter)):
+        gnorm = float(np.linalg.norm(grad))
+        obj.log(it, val, gnorm)
+        if gnorm <= tol:
+            break
+        # two-loop recursion on host (d-vector ops; data never touched)
+        q = grad.copy()
+        alphas = []
+        for s, y_ in zip(reversed(S), reversed(Y)):
+            rho = 1.0 / float(y_ @ s)
+            a = rho * float(s @ q)
+            q -= a * y_
+            alphas.append((rho, a))
+        if Y:
+            q *= float(S[-1] @ Y[-1]) / float(Y[-1] @ Y[-1])
+        for (rho, a), s, y_ in zip(reversed(alphas), S, Y):
+            q += (a - rho * float(y_ @ q)) * s
+        t, direction, nv, ng = _armijo(obj, beta, val, grad, -q)
+        s = t * direction
+        y_ = ng - grad
+        if float(s @ y_) > 1e-10 * np.linalg.norm(s) * np.linalg.norm(y_):
+            S.append(s)
+            Y.append(y_)
+            if len(S) > memory:
+                S.pop(0)
+                Y.pop(0)
+        beta = beta + s
+        val, grad = nv, ng
+        n_iter = it + 1
+    return beta, {"n_iter": n_iter, "grad_norm": float(np.linalg.norm(grad)),
+                  "data_passes": obj.passes}
+
+
+def gradient_descent(obj: StreamedObjective, beta0, max_iter=100, tol=1e-6,
+                     init_step=1.0, **_):
+    if obj.reg not in regularizers.SMOOTH:
+        raise ValueError(
+            "streamed gradient_descent handles smooth penalties only"
+        )
+    beta = np.asarray(beta0, np.float64)
+    val, grad = obj.value_and_grad(beta)
+    step = init_step
+    n_iter = 0
+    for it in range(int(max_iter)):
+        gnorm = float(np.linalg.norm(grad))
+        obj.log(it, val, gnorm)
+        if gnorm <= tol:
+            break
+        t, direction, nv, ng = _armijo(obj, beta, val, grad, -grad, t0=step)
+        beta = beta + t * direction
+        val, grad = nv, ng
+        step = t * 2.0
+        n_iter = it + 1
+    return beta, {"n_iter": n_iter, "grad_norm": float(np.linalg.norm(grad)),
+                  "data_passes": obj.passes}
+
+
+def newton(obj: StreamedObjective, beta0, max_iter=50, tol=1e-6, **_):
+    if obj.reg not in regularizers.SMOOTH:
+        raise ValueError("streamed newton handles smooth penalties only")
+    beta = np.asarray(beta0, np.float64)
+    d = beta.shape[0]
+    pmask = np.asarray(obj.pmask, np.float64)
+    ridge = (float(obj.lam) * pmask if obj.reg == "l2"
+             else np.zeros(d)) + 1e-8
+    n_iter = 0
+    gnorm = np.inf
+    for it in range(int(max_iter)):
+        val, grad, hess = obj.value_and_grad_and_hess(beta)
+        gnorm = float(np.linalg.norm(grad))
+        obj.log(it, val, gnorm)
+        if gnorm <= tol:
+            break
+        hess = hess + np.diag(ridge)
+        delta = np.linalg.lstsq(hess, grad, rcond=None)[0]
+        t = 1.0
+        while t > 1e-6:
+            if obj.value(beta - t * delta) <= val:
+                break
+            t *= 0.5
+        beta = beta - t * delta
+        n_iter = it + 1
+    return beta, {"n_iter": n_iter, "grad_norm": gnorm,
+                  "data_passes": obj.passes}
+
+
+def proximal_grad(obj: StreamedObjective, beta0, max_iter=100, tol=1e-7,
+                  init_step=1.0, **_):
+    # penalty handled by the prox; the streamed objective evaluates the
+    # smooth part only
+    smooth = StreamedObjective(
+        obj.stream, obj.n_rows, obj.lam * 0.0, obj.pmask, obj.l1_ratio,
+        obj.family, "none", obj.intercept, logger=obj.logger,
+    )
+    lam = float(np.asarray(obj.lam))
+    pmask_j = jnp.asarray(obj.pmask)
+    beta = np.asarray(beta0, np.float64)
+    val, grad = smooth.value_and_grad(beta)
+    step = init_step
+    n_iter = 0
+    delta = np.inf
+
+    def candidate(t):
+        return np.asarray(regularizers.prox(
+            obj.reg, jnp.asarray(beta - t * grad), lam, t, pmask_j,
+            obj.l1_ratio,
+        ), np.float64)
+
+    for it in range(int(max_iter)):
+        t = step
+        while True:
+            z = candidate(t)
+            dz = z - beta
+            quad = val + float(grad @ dz) + float(dz @ dz) / (2.0 * t)
+            # evaluate value AND gradient in the trial pass: the accepted
+            # candidate's gradient is reused below, so acceptance costs no
+            # extra epoch over the stream
+            zv, zg = smooth.value_and_grad(z)
+            if zv <= quad or t <= 1e-20:
+                break
+            t *= 0.5
+        delta = float(np.linalg.norm(z - beta)) / max(t, 1e-20)
+        beta = z
+        val, grad = zv, zg
+        smooth.log(it, val, delta)
+        step = t * 1.2
+        n_iter = it + 1
+        if delta <= tol:
+            break
+    obj.passes = smooth.passes
+    return beta, {"n_iter": n_iter, "opt_residual": float(delta),
+                  "data_passes": obj.passes}
+
+
+def admm(obj: StreamedObjective, beta0, max_iter=250, tol=1e-4, rho=1.0,
+         local_iter=8, **_):
+    """Block-consensus ADMM: each streamed block is a consensus member
+    (the in-memory version's mesh shard, ``solvers.py::_admm_run``).
+    Per-block (b, u) state is (n_blocks, d) on host — tiny next to X."""
+    reg = obj.reg
+    lam = float(np.asarray(obj.lam))
+    if reg == "none":
+        reg, lam = "l2", 0.0
+    n_blocks = obj.stream.n_blocks
+    d = len(np.asarray(beta0))
+    B = np.tile(np.asarray(beta0, np.float32)[None], (n_blocks, 1))
+    U = np.zeros((n_blocks, d), np.float32)
+    z = jnp.asarray(beta0, jnp.float32)
+    pmask_j = jnp.asarray(obj.pmask)
+    rho_f = float(rho)
+    n_iter = 0
+    primal = dual = np.inf
+    for it in range(int(max_iter)):
+        obj.passes += 1
+        bi = 0
+        for blk in obj.stream:
+            Xb, yb = blk.arrays
+            B[bi] = np.asarray(_block_admm_local(
+                Xb, yb, blk.mask, jnp.asarray(B[bi]), jnp.asarray(U[bi]), z,
+                jnp.float32(rho_f), jnp.float32(obj.n_rows), local_iter,
+                obj.family, obj.intercept,
+            ))
+            bi += 1
+        bu_mean = jnp.asarray((B + U).mean(axis=0))
+        z_new = regularizers.prox(reg, bu_mean, lam,
+                                  1.0 / (rho_f * n_blocks), pmask_j,
+                                  obj.l1_ratio)
+        z_h = np.asarray(z_new, np.float32)
+        U = U + B - z_h[None, :]
+        primal = float(np.sqrt(((B - z_h[None, :]) ** 2).sum()))
+        dual = float(rho_f * np.sqrt(n_blocks)
+                     * np.linalg.norm(z_h - np.asarray(z)))
+        z = z_new
+        obj.log(it, primal, dual)
+        n_iter = it + 1
+        if primal <= tol and dual <= tol:
+            break
+        if primal > 10.0 * dual:
+            rho_f *= 2.0
+            U /= 2.0
+        elif dual > 10.0 * primal:
+            rho_f *= 0.5
+            U *= 2.0
+    return (np.asarray(z, np.float64),
+            {"n_iter": n_iter, "primal_residual": primal,
+             "dual_residual": dual, "data_passes": obj.passes})
+
+
+STREAMED_SOLVERS = {
+    "admm": admm,
+    "lbfgs": lbfgs,
+    "newton": newton,
+    "gradient_descent": gradient_descent,
+    "proximal_grad": proximal_grad,
+}
+
+
+def solve_streamed(solver, stream, n_rows, beta0, family, reg, lam, pmask,
+                   l1_ratio=0.5, intercept=True, max_iter=100, tol=1e-6,
+                   logger=None, **kwargs):
+    if solver not in STREAMED_SOLVERS:
+        raise ValueError(
+            f"Unknown solver {solver!r}; options: {sorted(STREAMED_SOLVERS)}"
+        )
+    obj = StreamedObjective(
+        stream, n_rows, jnp.asarray(lam, jnp.float32), jnp.asarray(pmask),
+        l1_ratio, family, reg, intercept, logger=logger,
+    )
+    beta, info = STREAMED_SOLVERS[solver](
+        obj, beta0, max_iter=max_iter, tol=tol, **kwargs
+    )
+    info["streamed"] = True
+    info["n_blocks"] = stream.n_blocks
+    return beta, info

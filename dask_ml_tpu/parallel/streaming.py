@@ -4,10 +4,11 @@ Reference equivalent: dask's chunk scheduling — blocks materialize on
 workers as tasks run (SURVEY.md §2b row 1). TPU design (SURVEY.md §7
 design stance #1, "the heart of the system"): the working set lives in
 host RAM (numpy / np.memmap); fixed-shape blocks are placed onto the mesh
-with ``jax.device_put`` ONE BLOCK AHEAD of compute (device_put is async —
-issuing the next transfer before consuming the current block overlaps DMA
-with compute, the double-buffer pattern), and jitted steps donate the
-block buffer so XLA reuses the HBM.
+with ``jax.device_put`` AHEAD of compute (device_put is async — issuing
+the next transfer before consuming the current block overlaps DMA with
+compute, the double-buffer pattern). A consumed block's HBM is released
+when its Python reference drops at the next loop iteration, so peak
+footprint is ≈ (prefetch + 1) blocks.
 
 Blocks have a fixed padded shape (static shapes for jit); the final
 partial block carries its logical row count and a mask.
@@ -35,20 +36,67 @@ class Block:
         self.mask = mask
 
 
+# auto block budget: bytes of ONE block's X on device. Fixed bytes (not a
+# fraction of n) so an arbitrarily large memmap still streams in
+# HBM-bounded blocks; peak device footprint ≈ (prefetch + 1) blocks.
+_AUTO_BLOCK_BYTES = 256 << 20
+
+
+def auto_block_rows(n_rows: int, row_bytes: int = 4) -> int:
+    """Block size from config: ``stream_block_rows`` if set, else an
+    HBM byte budget divided by the bytes-per-row of the streamed data."""
+    from ..config import get_config
+
+    br = get_config().stream_block_rows
+    if br and br > 0:
+        return int(br)
+    return max(_AUTO_BLOCK_BYTES // max(int(row_bytes), 1), 1)
+
+
+def stream_plan(X) -> int | None:
+    """Rows-per-block when ``X`` should be fitted out-of-core, else None.
+
+    Streams when X is host-resident and either (a) an ``np.memmap`` —
+    its backing file may exceed host AND device memory, so it must never
+    be materialized whole — or (b) larger than a configured
+    ``config.stream_block_rows``. Device-resident inputs (ShardedArray /
+    jax.Array) always take the resident path.
+    """
+    from ..config import get_config
+
+    if not isinstance(X, np.ndarray) or isinstance(X, np.generic):
+        return None
+    n = X.shape[0] if X.ndim else 0
+    if n == 0:
+        return None
+    if isinstance(X, np.memmap):
+        # blocks stream as float32 regardless of the memmap dtype
+        row_bytes = 4 * int(np.prod(X.shape[1:], dtype=np.int64) or 1)
+        return min(auto_block_rows(n, row_bytes), n)
+    br = get_config().stream_block_rows
+    if br and 0 < br < n:
+        return br
+    return None
+
+
 class BlockStream:
-    """Double-buffered epoch iterator over host arrays.
+    """Prefetched epoch iterator over host arrays.
 
     Parameters
     ----------
     arrays : tuple of host arrays (np.ndarray / np.memmap), equal length.
     block_rows : rows per block (rounded up to a multiple of the mesh's
-        data-axis size).
+        data-axis size); None reads ``config.stream_block_rows``, falling
+        back to an HBM byte budget divided by the arrays' combined
+        bytes-per-row.
     shuffle : shuffle block order each epoch (the reference's
         ``shuffle_blocks``); rows within a block keep locality.
+    prefetch : transfers kept in flight ahead of compute (1 = classic
+        double buffering); None reads ``config.stream_prefetch``.
     """
 
-    def __init__(self, arrays, block_rows, mesh=None, shuffle=False,
-                 seed=None, dtype=np.float32):
+    def __init__(self, arrays, block_rows=None, mesh=None, shuffle=False,
+                 seed=None, dtype=np.float32, prefetch=None):
         self.mesh = resolve_mesh(mesh)
         self.arrays = tuple(arrays)
         n = len(self.arrays[0])
@@ -56,6 +104,17 @@ class BlockStream:
             if len(a) != n:
                 raise ValueError("arrays have inconsistent lengths")
         self.n_rows = n
+        if block_rows is None:
+            row_bytes = sum(
+                4 * int(np.prod(a.shape[1:], dtype=np.int64) or 1)
+                for a in self.arrays
+            )
+            block_rows = min(auto_block_rows(n, row_bytes), n)
+        if prefetch is None:
+            from ..config import get_config
+
+            prefetch = get_config().stream_prefetch
+        self.prefetch = max(int(prefetch), 1)
         shards = data_shards(self.mesh)
         self.block_rows = max(
             int(np.ceil(block_rows / shards)) * shards, shards
@@ -96,15 +155,18 @@ class BlockStream:
         order = np.arange(self.n_blocks)
         if self.shuffle:
             self.rng.shuffle(order)
-        # one-ahead prefetch: transfer of block i+1 overlaps compute on i
-        pending = None
+        # k-deep prefetch: device_put is async, so issuing the next k
+        # transfers before consuming the current block overlaps DMA with
+        # compute (k=1 is the classic double buffer)
+        from collections import deque
+
+        pending = deque()
         for b in order:
-            nxt = self._put(self._block_host(b))
-            if pending is not None:
-                yield pending
-            pending = nxt
-        if pending is not None:
-            yield pending
+            pending.append(self._put(self._block_host(b)))
+            if len(pending) > self.prefetch:
+                yield pending.popleft()
+        while pending:
+            yield pending.popleft()
 
     def __len__(self):
         return self.n_blocks
@@ -112,3 +174,15 @@ class BlockStream:
     def epochs(self, n_epochs):
         for _ in range(n_epochs):
             yield from self
+
+
+def streamed_map(X, block_rows, fn):
+    """Map ``fn(block) -> host array (block_valid_rows, ...)`` over X's
+    blocks and concatenate — the one stream→compute→host pattern shared by
+    every streamed inference path (GLM decision values, KMeans labels /
+    distances, PCA scores). ``fn`` receives the padded device block; its
+    output is sliced to the block's logical rows here."""
+    outs = []
+    for blk in BlockStream((X,), block_rows=block_rows):
+        outs.append(np.asarray(fn(blk))[: blk.n_rows])
+    return np.concatenate(outs, axis=0)

@@ -53,6 +53,25 @@ class MetricsLogger:
         self.close()
 
 
+@contextlib.contextmanager
+def fit_logger(component, **extra):
+    """Per-fit MetricsLogger bound to ``config.metrics_path``; yields None
+    (a no-op for callers that guard on it) when the knob is unset. This is
+    how estimators/solvers wire per-step JSONL without every call site
+    touching config (BASELINE.md measurement protocol)."""
+    from ..config import get_config
+
+    path = get_config().metrics_path
+    if not path:
+        yield None
+        return
+    logger = MetricsLogger(path, extra={"component": component, **extra})
+    try:
+        yield logger
+    finally:
+        logger.close()
+
+
 def timed(fn, *args, **kwargs):
     """(result, seconds) with a block_until_ready barrier — the honest way
     to time an async-dispatch jax program."""

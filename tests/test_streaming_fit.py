@@ -1,0 +1,252 @@
+"""Out-of-core (streamed) fit parity: LogisticRegression / KMeans / PCA
+fitted from an np.memmap several× the block size must match the resident
+in-memory fit (VERDICT r2 #1 / SURVEY.md §7 B0 'the heart of the
+system'), and the stream config knobs must be consumed."""
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu import config
+
+
+def _memmap(tmp_path, arr, name):
+    p = str(tmp_path / name)
+    mm = np.memmap(p, dtype=np.float32, mode="w+", shape=arr.shape)
+    mm[:] = arr
+    mm.flush()
+    return np.memmap(p, dtype=np.float32, mode="r", shape=arr.shape)
+
+
+@pytest.fixture(scope="module")
+def clf_data():
+    rng = np.random.RandomState(0)
+    n, d = 4000, 12
+    X = rng.randn(n, d).astype(np.float32)
+    beta = rng.randn(d) / np.sqrt(d)
+    p = 1.0 / (1.0 + np.exp(-(X @ beta + 0.3)))
+    y = (rng.uniform(size=n) < p).astype(np.float32)
+    return X, y
+
+
+@pytest.mark.parametrize("solver,penalty,rtol", [
+    ("lbfgs", "l2", 2e-2),
+    ("newton", "l2", 2e-2),
+    ("gradient_descent", "l2", 5e-2),
+    ("proximal_grad", "l1", 5e-2),
+    ("admm", "l2", 5e-2),
+])
+def test_logreg_memmap_matches_resident(tmp_path, clf_data, solver, penalty,
+                                        rtol):
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    X, y = clf_data
+    Xmm = _memmap(tmp_path, X, f"X_{solver}.f32")
+    kw = dict(solver=solver, penalty=penalty, C=1.0, max_iter=200, tol=1e-8)
+
+    resident = LogisticRegression(**kw).fit(X.copy(), y)
+    with config.set(stream_block_rows=1000):
+        streamed = LogisticRegression(**kw).fit(Xmm, y)
+
+    assert streamed.solver_info_["streamed"] is True
+    assert streamed.solver_info_["n_blocks"] > 1
+    np.testing.assert_allclose(
+        streamed.coef_, resident.coef_, rtol=rtol, atol=5e-3
+    )
+    np.testing.assert_allclose(
+        streamed.intercept_, resident.intercept_, rtol=rtol, atol=5e-3
+    )
+    # predictions agree on the training data
+    assert np.mean(streamed.predict(X) == resident.predict(X)) > 0.99
+
+
+def test_linear_regression_memmap(tmp_path):
+    from dask_ml_tpu.linear_model import LinearRegression
+
+    rng = np.random.RandomState(1)
+    n, d = 3000, 8
+    X = rng.randn(n, d).astype(np.float32)
+    w = rng.randn(d)
+    y = (X @ w + 0.5 + 0.01 * rng.randn(n)).astype(np.float32)
+    Xmm = _memmap(tmp_path, X, "Xlin.f32")
+
+    resident = LinearRegression(solver="lbfgs", max_iter=200, tol=1e-9).fit(X, y)
+    with config.set(stream_block_rows=800):
+        streamed = LinearRegression(solver="lbfgs", max_iter=200, tol=1e-9).fit(Xmm, y)
+    assert streamed.solver_info_["streamed"] is True
+    np.testing.assert_allclose(streamed.coef_, resident.coef_,
+                               rtol=1e-2, atol=1e-3)
+
+
+def test_config_stream_block_rows_triggers_streaming(clf_data):
+    """A plain (non-memmap) ndarray streams when config.stream_block_rows
+    is set below n — the knob is consumed, not dead (VERDICT r2 weak #8)."""
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    X, y = clf_data
+    with config.set(stream_block_rows=1000):
+        clf = LogisticRegression(solver="lbfgs", max_iter=50).fit(X, y)
+    assert clf.solver_info_["streamed"] is True
+    assert clf.solver_info_["n_blocks"] == 4
+    # unset: resident path
+    clf2 = LogisticRegression(solver="lbfgs", max_iter=50).fit(X, y)
+    assert "streamed" not in clf2.solver_info_
+
+
+def test_stream_prefetch_knob_consumed():
+    from dask_ml_tpu.parallel.streaming import BlockStream
+
+    X = np.zeros((64, 2), np.float32)
+    with config.set(stream_prefetch=3):
+        s = BlockStream((X,), block_rows=8)
+    assert s.prefetch == 3
+    assert list(b.n_rows for b in s) == [8] * 8
+
+
+def test_stream_plan_rules():
+    from dask_ml_tpu.parallel.streaming import stream_plan
+
+    X = np.zeros((100, 2), np.float32)
+    assert stream_plan(X) is None  # small ndarray, no knob: resident
+    with config.set(stream_block_rows=10):
+        assert stream_plan(X) == 10
+    import jax.numpy as jnp
+
+    assert stream_plan(jnp.zeros((100, 2))) is None  # device input
+
+
+def test_kmeans_memmap_matches_resident(tmp_path):
+    from dask_ml_tpu.cluster import KMeans
+
+    rng = np.random.RandomState(2)
+    centers_true = rng.randn(4, 6).astype(np.float32) * 4
+    X = np.concatenate([
+        centers_true[i] + 0.3 * rng.randn(500, 6).astype(np.float32)
+        for i in range(4)
+    ])
+    rng.shuffle(X)
+    Xmm = _memmap(tmp_path, X, "Xkm.f32")
+    init = centers_true + 0.5  # same explicit init both paths
+
+    resident = KMeans(n_clusters=4, init=init, max_iter=50, tol=1e-6).fit(X)
+    with config.set(stream_block_rows=512):
+        streamed = KMeans(n_clusters=4, init=init, max_iter=50, tol=1e-6).fit(Xmm)
+
+    np.testing.assert_allclose(
+        streamed.cluster_centers_, resident.cluster_centers_,
+        rtol=1e-4, atol=1e-4,
+    )
+    np.testing.assert_allclose(streamed.inertia_, resident.inertia_,
+                               rtol=1e-4)
+    res_labels = resident.labels_.to_numpy()
+    assert np.array_equal(streamed.labels_, res_labels)
+    assert streamed.n_iter_ >= 1
+
+
+@pytest.mark.parametrize("init", ["k-means||", "k-means++", "random"])
+def test_kmeans_streamed_inits_are_sane(tmp_path, init):
+    from dask_ml_tpu.cluster import KMeans
+
+    rng = np.random.RandomState(3)
+    centers_true = rng.randn(3, 5).astype(np.float32) * 5
+    X = np.concatenate([
+        centers_true[i] + 0.2 * rng.randn(400, 5).astype(np.float32)
+        for i in range(3)
+    ])
+    rng.shuffle(X)
+    Xmm = _memmap(tmp_path, X, f"Xkm_{init}.f32")
+    with config.set(stream_block_rows=400):
+        streamed = KMeans(n_clusters=3, init=init, random_state=0,
+                          max_iter=100).fit(Xmm)
+    resident = KMeans(n_clusters=3, init=init, random_state=0,
+                      max_iter=100).fit(X)
+    # well-separated blobs: both must land on the (same) global optimum
+    np.testing.assert_allclose(streamed.inertia_, resident.inertia_,
+                               rtol=0.05)
+
+
+def test_pca_memmap_matches_resident(tmp_path):
+    from dask_ml_tpu.decomposition import PCA
+
+    rng = np.random.RandomState(4)
+    n, d = 3000, 10
+    scale = np.linspace(5, 0.1, d)
+    X = (rng.randn(n, d) * scale + rng.randn(d)).astype(np.float32)
+    Xmm = _memmap(tmp_path, X, "Xpca.f32")
+
+    resident = PCA(n_components=4, svd_solver="full").fit(X)
+    with config.set(stream_block_rows=700):
+        streamed = PCA(n_components=4, svd_solver="full").fit(Xmm)
+
+    np.testing.assert_allclose(streamed.mean_, resident.mean_,
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(
+        streamed.explained_variance_, resident.explained_variance_,
+        rtol=1e-2,
+    )
+    np.testing.assert_allclose(
+        streamed.singular_values_, resident.singular_values_, rtol=1e-2
+    )
+    # same V-based sign convention on both paths → direct comparison
+    np.testing.assert_allclose(
+        streamed.components_, resident.components_, rtol=5e-2, atol=5e-3
+    )
+    # streamed transform matches resident transform
+    t_res = resident.transform(X).to_numpy()
+    with config.set(stream_block_rows=700):
+        t_str = streamed.transform(Xmm)
+    np.testing.assert_allclose(t_str, t_res, rtol=5e-2, atol=5e-3)
+
+
+def test_streamed_inference_paths(tmp_path, clf_data):
+    """predict/transform/score also stream for out-of-core inputs — the
+    whole pipeline runs without materializing X on device."""
+    from dask_ml_tpu.cluster import KMeans
+    from dask_ml_tpu.decomposition import PCA
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    X, y = clf_data
+    Xmm = _memmap(tmp_path, X, "Xinfer.f32")
+    with config.set(stream_block_rows=1000):
+        clf = LogisticRegression(solver="lbfgs", max_iter=50).fit(Xmm, y)
+        pred_mm = clf.predict(Xmm)
+        proba_mm = clf.predict_proba(Xmm)
+    pred_res = clf.predict(X)
+    assert isinstance(pred_mm, np.ndarray)
+    np.testing.assert_array_equal(pred_mm, pred_res)
+    np.testing.assert_allclose(proba_mm[:, 1],
+                               clf.predict_proba(X)[:, 1], atol=1e-5)
+
+    with config.set(stream_block_rows=1000):
+        km = KMeans(n_clusters=3, init="random", random_state=0,
+                    max_iter=20).fit(Xmm)
+        labels_mm = km.predict(Xmm)
+        dists_mm = km.transform(Xmm)
+        score_mm = km.score(Xmm)
+    labels_res = km.predict(X).to_numpy()
+    np.testing.assert_array_equal(labels_mm, labels_res)
+    assert dists_mm.shape == (len(X), 3)
+    np.testing.assert_allclose(score_mm, km.score(X), rtol=1e-4)
+
+    with config.set(stream_block_rows=1000):
+        scores_mm = PCA(n_components=3).fit_transform(Xmm)
+    assert isinstance(scores_mm, np.ndarray)
+    assert scores_mm.shape == (len(X), 3)
+
+
+def test_streamed_metrics_logging(tmp_path, clf_data):
+    """config.metrics_path wires per-step JSONL out of the streamed solver
+    (VERDICT r2 #3)."""
+    import json
+
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    X, y = clf_data
+    path = str(tmp_path / "metrics.jsonl")
+    with config.set(metrics_path=path, stream_block_rows=1000):
+        LogisticRegression(solver="lbfgs", max_iter=20).fit(X, y)
+    records = [json.loads(line) for line in open(path)]
+    assert len(records) >= 2
+    for r in records:
+        assert r["component"] == "LogisticRegression"
+        assert "loss" in r and "grad_norm" in r and "step" in r
+        assert r["streamed"] is True
