@@ -33,5 +33,16 @@ __all__ = [
 
 
 def to_host(x):
-    """Move a fitted attribute to host numpy (fitted attrs are small)."""
+    """Move a fitted attribute to host numpy (fitted attrs are small).
+
+    Under a multi-process runtime an array on the global mesh spans
+    devices this process cannot address; it is gathered to every host
+    with a collective (all processes reach this call in SPMD lockstep —
+    the same contract as any other collective op on the global mesh)."""
+    import jax
+
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
     return np.asarray(x)

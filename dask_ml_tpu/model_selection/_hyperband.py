@@ -19,6 +19,22 @@ from ._incremental import BaseIncrementalSearchCV
 from ._successive_halving import SuccessiveHalvingSearchCV
 
 
+def _host_estimator(est):
+    """Replace any device-array attributes with host numpy so the model
+    pickles across the process-gather channel (and stays usable — every
+    consumer re-coerces with jnp.asarray)."""
+    import jax
+
+    from ..base import to_host
+
+    if est is None:
+        return est
+    for k, v in list(vars(est).items()):
+        if isinstance(v, jax.Array):
+            setattr(est, k, to_host(v))
+    return est
+
+
 def _brackets(max_iter, eta):
     """Hyperband bracket table: [(bracket, n_models, n_initial_iter)]."""
     s_max = int(math.floor(math.log(max_iter, eta)))
@@ -83,13 +99,35 @@ class HyperbandSearchCV(BaseIncrementalSearchCV):
     def fit(self, X, y=None, **fit_params):
         rng_seed = self.random_state
         brackets = _brackets(self.max_iter, self.aggressiveness)
-        self.history_ = []
-        self.model_history_ = {}
-        all_results = []
-        best = (-np.inf, None, None, None)  # score, params, est, bracket
-        meta_brackets = []
-        offset = 0
-        for s, n, r in brackets:
+
+        # Multi-process: brackets are independent SHA sweeps, so each
+        # process runs a strided share on its local-device mesh and the
+        # per-bracket payloads (history, results, best model) merge via
+        # one object-allgather — BASELINE configs[4] 'trials parallel
+        # across TPU hosts' (SURVEY.md §3.5). Single-process: all local.
+        import jax as _jax
+
+        n_proc = _jax.process_count()
+        placement_mesh = None
+        if n_proc > 1:
+            from ..parallel.sharded import ShardedArray
+
+            if isinstance(X, ShardedArray) or isinstance(y, ShardedArray):
+                raise ValueError(
+                    "multi-process Hyperband requires host-resident X/y "
+                    "(each process loads its copy and runs a disjoint "
+                    "bracket subset)"
+                )
+            from ..parallel.distributed import local_mesh
+
+            placement_mesh = local_mesh()
+            self._dist_stats = (_jax.process_index(), n_proc)
+
+        payloads = {}
+        local_exc = None
+        for bi, (s, n, r) in enumerate(brackets):
+            if n_proc > 1 and bi % n_proc != _jax.process_index():
+                continue
             sha = SuccessiveHalvingSearchCV(
                 clone(self.estimator), self.parameters,
                 n_initial_parameters=n, n_initial_iter=r,
@@ -100,17 +138,67 @@ class HyperbandSearchCV(BaseIncrementalSearchCV):
                 scoring=self.scoring, verbose=self.verbose,
                 prefix=f"{self.prefix}bracket={s}",
             )
-            sha.fit(X, y, **fit_params)
-            for rec in sha.history_:
+            try:
+                if placement_mesh is not None:
+                    from ..parallel.mesh import use_mesh
+
+                    with use_mesh(placement_mesh):
+                        sha.fit(X, y, **fit_params)
+                else:
+                    sha.fit(X, y, **fit_params)
+            except Exception as e:
+                if n_proc == 1:
+                    raise
+                # hold the failure: peers must learn about it through the
+                # gather below instead of blocking in it forever
+                local_exc = e
+                break
+            payloads[bi] = {
+                "s": s,
+                "history": sha.history_,
+                "model_history": sha.model_history_,
+                "results": dict(sha.cv_results_),
+                "best_score": sha.best_score_,
+                "best_params": sha.best_params_,
+                "best_estimator": _host_estimator(sha.best_estimator_),
+            }
+
+        if n_proc > 1:
+            from ..parallel.distributed import allgather_object
+
+            parts = allgather_object({
+                "payloads": {} if local_exc is not None else payloads,
+                "error": None if local_exc is None else repr(local_exc),
+            })
+            if local_exc is not None:
+                raise local_exc
+            bad = [p["error"] for p in parts if p["error"] is not None]
+            if bad:
+                raise RuntimeError(
+                    f"peer process failed during distributed Hyperband: {bad}"
+                )
+            payloads = {}
+            for part in parts:
+                payloads.update(part["payloads"])
+
+        self.history_ = []
+        self.model_history_ = {}
+        all_results = []
+        best = (-np.inf, None, None, None)  # score, params, est, bracket
+        meta_brackets = []
+        offset = 0
+        for bi in range(len(brackets)):
+            p = payloads[bi]
+            s = p["s"]
+            for rec in p["history"]:
                 rec = dict(rec)
                 rec["bracket"] = s
                 rec["model_id"] = rec["model_id"] + offset
                 self.history_.append(rec)
-            for mid, recs in sha.model_history_.items():
+            for mid, recs in p["model_history"].items():
                 self.model_history_[mid + offset] = recs
-            res = sha.cv_results_
+            res = p["results"]
             n_models = len(res["params"])
-            res = dict(res)
             res["bracket"] = np.full(n_models, s)
             res["model_id"] = res["model_id"] + offset
             all_results.append(res)
@@ -118,9 +206,9 @@ class HyperbandSearchCV(BaseIncrementalSearchCV):
                 "bracket": s, "n_models": n_models,
                 "partial_fit_calls": int(res["partial_fit_calls"].sum()),
             })
-            if sha.best_score_ > best[0]:
-                best = (sha.best_score_, sha.best_params_,
-                        sha.best_estimator_, s)
+            if p["best_score"] > best[0]:
+                best = (p["best_score"], p["best_params"],
+                        p["best_estimator"], s)
             offset += n_models
 
         # merge bracket cv_results_

@@ -259,61 +259,159 @@ class _BaseSearchCV(BaseEstimator):
 
         tasks = [(ci, fi) for ci in range(len(candidates))
                  for fi in range(n_folds)]
-        # Pipelines run sequentially: the prefix memo shares fitted
-        # transformers AND their transformed (device-resident) outputs
-        # across candidates, which must stay on one mesh.
-        workers = 1 if _is_pipeline(self.estimator) \
-            else self._resolve_execution(len(tasks))
-        device_native = _is_device_native(self.estimator)
-        mesh = X.mesh if isinstance(X, ShardedArray) else resolve_mesh(None)
-        if workers > 1 and device_native:
-            if mesh.devices.size < 2:
-                workers = 1  # no disjoint subsets to place trials on
-            elif isinstance(X, ShardedArray) and self.n_jobs in (None, -1):
-                # X was sharded across the whole mesh, possibly because it
-                # only fits that way — re-placing full folds onto smaller
-                # submeshes could OOM a chip, so trial placement is
-                # opt-in (explicit n_jobs) for sharded inputs
-                workers = 1
 
-        if workers == 1:
-            for ci, fi in tasks:
-                run_task(ci, fi, cache.fold(fi))
-        elif not device_native:
-            # host estimators (e.g. raw sklearn): plain thread pool
-            with ThreadPoolExecutor(max_workers=workers) as pool:
-                futures = [
-                    pool.submit(run_task, ci, fi, cache.fold(fi))
-                    for ci, fi in tasks
-                ]
-                for f in futures:
-                    f.result()  # surface the first error_score='raise'
-        else:
-            # mesh-subset trial placement (SURVEY.md §3.4/§3.5): partition
-            # the mesh into disjoint submeshes, one per worker; each trial
-            # checks a submesh out, re-places its (host) fold onto it, and
-            # fits entirely within it — concurrent XLA programs never
-            # share devices, so their collectives cannot interleave.
-            subs = _submeshes(mesh, workers)
-            workers = len(subs)
-            folds_h = cache.host_folds()
-            free = queue.SimpleQueue()
-            for s in subs:
-                free.put(s)
+        # Multi-process distribution (SURVEY.md §3.5 'trials pinned to
+        # hosts', §5 comm row): under a live jax.distributed runtime each
+        # process takes a strided share of the (candidate, fold) tasks and
+        # fits it on ITS OWN local-device mesh — per-trial programs never
+        # emit cross-host collectives, so processes run different trials
+        # concurrently. Scores merge through one allgather at the end; the
+        # reference's scheduler→worker task placement + result gathering
+        # over TCP becomes placement-by-index + a device-fabric collective.
+        import jax as _jax
 
-            def run_on_submesh(ci, fi):
-                sub = free.get()
-                try:
-                    with use_mesh(sub):
-                        run_task(ci, fi, folds_h[fi])
-                finally:
-                    free.put(sub)
+        n_proc = _jax.process_count()
+        my_tasks = tasks
+        dist_mesh = None
+        if n_proc > 1:
+            if isinstance(X, ShardedArray) or isinstance(y, ShardedArray):
+                raise ValueError(
+                    "multi-process search requires host-resident X/y (each "
+                    "process loads its copy and fits a disjoint trial "
+                    "subset); a ShardedArray on the global mesh cannot be "
+                    "split into per-process trials"
+                )
+            my_tasks = tasks[_jax.process_index()::n_proc]
+            from ..parallel.distributed import local_mesh
 
-            with ThreadPoolExecutor(max_workers=workers) as pool:
-                futures = [pool.submit(run_on_submesh, ci, fi)
-                           for ci, fi in tasks]
-                for f in futures:
-                    f.result()
+            dist_mesh = local_mesh()
+            self._dist_stats = (
+                len(my_tasks), len(tasks), _jax.process_index(), n_proc
+            )
+
+        def _placement():
+            import contextlib
+
+            return use_mesh(dist_mesh) if dist_mesh is not None \
+                else contextlib.nullcontext()
+
+        def _sync_failures(exc):
+            """Exchange failure state so an exception on ONE process fails
+            ALL of them fast — peers must not block forever in the merge
+            collective waiting for a process that already raised."""
+            if n_proc <= 1:
+                if exc is not None:
+                    raise exc
+                return
+            from ..parallel.distributed import allgather_object
+
+            errs = allgather_object(None if exc is None else repr(exc))
+            if exc is not None:
+                raise exc
+            bad = [e for e in errs if e is not None]
+            if bad:
+                raise RuntimeError(
+                    f"peer process failed during distributed search: {bad}"
+                )
+
+        class _Capture:
+            """Placement context that, under multi-process, holds an
+            exception instead of raising so the failure is exchanged with
+            peers (via _sync_failures) before anyone reaches the merge
+            collective."""
+
+            exc = None
+
+            def __enter__(self):
+                self._cm = _placement()
+                self._cm.__enter__()
+                return self
+
+            def __exit__(self, et, ev, tb):
+                self._cm.__exit__(et, ev, tb)
+                if ev is not None and n_proc > 1:
+                    self.exc = ev
+                    return True
+                return False
+
+        _cap = _Capture()
+        with _cap:
+            # Pipelines run sequentially: the prefix memo shares fitted
+            # transformers AND their transformed (device-resident) outputs
+            # across candidates, which must stay on one mesh.
+            workers = 1 if _is_pipeline(self.estimator) \
+                else self._resolve_execution(len(my_tasks))
+            device_native = _is_device_native(self.estimator)
+            mesh = X.mesh if isinstance(X, ShardedArray) else resolve_mesh(None)
+            if workers > 1 and device_native:
+                if mesh.devices.size < 2:
+                    workers = 1  # no disjoint subsets to place trials on
+                elif isinstance(X, ShardedArray) and self.n_jobs in (None, -1):
+                    # X was sharded across the whole mesh, possibly because
+                    # it only fits that way — re-placing full folds onto
+                    # smaller submeshes could OOM a chip, so trial placement
+                    # is opt-in (explicit n_jobs) for sharded inputs
+                    workers = 1
+
+            if workers == 1:
+                for ci, fi in my_tasks:
+                    run_task(ci, fi, cache.fold(fi))
+            elif not device_native:
+                # host estimators (e.g. raw sklearn): plain thread pool
+                with ThreadPoolExecutor(max_workers=workers) as pool:
+                    futures = [
+                        pool.submit(run_task, ci, fi, cache.fold(fi))
+                        for ci, fi in my_tasks
+                    ]
+                    for f in futures:
+                        f.result()  # surface the first error_score='raise'
+            else:
+                # mesh-subset trial placement (SURVEY.md §3.4/§3.5):
+                # partition the mesh into disjoint submeshes, one per
+                # worker; each trial checks a submesh out, re-places its
+                # (host) fold onto it, and fits entirely within it —
+                # concurrent XLA programs never share devices, so their
+                # collectives cannot interleave.
+                subs = _submeshes(mesh, workers)
+                workers = len(subs)
+                folds_h = cache.host_folds()
+                free = queue.SimpleQueue()
+                for s in subs:
+                    free.put(s)
+
+                def run_on_submesh(ci, fi):
+                    sub = free.get()
+                    try:
+                        with use_mesh(sub):
+                            run_task(ci, fi, folds_h[fi])
+                    finally:
+                        free.put(sub)
+
+                with ThreadPoolExecutor(max_workers=workers) as pool:
+                    futures = [pool.submit(run_on_submesh, ci, fi)
+                               for ci, fi in my_tasks]
+                    for f in futures:
+                        f.result()
+
+        _sync_failures(_cap.exc)
+        if n_proc > 1:
+            # score-gather channel: every process receives every score and
+            # assembles identical cv_results_ (each cell was computed by
+            # exactly one process; unfilled cells stay NaN on all)
+            from ..parallel.distributed import allgather_host
+
+            def merge(local):
+                stacked = allgather_host(local)  # (P, C, F)
+                filled = ~np.isnan(stacked)
+                return np.where(
+                    filled.any(axis=0),
+                    np.nansum(np.where(filled, stacked, 0.0), axis=0),
+                    np.nan,
+                )
+
+            scores = merge(scores)
+            if self.return_train_score:
+                train_scores = merge(train_scores)
 
         mean = scores.mean(axis=1)
         std = scores.std(axis=1)
@@ -351,8 +449,12 @@ class _BaseSearchCV(BaseEstimator):
         self._memo_stats = (memo.hits, memo.misses)
 
         if self.refit:
-            est = clone(self.estimator).set_params(**self.best_params_)
-            est.fit(X, y, **fit_params)
+            # multi-process: every process refits identically on its local
+            # mesh (cv_results_ are identical everywhere, so best_params_
+            # agree) — no cross-host program, consistent final state
+            with _placement():
+                est = clone(self.estimator).set_params(**self.best_params_)
+                est.fit(X, y, **fit_params)
             self.best_estimator_ = est
         return self
 

@@ -71,6 +71,63 @@ def global_mesh(axis_names=("data",), shape=None):
                        devices=jax.devices())
 
 
+def local_mesh(axis_names=("data",), shape=None):
+    """Mesh over THIS process's devices only. Trials placed here never
+    emit cross-host collectives, so different processes can run different
+    programs concurrently — the placement unit for distributed
+    hyperparameter search (SURVEY.md §3.5: 'trials pinned to
+    hosts/mesh-subsets')."""
+    return device_mesh(shape=shape, axis_names=axis_names,
+                       devices=jax.local_devices())
+
+
+def allgather_object(obj):
+    """Gather one small picklable host object per process; every process
+    receives the list ``[obj_from_proc_0, ..., obj_from_proc_{P-1}]``.
+    Variable-size pickles ride the fixed-size device collective by
+    padding to the max length (sizes exchanged first) — the control-plane
+    result channel for distributed searches, replacing the reference's
+    msgpack/pickle frames over TCP (SURVEY.md §5 comm row)."""
+    import pickle
+
+    if process_count() == 1:
+        return [obj]
+    buf = np.frombuffer(pickle.dumps(obj), np.uint8)
+    sizes = allgather_host(np.array([buf.size], np.int32))[:, 0]
+    padded = np.zeros(int(sizes.max()), np.uint8)
+    padded[: buf.size] = buf
+    stacked = allgather_host(padded)
+    return [
+        pickle.loads(stacked[i, : sizes[i]].tobytes())
+        for i in range(len(sizes))
+    ]
+
+
+def allgather_host(value: np.ndarray) -> np.ndarray:
+    """Gather a small host array from every process; returns the
+    (n_processes, *shape) stack on all of them (shape/dtype must match
+    across processes). The score-gather channel of distributed searches —
+    replaces the reference's worker→scheduler result messages with one
+    device-fabric collective.
+
+    The payload rides the collective as raw bytes: ``jnp.asarray`` would
+    silently downcast float64 (x64 disabled by default), and score merges
+    must be bit-exact with the single-process run."""
+    value = np.ascontiguousarray(value)
+    if process_count() == 1:
+        return value[None]
+    from jax.experimental import multihost_utils
+
+    buf = np.frombuffer(value.tobytes(), np.uint8)
+    stacked = np.asarray(
+        multihost_utils.process_allgather(jnp.asarray(buf), tiled=False)
+    )
+    return np.stack([
+        np.frombuffer(stacked[i].tobytes(), value.dtype).reshape(value.shape)
+        for i in range(stacked.shape[0])
+    ])
+
+
 def barrier(name="barrier"):
     """Cross-host sync point: a tiny psum over every device."""
     x = jnp.ones((jax.device_count(),))

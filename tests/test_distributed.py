@@ -142,3 +142,189 @@ def test_worker_death_detected(tmp_path):
         for p in procs:  # no orphans on timeout/assert failure
             if p.poll() is None:
                 p.kill()
+
+
+_SEARCH_WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    pid = int(sys.argv[1]); port = sys.argv[2]; expected_path = sys.argv[3]
+    jax.distributed.initialize(
+        coordinator_address="127.0.0.1:" + port,
+        num_processes=2, process_id=pid)
+    from sklearn.datasets import make_classification
+    from dask_ml_tpu.linear_model import LogisticRegression
+    from dask_ml_tpu.model_selection import GridSearchCV
+    X, y = make_classification(n_samples=400, n_features=8,
+                               n_informative=4, random_state=0)
+    X = X.astype(np.float32); y = y.astype(np.float32)
+    search = GridSearchCV(
+        LogisticRegression(solver="lbfgs", max_iter=50),
+        {{"C": [0.01, 0.1, 1.0, 10.0]}}, cv=3,
+        scheduler="synchronous", refit=True,
+    )
+    search.fit(X, y)
+    n_local, n_total, proc, n_proc = search._dist_stats
+    assert n_proc == 2 and proc == pid
+    assert n_local < n_total, (n_local, n_total)   # fitted a strict subset
+    assert n_local == len(range(pid, n_total, 2))
+    scores = search.cv_results_["mean_test_score"]
+    assert not np.isnan(scores).any(), scores      # merge filled every cell
+    expected = np.load(expected_path)
+    assert np.allclose(scores, expected, atol=1e-4), (scores, expected)
+    # refit happened locally and the final state is usable
+    assert search.best_estimator_.score(X, y) > 0.7
+    print("proc", pid, "search OK", flush=True)
+""")
+
+
+@pytest.mark.slow
+def test_two_process_distributed_search(tmp_path):
+    """Real 2-process Grid search: each process fits a disjoint trial
+    subset on its local-device mesh; the allgather merge reassembles
+    cv_results_ identical to the sequential single-process run
+    (SURVEY.md §3.5 'trials pinned to hosts', VERDICT r2 #2)."""
+    import numpy as np
+    from sklearn.datasets import make_classification
+
+    from dask_ml_tpu.linear_model import LogisticRegression
+    from dask_ml_tpu.model_selection import GridSearchCV
+
+    # sequential reference in THIS (single-)process
+    X, y = make_classification(n_samples=400, n_features=8,
+                               n_informative=4, random_state=0)
+    X = X.astype(np.float32)
+    y = y.astype(np.float32)
+    seq = GridSearchCV(
+        LogisticRegression(solver="lbfgs", max_iter=50),
+        {"C": [0.01, 0.1, 1.0, 10.0]}, cv=3,
+        scheduler="synchronous", refit=False,
+    ).fit(X, y)
+    expected_path = str(tmp_path / "expected.npy")
+    np.save(expected_path, np.asarray(seq.cv_results_["mean_test_score"]))
+
+    port = str(_free_port())
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _SEARCH_WORKER.format(repo=REPO),
+             str(i), port, expected_path],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        for i in range(2)
+    ]
+    try:
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+        for i, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"proc {i} failed:\n{out}"
+            assert f"proc {i} search OK" in out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+_HB_BODY = textwrap.dedent("""
+    import numpy as np
+    from scipy.stats import loguniform
+    from dask_ml_tpu.models.sgd import SGDClassifier
+    from dask_ml_tpu.model_selection import HyperbandSearchCV
+    rng = np.random.RandomState(0)
+    X = rng.randn(600, 6).astype(np.float32)
+    w = rng.randn(6)
+    y = (X @ w > 0).astype(np.float32)
+    params = {{"alpha": [1e-5, 1e-4, 1e-3, 1e-2],
+              "eta0": [0.01, 0.05, 0.1, 0.5]}}
+    search = HyperbandSearchCV(
+        SGDClassifier(tol=1e-3, random_state=0), params,
+        max_iter=9, aggressiveness=3, random_state=0,
+    )
+    search.fit(X, y, classes=[0.0, 1.0])
+""")
+
+_HB_SOLO = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+""") + _HB_BODY + textwrap.dedent("""
+    import numpy as np
+    np.savez(sys.argv[1],
+             test_score=np.asarray(search.cv_results_["test_score"],
+                                   np.float64),
+             best_score=search.best_score_,
+             n_history=len(search.history_))
+    print("solo OK", flush=True)
+""")
+
+_HB_WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    pid = int(sys.argv[1]); port = sys.argv[2]
+    jax.distributed.initialize(
+        coordinator_address="127.0.0.1:" + port,
+        num_processes=2, process_id=pid)
+""") + _HB_BODY + textwrap.dedent("""
+    import numpy as np
+    assert search._dist_stats == (pid, 2)
+    exp = np.load(sys.argv[3])
+    got = np.asarray(search.cv_results_["test_score"], np.float64)
+    assert got.shape == exp["test_score"].shape, (got.shape,
+                                                 exp["test_score"].shape)
+    assert np.allclose(got, exp["test_score"], atol=1e-5), (
+        got, exp["test_score"])
+    assert abs(search.best_score_ - float(exp["best_score"])) < 1e-5
+    assert len(search.history_) == int(exp["n_history"])
+    assert {{r["bracket"] for r in search.history_}} == {{0, 1, 2}}
+    # the gathered best model is usable on every process
+    assert 0.0 <= search.best_estimator_.score(X, y) <= 1.0
+    print("proc", pid, "hyperband OK", flush=True)
+""")
+
+
+@pytest.mark.slow
+def test_two_process_hyperband_brackets(tmp_path):
+    """Hyperband brackets distributed over 2 real processes reassemble
+    history_/cv_results_/best identical to the single-process run
+    (BASELINE configs[4]; VERDICT r2 #2)."""
+    exp = str(tmp_path / "expected.npz")
+    solo = subprocess.run(
+        [sys.executable, "-c", _HB_SOLO.format(repo=REPO), exp],
+        capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert solo.returncode == 0, solo.stdout + solo.stderr
+
+    port = str(_free_port())
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _HB_WORKER.format(repo=REPO),
+             str(i), port, exp],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        for i in range(2)
+    ]
+    try:
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+        for i, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"proc {i} failed:\n{out}"
+            assert f"proc {i} hyperband OK" in out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
