@@ -132,6 +132,17 @@ def run():
         clf.fit(Xs, ys)
         elapsed = time.perf_counter() - t0
     iters = clf.n_iter_ or max_iter
+
+    # traceability run (BASELINE.md measurement protocol): a SEPARATE
+    # short fit writes per-iteration JSONL. The timed fit above runs
+    # WITHOUT logging — the log=True trace carries a per-iteration host
+    # callback that would pollute the headline number.
+    metrics_file = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_metrics.jsonl"
+    )
+    open(metrics_file, "w").close()  # fresh file per bench run
+    with config.set(dtype=dtype, metrics_path=metrics_file):
+        LogisticRegression(solver="lbfgs", max_iter=10, tol=0.0).fit(Xs, ys)
     value = n_rows * iters / elapsed / n_chips
 
     # sklearn reference on a host subsample of the same data
@@ -158,6 +169,7 @@ def run():
         "n_rows": n_rows,
         "n_features": n_feat,
         "iters": int(iters),
+        "metrics_file": metrics_file,
     }
 
 

@@ -72,6 +72,24 @@ def fit(model_factory, params_list, train_blocks, X_test, y_test, scorer,
         additional_calls, fit_params=None, patience=False, tol=1e-3,
         max_iter=None, prefix="", verbose=False, checkpoint=None,
         ckpt_token=None, hook_state=None, scoring_is_default=False):
+    """Core controller entry: opens the per-fit JSONL sink (closed even on
+    error) around the actual controller loop in :func:`_fit`."""
+    from ..utils.observability import fit_logger
+
+    with fit_logger("adaptive_search", prefix=prefix) as logger:
+        return _fit(model_factory, params_list, train_blocks, X_test,
+                    y_test, scorer, additional_calls, fit_params=fit_params,
+                    patience=patience, tol=tol, max_iter=max_iter,
+                    prefix=prefix, verbose=verbose, checkpoint=checkpoint,
+                    ckpt_token=ckpt_token, hook_state=hook_state,
+                    scoring_is_default=scoring_is_default, logger=logger)
+
+
+def _fit(model_factory, params_list, train_blocks, X_test, y_test, scorer,
+         additional_calls, fit_params=None, patience=False, tol=1e-3,
+         max_iter=None, prefix="", verbose=False, checkpoint=None,
+         ckpt_token=None, hook_state=None, scoring_is_default=False,
+         logger=None):
     """Core controller (ref: _incremental.py::_fit). Returns
     (info, models, history).
 
@@ -147,6 +165,10 @@ def fit(model_factory, params_list, train_blocks, X_test, y_test, scorer,
             }
             history.append(record)
             info[mid].append(record)
+            if logger is not None:
+                logger.log(step=m["partial_fit_calls"], model_id=mid,
+                           score=float(score), batch_size=len(mids),
+                           partial_fit_time=fit_time, score_time=score_time)
 
     def train_one(mid, n_calls):
         m = meta[mid]

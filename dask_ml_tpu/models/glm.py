@@ -169,14 +169,19 @@ class _GLMBase(BaseEstimator):
         )
         kwargs = dict(self.solver_kwargs or {})
         l1_ratio = kwargs.pop("l1_ratio", 0.5)
-        beta, info = solve(
-            self.solver,
-            X=data, y=y_data, mask=X.row_mask(dtype=jnp.float32),
-            n_rows=X.n_rows, beta0=beta0, family=self.family,
-            reg=self.penalty, lam=jnp.asarray(lam, jnp.float32),
-            pmask=jnp.asarray(pmask), l1_ratio=l1_ratio,
-            max_iter=self.max_iter, tol=self.tol, mesh=mesh, **kwargs,
-        )
+        from ..utils.observability import active_logger, fit_logger
+
+        with fit_logger(type(self).__name__, solver=self.solver,
+                        n_rows=X.n_rows) as logger, active_logger(logger):
+            beta, info = solve(
+                self.solver,
+                X=data, y=y_data, mask=X.row_mask(dtype=jnp.float32),
+                n_rows=X.n_rows, beta0=beta0, family=self.family,
+                reg=self.penalty, lam=jnp.asarray(lam, jnp.float32),
+                pmask=jnp.asarray(pmask), l1_ratio=l1_ratio,
+                max_iter=self.max_iter, tol=self.tol, mesh=mesh,
+                log=logger is not None, **kwargs,
+            )
         return self._finish_fit(to_host(beta), classes, info, X.shape[1])
 
     def _coef_flat(self):

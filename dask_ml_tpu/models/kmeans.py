@@ -40,8 +40,11 @@ from ..utils.validation import check_array, check_is_fitted
 
 # -- jitted kernels ---------------------------------------------------------
 
-@jax.jit
-def _lloyd_run(X, mask, centers0, max_iter, tol2):
+from ..utils.observability import emit_jit_step
+
+
+@partial(jax.jit, static_argnames=("log",))
+def _lloyd_run(X, mask, centers0, max_iter, tol2, log=False):
     """Full Lloyd loop on device. Returns (centers, n_iter, final_shift2)."""
     k = centers0.shape[0]
 
@@ -60,6 +63,8 @@ def _lloyd_run(X, mask, centers0, max_iter, tol2):
         counts = jax.ops.segment_sum(mask, labels, num_segments=k)
         new = jnp.where(counts[:, None] > 0, sums / counts[:, None], centers)
         shift2 = jnp.sum((new - centers) ** 2)
+        if log:
+            emit_jit_step(it, center_shift2=shift2)
         return new, it + 1, shift2
 
     inf = jnp.asarray(jnp.inf, X.dtype)
@@ -67,9 +72,9 @@ def _lloyd_run(X, mask, centers0, max_iter, tol2):
     return centers, it, shift2
 
 
-@partial(jax.jit, static_argnames=("mesh", "interpret"))
+@partial(jax.jit, static_argnames=("mesh", "interpret", "log"))
 def _lloyd_run_pallas(X, mask, centers0, max_iter, tol2, mesh,
-                      interpret=False):
+                      interpret=False, log=False):
     """Lloyd loop where each iteration's data pass is the fused Pallas
     kernel (ops/pallas_fused.py): X streams through VMEM once per
     iteration; sums/counts psum over ICI."""
@@ -103,6 +108,8 @@ def _lloyd_run_pallas(X, mask, centers0, max_iter, tol2, mesh,
         sums, counts = step(X, mask, centers)
         new = jnp.where(counts[:, None] > 0, sums / counts[:, None], centers)
         shift2 = jnp.sum((new - centers) ** 2)
+        if log:
+            emit_jit_step(it, center_shift2=shift2)
         return new, it + 1, shift2
 
     inf = jnp.asarray(jnp.inf, X.dtype)
@@ -509,15 +516,24 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         use_pallas = self.use_pallas
         if use_pallas is None:  # auto: fused kernel on real TPU only
             use_pallas = jax.default_backend() == "tpu"
-        if use_pallas:
-            centers, n_iter, _ = _lloyd_run_pallas(
-                X.data, mask, centers0, jnp.asarray(self.max_iter), tol2,
-                X.mesh, interpret=jax.default_backend() != "tpu",
-            )
-        else:
-            centers, n_iter, _ = _lloyd_run(
-                X.data, mask, centers0, jnp.asarray(self.max_iter), tol2
-            )
+        from ..utils.observability import active_logger, fit_logger
+
+        with fit_logger("KMeans", n_rows=X.n_rows,
+                        n_clusters=self.n_clusters) as logger, \
+                active_logger(logger):
+            if use_pallas:
+                centers, n_iter, _ = _lloyd_run_pallas(
+                    X.data, mask, centers0, jnp.asarray(self.max_iter), tol2,
+                    X.mesh, interpret=jax.default_backend() != "tpu",
+                    log=logger is not None,
+                )
+            else:
+                centers, n_iter, _ = _lloyd_run(
+                    X.data, mask, centers0, jnp.asarray(self.max_iter), tol2,
+                    log=logger is not None,
+                )
+            # active_logger's exit runs jax.effects_barrier(), draining
+            # the per-iteration callbacks before the sink unbinds
         labels, inertia = _labels_inertia(X.data, mask, centers)
         self.cluster_centers_ = to_host(centers)
         self.labels_ = ShardedArray(labels, X.n_rows, X.mesh)

@@ -30,6 +30,7 @@ import optax
 from jax.sharding import PartitionSpec as P
 
 from ...parallel.mesh import DATA_AXIS
+from ...utils.observability import emit_jit_step
 from ..solvers import regularizers
 from ..solvers.families import get_family
 from ...ops.linalg import shard_map
@@ -61,9 +62,9 @@ def _check_smooth(reg, solver):
 # L-BFGS (optax, zoom linesearch) — whole optimization in one XLA program
 # --------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("family", "reg", "memory"))
+@partial(jax.jit, static_argnames=("family", "reg", "memory", "log"))
 def _lbfgs_run(X, y, mask, n_rows, beta0, lam, pmask, l1_ratio, max_iter, tol,
-               family, reg, memory=10):
+               family, reg, memory=10, log=False):
     loss = partial(_smooth_loss, X=X, y=y, mask=mask, n_rows=n_rows, lam=lam,
                    pmask=pmask, l1_ratio=l1_ratio, family=family, reg=reg)
     opt = optax.lbfgs(memory_size=memory)
@@ -80,7 +81,10 @@ def _lbfgs_run(X, y, mask, n_rows, beta0, lam, pmask, l1_ratio, max_iter, tol,
             grad, state, beta, value=value, grad=grad, value_fn=loss
         )
         beta = optax.apply_updates(beta, updates)
-        return beta, state, jnp.linalg.norm(grad), it + 1
+        gnorm = jnp.linalg.norm(grad)
+        if log:  # static: the silent trace has no callback at all
+            emit_jit_step(it, loss=value, grad_norm=gnorm)
+        return beta, state, gnorm, it + 1
 
     state = opt.init(beta0)
     beta, state, gnorm, it = jax.lax.while_loop(
@@ -90,12 +94,12 @@ def _lbfgs_run(X, y, mask, n_rows, beta0, lam, pmask, l1_ratio, max_iter, tol,
 
 
 def lbfgs(X, y, mask, n_rows, beta0, family, reg, lam, pmask, l1_ratio=0.5,
-          max_iter=100, tol=1e-6, memory=10, **_):
+          max_iter=100, tol=1e-6, memory=10, log=False, **_):
     _check_smooth(reg, "lbfgs")
     beta, it, gnorm = _lbfgs_run(
         X, y, mask, n_rows, beta0, lam, pmask, l1_ratio,
         jnp.asarray(max_iter), jnp.asarray(tol, beta0.dtype), family, reg,
-        memory=memory,
+        memory=memory, log=log,
     )
     return beta, {"n_iter": int(it), "grad_norm": float(gnorm)}
 
@@ -104,9 +108,10 @@ def lbfgs(X, y, mask, n_rows, beta0, family, reg, lam, pmask, l1_ratio=0.5,
 # Gradient descent with Armijo backtracking (dask_glm::gradient_descent)
 # --------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("family", "reg"))
+@partial(jax.jit, static_argnames=("family", "reg", "log"))
 def _gd_run(X, y, mask, n_rows, beta0, lam, pmask, l1_ratio, max_iter, tol,
-            init_step, family, reg, armijo=1e-4, backtrack=0.5, grow=2.0):
+            init_step, family, reg, armijo=1e-4, backtrack=0.5, grow=2.0,
+            log=False):
     loss = partial(_smooth_loss, X=X, y=y, mask=mask, n_rows=n_rows, lam=lam,
                    pmask=pmask, l1_ratio=l1_ratio, family=family, reg=reg)
 
@@ -124,6 +129,8 @@ def _gd_run(X, y, mask, n_rows, beta0, lam, pmask, l1_ratio, max_iter, tol,
 
         t = jax.lax.while_loop(ls_cond, lambda t: t * backtrack, step)
         beta = beta - t * grad
+        if log:
+            emit_jit_step(it, loss=val, grad_norm=jnp.sqrt(g2))
         return beta, t * grow, jnp.sqrt(g2), it + 1
 
     beta, step, gnorm, it = jax.lax.while_loop(
@@ -135,12 +142,13 @@ def _gd_run(X, y, mask, n_rows, beta0, lam, pmask, l1_ratio, max_iter, tol,
 
 
 def gradient_descent(X, y, mask, n_rows, beta0, family, reg, lam, pmask,
-                     l1_ratio=0.5, max_iter=100, tol=1e-6, init_step=1.0, **_):
+                     l1_ratio=0.5, max_iter=100, tol=1e-6, init_step=1.0,
+                     log=False, **_):
     _check_smooth(reg, "gradient_descent")
     beta, it, gnorm = _gd_run(
         X, y, mask, n_rows, beta0, lam, pmask, l1_ratio,
         jnp.asarray(max_iter), jnp.asarray(tol, beta0.dtype),
-        init_step, family, reg,
+        init_step, family, reg, log=log,
     )
     return beta, {"n_iter": int(it), "grad_norm": float(gnorm)}
 
@@ -150,9 +158,9 @@ def gradient_descent(X, y, mask, n_rows, beta0, family, reg, lam, pmask,
 # non-smooth penalties via regularizers.prox
 # --------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("family", "reg"))
+@partial(jax.jit, static_argnames=("family", "reg", "log"))
 def _pg_run(X, y, mask, n_rows, beta0, lam, pmask, l1_ratio, max_iter, tol,
-            init_step, family, reg, backtrack=0.5, grow=1.2):
+            init_step, family, reg, backtrack=0.5, grow=1.2, log=False):
     smooth = partial(_smooth_loss, X=X, y=y, mask=mask, n_rows=n_rows,
                      lam=lam * 0.0, pmask=pmask, l1_ratio=l1_ratio,
                      family=family, reg="none")  # penalty handled by prox
@@ -177,6 +185,8 @@ def _pg_run(X, y, mask, n_rows, beta0, lam, pmask, l1_ratio, max_iter, tol,
         t = jax.lax.while_loop(ls_cond, lambda t: t * backtrack, step)
         z = candidate(t)
         delta = jnp.linalg.norm(z - beta) / jnp.maximum(t, 1e-20)
+        if log:
+            emit_jit_step(it, loss=val, opt_residual=delta)
         return z, t * grow, delta, it + 1
 
     beta, step, delta, it = jax.lax.while_loop(
@@ -188,11 +198,12 @@ def _pg_run(X, y, mask, n_rows, beta0, lam, pmask, l1_ratio, max_iter, tol,
 
 
 def proximal_grad(X, y, mask, n_rows, beta0, family, reg, lam, pmask,
-                  l1_ratio=0.5, max_iter=100, tol=1e-7, init_step=1.0, **_):
+                  l1_ratio=0.5, max_iter=100, tol=1e-7, init_step=1.0,
+                  log=False, **_):
     beta, it, delta = _pg_run(
         X, y, mask, n_rows, beta0, lam, pmask, l1_ratio,
         jnp.asarray(max_iter), jnp.asarray(tol, beta0.dtype),
-        init_step, family, reg,
+        init_step, family, reg, log=log,
     )
     return beta, {"n_iter": int(it), "opt_residual": float(delta)}
 
@@ -201,9 +212,9 @@ def proximal_grad(X, y, mask, n_rows, beta0, family, reg, lam, pmask,
 # Newton (dask_glm::newton) with step-halving safeguard, fully on device
 # --------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("family", "reg"))
+@partial(jax.jit, static_argnames=("family", "reg", "log"))
 def _newton_run(X, y, mask, n_rows, beta0, lam, pmask, l1_ratio, max_iter, tol,
-                family, reg):
+                family, reg, log=False):
     fam = get_family(family)
     loss = partial(_smooth_loss, X=X, y=y, mask=mask, n_rows=n_rows, lam=lam,
                    pmask=pmask, l1_ratio=l1_ratio, family=family, reg=reg)
@@ -231,6 +242,8 @@ def _newton_run(X, y, mask, n_rows, beta0, lam, pmask, l1_ratio, max_iter, tol,
         t = jax.lax.while_loop(ls_cond, lambda t: t * 0.5,
                                jnp.asarray(1.0, beta.dtype))
         beta = beta - t * delta
+        if log:
+            emit_jit_step(it, loss=val, grad_norm=jnp.linalg.norm(grad))
         return beta, jnp.linalg.norm(grad), it + 1
 
     beta, gnorm, it = jax.lax.while_loop(
@@ -240,11 +253,12 @@ def _newton_run(X, y, mask, n_rows, beta0, lam, pmask, l1_ratio, max_iter, tol,
 
 
 def newton(X, y, mask, n_rows, beta0, family, reg, lam, pmask, l1_ratio=0.5,
-           max_iter=50, tol=1e-6, **_):
+           max_iter=50, tol=1e-6, log=False, **_):
     _check_smooth(reg, "newton")
     beta, it, gnorm = _newton_run(
         X, y, mask, n_rows, beta0, lam, pmask, l1_ratio,
         jnp.asarray(max_iter), jnp.asarray(tol, beta0.dtype), family, reg,
+        log=log,
     )
     return beta, {"n_iter": int(it), "grad_norm": float(gnorm)}
 
@@ -255,9 +269,10 @@ def newton(X, y, mask, n_rows, beta0, family, reg, lam, pmask, l1_ratio=0.5,
 # reference pays a gather-to-client + broadcast over TCP.
 # --------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("family", "reg", "local_iter", "mesh"))
+@partial(jax.jit, static_argnames=("family", "reg", "local_iter", "mesh",
+                                   "log"))
 def _admm_run(X, y, mask, n_rows, B, U, z, lam, pmask, l1_ratio, rho,
-              max_iter, abstol, family, reg, local_iter, mesh):
+              max_iter, abstol, family, reg, local_iter, mesh, log=False):
     fam = get_family(family)
     n_shards = mesh.shape[DATA_AXIS]
 
@@ -300,6 +315,8 @@ def _admm_run(X, y, mask, n_rows, B, U, z, lam, pmask, l1_ratio, rho,
         primal = jnp.sqrt(primal2)
         # Boyd §3.4.1 residual balancing; U is the scaled dual, rescale on
         # rho changes
+        if log:
+            emit_jit_step(it, primal_residual=primal, dual_residual=dual)
         grow = primal > 10.0 * dual
         shrink = dual > 10.0 * primal
         scale = jnp.where(grow, 2.0, jnp.where(shrink, 0.5, 1.0)).astype(z.dtype)
@@ -313,7 +330,8 @@ def _admm_run(X, y, mask, n_rows, B, U, z, lam, pmask, l1_ratio, rho,
 
 
 def admm(X, y, mask, n_rows, beta0, family, reg, lam, pmask, l1_ratio=0.5,
-         max_iter=250, tol=1e-4, rho=1.0, local_iter=8, mesh=None, **_):
+         max_iter=250, tol=1e-4, rho=1.0, local_iter=8, mesh=None, log=False,
+         **_):
     if reg == "none":
         reg = "l2"
         lam = jnp.asarray(0.0, beta0.dtype)
@@ -325,6 +343,7 @@ def admm(X, y, mask, n_rows, beta0, family, reg, lam, pmask, l1_ratio=0.5,
         X, y, mask, n_rows, B, U, beta0, lam, pmask, l1_ratio,
         jnp.asarray(rho, beta0.dtype), jnp.asarray(max_iter),
         jnp.asarray(tol, beta0.dtype), family, reg, local_iter, mesh,
+        log=log,
     )
     return z, {"n_iter": int(it), "primal_residual": float(primal),
                "dual_residual": float(dual)}

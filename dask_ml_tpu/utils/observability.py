@@ -53,6 +53,56 @@ class MetricsLogger:
         self.close()
 
 
+# The jit-step sink registry is module-GLOBAL, not thread-local:
+# jax.debug.callback runs on the runtime's callback threads, which never
+# see the fitting thread's locals. Each fit registers its own logger and
+# removes exactly ITS entry on exit (not a save/restore of a single slot,
+# which a non-LIFO exit under concurrent fits would corrupt). Concurrent
+# fits share the sink: records all land in the (one) configured metrics
+# file, only the per-fit `extra` fields of overlapping fits may mix.
+_active_loggers = []
+_active_lock = __import__("threading").Lock()
+
+
+@contextlib.contextmanager
+def active_logger(logger):
+    """Bind ``logger`` as an ambient jit-step sink: ``emit_jit_step``
+    callbacks fired from inside compiled loops (lax.while_loop bodies)
+    write to it. Device-side programs can't hold a Python handle, so the
+    binding is ambient, scoped to the fit call. On exit, pending callback
+    effects are flushed (``jax.effects_barrier``) before unbinding so tail
+    iterations are never dropped."""
+    if logger is None:
+        yield None
+        return
+    with _active_lock:
+        _active_loggers.append(logger)
+    try:
+        yield logger
+    finally:
+        jax.effects_barrier()  # drain in-flight debug callbacks first
+        with _active_lock:
+            _active_loggers.remove(logger)
+
+
+def _jit_step_cb(step, metrics_names, *values):
+    with _active_lock:
+        lg = _active_loggers[-1] if _active_loggers else None
+    if lg is not None:
+        lg.log(step=int(step),
+               **{n: float(v) for n, v in zip(metrics_names, values)})
+
+
+def emit_jit_step(step, **metrics):
+    """Call INSIDE a jitted loop body to emit one JSONL record per
+    iteration via ``jax.debug.callback`` (callers gate on a static flag so
+    the no-logging trace carries zero callback overhead)."""
+    names = tuple(sorted(metrics))
+    jax.debug.callback(
+        _jit_step_cb, step, names, *(metrics[n] for n in names)
+    )
+
+
 @contextlib.contextmanager
 def fit_logger(component, **extra):
     """Per-fit MetricsLogger bound to ``config.metrics_path``; yields None

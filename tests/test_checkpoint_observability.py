@@ -257,3 +257,102 @@ def test_checkpoint_resume_disabled_without_random_state(tmp_path):
     assert _FlakyClassifier.CALLS["n"] == int(
         s.cv_results_["partial_fit_calls"].sum()
     )
+
+
+def _read_jsonl(path):
+    return [json.loads(line) for line in open(path)]
+
+
+def test_resident_glm_per_step_metrics(tmp_path):
+    """config.metrics_path wires per-iteration JSONL OUT OF the jitted
+    while_loop solvers via debug callbacks (VERDICT r2 #3)."""
+    from dask_ml_tpu import config
+    from dask_ml_tpu.linear_model import LogisticRegression
+    from dask_ml_tpu.parallel import as_sharded
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(400, 6).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    Xs, ys = as_sharded(X), as_sharded(y)
+    path = str(tmp_path / "glm.jsonl")
+    with config.set(metrics_path=path):
+        clf = LogisticRegression(solver="lbfgs", max_iter=20).fit(Xs, ys)
+    recs = _read_jsonl(path)
+    assert len(recs) == clf.n_iter_
+    for r in recs:
+        assert r["component"] == "LogisticRegression"
+        assert r["solver"] == "lbfgs"
+        assert "loss" in r and "grad_norm" in r and "step" in r
+    # steps are the solver's own iteration counter
+    assert [r["step"] for r in recs] == list(range(clf.n_iter_))
+    # silent path: no file grows without the knob
+    clf2 = LogisticRegression(solver="lbfgs", max_iter=5).fit(Xs, ys)
+    assert len(_read_jsonl(path)) == len(recs)
+
+
+@pytest.mark.parametrize("solver,keys", [
+    ("newton", ("loss", "grad_norm")),
+    ("gradient_descent", ("loss", "grad_norm")),
+    ("proximal_grad", ("loss", "opt_residual")),
+    ("admm", ("primal_residual", "dual_residual")),
+])
+def test_all_resident_solvers_emit_metrics(tmp_path, solver, keys):
+    from dask_ml_tpu import config
+    from dask_ml_tpu.linear_model import LogisticRegression
+    from dask_ml_tpu.parallel import as_sharded
+
+    rng = np.random.RandomState(1)
+    X = rng.randn(300, 5).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    path = str(tmp_path / f"{solver}.jsonl")
+    with config.set(metrics_path=path):
+        LogisticRegression(solver=solver, max_iter=5).fit(
+            as_sharded(X), as_sharded(y)
+        )
+    recs = _read_jsonl(path)
+    assert recs, solver
+    for k in keys:
+        assert all(k in r for r in recs), (solver, k, recs[0])
+
+
+def test_kmeans_per_iteration_metrics(tmp_path):
+    from dask_ml_tpu import config
+    from dask_ml_tpu.cluster import KMeans
+    from dask_ml_tpu.parallel import as_sharded
+
+    rng = np.random.RandomState(2)
+    X = np.concatenate([
+        rng.randn(200, 4).astype(np.float32) + 4 * i for i in range(3)
+    ])
+    path = str(tmp_path / "km.jsonl")
+    with config.set(metrics_path=path):
+        km = KMeans(n_clusters=3, init="random", random_state=0,
+                    max_iter=20).fit(as_sharded(X))
+    recs = _read_jsonl(path)
+    assert len(recs) == km.n_iter_
+    for r in recs:
+        assert r["component"] == "KMeans"
+        assert "center_shift2" in r and "step" in r
+
+
+def test_adaptive_search_metrics(tmp_path):
+    from dask_ml_tpu import config
+    from dask_ml_tpu.model_selection import IncrementalSearchCV
+    from dask_ml_tpu.models.sgd import SGDClassifier
+
+    rng = np.random.RandomState(3)
+    X = rng.randn(400, 5).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    path = str(tmp_path / "search.jsonl")
+    with config.set(metrics_path=path):
+        search = IncrementalSearchCV(
+            SGDClassifier(random_state=0),
+            {"alpha": [1e-4, 1e-3, 1e-2]},
+            n_initial_parameters=3, max_iter=5, random_state=0,
+        )
+        search.fit(X, y, classes=[0.0, 1.0])
+    recs = [r for r in _read_jsonl(path)
+            if r.get("component") == "adaptive_search"]
+    assert len(recs) == len(search.history_)
+    for r in recs:
+        assert "model_id" in r and "score" in r and "batch_size" in r
