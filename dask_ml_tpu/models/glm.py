@@ -252,16 +252,21 @@ class LogisticRegression(_GLMBase):
     family = "logistic"
 
     def _encode_y(self, y: ShardedArray):
-        y_host = y.to_numpy()
-        classes = np.unique(y_host)
-        if len(classes) != 2:
+        # classes found ON DEVICE — the label column never round-trips
+        # through host (three scalars do)
+        from ..utils.validation import device_binary_classes
+
+        try:
+            classes = device_binary_classes(y)
+        except ValueError as e:
             raise ValueError(
-                f"LogisticRegression supports binary targets; got "
-                f"{len(classes)} classes"
-            )
+                f"LogisticRegression supports binary targets; {e}"
+            ) from None
         self.classes_ = classes
-        y01 = (y_host == classes[1]).astype(np.float32)
-        return ShardedArray.from_array(y01, mesh=y.mesh).data, classes
+        mask = y.row_mask(jnp.float32)
+        y01 = (y.data == jnp.asarray(classes[1], y.data.dtype)
+               ).astype(jnp.float32) * mask
+        return y01, classes
 
     def _encode_y_host(self, y):
         y = np.asarray(y)

@@ -80,6 +80,40 @@ def check_chunks(n_samples, n_features, chunks=None, mesh=None):
     raise AssertionError(f"Unexpected chunks value: {chunks!r}")
 
 
+def device_binary_classes(y: ShardedArray) -> np.ndarray:
+    """The two class values of a device label vector, WITHOUT pulling the
+    column to host (VERDICT r2 #4: ``_encode_y`` full-column round-trip).
+    One jitted masked reduction; only three scalars cross to host. Raises
+    ValueError for non-binary targets (the error path falls back to a
+    host ``np.unique`` for an exact class count in the message)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def _scan(data, mask):
+        valid = mask > 0
+        # float32 scan regardless of label dtype: ±inf sentinels don't
+        # exist for int/bool labels, and class values are small enough to
+        # survive the cast exactly
+        df = data.astype(jnp.float32)
+        big = jnp.asarray(jnp.inf, jnp.float32)
+        mn = jnp.min(jnp.where(valid, df, big))
+        mx = jnp.max(jnp.where(valid, df, -big))
+        binary = jnp.all(~valid | (df == mn) | (df == mx))
+        return mn, mx, binary
+
+    mn, mx, binary = _scan(y.data, y.row_mask(jnp.float32))
+    mn, mx = float(mn), float(mx)
+    if not bool(binary) or mn == mx:
+        n_classes = len(np.unique(y.to_numpy()))  # error path only
+        raise ValueError(
+            f"expected binary targets; got {n_classes} classes"
+        )
+    # classes keep the label dtype (np.unique parity: int labels give
+    # int classes, so predict() returns the caller's dtype)
+    return np.asarray([mn, mx]).astype(np.dtype(str(y.dtype)))
+
+
 def check_is_fitted(est, attr: str):
     if not hasattr(est, attr):
         raise AttributeError(

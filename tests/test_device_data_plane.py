@@ -1,0 +1,143 @@
+"""Device data plane completeness (VERDICT r2 #4): ShardedArray inputs
+must never round-trip the full dataset through host — not in the
+wrappers, not in GLM label encoding, not in concurrent GridSearchCV over
+sharded input. The spy counts every ShardedArray.to_numpy() pull."""
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu.parallel import as_sharded
+from dask_ml_tpu.parallel.sharded import ShardedArray
+
+
+@pytest.fixture()
+def spy(monkeypatch):
+    calls = []
+    orig = ShardedArray.to_numpy
+
+    def spy_fn(self):
+        calls.append(self.n_rows)
+        return orig(self)
+
+    monkeypatch.setattr(ShardedArray, "to_numpy", spy_fn)
+    return calls
+
+
+@pytest.fixture(scope="module")
+def xy_device():
+    rng = np.random.RandomState(0)
+    X = rng.randn(480, 8).astype(np.float32)
+    y = (X[:, 0] + 0.3 * rng.randn(480) > 0).astype(np.float32)
+    return X, y
+
+
+def _no_full_pulls(calls, n):
+    assert not any(c >= n for c in calls), calls
+
+
+def test_sgd_fit_stays_on_device(xy_device, spy):
+    from dask_ml_tpu.models.sgd import SGDClassifier
+
+    X, y = xy_device
+    Xs, ys = as_sharded(X), as_sharded(y)
+    clf = SGDClassifier(random_state=0, max_iter=5).fit(Xs, ys)
+    _no_full_pulls(spy, len(X))
+    assert clf.score(X, y) > 0.7
+
+
+def test_incremental_wrapper_stays_on_device(xy_device, spy):
+    from dask_ml_tpu.models.sgd import SGDClassifier
+    from dask_ml_tpu.wrappers import Incremental
+
+    X, y = xy_device
+    Xs, ys = as_sharded(X), as_sharded(y)
+    inc = Incremental(SGDClassifier(random_state=0), random_state=0)
+    inc.fit(Xs, ys, classes=[0.0, 1.0])
+    _no_full_pulls(spy, len(X))
+    # the wrapped device model is fitted and usable
+    assert inc.estimator_.coef_.shape == (1, 8)
+    # parity with the host-input path
+    inc_host = Incremental(SGDClassifier(random_state=0), random_state=0)
+    inc_host.fit(X, y, classes=[0.0, 1.0])
+    np.testing.assert_allclose(
+        inc.estimator_.coef_, inc_host.estimator_.coef_, rtol=1e-4,
+        atol=1e-5,
+    )
+
+
+def test_glm_encode_y_stays_on_device(xy_device, spy):
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    X, y = xy_device
+    Xs, ys = as_sharded(X), as_sharded(y)
+    clf = LogisticRegression(solver="lbfgs", max_iter=50).fit(Xs, ys)
+    _no_full_pulls(spy, len(X))
+    np.testing.assert_array_equal(clf.classes_, [0.0, 1.0])
+    assert clf.score(Xs, ys) > 0.7
+
+
+def test_device_classes_integer_labels(xy_device):
+    """Integer (and bool) label dtypes must work on the device path, as
+    np.unique does on host, and classes_ keeps the label dtype."""
+    from dask_ml_tpu.models.sgd import SGDClassifier
+
+    X, y = xy_device
+    yi = y.astype(np.int32)
+    clf = SGDClassifier(random_state=0, max_iter=3).fit(
+        as_sharded(X), as_sharded(yi)
+    )
+    np.testing.assert_array_equal(clf.classes_, [0, 1])
+    assert np.issubdtype(clf.classes_.dtype, np.integer)
+    assert set(np.unique(clf.predict(X))) <= {0, 1}
+
+
+def test_device_fit_explicit_classes_kwarg(xy_device):
+    """fit(..., classes=[...]) must apply the classes on both data
+    planes — labels like {-1, +1} would otherwise train un-encoded."""
+    from dask_ml_tpu.models.sgd import SGDClassifier
+
+    X, y = xy_device
+    ypm = np.where(y > 0, 1.0, -1.0).astype(np.float32)
+    dev = SGDClassifier(random_state=0, max_iter=5).fit(
+        as_sharded(X), as_sharded(ypm), classes=[-1.0, 1.0]
+    )
+    np.testing.assert_array_equal(dev.classes_, [-1.0, 1.0])
+    assert set(np.unique(dev.predict(X))) <= {-1.0, 1.0}
+    assert dev.score(X, ypm) > 0.7
+    host = SGDClassifier(random_state=0, max_iter=5).fit(
+        X, ypm, classes=[-1.0, 1.0]
+    )
+    np.testing.assert_array_equal(host.classes_, [-1.0, 1.0])
+    assert host.score(X, ypm) > 0.7
+
+
+def test_glm_encode_y_non_binary_raises(xy_device):
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    X, _ = xy_device
+    y3 = as_sharded(np.arange(len(X), dtype=np.float32) % 3)
+    with pytest.raises(ValueError, match="binary.*3 classes"):
+        LogisticRegression(solver="lbfgs").fit(as_sharded(X), y3)
+
+
+def test_concurrent_gridsearch_sharded_stays_on_device(xy_device, spy):
+    """Sharded input + explicit n_jobs: trials run on disjoint submeshes
+    with DEVICE-resharded folds (no host_folds materialization)."""
+    from dask_ml_tpu.linear_model import LogisticRegression
+    from dask_ml_tpu.model_selection import GridSearchCV
+
+    X, y = xy_device
+    Xs, ys = as_sharded(X), as_sharded(y)
+    grid = {"C": [0.1, 1.0, 10.0]}
+    est = LogisticRegression(solver="lbfgs", max_iter=50)
+    conc = GridSearchCV(est, grid, cv=3, n_jobs=2, refit=False)
+    conc.fit(Xs, ys)
+    _no_full_pulls(spy, len(X))
+
+    seq = GridSearchCV(est, grid, cv=3, scheduler="synchronous",
+                       refit=False)
+    seq.fit(Xs, ys)
+    np.testing.assert_allclose(
+        conc.cv_results_["mean_test_score"],
+        seq.cv_results_["mean_test_score"], atol=1e-5,
+    )
