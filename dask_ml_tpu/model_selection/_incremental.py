@@ -63,6 +63,36 @@ def _blocks_of(X, y, n_blocks):
             if len(Xh[i:i + bs])]
 
 
+def _data_fingerprint(a, n_sample=96) -> str:
+    """Cheap content fingerprint of a training array for checkpoint
+    identity (ADVICE r1 #1): same-shape different-content data must not
+    resume a stale search. Samples head, evenly strided middle, AND tail
+    rows (a head-only hash would miss tail-edited data); for a
+    ShardedArray that is one small device gather, never a full pull.
+    Sample-based by design — collisions need identical values at every
+    probed row."""
+    import hashlib
+
+    if a is None:
+        return "none"
+    n = a.shape[0] if hasattr(a, "shape") else len(a)
+    k = max(n_sample // 3, 1)
+    idx = np.unique(np.concatenate([
+        np.arange(min(k, n)),
+        np.linspace(0, n - 1, num=min(k, n), dtype=np.int64),
+        np.arange(max(n - k, 0), n),
+    ]))
+    if isinstance(a, ShardedArray):
+        from ..parallel.sharded import take_rows
+
+        sample = take_rows(a, idx).to_numpy()
+    else:
+        sample = np.asarray(a)[idx]
+    return hashlib.sha1(
+        np.ascontiguousarray(sample).tobytes()
+    ).hexdigest()
+
+
 def _supports_batch(model) -> bool:
     return hasattr(type(model), "_batched_partial_fit") and \
         hasattr(model, "_batch_key")
@@ -362,34 +392,38 @@ class BaseIncrementalSearchCV(BaseEstimator):
         ckpt_dir = get_config().checkpoint_dir
         checkpoint = None
         ckpt_token = None
-        if ckpt_dir:
+        # random_state=None draws a fresh split every run, so resume is
+        # impossible (the split cannot be reproduced) — no checkpoint is
+        # created AT ALL: writing unresumable state every round is pure
+        # overhead and a shared-directory hazard (ADVICE r1 #2).
+        if ckpt_dir and self.random_state is not None:
             import hashlib
 
             from ..utils.checkpoint import SearchCheckpoint
             from ._normalize import _token_piece, estimator_token
 
             # identity token: a stale checkpoint from a different search
-            # (estimator, candidate params, data shape, split, budget)
-            # must NOT be resumed — it would relabel old models with new
-            # params or leak a different split's training rows into test
-            # scores. random_state=None draws a fresh split every run, so
-            # resume is disabled (token None): the split cannot be
-            # reproduced.
-            if self.random_state is not None:
-                ckpt_token = hashlib.sha1("|".join([
-                    type(self).__name__, self.prefix,
-                    estimator_token(self.estimator),
-                    _token_piece(params_list),
-                    str(getattr(X, "shape", np.shape(X))),
-                    str(len(blocks)), str(self.max_iter),
-                    str(self.patience), str(self.tol),
-                    str(self.random_state), str(test_size),
-                ]).encode()).hexdigest()
+            # (estimator, candidate params, data CONTENT + shape, split,
+            # budget) must NOT be resumed — it would relabel old models
+            # with new params or leak a different split's training rows
+            # into test scores. The content fingerprint (ADVICE r1 #1)
+            # catches same-shape-different-data: a handful of sample rows
+            # is hashed, so it costs one tiny device fetch at most.
+            ckpt_token = hashlib.sha1("|".join([
+                type(self).__name__, self.prefix,
+                estimator_token(self.estimator),
+                _token_piece(params_list),
+                str(getattr(X, "shape", np.shape(X))),
+                _data_fingerprint(X), _data_fingerprint(y),
+                str(len(blocks)), str(self.max_iter),
+                str(self.patience), str(self.tol),
+                str(self.random_state), str(test_size),
+            ]).encode()).hexdigest()
             # per-search directory: another search of the same class must
             # not overwrite or clear this search's resumable state
             sub = "-".join(
                 p for p in (type(self).__name__, self.prefix,
-                            ckpt_token[:12] if ckpt_token else "noresume")
+                            ckpt_token[:12])
                 if p
             )
             checkpoint = SearchCheckpoint(os.path.join(ckpt_dir, sub))

@@ -357,50 +357,60 @@ class _BaseSearchCV(BaseEstimator):
                 # (host) fold onto it, and fits entirely within it —
                 # concurrent XLA programs never share devices, so their
                 # collectives cannot interleave.
-                if isinstance(X, ShardedArray):
+                device_folds = isinstance(X, ShardedArray) or \
+                    isinstance(y, ShardedArray)
+                if device_folds:
                     # Device folds (VERDICT r2 weak #4): reshard each fold
-                    # DEVICE-TO-DEVICE onto a statically assigned submesh,
-                    # ALL BEFORE any trial launches — reshard programs run
-                    # on the parent mesh, and a parent-mesh program in
-                    # flight while a trial runs on a sub-mesh can
-                    # deadlock their collectives on shared devices. Each
-                    # fold reshards exactly once; concurrency is across
-                    # folds, each submesh-thread running its folds'
-                    # candidates sequentially.
+                    # DEVICE-TO-DEVICE onto a submesh BEFORE its trials
+                    # launch — reshard programs run on the parent mesh,
+                    # and a parent-mesh program in flight while a trial
+                    # runs on a sub-mesh can deadlock their collectives on
+                    # shared devices. Folds run in WAVES of one fold per
+                    # submesh: each wave reshards sequentially, runs its
+                    # folds' candidates concurrently, then frees the
+                    # copies — peak extra HBM is one fold per submesh, not
+                    # cv× the dataset.
                     import jax as _jx
 
                     from ..parallel.sharded import reshard
 
                     subs = _submeshes(mesh, min(workers, n_folds))
-                    fold_on_sub = {}
-                    for fi in range(n_folds):
-                        sub = subs[fi % len(subs)]
-                        fold_on_sub[fi] = tuple(
-                            reshard(a, sub) if isinstance(a, ShardedArray)
-                            else a
-                            for a in cache.fold(fi)
-                        )
-                    # drain every parent-mesh program before trials start
-                    _jx.block_until_ready([
-                        a.data for f in fold_on_sub.values() for a in f
-                        if isinstance(a, ShardedArray)
-                    ])
+                    S = len(subs)
+                    for w0 in range(0, n_folds, S):
+                        wave = list(range(w0, min(w0 + S, n_folds)))
+                        wave_folds = {}
+                        for j, fi in enumerate(wave):
+                            wave_folds[fi] = (subs[j], tuple(
+                                reshard(a, subs[j])
+                                if isinstance(a, ShardedArray) else a
+                                for a in cache.fold(fi)
+                            ))
+                        # drain parent-mesh programs before trials start
+                        _jx.block_until_ready([
+                            a.data for _, f in wave_folds.values()
+                            for a in f if isinstance(a, ShardedArray)
+                        ])
 
-                    def run_fold_group(si):
-                        with use_mesh(subs[si]):
-                            for ci, fi in my_tasks:
-                                if fi % len(subs) == si:
-                                    run_task(ci, fi, fold_on_sub[fi])
+                        def run_fold_group(fi):
+                            sub, fold = wave_folds[fi]
+                            with use_mesh(sub):
+                                for ci, fj in my_tasks:
+                                    if fj == fi:
+                                        run_task(ci, fj, fold)
 
-                    with ThreadPoolExecutor(max_workers=len(subs)) as pool:
-                        futures = [pool.submit(run_fold_group, si)
-                                   for si in range(len(subs))]
-                        for f in futures:
-                            f.result()
+                        with ThreadPoolExecutor(
+                            max_workers=len(wave)
+                        ) as pool:
+                            futures = [pool.submit(run_fold_group, fi)
+                                       for fi in wave]
+                            for f in futures:
+                                f.result()
                 else:
-                    # host folds: each trial checks a submesh out and the
-                    # estimator places its fold onto it — host→device
-                    # placement is safe under concurrent launches
+                    # pure-host folds (X and y both host): extraction is
+                    # numpy slicing, safe inside worker threads; each
+                    # trial checks a submesh out and the estimator places
+                    # its fold onto it — host→device placement is safe
+                    # under concurrent launches
                     subs = _submeshes(mesh, workers)
                     workers = len(subs)
                     free = queue.SimpleQueue()

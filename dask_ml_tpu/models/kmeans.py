@@ -490,6 +490,12 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             labels[cursor:cursor + m] = np.asarray(lb)[:m]
             inertia += float(ib)
             cursor += m
+        if not np.isfinite(inertia) or \
+                not bool(jnp.isfinite(centers).all()):
+            raise FloatingPointError(
+                "KMeans produced non-finite centers/inertia: the input "
+                "contains NaN/Inf"
+            )
         self.cluster_centers_ = np.asarray(centers)
         self.labels_ = labels
         self.inertia_ = inertia
@@ -535,6 +541,15 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             # active_logger's exit runs jax.effects_barrier(), draining
             # the per-iteration callbacks before the sink unbinds
         labels, inertia = _labels_inertia(X.data, mask, centers)
+        # NaN sanitizer (SURVEY.md §5): a NaN makes the tol while_loop
+        # exit as "converged" (NaN comparisons are False) — check the
+        # final inertia/centers instead of trusting convergence
+        if not bool(jnp.isfinite(inertia)) or \
+                not bool(jnp.isfinite(centers).all()):
+            raise FloatingPointError(
+                "KMeans produced non-finite centers/inertia: the input "
+                "contains NaN/Inf"
+            )
         self.cluster_centers_ = to_host(centers)
         self.labels_ = ShardedArray(labels, X.n_rows, X.mesh)
         self.inertia_ = float(inertia)

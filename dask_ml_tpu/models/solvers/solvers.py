@@ -26,6 +26,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import PartitionSpec as P
 
@@ -50,6 +51,22 @@ def _smooth_loss(beta, X, y, mask, n_rows, lam, pmask, l1_ratio, family, reg):
     return base + regularizers.value(reg, beta, lam, pmask, l1_ratio)
 
 
+def check_finite_result(beta, info, solver):
+    """NaN/Inf sanitizer (SURVEY.md §5 race-detection row): a NaN ends a
+    ``gnorm > tol`` while_loop as "converged", silently. Every solver
+    funnels its result through here; non-finite parameters raise instead
+    of becoming a model."""
+    beta_h = np.asarray(beta)
+    scalars = [v for v in info.values() if isinstance(v, (int, float))]
+    if not np.isfinite(beta_h).all() or not np.all(np.isfinite(scalars)):
+        raise FloatingPointError(
+            f"solver {solver!r} produced non-finite parameters "
+            f"(info={info}): the input contains NaN/Inf or the solve "
+            f"diverged — validate the data or reduce the step size / C"
+        )
+    return beta, info
+
+
 def _check_smooth(reg, solver):
     if reg not in regularizers.SMOOTH:
         raise ValueError(
@@ -63,8 +80,13 @@ def _check_smooth(reg, solver):
 # --------------------------------------------------------------------------
 
 @partial(jax.jit, static_argnames=("family", "reg", "memory", "log"))
-def _lbfgs_run(X, y, mask, n_rows, beta0, lam, pmask, l1_ratio, max_iter, tol,
-               family, reg, memory=10, log=False):
+def _lbfgs_chunk(X, y, mask, n_rows, carry, lam, pmask, l1_ratio, stop_it,
+                 tol, family, reg, memory=10, log=False):
+    """Run the L-BFGS while_loop from ``carry`` until ``stop_it`` (or
+    convergence). A full solve is one chunk with stop_it = max_iter; the
+    checkpointed path runs k-iteration chunks so (beta, optimizer state)
+    hits stable storage between programs (SURVEY.md §5 checkpoint row —
+    TPU slices fail whole, recovery is checkpoint-restart)."""
     loss = partial(_smooth_loss, X=X, y=y, mask=mask, n_rows=n_rows, lam=lam,
                    pmask=pmask, l1_ratio=l1_ratio, family=family, reg=reg)
     opt = optax.lbfgs(memory_size=memory)
@@ -72,7 +94,7 @@ def _lbfgs_run(X, y, mask, n_rows, beta0, lam, pmask, l1_ratio, max_iter, tol,
 
     def cond(carry):
         beta, state, gnorm, it = carry
-        return (it < max_iter) & (gnorm > tol)
+        return (it < stop_it) & (gnorm > tol)
 
     def body(carry):
         beta, state, _, it = carry
@@ -86,22 +108,60 @@ def _lbfgs_run(X, y, mask, n_rows, beta0, lam, pmask, l1_ratio, max_iter, tol,
             emit_jit_step(it, loss=value, grad_norm=gnorm)
         return beta, state, gnorm, it + 1
 
-    state = opt.init(beta0)
-    beta, state, gnorm, it = jax.lax.while_loop(
-        cond, body, (beta0, state, jnp.asarray(jnp.inf, beta0.dtype), 0)
-    )
-    return beta, it, gnorm
+    return jax.lax.while_loop(cond, body, carry)
 
 
 def lbfgs(X, y, mask, n_rows, beta0, family, reg, lam, pmask, l1_ratio=0.5,
-          max_iter=100, tol=1e-6, memory=10, log=False, **_):
+          max_iter=100, tol=1e-6, memory=10, log=False, checkpoint_path=None,
+          checkpoint_every=0, **_):
+    """When ``checkpoint_path`` + ``checkpoint_every`` are set (via
+    ``solver_kwargs``), the solve runs in k-iteration chunks with
+    (beta, optimizer state, it) persisted after each — a killed 3-hour
+    fit resumes mid-solve instead of from zero (VERDICT r2 #5)."""
     _check_smooth(reg, "lbfgs")
-    beta, it, gnorm = _lbfgs_run(
-        X, y, mask, n_rows, beta0, lam, pmask, l1_ratio,
-        jnp.asarray(max_iter), jnp.asarray(tol, beta0.dtype), family, reg,
-        memory=memory, log=log,
-    )
-    return beta, {"n_iter": int(it), "grad_norm": float(gnorm)}
+    opt = optax.lbfgs(memory_size=memory)
+    carry = (beta0, opt.init(beta0), jnp.asarray(jnp.inf, beta0.dtype), 0)
+    tol_a = jnp.asarray(tol, beta0.dtype)
+    run = partial(_lbfgs_chunk, X, y, mask, n_rows, lam=lam, pmask=pmask,
+                  l1_ratio=l1_ratio, tol=tol_a, family=family, reg=reg,
+                  memory=memory, log=log)
+    resumed_from = 0
+    if not (checkpoint_path and checkpoint_every):
+        beta, state, gnorm, it = run(carry=carry,
+                                     stop_it=jnp.asarray(max_iter))
+    else:
+        import os
+
+        from ...utils import checkpoint as ckpt
+
+        if os.path.exists(os.path.abspath(checkpoint_path)):
+            restored = ckpt.restore_pytree(checkpoint_path, like=carry)
+            # host views: restored leaves come back committed to one
+            # device; jit must be free to re-place them with X's sharding
+            carry = tuple(jax.tree.map(
+                lambda a: np.asarray(a), tuple(restored)
+            ))
+            resumed_from = int(carry[3])
+        while True:
+            it = int(carry[3])
+            gnorm = float(carry[2])
+            if it >= max_iter or (it > 0 and gnorm <= tol):
+                break
+            stop = min(it + int(checkpoint_every), max_iter)
+            carry = run(carry=carry, stop_it=jnp.asarray(stop))
+            ckpt.save_pytree(checkpoint_path, tuple(carry))
+        # completed: CLEAR the checkpoint — a finished solve's state left
+        # on disk would be silently "resumed" (returning the stale beta)
+        # by the next fit sharing the path. The path identifies ONE fit;
+        # only a killed run leaves state behind.
+        import shutil
+
+        shutil.rmtree(os.path.abspath(checkpoint_path), ignore_errors=True)
+        beta, state, gnorm, it = carry
+    info = {"n_iter": int(it), "grad_norm": float(gnorm)}
+    if checkpoint_path and checkpoint_every:
+        info["resumed_from"] = resumed_from
+    return beta, info
 
 
 # --------------------------------------------------------------------------
@@ -361,4 +421,5 @@ SOLVERS = {
 def solve(solver: str, **kwargs):
     if solver not in SOLVERS:
         raise ValueError(f"Unknown solver {solver!r}; options: {sorted(SOLVERS)}")
-    return SOLVERS[solver](**kwargs)
+    beta, info = SOLVERS[solver](**kwargs)
+    return check_finite_result(beta, info, solver)

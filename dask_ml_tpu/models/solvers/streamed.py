@@ -448,4 +448,6 @@ def solve_streamed(solver, stream, n_rows, beta0, family, reg, lam, pmask,
     )
     info["streamed"] = True
     info["n_blocks"] = stream.n_blocks
-    return beta, info
+    from .solvers import check_finite_result
+
+    return check_finite_result(beta, info, solver)

@@ -92,26 +92,32 @@ def device_binary_classes(y: ShardedArray) -> np.ndarray:
     @jax.jit
     def _scan(data, mask):
         valid = mask > 0
-        # float32 scan regardless of label dtype: ±inf sentinels don't
-        # exist for int/bool labels, and class values are small enough to
-        # survive the cast exactly
-        df = data.astype(jnp.float32)
-        big = jnp.asarray(jnp.inf, jnp.float32)
-        mn = jnp.min(jnp.where(valid, df, big))
-        mx = jnp.max(jnp.where(valid, df, -big))
-        binary = jnp.all(~valid | (df == mn) | (df == mx))
+        if data.dtype == jnp.bool_:
+            data = data.astype(jnp.int32)
+        # dtype-native sentinels: a float32 cast would corrupt integer
+        # labels beyond 2^24 (ID-like class codes)
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            big = jnp.asarray(jnp.inf, data.dtype)
+            small = -big
+        else:
+            info = jnp.iinfo(data.dtype)
+            big = jnp.asarray(info.max, data.dtype)
+            small = jnp.asarray(info.min, data.dtype)
+        mn = jnp.min(jnp.where(valid, data, big))
+        mx = jnp.max(jnp.where(valid, data, small))
+        binary = jnp.all(~valid | (data == mn) | (data == mx))
         return mn, mx, binary
 
     mn, mx, binary = _scan(y.data, y.row_mask(jnp.float32))
-    mn, mx = float(mn), float(mx)
-    if not bool(binary) or mn == mx:
+    mn_h, mx_h = np.asarray(mn), np.asarray(mx)
+    if not bool(binary) or mn_h == mx_h:
         n_classes = len(np.unique(y.to_numpy()))  # error path only
         raise ValueError(
             f"expected binary targets; got {n_classes} classes"
         )
     # classes keep the label dtype (np.unique parity: int labels give
     # int classes, so predict() returns the caller's dtype)
-    return np.asarray([mn, mx]).astype(np.dtype(str(y.dtype)))
+    return np.stack([mn_h, mx_h]).astype(np.dtype(str(y.dtype)))
 
 
 def check_is_fitted(est, attr: str):

@@ -245,7 +245,9 @@ def test_checkpoint_resume_disabled_without_random_state(tmp_path):
                 _FlakyClassifier(), {"alpha": [1e-4, 1e-3, 1e-2, 1e-1]},
                 n_initial_parameters=4, max_iter=6, random_state=None,
             ).fit(X, y, classes=[0, 1])
-    assert os.listdir(ckpt_dir) == ["IncrementalSearchCV-noresume"]
+    # ADVICE r1 #2: no checkpoint state is written AT ALL — resume is
+    # impossible, so writes would be pure overhead and a shared-dir hazard
+    assert not os.path.exists(ckpt_dir) or os.listdir(ckpt_dir) == []
 
     # rerun completes from scratch (no resume), using its own full budget
     _FlakyClassifier.CALLS.update(n=0, fail_at=None)
@@ -356,3 +358,42 @@ def test_adaptive_search_metrics(tmp_path):
     assert len(recs) == len(search.history_)
     for r in recs:
         assert "model_id" in r and "score" in r and "batch_size" in r
+
+
+def test_checkpoint_data_fingerprint_isolates(tmp_path):
+    """ADVICE r1 #1: same shape, same params, DIFFERENT data content must
+    not resume the stale search — the identity token carries a content
+    fingerprint."""
+    from sklearn.datasets import make_classification
+
+    from dask_ml_tpu import config
+    from dask_ml_tpu.model_selection import IncrementalSearchCV
+
+    X, y = make_classification(n_samples=300, n_features=6, random_state=0)
+    X2, y2 = make_classification(n_samples=300, n_features=6,
+                                 random_state=99)  # same shape, new data
+    ckpt_dir = os.path.join(tmp_path, "ckfp")
+    params = {"alpha": [1e-4, 1e-3, 1e-2, 1e-1]}
+
+    def search():
+        return IncrementalSearchCV(
+            _FlakyClassifier(), params,
+            n_initial_parameters=4, max_iter=6, random_state=0,
+        )
+
+    _FlakyClassifier.CALLS.update(n=0, fail_at=6)
+    with config.set(checkpoint_dir=ckpt_dir):
+        with pytest.raises(RuntimeError, match="injected"):
+            search().fit(X, y, classes=[0, 1])
+    assert len(os.listdir(ckpt_dir)) == 1
+
+    # same-shape different data: must get its OWN token directory and run
+    # from scratch, not resume the stale models
+    _FlakyClassifier.CALLS.update(n=0, fail_at=None)
+    with config.set(checkpoint_dir=ckpt_dir):
+        s = search().fit(X2, y2, classes=[0, 1])
+    assert len(os.listdir(ckpt_dir)) == 2  # distinct token dirs
+    # fresh run executed its entire own budget (nothing resumed)
+    assert _FlakyClassifier.CALLS["n"] == int(
+        s.cv_results_["partial_fit_calls"].sum()
+    )
