@@ -140,14 +140,52 @@ class CountVectorizer(TransformerMixin, BaseEstimator):
         return self
 
     def _build_vocabulary(self, raw_documents):
-        vocab = set()
+        """Union of per-block vocabularies with GLOBAL document/term
+        frequencies, then sklearn's own pruning semantics applied to the
+        merged counts: min_df/max_df filter on corpus-wide document
+        frequency and max_features keeps the top terms by corpus term
+        frequency (ties alphabetical) — matching what sklearn computes on
+        the concatenated corpus (ref CountVectorizer._limit_features).
+        Removed terms land in ``stop_words_``, as in sklearn."""
+        from collections import Counter
+
+        df = Counter()  # document frequency per term
+        tf = Counter()  # corpus term frequency (max_features ranking)
+        n_docs = 0
         for block in _blocks(raw_documents):
             cv = sktext.CountVectorizer(**self.get_params())
             cv.set_params(vocabulary=None, max_df=1.0, min_df=1,
                           max_features=None)
-            cv.fit(block)
-            vocab.update(cv.vocabulary_)
-        return {t: i for i, t in enumerate(sorted(vocab))}
+            Xb = cv.fit_transform(block)
+            n_docs += Xb.shape[0]
+            terms = cv.get_feature_names_out()
+            dfs = np.asarray((Xb > 0).sum(axis=0)).ravel()
+            tfs = np.asarray(Xb.sum(axis=0)).ravel()
+            for t, d, c in zip(terms, dfs, tfs):
+                df[t] += int(d)
+                tf[t] += int(c)
+        # sklearn threshold semantics: integer = absolute count, float =
+        # fraction of documents (no rounding)
+        min_c = (self.min_df if isinstance(self.min_df, (int, np.integer))
+                 else self.min_df * n_docs)
+        max_c = (self.max_df if isinstance(self.max_df, (int, np.integer))
+                 else self.max_df * n_docs)
+        if max_c < min_c:
+            raise ValueError("max_df corresponds to < documents than min_df")
+        kept = {t for t, c in df.items() if min_c <= c <= max_c}
+        removed = set(df) - kept
+        if self.max_features is not None and len(kept) > self.max_features:
+            ranked = sorted(kept, key=lambda t: (-tf[t], t))
+            cut = set(ranked[int(self.max_features):])
+            removed |= cut
+            kept -= cut
+        if not kept:
+            raise ValueError(
+                "After pruning, no terms remain. Try a lower min_df or a "
+                "higher max_df."
+            )
+        self.stop_words_ = removed
+        return {t: i for i, t in enumerate(sorted(kept))}
 
     def fit_transform(self, raw_documents, y=None):
         if self.vocabulary is not None:

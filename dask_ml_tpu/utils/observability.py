@@ -24,6 +24,11 @@ class MetricsLogger:
         self.extra = extra or {}
         self._fh = None
         self.t0 = time.time()
+        import threading as _threading
+
+        # log() is called from trial worker threads and jit callback
+        # threads; one lock keeps the lazy open and each JSONL line atomic
+        self._lock = _threading.Lock()
 
     def _handle(self):
         if self.path is None:
@@ -37,14 +42,17 @@ class MetricsLogger:
         if step is not None:
             rec["step"] = step
         rec.update(metrics)
-        h = self._handle()
-        h.write(json.dumps(rec) + "\n")
-        h.flush()
+        line = json.dumps(rec) + "\n"
+        with self._lock:
+            h = self._handle()
+            h.write(line)
+            h.flush()
 
     def close(self):
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
 
     def __enter__(self):
         return self

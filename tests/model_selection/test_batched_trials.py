@@ -141,3 +141,65 @@ def test_heterogeneous_cohorts_split():
     a._batch_prepare({"classes": [0, 1]})
     b._batch_prepare({"classes": [0, 1]})
     assert a._batch_key() != b._batch_key()
+
+
+def test_host_solo_trials_run_concurrently(xy_classification):
+    """VERDICT r2 weak #1: non-batchable (host sklearn) trials advance
+    through a thread pool, not a strictly sequential loop — placement
+    evidence lands in history_ (executor/thread fields)."""
+    from sklearn.linear_model import SGDClassifier as SkSGD
+
+    X, y = xy_classification
+    search = IncrementalSearchCV(
+        SkSGD(tol=None), {"alpha": [1e-5, 1e-4, 1e-3, 1e-2]},
+        n_initial_parameters="grid", decay_rate=None, max_iter=3,
+        random_state=0,
+    )
+    search.fit(X, y, classes=[0.0, 1.0])
+    threaded = [r for r in search.history_ if r["executor"] == "threads"]
+    assert threaded, search.history_[:2]
+    assert len({r["thread"] for r in threaded}) > 1  # real concurrency
+    assert search.best_score_ > 0.5
+
+
+def test_cursor_diverged_device_models_progress(xy_classification):
+    """Device-protocol models whose block cursors diverged fall out of
+    the vmapped cohort but still make progress (sequential singleton
+    groups — the safe path on one shared mesh)."""
+    from dask_ml_tpu.model_selection._incremental import fit as ctrl_fit
+    from dask_ml_tpu.metrics.scorer import check_scoring
+
+    X, y = xy_classification
+    X = X.astype(np.float32)
+    y = y.astype(np.float32)
+    blocks = [(X[i::4], y[i::4]) for i in range(4)]
+
+    calls_seen = []
+
+    def hook(info):
+        calls_seen.append({m: r[-1]["partial_fit_calls"]
+                           for m, r in info.items()})
+        rounds = len(calls_seen)
+        if rounds == 1:
+            return {0: 1, 1: 2}  # diverge the cursors
+        if rounds <= 3:
+            return {0: 1, 1: 1}  # both advance, cursors stay diverged
+        return {}
+
+    def factory(params):
+        return SGDClassifier(tol=1e-3, **params)
+
+    scorer = check_scoring(SGDClassifier(), None)
+    info, models, meta, history = ctrl_fit(
+        factory, [{"eta0": 0.1}, {"eta0": 0.2}], blocks,
+        X[:100], y[:100], scorer, hook,
+        fit_params={"classes": [0.0, 1.0]},
+    )
+    # cursors diverged after round 2 and both models kept advancing
+    assert meta[0]["block_cursor"] != meta[1]["block_cursor"]
+    assert meta[0]["partial_fit_calls"] == 4  # 1 initial + 1 + 1 + 1
+    assert meta[1]["partial_fit_calls"] == 5  # 1 initial + 2 + 1 + 1
+    # diverged device models advanced as sequential singletons
+    late = [r for r in history if r["partial_fit_calls"] >= 4]
+    assert late and all(r["batch_size"] == 1 for r in late)
+    assert all(r["executor"] == "sequential" for r in late)

@@ -41,7 +41,7 @@ def test_logreg_memmap_matches_resident(tmp_path, clf_data, solver, penalty,
 
     X, y = clf_data
     Xmm = _memmap(tmp_path, X, f"X_{solver}.f32")
-    kw = dict(solver=solver, penalty=penalty, C=1.0, max_iter=200, tol=1e-8)
+    kw = dict(solver=solver, penalty=penalty, C=1.0, max_iter=80, tol=1e-7)
 
     resident = LogisticRegression(**kw).fit(X.copy(), y)
     with config.set(stream_block_rows=1000):
@@ -69,9 +69,9 @@ def test_linear_regression_memmap(tmp_path):
     y = (X @ w + 0.5 + 0.01 * rng.randn(n)).astype(np.float32)
     Xmm = _memmap(tmp_path, X, "Xlin.f32")
 
-    resident = LinearRegression(solver="lbfgs", max_iter=200, tol=1e-9).fit(X, y)
+    resident = LinearRegression(solver="lbfgs", max_iter=60, tol=1e-7).fit(X, y)
     with config.set(stream_block_rows=800):
-        streamed = LinearRegression(solver="lbfgs", max_iter=200, tol=1e-9).fit(Xmm, y)
+        streamed = LinearRegression(solver="lbfgs", max_iter=60, tol=1e-7).fit(Xmm, y)
     assert streamed.solver_info_["streamed"] is True
     np.testing.assert_allclose(streamed.coef_, resident.coef_,
                                rtol=1e-2, atol=1e-3)

@@ -181,3 +181,96 @@ def test_add_intercept():
 
     arr = add_intercept(np.zeros((3, 2)))
     np.testing.assert_array_equal(arr[:, 2], 1.0)
+
+
+def test_count_vectorizer_df_semantics_match_sklearn(monkeypatch):
+    """min_df/max_df/max_features apply to the MERGED vocabulary with
+    global document frequencies (VERDICT r2 missing #6) — parity with
+    sklearn on the concatenated corpus, across multiple blocks."""
+    import sklearn.feature_extraction.text as sktext
+
+    import dask_ml_tpu.feature_extraction.text as text_mod
+
+    corpus = [
+        "apple banana cherry", "apple banana", "apple cherry date",
+        "banana cherry", "apple", "date elderberry fig",
+        "fig grape apple", "banana grape", "cherry date fig grape",
+        "apple banana cherry date", "elderberry", "grape fig",
+    ]
+    orig_blocks = text_mod._blocks
+    monkeypatch.setattr(
+        text_mod, "_blocks",
+        lambda docs, block_size=3: orig_blocks(docs, 3),
+    )
+    for kw in (
+        dict(min_df=2),
+        dict(min_df=3),
+        dict(max_df=0.5),
+        dict(min_df=2, max_df=0.7),
+        dict(max_features=4),
+        dict(min_df=2, max_features=3),
+        dict(min_df=0.1, max_df=0.9),
+    ):
+        ours = text_mod.CountVectorizer(**kw).fit(corpus)
+        sk = sktext.CountVectorizer(**kw).fit(corpus)
+        assert ours.vocabulary_ == sk.vocabulary_, kw
+        # removed terms are exposed (sklearn 1.x dropped stop_words_)
+        assert ours.stop_words_.isdisjoint(ours.vocabulary_), kw
+        Xo = ours.transform(corpus)
+        Xs = sk.transform(corpus)
+        assert (Xo != Xs).nnz == 0, kw
+
+
+def test_count_vectorizer_all_pruned_raises():
+    from dask_ml_tpu.feature_extraction.text import CountVectorizer
+
+    # threshold inversion: sklearn-parity error
+    with pytest.raises(ValueError, match="max_df corresponds"):
+        CountVectorizer(min_df=10).fit(["one two", "three four"])
+    # every term unique and min_df=2: nothing survives pruning
+    with pytest.raises(ValueError, match="no terms remain"):
+        CountVectorizer(min_df=2).fit(
+            ["one two", "three four", "five six", "seven eight"]
+        )
+
+
+def test_sketched_quantiles_parity_at_1m_rows():
+    """Histogram-sketch quantiles within tolerance of exact at 1e6 rows
+    (VERDICT r2 missing #7)."""
+    from dask_ml_tpu.parallel import as_sharded
+    from dask_ml_tpu.preprocessing.data import _masked_quantiles
+
+    rng = np.random.RandomState(0)
+    X = np.stack([
+        rng.randn(1_000_000),
+        rng.exponential(2.0, 1_000_000),
+        rng.uniform(-5, 5, 1_000_000),
+    ], axis=1).astype(np.float32)
+    Xs = as_sharded(X)
+    qs = [0.25, 0.5, 0.75]
+    exact = np.asarray(_masked_quantiles(Xs, qs, sketch=False))
+    sketch = np.asarray(_masked_quantiles(Xs, qs, sketch=True))
+    # error bound: one bin width = (max-min)/4096 per column
+    bin_w = (X.max(axis=0) - X.min(axis=0)) / 4096
+    assert np.all(np.abs(sketch - exact) <= bin_w[None, :] + 1e-6)
+    # auto dispatch: exactly 1M rows is still exact; above goes sketch
+    auto = np.asarray(_masked_quantiles(Xs, qs))
+    np.testing.assert_allclose(auto, exact, atol=1e-6)
+
+
+def test_robust_scaler_sketch_matches_exact_at_scale():
+    from dask_ml_tpu.parallel import as_sharded
+    from dask_ml_tpu.preprocessing import RobustScaler
+
+    rng = np.random.RandomState(1)
+    X = (rng.randn(1_200_000, 2) * [2.0, 0.5] + [1.0, -3.0]).astype(
+        np.float32
+    )
+    scaler = RobustScaler().fit(as_sharded(X))  # auto: sketch path
+    import numpy as _np
+
+    center_exact = _np.median(X, axis=0)
+    scale_exact = (_np.percentile(X, 75, axis=0)
+                   - _np.percentile(X, 25, axis=0))
+    np.testing.assert_allclose(scaler.center_, center_exact, atol=2e-2)
+    np.testing.assert_allclose(scaler.scale_, scale_exact, rtol=2e-2)
