@@ -82,6 +82,11 @@ def train_test_split(*arrays, test_size=None, train_size=None,
         blockwise = False  # contiguous split needs no per-block handling
     rng = np.random.RandomState(random_state)
     first = arrays[0]
+    from ..parallel.frames import PartitionedFrame
+
+    if isinstance(first, PartitionedFrame):
+        return _split_frames(arrays, test_size, train_size, rng, shuffle,
+                             blockwise)
     n = first.n_rows if isinstance(first, ShardedArray) else len(first)
     for a in arrays:
         na = a.n_rows if isinstance(a, ShardedArray) else len(a)
@@ -94,8 +99,15 @@ def train_test_split(*arrays, test_size=None, train_size=None,
         )
     else:
         n_train, n_test = _validate_sizes(n, test_size, train_size)
-        idx = rng.permutation(n) if shuffle else np.arange(n)
-        test_idx, train_idx = idx[:n_test], idx[n_test:n_test + n_train]
+        if shuffle:
+            idx = rng.permutation(n)
+            test_idx, train_idx = idx[:n_test], idx[n_test:n_test + n_train]
+        else:
+            # sklearn contract: unshuffled split is train = LEADING rows,
+            # test = trailing (the chronological-holdout idiom)
+            idx = np.arange(n)
+            train_idx = idx[:n_train]
+            test_idx = idx[n_train:n_train + n_test]
 
     out = []
     for a in arrays:
@@ -104,6 +116,62 @@ def train_test_split(*arrays, test_size=None, train_size=None,
         else:
             a = np.asarray(a)
             out.extend([a[train_idx], a[test_idx]])
+    return out
+
+
+def _split_frames(arrays, test_size, train_size, rng, shuffle, blockwise):
+    """train_test_split over PartitionedFrames. ``blockwise=True`` (the
+    reference's default for dd): each partition splits its own rows — no
+    global shuffle crosses partitions. ``blockwise=False``: a global
+    permutation over the concatenated frame, re-partitioned afterwards."""
+    from ..parallel.frames import PartitionedFrame
+
+    first = arrays[0]
+    part_lens = [len(p) for p in first.partitions]
+    for a in arrays:
+        if not isinstance(a, PartitionedFrame) or \
+                [len(p) for p in a.partitions] != part_lens:
+            raise ValueError(
+                "all arrays must be PartitionedFrames with identical "
+                "partition lengths"
+            )
+    if blockwise:
+        train_ix, test_ix = [], []
+        for m in part_lens:
+            if m == 0:  # empty partitions contribute nothing to either
+                train_ix.append(np.arange(0))
+                test_ix.append(np.arange(0))
+                continue
+            n_train, n_test = _validate_sizes(m, test_size, train_size)
+            idx = rng.permutation(m) if shuffle else np.arange(m)
+            test_ix.append(idx[:n_test])
+            train_ix.append(idx[n_test:n_test + n_train])
+        out = []
+        for a in arrays:
+            out.append(PartitionedFrame([
+                p.iloc[ix] for p, ix in zip(a.partitions, train_ix)
+            ]))
+            out.append(PartitionedFrame([
+                p.iloc[ix] for p, ix in zip(a.partitions, test_ix)
+            ]))
+        return out
+    n = sum(part_lens)
+    n_train, n_test = _validate_sizes(n, test_size, train_size)
+    if shuffle:
+        idx = rng.permutation(n)
+        test_idx, train_idx = idx[:n_test], idx[n_test:n_test + n_train]
+    else:
+        # sklearn contract: unshuffled split is train = LEADING rows,
+        # test = trailing (the chronological-holdout idiom)
+        idx = np.arange(n)
+        train_idx, test_idx = idx[:n_train], idx[n_train:n_train + n_test]
+    out = []
+    for a in arrays:
+        host = a.compute()
+        out.append(PartitionedFrame.from_pandas(
+            host.iloc[train_idx], a.npartitions))
+        out.append(PartitionedFrame.from_pandas(
+            host.iloc[test_idx], a.npartitions))
     return out
 
 

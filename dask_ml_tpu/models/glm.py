@@ -169,10 +169,15 @@ class _GLMBase(BaseEstimator):
         )
         kwargs = dict(self.solver_kwargs or {})
         l1_ratio = kwargs.pop("l1_ratio", 0.5)
-        from ..utils.observability import active_logger, fit_logger
+        from ..utils.observability import (
+            active_logger, fit_logger, jit_callbacks_supported,
+        )
 
         with fit_logger(type(self).__name__, solver=self.solver,
                         n_rows=X.n_rows) as logger, active_logger(logger):
+            # per-step callbacks need backend support (axon PJRT lacks
+            # host callbacks); degrade to one summary record per fit
+            log_steps = logger is not None and jit_callbacks_supported()
             beta, info = solve(
                 self.solver,
                 X=data, y=y_data, mask=X.row_mask(dtype=jnp.float32),
@@ -180,8 +185,12 @@ class _GLMBase(BaseEstimator):
                 reg=self.penalty, lam=jnp.asarray(lam, jnp.float32),
                 pmask=jnp.asarray(pmask), l1_ratio=l1_ratio,
                 max_iter=self.max_iter, tol=self.tol, mesh=mesh,
-                log=logger is not None, **kwargs,
+                log=log_steps, **kwargs,
             )
+            if logger is not None and not log_steps:
+                logger.log(step=info.get("n_iter"), summary=True,
+                           **{k: v for k, v in info.items()
+                              if isinstance(v, (int, float))})
         return self._finish_fit(to_host(beta), classes, info, X.shape[1])
 
     def _coef_flat(self):

@@ -81,14 +81,20 @@ def _lloyd_run_pallas(X, mask, centers0, max_iter, tol2, mesh,
     from jax.sharding import PartitionSpec as P
 
     from ..ops.linalg import shard_map
-    from ..ops.pallas_fused import fused_assign_update
+    from ..ops.pallas_fused import fused_lloyd_stats
     from ..parallel.mesh import DATA_AXIS
 
     k = centers0.shape[0]
 
     def shard_step(xs, ms, c):
-        _, _, sums, counts, _ = fused_assign_update(
-            xs, ms, c, interpret=interpret
+        # per-shard valid-row count (valid rows are a prefix of each
+        # shard's padded rows by construction) — the stats-only kernel
+        # takes this scalar instead of an (n, 1) mask operand whose TPU
+        # layout would pad 128x in HBM. Integer sum: an f32 accumulator
+        # saturates at 2^24 rows, silently dropping rows past 16.7M
+        nv = jnp.sum(ms.astype(jnp.int32))
+        sums, counts, _ = fused_lloyd_stats(
+            xs, nv, c, interpret=interpret
         )
         return (jax.lax.psum(sums, DATA_AXIS),
                 jax.lax.psum(counts, DATA_AXIS))
@@ -522,22 +528,30 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         use_pallas = self.use_pallas
         if use_pallas is None:  # auto: fused kernel on real TPU only
             use_pallas = jax.default_backend() == "tpu"
-        from ..utils.observability import active_logger, fit_logger
+        from ..utils.observability import (
+            active_logger, fit_logger, jit_callbacks_supported,
+        )
 
         with fit_logger("KMeans", n_rows=X.n_rows,
                         n_clusters=self.n_clusters) as logger, \
                 active_logger(logger):
+            # per-step callbacks need backend support (axon PJRT lacks
+            # host callbacks); degrade to one summary record per fit
+            log_steps = logger is not None and jit_callbacks_supported()
             if use_pallas:
-                centers, n_iter, _ = _lloyd_run_pallas(
+                centers, n_iter, shift2 = _lloyd_run_pallas(
                     X.data, mask, centers0, jnp.asarray(self.max_iter), tol2,
                     X.mesh, interpret=jax.default_backend() != "tpu",
-                    log=logger is not None,
+                    log=log_steps,
                 )
             else:
-                centers, n_iter, _ = _lloyd_run(
+                centers, n_iter, shift2 = _lloyd_run(
                     X.data, mask, centers0, jnp.asarray(self.max_iter), tol2,
-                    log=logger is not None,
+                    log=log_steps,
                 )
+            if logger is not None and not log_steps:
+                logger.log(step=int(n_iter), center_shift2=float(shift2),
+                           summary=True)
             # active_logger's exit runs jax.effects_barrier(), draining
             # the per-iteration callbacks before the sink unbinds
         labels, inertia = _labels_inertia(X.data, mask, centers)

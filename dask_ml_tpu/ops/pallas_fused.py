@@ -83,6 +83,91 @@ def _assign_update_kernel(x_ref, m_ref, c_ref, c2_ref, labels_ref, mind_ref,
     counts_ref[:] += jnp.sum(onehot, axis=0, keepdims=True)
 
 
+def _lloyd_stats_kernel(x_ref, nv_ref, c_ref, c2_ref, sums_ref, counts_ref,
+                        inertia_ref, *, tile):
+    i = pl.program_id(0)
+    x = x_ref[:]                       # (tile, d)
+    c = c_ref[:]                       # (k, d)
+    c2 = c2_ref[:]                     # (1, k)
+    k = c.shape[0]
+    # row validity from the GLOBAL row index (valid rows are a prefix of
+    # the padded array by construction) — no (n, 1) mask operand, whose
+    # T(8,128) layout would pad 128× in HBM
+    row_ids = jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], 1), 0) \
+        + i * tile
+    m = (row_ids < nv_ref[0, 0]).astype(jnp.float32)    # (tile, 1) VMEM
+    xc = jax.lax.dot_general(
+        x, c, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    d2 = jnp.sum(x * x, axis=1, keepdims=True) - 2.0 * xc + c2
+    d2 = jnp.maximum(d2, 0.0)
+    mind = jnp.min(d2, axis=1, keepdims=True)
+    iota = jax.lax.broadcasted_iota(
+        jnp.int32, (x.shape[0], k), 1
+    ).astype(jnp.float32)
+    labf = jnp.min(jnp.where(d2 <= mind, iota, float(k)), axis=1,
+                   keepdims=True)
+    onehot = (iota == labf).astype(jnp.float32) * m
+
+    @pl.when(i == 0)
+    def _init():
+        sums_ref[:] = jnp.zeros_like(sums_ref)
+        counts_ref[:] = jnp.zeros_like(counts_ref)
+        inertia_ref[:] = jnp.zeros_like(inertia_ref)
+
+    sums_ref[:] += jax.lax.dot_general(
+        onehot, x, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    counts_ref[:] += jnp.sum(onehot, axis=0, keepdims=True)
+    inertia_ref[:] += jnp.sum(mind * m, axis=0, keepdims=True)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_lloyd_stats(x, n_valid, centers, interpret=False):
+    """Lloyd-iteration statistics WITHOUT per-row outputs: returns only
+    (sums (k, d), counts (k,), inertia scalar). The full kernel's
+    per-row labels/min-d2 outputs are (n, 1) arrays whose TPU tiled
+    layout T(8,128) pads them 128× in HBM (~512 B/row) — at 10⁷+ rows
+    that alone OOMs the chip, and the Lloyd loop never reads them. Row
+    validity rides in as one scalar (valid rows are a prefix of the
+    padded block)."""
+    n, d = x.shape
+    k = centers.shape[0]
+    x = x.astype(jnp.float32)
+    centers = centers.astype(jnp.float32)
+    tile = _pick_tile(n)
+    n_pad = -(-n // tile) * tile
+    if n_pad != n:
+        x = jnp.pad(x, ((0, n_pad - n), (0, 0)))
+    grid = (n_pad // tile,)
+    c2 = jnp.sum(centers * centers, axis=1)[None, :]
+    nv = jnp.asarray(n_valid, jnp.int32).reshape(1, 1)
+    sums, counts, inertia = pl.pallas_call(
+        functools.partial(_lloyd_stats_kernel, tile=tile),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+            pl.BlockSpec((k, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, k), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((k, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, k), lambda i: (0, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((k, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, k), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, nv, centers, c2)
+    return sums, counts[0], inertia[0, 0]
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def fused_assign_update(x, mask, centers, interpret=False):
     """One Lloyd-iteration data pass over a (per-device) block.

@@ -1,5 +1,6 @@
 from . import distributed
+from .frames import PartitionedFrame, from_pandas
 from .mesh import (DATA_AXIS, MODEL_AXIS, default_mesh, device_mesh,
                    resolve_mesh, use_mesh)
 from .sharded import ShardedArray, as_sharded, reshard, row_mask, take_rows
-from .streaming import Block, BlockStream
+from .streaming import Block, BlockStream, stream_plan, streamed_map

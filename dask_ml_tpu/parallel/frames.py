@@ -1,0 +1,152 @@
+"""Row-partitioned DataFrame substrate — the host-side scale-out frame
+story.
+
+Reference: ``dask.dataframe`` (SURVEY.md §1 L2: "dd.DataFrame — the data
+type every dask-ml estimator consumes"). The reference's frame layer is a
+task graph of pandas partitions with map_partitions + shuffle/reduce; the
+TPU-native stack keeps frames HOST-side (TPUs have no string/categorical
+kernels — SURVEY.md §7 "Sparse"/dtype notes): a
+:class:`PartitionedFrame` is a list of pandas partitions with
+
+- ``map_partitions`` fanned over a thread pool (pandas' C kernels release
+  the GIL, so partitions genuinely overlap),
+- controller-side reductions for global statistics (category unions,
+  lengths) — the same map/reduce shape as dd without a scheduler,
+- ``to_sharded``: the bridge that places the numeric columns on the
+  device mesh as a ShardedArray, where the estimator stack takes over.
+
+Categorizer/DummyEncoder/OrdinalEncoder consume this type partition-wise
+with GLOBAL categories, matching the reference's dd behavior.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pandas as pd
+
+__all__ = ["PartitionedFrame", "from_pandas"]
+
+_MAX_WORKERS = 8
+
+
+class PartitionedFrame:
+    """A logically concatenated DataFrame stored as row partitions."""
+
+    def __init__(self, partitions):
+        partitions = list(partitions)
+        if not partitions:
+            raise ValueError("PartitionedFrame needs >= 1 partition")
+        cols = partitions[0].columns
+        for p in partitions[1:]:
+            if not p.columns.equals(cols):
+                raise ValueError("partitions have mismatched columns")
+        self.partitions = partitions
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def from_pandas(cls, df: pd.DataFrame, npartitions: int = 8):
+        n = len(df)
+        npartitions = max(1, min(npartitions, n or 1))
+        bounds = np.linspace(0, n, npartitions + 1, dtype=int)
+        return cls([
+            df.iloc[lo:hi] for lo, hi in zip(bounds[:-1], bounds[1:])
+            if hi > lo
+        ] or [df])
+
+    # -- metadata ----------------------------------------------------------
+    @property
+    def npartitions(self):
+        return len(self.partitions)
+
+    @property
+    def columns(self):
+        return self.partitions[0].columns
+
+    @property
+    def dtypes(self):
+        return self.partitions[0].dtypes
+
+    def __len__(self):
+        return sum(len(p) for p in self.partitions)
+
+    def __repr__(self):
+        return (f"PartitionedFrame(npartitions={self.npartitions}, "
+                f"n_rows={len(self)}, columns={list(self.columns)})")
+
+    # -- partition-parallel ops -------------------------------------------
+    def map_partitions(self, fn, *args, **kwargs):
+        """Apply ``fn(partition, *args, **kwargs)`` to every partition
+        concurrently. DataFrame results re-wrap as a PartitionedFrame;
+        anything else returns the list of per-partition results."""
+        if len(self.partitions) == 1:
+            results = [fn(self.partitions[0], *args, **kwargs)]
+        else:
+            with ThreadPoolExecutor(
+                max_workers=min(_MAX_WORKERS, len(self.partitions))
+            ) as pool:
+                results = list(pool.map(
+                    lambda p: fn(p, *args, **kwargs), self.partitions
+                ))
+        if all(isinstance(r, pd.DataFrame) for r in results):
+            return PartitionedFrame(results)
+        return results
+
+    def reduce_partitions(self, map_fn, reduce_fn):
+        """map over partitions + controller-side reduce — the dd
+        tree-reduce shape for global statistics."""
+        return reduce_fn(self.map_partitions(map_fn))
+
+    # -- pandas-surface subset --------------------------------------------
+    def __getitem__(self, key):
+        if isinstance(key, (list, pd.Index)):
+            return PartitionedFrame([p[list(key)] for p in self.partitions])
+        return pd.concat([p[key] for p in self.partitions])  # one Series
+
+    def assign(self, **kwargs):
+        return self.map_partitions(lambda p: p.assign(**kwargs))
+
+    def compute(self) -> pd.DataFrame:
+        """Materialize the single concatenated pandas DataFrame."""
+        return pd.concat(self.partitions, axis=0)
+
+    # -- global categorical support ---------------------------------------
+    def global_categories(self, columns):
+        """Per-column category union across ALL partitions (the
+        reference's distributed ``.cat`` known-categories build)."""
+        def part_cats(p):
+            return {c: pd.unique(p[c].dropna()) for c in columns}
+
+        parts = self.map_partitions(part_cats)
+        out = {}
+        for c in columns:
+            vals = pd.unique(np.concatenate([
+                np.asarray(d[c], dtype=object) for d in parts
+            ])) if parts else []
+            out[c] = pd.CategoricalDtype(vals)
+        return out
+
+    # -- device bridge -----------------------------------------------------
+    def to_sharded(self, mesh=None, dtype=np.float32, columns=None):
+        """Place the (numeric) columns onto the device mesh as a
+        ShardedArray — the frame→array handoff where TPU compute begins.
+        Categorical columns must be encoded first (OrdinalEncoder /
+        DummyEncoder)."""
+        from .sharded import ShardedArray
+
+        cols = list(columns) if columns is not None else [
+            c for c in self.columns
+            if np.issubdtype(self.dtypes[c], np.number)
+            or self.dtypes[c] == bool
+        ]
+        if not cols:
+            raise ValueError("no numeric columns to place on device")
+        host = np.concatenate([
+            p[cols].to_numpy(dtype=dtype) for p in self.partitions
+        ], axis=0)
+        return ShardedArray.from_array(host, mesh=mesh, dtype=dtype)
+
+
+def from_pandas(df: pd.DataFrame, npartitions: int = 8) -> PartitionedFrame:
+    return PartitionedFrame.from_pandas(df, npartitions)

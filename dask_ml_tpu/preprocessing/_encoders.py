@@ -138,6 +138,12 @@ class OrdinalEncoder(TransformerMixin, BaseEstimator):
         self.dtype = dtype
 
     def fit(self, X, y=None):
+        from ..parallel.frames import PartitionedFrame
+
+        if isinstance(X, PartitionedFrame):
+            # post-Categorizer partitions share GLOBAL categorical dtypes,
+            # so the first partition carries everything fit needs
+            return self.fit(X.partitions[0])
         if isinstance(X, pd.DataFrame):
             self.categorical_columns_ = [
                 c for c in X.columns
@@ -164,6 +170,10 @@ class OrdinalEncoder(TransformerMixin, BaseEstimator):
 
     def transform(self, X):
         check_is_fitted(self, "categories_")
+        from ..parallel.frames import PartitionedFrame
+
+        if isinstance(X, PartitionedFrame):
+            return X.map_partitions(self.transform)
         if isinstance(X, pd.DataFrame):
             out = X.copy()
             for c in self.categorical_columns_:
@@ -190,8 +200,14 @@ class Categorizer(TransformerMixin, BaseEstimator):
         self.columns = columns
 
     def fit(self, X, y=None):
+        from ..parallel.frames import PartitionedFrame
+
+        if isinstance(X, PartitionedFrame):
+            return self._fit_partitioned(X)
         if not isinstance(X, pd.DataFrame):
-            raise TypeError("Categorizer requires a pandas DataFrame")
+            raise TypeError(
+                "Categorizer requires a pandas DataFrame or PartitionedFrame"
+            )
         columns = self.columns
         if columns is None:
             # object (pandas<3) or str/string (pandas>=3) or categorical
@@ -215,8 +231,46 @@ class Categorizer(TransformerMixin, BaseEstimator):
         self.columns_ = pd.Index(columns)
         return self
 
+    def _fit_partitioned(self, X):
+        """Global category union across partitions — the reference's
+        distributed known-categories build (dd ``.cat.as_known()``)."""
+        columns = self.columns
+        if columns is None:
+            columns = [
+                c for c in X.columns
+                if pd.api.types.is_object_dtype(X.dtypes[c])
+                or pd.api.types.is_string_dtype(X.dtypes[c])
+                or isinstance(X.dtypes[c], pd.CategoricalDtype)
+            ]
+        fixed = {
+            c: (self.categories[c] if self.categories is not None
+                and c in self.categories else None)
+            for c in columns
+        }
+        need_global = [
+            c for c in columns
+            if fixed[c] is None
+            and not isinstance(X.dtypes[c], pd.CategoricalDtype)
+        ]
+        global_cats = X.global_categories(need_global) if need_global else {}
+        categories = {}
+        for c in columns:
+            if fixed[c] is not None:
+                categories[c] = fixed[c]
+            elif isinstance(X.dtypes[c], pd.CategoricalDtype):
+                categories[c] = X.dtypes[c]
+            else:
+                categories[c] = global_cats[c]
+        self.categories_ = categories
+        self.columns_ = pd.Index(columns)
+        return self
+
     def transform(self, X, y=None):
         check_is_fitted(self, "categories_")
+        from ..parallel.frames import PartitionedFrame
+
+        if isinstance(X, PartitionedFrame):
+            return X.map_partitions(self.transform)
         X = X.copy()
         for c, dtype in self.categories_.items():
             X[c] = X[c].astype(dtype)
@@ -232,8 +286,16 @@ class DummyEncoder(TransformerMixin, BaseEstimator):
         self.drop_first = drop_first
 
     def fit(self, X, y=None):
+        from ..parallel.frames import PartitionedFrame
+
+        if isinstance(X, PartitionedFrame):
+            # post-Categorizer partitions share GLOBAL categorical dtypes
+            return self.fit(X.partitions[0])
         if not isinstance(X, pd.DataFrame):
-            raise TypeError("DummyEncoder requires a pandas DataFrame")
+            raise TypeError(
+                "DummyEncoder requires a pandas DataFrame or "
+                "PartitionedFrame"
+            )
         columns = self.columns
         if columns is None:
             columns = [
@@ -261,6 +323,10 @@ class DummyEncoder(TransformerMixin, BaseEstimator):
 
     def transform(self, X, y=None):
         check_is_fitted(self, "columns_")
+        from ..parallel.frames import PartitionedFrame
+
+        if isinstance(X, PartitionedFrame):
+            return X.map_partitions(self.transform)
         out = pd.get_dummies(X, columns=list(self.columns_),
                              drop_first=self.drop_first)
         return out.reindex(columns=self.transformed_columns_, fill_value=0)

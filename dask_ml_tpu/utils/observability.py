@@ -111,6 +111,29 @@ def emit_jit_step(step, **metrics):
     )
 
 
+_callbacks_supported = None
+
+
+def jit_callbacks_supported() -> bool:
+    """Whether the active backend can run host callbacks from compiled
+    code. Some TPU runtimes (axon PJRT) cannot — per-step jit logging
+    must then degrade to one summary record per fit instead of crashing
+    the solve. Probed once with a tiny program."""
+    global _callbacks_supported
+    if _callbacks_supported is None:
+        try:
+            def probe(x):
+                jax.debug.callback(lambda v: None, x)
+                return x + 1
+
+            jax.block_until_ready(jax.jit(probe)(0))
+            jax.effects_barrier()
+            _callbacks_supported = True
+        except Exception:
+            _callbacks_supported = False
+    return _callbacks_supported
+
+
 @contextlib.contextmanager
 def fit_logger(component, **extra):
     """Per-fit MetricsLogger bound to ``config.metrics_path``; yields None

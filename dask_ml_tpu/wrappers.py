@@ -73,7 +73,23 @@ class ParallelPostFit(BaseEstimator):
         if _is_device_estimator(est):
             return getattr(est, method)(X)
         mesh = X.mesh if isinstance(X, ShardedArray) else None
-        parts = [getattr(est, method)(b) for b in _host_blocks(X)]
+        # blocks are SLICES of one host buffer (views, not copies), so
+        # listing them costs nothing beyond the to_numpy pull a host
+        # estimator needs anyway
+        blocks = list(_host_blocks(X))
+        fn = getattr(est, method)
+        if len(blocks) > 1:
+            # the reference's map_blocks runs post-fit blocks on parallel
+            # workers; here a thread pool over the host estimator's
+            # (read-only, GIL-releasing sklearn C kernels) per-block calls
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                max_workers=min(8, len(blocks))
+            ) as pool:
+                parts = list(pool.map(fn, blocks))
+        else:
+            parts = [fn(b) for b in blocks]
         out = np.concatenate(parts, axis=0)
         return as_sharded(out, mesh=mesh) if mesh is not None else out
 

@@ -86,3 +86,16 @@ def test_shuffle_split(data):
     assert ss.get_n_splits() == 3
     tr, te = folds[0]
     assert not set(tr) & set(te)
+
+
+def test_unshuffled_split_is_train_leading():
+    """sklearn contract: shuffle=False gives train = leading rows, test =
+    trailing (the chronological-holdout idiom)."""
+    import numpy as np
+
+    from dask_ml_tpu.model_selection import train_test_split
+
+    X = np.arange(100)[:, None].astype(np.float32)
+    Xtr, Xte = train_test_split(X, test_size=0.25, shuffle=False)
+    assert Xtr[0, 0] == 0 and Xtr[-1, 0] == 74
+    assert Xte[0, 0] == 75 and Xte[-1, 0] == 99

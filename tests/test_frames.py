@@ -1,0 +1,126 @@
+"""PartitionedFrame — the scale-out frame substrate (SURVEY.md §1 L2:
+the reference's dd.DataFrame role). Global-category correctness across
+partitions is the load-bearing property: a category seen in only ONE
+partition must appear in every partition's dtype."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from dask_ml_tpu.parallel import PartitionedFrame, from_pandas
+
+
+@pytest.fixture()
+def df():
+    rng = np.random.RandomState(0)
+    n = 200
+    return pd.DataFrame({
+        "a": rng.randn(n),
+        "b": rng.randint(0, 5, n).astype(np.int64),
+        "c": np.where(rng.rand(n) < 0.5, "x", "y"),
+    })
+
+
+def test_roundtrip_and_metadata(df):
+    pf = from_pandas(df, npartitions=4)
+    assert pf.npartitions == 4
+    assert len(pf) == len(df)
+    assert list(pf.columns) == ["a", "b", "c"]
+    pd.testing.assert_frame_equal(pf.compute(), df)
+
+
+def test_map_partitions_and_getitem(df):
+    pf = from_pandas(df, npartitions=4)
+    doubled = pf.map_partitions(lambda p: p.assign(a=p.a * 2))
+    np.testing.assert_allclose(doubled.compute()["a"], df["a"] * 2)
+    sub = pf[["a", "b"]]
+    assert list(sub.columns) == ["a", "b"]
+    lens = pf.map_partitions(len)
+    assert sum(lens) == len(df)
+
+
+def test_global_categories_cross_partition(df):
+    # "z" exists ONLY in the last partition
+    df = df.copy()
+    df.iloc[-1, df.columns.get_loc("c")] = "z"
+    pf = from_pandas(df, npartitions=4)
+    from dask_ml_tpu.preprocessing import Categorizer
+
+    cat = Categorizer().fit(pf)
+    out = cat.transform(pf)
+    for p in out.partitions:
+        assert set(p["c"].cat.categories) == {"x", "y", "z"}
+    # parity with the single-frame pandas path
+    single = Categorizer().fit(df)
+    assert set(single.categories_["c"].categories) == \
+        set(cat.categories_["c"].categories)
+
+
+def test_dummy_and_ordinal_over_partitions(df):
+    from dask_ml_tpu.preprocessing import (
+        Categorizer, DummyEncoder, OrdinalEncoder,
+    )
+
+    pf = from_pandas(df, npartitions=4)
+    cat_pf = Categorizer().fit(pf).transform(pf)
+    # DummyEncoder: partitioned result equals pandas result
+    enc = DummyEncoder().fit(cat_pf)
+    out = enc.transform(cat_pf)
+    ref_df = Categorizer().fit(df).transform(df)
+    ref = DummyEncoder().fit(ref_df).transform(ref_df)
+    pd.testing.assert_frame_equal(out.compute(), ref)
+    # OrdinalEncoder: codes agree with pandas path
+    out2 = OrdinalEncoder().fit(cat_pf).transform(cat_pf)
+    ref2 = OrdinalEncoder().fit(ref_df).transform(ref_df)
+    pd.testing.assert_frame_equal(out2.compute(), ref2)
+
+
+def test_to_sharded_bridge_end_to_end(df):
+    """frame → categorize → dummy-encode → device array → GLM fit: the
+    full frame-to-TPU pipeline."""
+    from dask_ml_tpu.linear_model import LogisticRegression
+    from dask_ml_tpu.parallel import ShardedArray, as_sharded
+    from dask_ml_tpu.preprocessing import Categorizer, DummyEncoder
+
+    pf = from_pandas(df, npartitions=4)
+    enc = DummyEncoder()
+    cat_pf = Categorizer().fit(pf).transform(pf)
+    feats = enc.fit(cat_pf).transform(cat_pf)
+    Xs = feats.to_sharded()
+    assert isinstance(Xs, ShardedArray)
+    assert Xs.shape == (len(df), len(enc.transformed_columns_))
+    y = (df["a"] > 0).astype(np.float32).to_numpy()
+    clf = LogisticRegression(solver="lbfgs", max_iter=30).fit(
+        Xs, as_sharded(y)
+    )
+    assert clf.score(Xs, as_sharded(y)) > 0.9
+
+
+def test_train_test_split_frames(df):
+    from dask_ml_tpu.model_selection import train_test_split
+
+    pf = from_pandas(df, npartitions=4)
+    y = from_pandas(df[["b"]], npartitions=4)
+    tr, te, ytr, yte = train_test_split(pf, y, test_size=0.25,
+                                        random_state=0)
+    assert isinstance(tr, PartitionedFrame)
+    assert len(tr) + len(te) == len(df)
+    assert len(ytr) == len(tr) and len(yte) == len(te)
+    # blockwise: every partition contributed to both sides
+    assert all(len(p) for p in tr.partitions)
+    assert all(len(p) for p in te.partitions)
+    # disjoint rows (index-based)
+    assert not set(tr.compute().index) & set(te.compute().index)
+
+    # global (non-blockwise) split also works
+    tr2, te2 = train_test_split(pf, test_size=0.25, blockwise=False,
+                                random_state=0)
+    assert len(tr2) + len(te2) == len(df)
+
+    with pytest.raises(ValueError, match="identical partition"):
+        train_test_split(pf, from_pandas(df, npartitions=3))
+
+
+def test_mismatched_partitions_rejected(df):
+    with pytest.raises(ValueError, match="mismatched columns"):
+        PartitionedFrame([df[["a"]], df[["b"]]])
