@@ -15,24 +15,11 @@ import math
 import numpy as np
 
 from ..base import clone
-from ._incremental import BaseIncrementalSearchCV
+from ._incremental import (
+    BaseIncrementalSearchCV, disable_process_distribution,
+    host_view_estimator,
+)
 from ._successive_halving import SuccessiveHalvingSearchCV
-
-
-def _host_estimator(est):
-    """Replace any device-array attributes with host numpy so the model
-    pickles across the process-gather channel (and stays usable — every
-    consumer re-coerces with jnp.asarray)."""
-    import jax
-
-    from ..base import to_host
-
-    if est is None:
-        return est
-    for k, v in list(vars(est).items()):
-        if isinstance(v, jax.Array):
-            setattr(est, k, to_host(v))
-    return est
 
 
 def _brackets(max_iter, eta):
@@ -139,13 +126,16 @@ class HyperbandSearchCV(BaseIncrementalSearchCV):
                 prefix=f"{self.prefix}bracket={s}",
             )
             try:
-                if placement_mesh is not None:
-                    from ..parallel.mesh import use_mesh
+                # bracket-level distribution: the inner SHA must not also
+                # distribute its candidates (peers run OTHER brackets)
+                with disable_process_distribution():
+                    if placement_mesh is not None:
+                        from ..parallel.mesh import use_mesh
 
-                    with use_mesh(placement_mesh):
+                        with use_mesh(placement_mesh):
+                            sha.fit(X, y, **fit_params)
+                    else:
                         sha.fit(X, y, **fit_params)
-                else:
-                    sha.fit(X, y, **fit_params)
             except Exception as e:
                 if n_proc == 1:
                     raise
@@ -160,7 +150,7 @@ class HyperbandSearchCV(BaseIncrementalSearchCV):
                 "results": dict(sha.cv_results_),
                 "best_score": sha.best_score_,
                 "best_params": sha.best_params_,
-                "best_estimator": _host_estimator(sha.best_estimator_),
+                "best_estimator": host_view_estimator(sha.best_estimator_),
             }
 
         if n_proc > 1:

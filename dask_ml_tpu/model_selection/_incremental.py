@@ -99,6 +99,42 @@ def _supports_batch(model) -> bool:
         hasattr(model, "_batch_key")
 
 
+def host_view_estimator(est):
+    """Replace any device-array attributes with host numpy so the model
+    pickles across the process-gather channel (and stays usable — every
+    consumer re-coerces with jnp.asarray)."""
+    import jax
+
+    from ..base import to_host
+
+    if est is None:
+        return est
+    for k, v in list(vars(est).items()):
+        if isinstance(v, jax.Array):
+            setattr(est, k, to_host(v))
+    return est
+
+
+# Hyperband distributes whole brackets across processes; the SHA fits it
+# runs per bracket must NOT additionally distribute their candidates (the
+# peers are busy with other brackets — a nested allgather would deadlock).
+_dist_disabled = False
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def disable_process_distribution():
+    global _dist_disabled
+    prev = _dist_disabled
+    _dist_disabled = True
+    try:
+        yield
+    finally:
+        _dist_disabled = prev
+
+
 def fit(model_factory, params_list, train_blocks, X_test, y_test, scorer,
         additional_calls, fit_params=None, patience=False, tol=1e-3,
         max_iter=None, prefix="", verbose=False, checkpoint=None,
@@ -141,6 +177,86 @@ def _fit(model_factory, params_list, train_blocks, X_test, y_test, scorer,
     info = {}
     start = time.time()
     n_blocks = len(train_blocks)
+    # Multi-process candidate distribution (SURVEY.md §3.5 'trials pinned
+    # to hosts'): model mid is OWNED by process (mid % n_proc); each
+    # round every process trains/scores only its models, then one
+    # object-allgather merges the round's records so the adaptive
+    # decisions (additional_calls, patience, budget caps) are computed
+    # identically everywhere from identical info.
+    import jax as _jax
+
+    n_proc = 1 if _dist_disabled else _jax.process_count()
+    pid = _jax.process_index() if n_proc > 1 else 0
+    placement_mesh = None
+    if n_proc > 1:
+        # per-process partial model state is not round-resumable
+        checkpoint = None
+        ckpt_token = None
+        # owned candidates run on THIS process's local-device mesh: a
+        # device estimator would otherwise dispatch global-mesh
+        # collectives its peers (busy with their own candidates) never
+        # enter — a silent deadlock (same placement rule as Hyperband's
+        # bracket distribution)
+        from ..parallel.distributed import local_mesh
+
+        placement_mesh = local_mesh()
+
+    def _owned(mid):
+        return n_proc == 1 or mid % n_proc == pid
+
+    pending = []  # this round's records, exchanged at the round barrier
+
+    def sync_round(exc=None):
+        if n_proc == 1:
+            if exc is not None:
+                raise exc
+            return
+        from ..parallel.distributed import allgather_object
+
+        payload = {
+            "records": list(pending),
+            "meta": {mid: {k: meta[mid][k] for k in
+                           ("partial_fit_calls", "block_cursor", "score")}
+                     for mid in meta if _owned(mid)},
+            "error": None if exc is None else repr(exc),
+        }
+        pending.clear()
+        parts = allgather_object(payload)
+        if exc is not None:
+            raise exc
+        bad = [p["error"] for p in parts if p["error"] is not None]
+        if bad:
+            raise RuntimeError(
+                f"peer process failed during distributed adaptive "
+                f"search: {bad}"
+            )
+        merged = [r for p in parts for r in p["records"]]
+        merged.sort(key=lambda r: (r["partial_fit_calls"], r["model_id"]))
+        for rec in merged:
+            history.append(rec)
+            info[rec["model_id"]].append(rec)
+        for p in parts:
+            for mid, m in p["meta"].items():
+                meta[mid].update(m)
+
+    def run_round(requests):
+        """One adaptive round: local execution of the owned share (on the
+        local mesh under multi-process), then the record exchange — a
+        failure anywhere fails every process fast instead of hanging
+        peers in the allgather."""
+        import contextlib
+
+        from ..parallel.mesh import use_mesh
+
+        placement = (use_mesh(placement_mesh) if placement_mesh is not None
+                     else contextlib.nullcontext())
+        try:
+            with placement:
+                run_requests(requests)
+        except Exception as e:
+            sync_round(e)
+            raise
+        sync_round()
     round_idx = 0
     active = None
 
@@ -196,9 +312,13 @@ def _fit(model_factory, params_list, train_blocks, X_test, y_test, scorer,
                 "batch_size": len(mids),
                 "executor": executor,
                 "thread": threading.get_ident(),
+                "owner": pid,
             }
-            history.append(record)
-            info[mid].append(record)
+            if n_proc > 1:
+                pending.append(record)
+            else:
+                history.append(record)
+                info[mid].append(record)
             if logger is not None:
                 logger.log(step=m["partial_fit_calls"], model_id=mid,
                            score=float(score), batch_size=len(mids),
@@ -254,6 +374,8 @@ def _fit(model_factory, params_list, train_blocks, X_test, y_test, scorer,
         grouped by (batch key, n_calls, block cursor)."""
         solo, groups = [], {}
         for mid, n_calls in requests.items():
+            if not _owned(mid):
+                continue
             model = models[mid]
             key = None
             if _supports_batch(model):
@@ -304,7 +426,7 @@ def _fit(model_factory, params_list, train_blocks, X_test, y_test, scorer,
 
     # first round: one call each (skipped when resuming a checkpoint)
     if restored is None:
-        run_requests({mid: 1 for mid in models})
+        run_round({mid: 1 for mid in models})
         round_idx = 1
         active = set(models)
         save_round()
@@ -339,12 +461,24 @@ def _fit(model_factory, params_list, train_blocks, X_test, y_test, scorer,
             requests[mid] = n_calls
         if not requests:
             break  # every requested model was retired; nothing can advance
-        run_requests(requests)
+        run_round(requests)
         round_idx += 1
         save_round()
 
     if checkpoint is not None:
         checkpoint.clear()  # completed: never resume into a new search
+    if n_proc > 1:
+        # every process receives every trained model (small: weights +
+        # params), so best_estimator_ and post-fit delegation work
+        # identically everywhere
+        from ..parallel.distributed import allgather_object
+
+        parts = allgather_object({
+            mid: host_view_estimator(models[mid])
+            for mid in models if _owned(mid)
+        })
+        for part in parts:
+            models.update(part)
     return info, models, meta, history
 
 
@@ -390,6 +524,22 @@ class BaseIncrementalSearchCV(BaseEstimator):
         ))
 
     def fit(self, X, y=None, **fit_params):
+        import jax as _jax
+
+        if _jax.process_count() > 1 and not _dist_disabled:
+            if isinstance(X, ShardedArray) or isinstance(y, ShardedArray):
+                raise ValueError(
+                    "multi-process adaptive search requires host-resident "
+                    "X/y (each process loads its copy and trains a "
+                    "disjoint candidate subset)"
+                )
+            if self.random_state is None:
+                raise ValueError(
+                    "multi-process adaptive search requires a fixed "
+                    "random_state: every process must derive the "
+                    "IDENTICAL train/test split and candidate sample"
+                )
+            self._dist_stats = (_jax.process_index(), _jax.process_count())
         test_size = self.test_size
         if test_size is None:
             test_size = 0.15

@@ -143,9 +143,14 @@ def _split_frames(arrays, test_size, train_size, rng, shuffle, blockwise):
                 test_ix.append(np.arange(0))
                 continue
             n_train, n_test = _validate_sizes(m, test_size, train_size)
-            idx = rng.permutation(m) if shuffle else np.arange(m)
-            test_ix.append(idx[:n_test])
-            train_ix.append(idx[n_test:n_test + n_train])
+            if shuffle:
+                idx = rng.permutation(m)
+                test_ix.append(idx[:n_test])
+                train_ix.append(idx[n_test:n_test + n_train])
+            else:  # sklearn contract: train = leading rows
+                idx = np.arange(m)
+                train_ix.append(idx[:n_train])
+                test_ix.append(idx[n_train:n_train + n_test])
         out = []
         for a in arrays:
             out.append(PartitionedFrame([

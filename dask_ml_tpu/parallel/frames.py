@@ -135,10 +135,12 @@ class PartitionedFrame:
         DummyEncoder)."""
         from .sharded import ShardedArray
 
+        # pandas-aware dtype checks: np.issubdtype raises TypeError on
+        # extension dtypes (Categorical, StringDtype, nullable Int64)
         cols = list(columns) if columns is not None else [
             c for c in self.columns
-            if np.issubdtype(self.dtypes[c], np.number)
-            or self.dtypes[c] == bool
+            if pd.api.types.is_numeric_dtype(self.dtypes[c])
+            or pd.api.types.is_bool_dtype(self.dtypes[c])
         ]
         if not cols:
             raise ValueError("no numeric columns to place on device")
