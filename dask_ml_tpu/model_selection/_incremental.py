@@ -26,6 +26,7 @@ from sklearn.model_selection import ParameterSampler
 from ..base import BaseEstimator, clone
 from ..metrics.scorer import check_scoring
 from ..parallel.sharded import ShardedArray
+from ..utils.validation import data_fingerprint as _data_fingerprint
 from ._split import train_test_split
 
 
@@ -62,36 +63,6 @@ def _blocks_of(X, y, n_blocks):
     bs = max(int(np.ceil(n / n_blocks)), 1)
     return [(Xh[i:i + bs], yh[i:i + bs]) for i in range(0, n, bs)
             if len(Xh[i:i + bs])]
-
-
-def _data_fingerprint(a, n_sample=96) -> str:
-    """Cheap content fingerprint of a training array for checkpoint
-    identity (ADVICE r1 #1): same-shape different-content data must not
-    resume a stale search. Samples head, evenly strided middle, AND tail
-    rows (a head-only hash would miss tail-edited data); for a
-    ShardedArray that is one small device gather, never a full pull.
-    Sample-based by design — collisions need identical values at every
-    probed row."""
-    import hashlib
-
-    if a is None:
-        return "none"
-    n = a.shape[0] if hasattr(a, "shape") else len(a)
-    k = max(n_sample // 3, 1)
-    idx = np.unique(np.concatenate([
-        np.arange(min(k, n)),
-        np.linspace(0, n - 1, num=min(k, n), dtype=np.int64),
-        np.arange(max(n - k, 0), n),
-    ]))
-    if isinstance(a, ShardedArray):
-        from ..parallel.sharded import take_rows
-
-        sample = take_rows(a, idx).to_numpy()
-    else:
-        sample = np.asarray(a)[idx]
-    return hashlib.sha1(
-        np.ascontiguousarray(sample).tobytes()
-    ).hexdigest()
 
 
 def _supports_batch(model) -> bool:

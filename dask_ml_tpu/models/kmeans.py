@@ -213,10 +213,61 @@ def _streamed_sample(stream, weights_fn, key, l):
     return rows[top]
 
 
-def _streamed_lloyd(stream, centers0, max_iter, tol2, logger=None):
+class _LloydCheckpoint:
+    """Mid-run Lloyd checkpointing (SURVEY.md §5 checkpoint row): saves
+    (centers, it) every k iterations under an IDENTITY TOKEN — a stale
+    checkpoint from a different fit (other data, init, budget, shapes)
+    is ignored rather than silently resumed, the same contract as the
+    adaptive-search checkpoints (_incremental.py). Cleared on
+    completion."""
+
+    def __init__(self, path, every, token, k, d):
+        self.path = path
+        self.every = int(every)
+        self.token = np.frombuffer(token.encode()[:40].ljust(40), np.uint8)
+        self.k, self.d = k, d
+
+    def restore(self):
+        """(centers, it) if a matching checkpoint exists, else None."""
+        import os
+
+        from ..utils import checkpoint as ckpt
+
+        if not os.path.exists(os.path.abspath(self.path)):
+            return None
+        like = {"token": np.zeros(40, np.uint8),
+                "centers": jnp.zeros((self.k, self.d), jnp.float32),
+                "it": 0}
+        try:
+            state = ckpt.restore_pytree(self.path, like=like)
+        except Exception:
+            return None  # different shapes = different fit: start fresh
+        if not np.array_equal(np.asarray(state["token"]), self.token):
+            return None
+        return jnp.asarray(np.asarray(state["centers"])), int(state["it"])
+
+    def save(self, centers, it):
+        from ..utils import checkpoint as ckpt
+
+        ckpt.save_pytree(self.path, {
+            "token": self.token, "centers": centers, "it": it,
+        })
+
+    def clear(self):
+        import os
+        import shutil
+
+        shutil.rmtree(os.path.abspath(self.path), ignore_errors=True)
+
+
+def _streamed_lloyd(stream, centers0, max_iter, tol2, logger=None,
+                    ckpt=None, start_it=0):
+    """Host-loop Lloyd over streamed blocks; ``ckpt`` (a
+    _LloydCheckpoint) persists every k passes so a killed multi-hour fit
+    resumes mid-run, and clears on completion."""
     centers = jnp.asarray(centers0)
-    n_iter = 0
-    for it in range(int(max_iter)):
+    n_iter = start_it
+    for it in range(start_it, int(max_iter)):
         sums = counts = inertia = None
         for blk in stream:
             s, c, i = _block_assign_stats(blk.arrays[0], blk.mask, centers)
@@ -229,8 +280,12 @@ def _streamed_lloyd(stream, centers0, max_iter, tol2, logger=None):
         n_iter = it + 1
         if logger is not None:
             logger.log(step=it, inertia=float(inertia), center_shift2=shift2)
+        if ckpt is not None and n_iter % ckpt.every == 0:
+            ckpt.save(centers, n_iter)
         if shift2 <= tol2:
             break
+    if ckpt is not None:
+        ckpt.clear()
     return centers, n_iter
 
 
@@ -385,7 +440,8 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
     def __init__(self, n_clusters=8, init="k-means||", oversampling_factor=2,
                  max_iter=300, tol=1e-4, precompute_distances="auto",
                  random_state=None, copy_x=True, n_jobs=1, algorithm="full",
-                 init_max_iter=None, use_pallas=None):
+                 init_max_iter=None, use_pallas=None, checkpoint_path=None,
+                 checkpoint_every=0):
         self.n_clusters = n_clusters
         self.init = init
         self.oversampling_factor = oversampling_factor
@@ -398,6 +454,8 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         self.algorithm = algorithm
         self.init_max_iter = init_max_iter
         self.use_pallas = use_pallas
+        self.checkpoint_path = checkpoint_path
+        self.checkpoint_every = checkpoint_every
 
     def _init_centers(self, X: ShardedArray):
         if isinstance(self.init, np.ndarray) or isinstance(
@@ -418,6 +476,29 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         if self.init == "random":
             return init_random(X, self.n_clusters, self.random_state)
         raise ValueError(f"Unknown init {self.init!r}")
+
+    def _make_ckpt(self, X, n, d):
+        """A _LloydCheckpoint when the knobs are set, else None. The
+        identity token covers the init CONFIG (not the computed centers —
+        resume must be able to skip init), the budget, and a data-content
+        fingerprint."""
+        if not (self.checkpoint_path and self.checkpoint_every):
+            return None
+        import hashlib
+
+        from ..utils.validation import data_fingerprint
+
+        if isinstance(self.init, (np.ndarray, jnp.ndarray)):
+            init_piece = hashlib.sha1(np.ascontiguousarray(
+                np.asarray(self.init, np.float32)).tobytes()).hexdigest()
+        else:
+            init_piece = f"{self.init}|{self.random_state}|"                          f"{self.oversampling_factor}|{self.init_max_iter}"
+        token = hashlib.sha1("|".join([
+            init_piece, str(self.n_clusters), str(n), str(d),
+            str(self.max_iter), str(self.tol), data_fingerprint(X),
+        ]).encode()).hexdigest()
+        return _LloydCheckpoint(self.checkpoint_path, self.checkpoint_every,
+                                token, self.n_clusters, d)
 
     def _init_centers_streamed(self, stream, n_features):
         if isinstance(self.init, (np.ndarray, jnp.ndarray)):
@@ -481,11 +562,19 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         mean = s / n
         var = ss / n - mean * mean
         tol2 = float(self.tol * jnp.mean(var))
-        centers0 = self._init_centers_streamed(stream, d)
+        ckpt = self._make_ckpt(X, n, d)
+        resume = ckpt.restore() if ckpt is not None else None
+        if resume is not None:
+            # resume SKIPS init entirely — k-means|| costs ~10 full
+            # passes over an out-of-core dataset
+            centers0, start_it = resume
+        else:
+            centers0, start_it = self._init_centers_streamed(stream, d), 0
         with fit_logger("KMeans", streamed=True, n_rows=n,
                         n_clusters=self.n_clusters) as logger:
             centers, n_iter = _streamed_lloyd(
-                stream, centers0, self.max_iter, tol2, logger=logger
+                stream, centers0, self.max_iter, tol2, logger=logger,
+                ckpt=ckpt, start_it=start_it,
             )
         labels = np.empty(n, np.int32)
         inertia = 0.0
@@ -538,17 +627,39 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             # per-step callbacks need backend support (axon PJRT lacks
             # host callbacks); degrade to one summary record per fit
             log_steps = logger is not None and jit_callbacks_supported()
-            if use_pallas:
-                centers, n_iter, shift2 = _lloyd_run_pallas(
-                    X.data, mask, centers0, jnp.asarray(self.max_iter), tol2,
-                    X.mesh, interpret=jax.default_backend() != "tpu",
+
+            def run_lloyd(c0, iters):
+                if use_pallas:
+                    return _lloyd_run_pallas(
+                        X.data, mask, c0, jnp.asarray(iters), tol2, X.mesh,
+                        interpret=jax.default_backend() != "tpu",
+                        log=log_steps,
+                    )
+                return _lloyd_run(
+                    X.data, mask, c0, jnp.asarray(iters), tol2,
                     log=log_steps,
                 )
+
+            ckpt = self._make_ckpt(X, X.n_rows, X.shape[1])
+            if ckpt is None:
+                centers, n_iter, shift2 = run_lloyd(centers0, self.max_iter)
             else:
-                centers, n_iter, shift2 = _lloyd_run(
-                    X.data, mask, centers0, jnp.asarray(self.max_iter), tol2,
-                    log=log_steps,
-                )
+                # chunked while_loops: every k iterations the (centers,
+                # it) state hits stable storage — the resident analog of
+                # the streamed path's per-pass checkpointing
+                resume = ckpt.restore()
+                centers, n_iter = (resume if resume is not None
+                                   else (centers0, 0))
+                shift2 = jnp.asarray(jnp.inf, X.dtype)
+                while n_iter < self.max_iter:
+                    chunk = min(int(self.checkpoint_every),
+                                self.max_iter - n_iter)
+                    centers, it_c, shift2 = run_lloyd(centers, chunk)
+                    n_iter += int(it_c)
+                    ckpt.save(centers, n_iter)
+                    if int(it_c) < chunk:
+                        break  # converged inside the chunk
+                ckpt.clear()
             if logger is not None and not log_steps:
                 logger.log(step=int(n_iter), center_shift2=float(shift2),
                            summary=True)

@@ -80,6 +80,35 @@ def check_chunks(n_samples, n_features, chunks=None, mesh=None):
     raise AssertionError(f"Unexpected chunks value: {chunks!r}")
 
 
+def data_fingerprint(a, n_sample=96) -> str:
+    """Cheap content fingerprint of an array for checkpoint identity:
+    same-shape different-content data must not resume stale state.
+    Samples head, evenly strided middle, AND tail rows; for a
+    ShardedArray that is one small device gather, never a full pull.
+    Sample-based by design — collisions need identical values at every
+    probed row."""
+    import hashlib
+
+    if a is None:
+        return "none"
+    n = a.shape[0] if hasattr(a, "shape") else len(a)
+    k = max(n_sample // 3, 1)
+    idx = np.unique(np.concatenate([
+        np.arange(min(k, n)),
+        np.linspace(0, n - 1, num=min(k, n), dtype=np.int64),
+        np.arange(max(n - k, 0), n),
+    ]))
+    if isinstance(a, ShardedArray):
+        from ..parallel.sharded import take_rows
+
+        sample = take_rows(a, idx).to_numpy()
+    else:
+        sample = np.asarray(a)[idx]
+    return hashlib.sha1(
+        np.ascontiguousarray(sample).tobytes()
+    ).hexdigest()
+
+
 def device_binary_classes(y: ShardedArray) -> np.ndarray:
     """The two class values of a device label vector, WITHOUT pulling the
     column to host (VERDICT r2 #4: ``_encode_y`` full-column round-trip).
