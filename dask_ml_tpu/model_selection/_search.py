@@ -29,7 +29,7 @@ import numpy as np
 from sklearn.model_selection import ParameterGrid, ParameterSampler
 
 from ..base import BaseEstimator, clone
-from ..metrics.scorer import check_scoring
+from ..metrics.scorer import check_scoring, get_scorer
 from ..parallel.mesh import device_mesh, resolve_mesh, use_mesh
 from ..parallel.sharded import ShardedArray, take_rows
 from ._normalize import estimator_token
@@ -67,6 +67,29 @@ def _submeshes(mesh, k):
         out.append(device_mesh(devices=devs[i:i + size]))
         i += size
     return out
+
+
+def _resolve_scorers(estimator, scoring, refit):
+    """({name: scorer}, multimetric). The reference (ex dask-searchcv)
+    supports multimetric scoring: a list/dict of scorers producing
+    mean_test_<name> columns, with ``refit`` naming the selection metric
+    (sklearn contract)."""
+    if scoring is None or callable(scoring) or isinstance(scoring, str):
+        return {"score": check_scoring(estimator, scoring)}, False
+    if isinstance(scoring, (list, tuple, set)):
+        scoring = {name: name for name in scoring}
+    if not isinstance(scoring, dict) or not scoring:
+        raise ValueError(f"cannot interpret scoring={scoring!r}")
+    scorers = {
+        name: sc if callable(sc) else get_scorer(sc)
+        for name, sc in scoring.items()
+    }
+    if refit not in (False, None) and refit not in scorers:
+        raise ValueError(
+            f"multimetric scoring requires refit to name one of "
+            f"{sorted(scorers)} (or refit=False); got {refit!r}"
+        )
+    return scorers, True
 
 
 def check_cv(cv=None):
@@ -214,14 +237,18 @@ class _BaseSearchCV(BaseEstimator):
         if not candidates:
             raise ValueError("no parameter candidates")
         cv = check_cv(self.cv)
-        scorer = check_scoring(self.estimator, self.scoring)
+        scorers, multimetric = _resolve_scorers(
+            self.estimator, self.scoring, self.refit
+        )
         cache = _CVCache(X, y, cv, cache=self.cache_cv)
         memo = _PrefixMemo()
         n_folds = cache.n_folds
 
-        scores = np.full((len(candidates), n_folds), np.nan)
+        scores = {name: np.full((len(candidates), n_folds), np.nan)
+                  for name in scorers}
         train_scores = (
-            np.full((len(candidates), n_folds), np.nan)
+            {name: np.full((len(candidates), n_folds), np.nan)
+             for name in scorers}
             if self.return_train_score else None
         )
 
@@ -234,13 +261,16 @@ class _BaseSearchCV(BaseEstimator):
                     est = memo.fit_pipeline(est, fi, Xtr, ytr)
                 else:
                     est.fit(Xtr, ytr, **fit_params)
-                scores[ci, fi] = scorer(est, Xte, yte)
+                for name, sc in scorers.items():
+                    scores[name][ci, fi] = sc(est, Xte, yte)
                 if self.return_train_score:
-                    train_scores[ci, fi] = scorer(est, Xtr, ytr)
+                    for name, sc in scorers.items():
+                        train_scores[name][ci, fi] = sc(est, Xtr, ytr)
             except Exception:
                 if self.error_score == "raise":
                     raise
-                scores[ci, fi] = self.error_score
+                for name in scorers:
+                    scores[name][ci, fi] = self.error_score
 
         tasks = [(ci, fi) for ci in range(len(candidates))
                  for fi in range(n_folds)]
@@ -447,29 +477,31 @@ class _BaseSearchCV(BaseEstimator):
                     np.nan,
                 )
 
-            scores = merge(scores)
+            scores = {name: merge(a) for name, a in scores.items()}
             if self.return_train_score:
-                train_scores = merge(train_scores)
+                train_scores = {name: merge(a)
+                                for name, a in train_scores.items()}
 
-        mean = scores.mean(axis=1)
-        std = scores.std(axis=1)
-        order = np.argsort(-mean, kind="stable")
-        ranks = np.empty(len(candidates), np.int32)
-        ranks[order] = np.arange(1, len(candidates) + 1)
-
-        results = {
-            "params": candidates,
-            "mean_test_score": mean,
-            "std_test_score": std,
-            "rank_test_score": ranks,
-        }
-        for fi in range(n_folds):
-            results[f"split{fi}_test_score"] = scores[:, fi]
-        if self.return_train_score:
-            results["mean_train_score"] = train_scores.mean(axis=1)
-            results["std_train_score"] = train_scores.std(axis=1)
+        results = {"params": candidates}
+        means = {}
+        for name, arr in scores.items():
+            suffix = name if multimetric else "score"
+            mean = arr.mean(axis=1)
+            means[name] = mean
+            order = np.argsort(-mean, kind="stable")
+            ranks = np.empty(len(candidates), np.int32)
+            ranks[order] = np.arange(1, len(candidates) + 1)
+            results[f"mean_test_{suffix}"] = mean
+            results[f"std_test_{suffix}"] = arr.std(axis=1)
+            results[f"rank_test_{suffix}"] = ranks
             for fi in range(n_folds):
-                results[f"split{fi}_train_score"] = train_scores[:, fi]
+                results[f"split{fi}_test_{suffix}"] = arr[:, fi]
+            if self.return_train_score:
+                tarr = train_scores[name]
+                results[f"mean_train_{suffix}"] = tarr.mean(axis=1)
+                results[f"std_train_{suffix}"] = tarr.std(axis=1)
+                for fi in range(n_folds):
+                    results[f"split{fi}_train_{suffix}"] = tarr[:, fi]
         for key in sorted({k for p in candidates for k in p}):
             results[f"param_{key}"] = np.ma.masked_all(
                 len(candidates), dtype=object
@@ -478,12 +510,17 @@ class _BaseSearchCV(BaseEstimator):
                 if key in p:
                     results[f"param_{key}"][ci] = p[key]
         self.cv_results_ = results
-        self.best_index_ = int(np.argmax(mean))
-        self.best_score_ = float(mean[self.best_index_])
-        self.best_params_ = candidates[self.best_index_]
+        # selection metric: the single scorer, or the refit-named one
+        # (sklearn contract: multimetric + refit=False sets no best_*)
+        sel = self.refit if multimetric else "score"
+        if sel in means:
+            sel_mean = means[sel]
+            self.best_index_ = int(np.argmax(sel_mean))
+            self.best_score_ = float(sel_mean[self.best_index_])
+            self.best_params_ = candidates[self.best_index_]
         self.n_splits_ = n_folds
-        self.scorer_ = scorer
-        self.multimetric_ = False
+        self.scorer_ = scorers if multimetric else scorers["score"]
+        self.multimetric_ = multimetric
         self._memo_stats = (memo.hits, memo.misses)
 
         if self.refit:
@@ -521,6 +558,9 @@ class _BaseSearchCV(BaseEstimator):
 
     def score(self, X, y=None):
         if hasattr(self, "scorer_") and self.scoring is not None:
+            if getattr(self, "multimetric_", False):
+                self._check_refit("score")  # refit names the metric
+                return self.scorer_[self.refit](self.best_estimator_, X, y)
             return self.scorer_(self.best_estimator_, X, y)
         self._check_refit("score")
         return self.best_estimator_.score(X, y)

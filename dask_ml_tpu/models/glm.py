@@ -16,6 +16,7 @@ non-parity we deliberately fix.)
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -40,6 +41,34 @@ def add_intercept(X):
         )
     arr = np.asarray(X)
     return np.concatenate([arr, np.ones((arr.shape[0], 1), arr.dtype)], axis=1)
+
+
+from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnames=("fit_intercept", "to_bf16", "encode"))
+def _prepare_fit(Xd, yd, mask, fit_intercept, to_bf16, encode):
+    """ONE program for all fit prep: intercept column, bf16 cast, binary
+    label scan + encoding. Launch count matters: on tunneled runtimes
+    every eager op / separate jit call pays a full dispatch round trip,
+    and the old prep chain (concat, cast, scan, eq, mul) cost more wall
+    clock than the entire 50-iteration solve."""
+    if fit_intercept:
+        Xd = jnp.concatenate([Xd, mask[:, None].astype(Xd.dtype)], axis=1)
+    if to_bf16:
+        Xd = Xd.astype(jnp.bfloat16)
+    if encode:
+        valid = mask > 0
+        big = jnp.asarray(jnp.inf, yd.dtype)
+        mn = jnp.min(jnp.where(valid, yd, big))
+        mx = jnp.max(jnp.where(valid, yd, -big))
+        binary = jnp.all(~valid | (yd == mn) | (yd == mx))
+        y_enc = (yd == mx).astype(jnp.float32) * mask
+        packed = jnp.stack([mn, mx, binary.astype(yd.dtype)])
+    else:
+        y_enc = yd
+        packed = jnp.zeros((3,), yd.dtype)
+    return Xd, y_enc, packed
 
 
 class _GLMBase(BaseEstimator):
@@ -67,14 +96,6 @@ class _GLMBase(BaseEstimator):
         self.solver_kwargs = solver_kwargs
 
     # -- internals --------------------------------------------------------
-    def _design(self, X: ShardedArray):
-        """Intercept ones column (zeroed on padding rows) via
-        ``add_intercept`` (SURVEY.md §3.2)."""
-        return add_intercept(X).data if self.fit_intercept else X.data
-
-    def _encode_y(self, y: ShardedArray):
-        return y.data, None
-
     def _encode_y_host(self, y):
         return np.asarray(y, np.float32), None
 
@@ -142,19 +163,31 @@ class _GLMBase(BaseEstimator):
         X, y = check_X_y(X, y, mesh=mesh, dtype=np.float32)
         if self.penalty not in regularizers.KNOWN:
             raise ValueError(f"Unknown penalty {self.penalty!r}")
-        data = self._design(X)
         from ..config import get_config
 
-        if get_config().dtype == "bfloat16" and self.solver in (
+        # bf16 design matrix: the _smooth_loss matvec rides the MXU at
+        # bf16 rate with f32 accumulation; solver state / y / mask stay
+        # f32. Newton/ADMM are excluded — their Hessian matmuls would
+        # silently upcast (no speedup) and bf16 Hessians risk conditioning
+        use_bf16 = get_config().dtype == "bfloat16" and self.solver in (
             "lbfgs", "gradient_descent", "proximal_grad"
-        ):
-            # bf16 design matrix: the _smooth_loss matvec rides the MXU at
-            # bf16 rate with f32 accumulation; solver state / y / mask
-            # stay f32. Newton/ADMM are excluded — their Hessian matmuls
-            # would silently upcast (no speedup) and bf16 Hessians risk
-            # conditioning
-            data = data.astype(jnp.bfloat16)
-        y_data, classes = self._encode_y(y)
+        )
+        mask = X.row_mask(dtype=jnp.float32)
+        data, y_data, packed = _prepare_fit(
+            X.data, y.data, mask, fit_intercept=self.fit_intercept,
+            to_bf16=use_bf16, encode=self.family == "logistic",
+        )
+        classes = None
+        if self.family == "logistic":
+            pk = np.asarray(packed)  # one small fetch: (mn, mx, binary)
+            if not bool(pk[2]) or pk[0] == pk[1]:
+                n_classes = len(np.unique(y.to_numpy()))  # error path only
+                raise ValueError(
+                    f"LogisticRegression supports binary targets; got "
+                    f"{n_classes} classes"
+                )
+            classes = np.asarray(pk[:2])
+            self.classes_ = classes
         d = data.shape[1]
         pmask = np.ones(d, np.float32)
         if self.fit_intercept:
@@ -180,7 +213,7 @@ class _GLMBase(BaseEstimator):
             log_steps = logger is not None and jit_callbacks_supported()
             beta, info = solve(
                 self.solver,
-                X=data, y=y_data, mask=X.row_mask(dtype=jnp.float32),
+                X=data, y=y_data, mask=mask,
                 n_rows=X.n_rows, beta0=beta0, family=self.family,
                 reg=self.penalty, lam=jnp.asarray(lam, jnp.float32),
                 pmask=jnp.asarray(pmask), l1_ratio=l1_ratio,
@@ -259,23 +292,6 @@ class LogisticRegression(_GLMBase):
     dask-glm's logistic family)."""
 
     family = "logistic"
-
-    def _encode_y(self, y: ShardedArray):
-        # classes found ON DEVICE — the label column never round-trips
-        # through host (three scalars do)
-        from ..utils.validation import device_binary_classes
-
-        try:
-            classes = device_binary_classes(y)
-        except ValueError as e:
-            raise ValueError(
-                f"LogisticRegression supports binary targets; {e}"
-            ) from None
-        self.classes_ = classes
-        mask = y.row_mask(jnp.float32)
-        y01 = (y.data == jnp.asarray(classes[1], y.data.dtype)
-               ).astype(jnp.float32) * mask
-        return y01, classes
 
     def _encode_y_host(self, y):
         y = np.asarray(y)

@@ -51,12 +51,21 @@ def _smooth_loss(beta, X, y, mask, n_rows, lam, pmask, l1_ratio, family, reg):
     return base + regularizers.value(reg, beta, lam, pmask, l1_ratio)
 
 
+def _host_scalars(*vals):
+    """Fetch a handful of device result scalars in ONE device→host
+    transfer — separate int()/float() pulls each pay a full round trip,
+    which dominates small fits on tunneled runtimes."""
+    return np.asarray(jnp.stack([
+        jnp.asarray(v, jnp.float32) for v in vals
+    ]))
+
+
 def check_finite_result(beta, info, solver):
     """NaN/Inf sanitizer (SURVEY.md §5 race-detection row): a NaN ends a
     ``gnorm > tol`` while_loop as "converged", silently. Every solver
     funnels its result through here; non-finite parameters raise instead
     of becoming a model."""
-    beta_h = np.asarray(beta)
+    beta_h = np.asarray(beta)  # the one beta fetch — callers reuse it
     scalars = [v for v in info.values() if isinstance(v, (int, float))]
     if not np.isfinite(beta_h).all() or not np.all(np.isfinite(scalars)):
         raise FloatingPointError(
@@ -64,7 +73,7 @@ def check_finite_result(beta, info, solver):
             f"(info={info}): the input contains NaN/Inf or the solve "
             f"diverged — validate the data or reduce the step size / C"
         )
-    return beta, info
+    return beta_h, info
 
 
 def _check_smooth(reg, solver):
@@ -129,6 +138,7 @@ def lbfgs(X, y, mask, n_rows, beta0, family, reg, lam, pmask, l1_ratio=0.5,
     if not (checkpoint_path and checkpoint_every):
         beta, state, gnorm, it = run(carry=carry,
                                      stop_it=jnp.asarray(max_iter))
+        it, gnorm = _host_scalars(it, gnorm)
     else:
         import os
 
@@ -210,6 +220,7 @@ def gradient_descent(X, y, mask, n_rows, beta0, family, reg, lam, pmask,
         jnp.asarray(max_iter), jnp.asarray(tol, beta0.dtype),
         init_step, family, reg, log=log,
     )
+    it, gnorm = _host_scalars(it, gnorm)
     return beta, {"n_iter": int(it), "grad_norm": float(gnorm)}
 
 
@@ -265,6 +276,7 @@ def proximal_grad(X, y, mask, n_rows, beta0, family, reg, lam, pmask,
         jnp.asarray(max_iter), jnp.asarray(tol, beta0.dtype),
         init_step, family, reg, log=log,
     )
+    it, delta = _host_scalars(it, delta)
     return beta, {"n_iter": int(it), "opt_residual": float(delta)}
 
 
@@ -320,6 +332,7 @@ def newton(X, y, mask, n_rows, beta0, family, reg, lam, pmask, l1_ratio=0.5,
         jnp.asarray(max_iter), jnp.asarray(tol, beta0.dtype), family, reg,
         log=log,
     )
+    it, gnorm = _host_scalars(it, gnorm)
     return beta, {"n_iter": int(it), "grad_norm": float(gnorm)}
 
 
@@ -405,6 +418,7 @@ def admm(X, y, mask, n_rows, beta0, family, reg, lam, pmask, l1_ratio=0.5,
         jnp.asarray(tol, beta0.dtype), family, reg, local_iter, mesh,
         log=log,
     )
+    it, primal, dual = _host_scalars(it, primal, dual)
     return z, {"n_iter": int(it), "primal_residual": float(primal),
                "dual_residual": float(dual)}
 

@@ -150,9 +150,24 @@ def _row_mask(n_padded: int, n_rows: int, sharding, dtype) -> jax.Array:
     return jax.lax.with_sharding_constraint((idx < n_rows).astype(dtype), sharding)
 
 
+import functools
+
+# result cache is bounded by SIZE, not just count: a cached (n,) f32 mask
+# pins n*4 bytes of device memory for the process lifetime
+_MASK_CACHE_MAX_ROWS = 4_194_304  # <= 16 MB per entry, 8 entries
+
+
+@functools.lru_cache(maxsize=8)
+def _row_mask_cached(n_padded: int, n_rows: int, mesh: Mesh, dtype):
+    return _row_mask(n_padded, n_rows, NamedSharding(mesh, P(DATA_AXIS)), dtype)
+
+
 def row_mask(n_padded: int, n_rows: int, mesh: Mesh, dtype=jnp.float32) -> jax.Array:
-    # all-static jitted helper: cache hit per (shape, mesh) instead of a
-    # fresh trace per call
+    # RESULT-cached for small/medium masks (they are requested several
+    # times per fit, and on tunneled runtimes every program launch costs
+    # a round trip); huge masks are rebuilt rather than pinned in HBM
+    if n_padded <= _MASK_CACHE_MAX_ROWS:
+        return _row_mask_cached(n_padded, n_rows, mesh, dtype)
     return _row_mask(n_padded, n_rows, NamedSharding(mesh, P(DATA_AXIS)), dtype)
 
 

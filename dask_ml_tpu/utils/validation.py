@@ -135,11 +135,38 @@ def device_binary_classes(y: ShardedArray) -> np.ndarray:
         mn = jnp.min(jnp.where(valid, data, big))
         mx = jnp.max(jnp.where(valid, data, small))
         binary = jnp.all(~valid | (data == mn) | (data == mx))
-        return mn, mx, binary
+        # one stacked f32 output = ONE device→host fetch; three separate
+        # scalar pulls cost three round trips (hundreds of ms each over a
+        # tunneled runtime). Integer class values ride BIT-PRESERVED
+        # (bitcast), not value-cast — f32 cannot represent ints > 2^24.
+        vals = jnp.stack([mn, mx])
+        if jnp.issubdtype(vals.dtype, jnp.floating):
+            if vals.dtype != jnp.float32:
+                # f64 under x64: a value-cast would round class values —
+                # fall back to native-dtype scalars (extra fetches, but
+                # the non-default mode pays for its precision)
+                return mn, mx, binary
+        else:
+            vals = jax.lax.bitcast_convert_type(
+                vals.astype(jnp.int32), jnp.float32
+            )
+        return jnp.concatenate(
+            [vals.astype(jnp.float32), binary.astype(jnp.float32)[None]]
+        )
 
-    mn, mx, binary = _scan(y.data, y.row_mask(jnp.float32))
-    mn_h, mx_h = np.asarray(mn), np.asarray(mx)
-    if not bool(binary) or mn_h == mx_h:
+    out = _scan(y.data, y.row_mask(jnp.float32))
+    if isinstance(out, tuple):  # f64 fallback path
+        mn_h, mx_h, binary = np.asarray(out[0]), np.asarray(out[1]),             bool(out[2])
+    else:
+        out = np.asarray(out)
+        binary = bool(out[2])
+        # mirror the scan's branch: bool was cast to int32 there, so only
+        # genuinely-floating labels come back as values (ints bitcast)
+        if np.issubdtype(np.dtype(str(y.dtype)), np.floating):
+            mn_h, mx_h = out[0], out[1]
+        else:
+            mn_h, mx_h = np.ascontiguousarray(out[:2]).view(np.int32)
+    if not binary or mn_h == mx_h:
         n_classes = len(np.unique(y.to_numpy()))  # error path only
         raise ValueError(
             f"expected binary targets; got {n_classes} classes"

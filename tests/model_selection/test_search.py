@@ -116,3 +116,55 @@ def test_search_error_score(data):
             LogisticRegression(solver="lbfgs"),
             {"penalty": ["bogus"]}, cv=2, refit=False,
         ).fit(X, y)
+
+
+def test_multimetric_grid_search_matches_sklearn(xy_classification):
+    """Multimetric scoring (ex dask-searchcv parity): list/dict scoring
+    produce per-metric cv_results_ columns; refit names the selection
+    metric."""
+    from sklearn.linear_model import LogisticRegression as SkLR
+    from sklearn.model_selection import GridSearchCV as SkGrid
+    from sklearn.model_selection import KFold as SkKFold
+
+    from dask_ml_tpu.model_selection import GridSearchCV
+
+    X, y = xy_classification
+    grid = {"C": [0.1, 1.0, 10.0]}
+    ours = GridSearchCV(
+        SkLR(max_iter=200), grid, cv=3,
+        scoring=["accuracy", "neg_log_loss"], refit="accuracy",
+        scheduler="synchronous",
+    ).fit(X, y)
+    ref = SkGrid(
+        SkLR(max_iter=200), grid, cv=SkKFold(3),
+        scoring=["accuracy", "neg_log_loss"], refit="accuracy",
+    ).fit(X, y)
+    assert ours.multimetric_ is True
+    for key in ("mean_test_accuracy", "mean_test_neg_log_loss",
+                "rank_test_accuracy"):
+        np.testing.assert_allclose(
+            ours.cv_results_[key], ref.cv_results_[key], rtol=5e-3,
+            atol=1e-4,
+        )
+    assert ours.best_params_ == ref.best_params_
+    assert ours.best_estimator_.score(X, y) > 0.7
+    # score() uses the refit metric's scorer
+    assert 0.0 <= ours.score(X, y) <= 1.0
+
+
+def test_multimetric_refit_validation(xy_classification):
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    from dask_ml_tpu.model_selection import GridSearchCV
+
+    X, y = xy_classification
+    with pytest.raises(ValueError, match="refit to name"):
+        GridSearchCV(SkLR(), {"C": [1.0]},
+                     scoring=["accuracy", "neg_log_loss"]).fit(X, y)
+    # refit=False: results only, no best_* attributes
+    s = GridSearchCV(
+        SkLR(max_iter=100), {"C": [0.1, 1.0]}, cv=3, refit=False,
+        scoring={"acc": "accuracy"}, scheduler="synchronous",
+    ).fit(X, y)
+    assert "mean_test_acc" in s.cv_results_
+    assert not hasattr(s, "best_index_")
