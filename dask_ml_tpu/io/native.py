@@ -26,10 +26,34 @@ _SRC = os.path.join(_ROOT, "native", "fast_loader.cpp")
 _SO = os.path.join(_ROOT, "native", "_fast_loader.so")
 
 
-def _build():
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-           "-o", _SO, _SRC]
-    subprocess.run(cmd, check=True, capture_output=True)
+def _build_and_load(src, so, configure):
+    """Shared compile-if-stale + dlopen + symbol-config flow for every
+    native helper; returns the configured library or None. A prebuilt
+    .so next to a MISSING source still loads (no getmtime on a path
+    that isn't there)."""
+    if not os.path.exists(so) or (
+        os.path.exists(src) and os.path.getmtime(so) < os.path.getmtime(src)
+    ):
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+             "-o", so, src],
+            check=True, capture_output=True,
+        )
+    lib = ctypes.CDLL(so)
+    configure(lib)
+    return lib
+
+
+def _configure_fast_loader(lib):
+    lib.csv_dims.restype = ctypes.c_int64
+    lib.csv_dims.argtypes = [ctypes.c_char_p,
+                             ctypes.POINTER(ctypes.c_int64)]
+    lib.csv_parse_f32.restype = ctypes.c_int64
+    lib.csv_parse_f32.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+    ]
 
 
 def load_library():
@@ -39,21 +63,7 @@ def load_library():
         if _lib is not None or _lib_failed:
             return _lib
         try:
-            if not os.path.exists(_SO) or (
-                os.path.getmtime(_SO) < os.path.getmtime(_SRC)
-            ):
-                _build()
-            lib = ctypes.CDLL(_SO)
-            lib.csv_dims.restype = ctypes.c_int64
-            lib.csv_dims.argtypes = [ctypes.c_char_p,
-                                     ctypes.POINTER(ctypes.c_int64)]
-            lib.csv_parse_f32.restype = ctypes.c_int64
-            lib.csv_parse_f32.argtypes = [
-                ctypes.c_char_p,
-                ctypes.POINTER(ctypes.c_float),
-                ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
-            ]
-            _lib = lib
+            _lib = _build_and_load(_SRC, _SO, _configure_fast_loader)
         except Exception:
             _lib_failed = True
         return _lib
@@ -90,3 +100,87 @@ def read_csv_sharded(path, mesh=None, n_threads=None):
     from ..parallel.sharded import as_sharded
 
     return as_sharded(read_csv_f32(path, n_threads=n_threads), mesh=mesh)
+
+
+# -- native block reader (native/block_reader.cpp) --------------------------
+
+_SRC_BR = os.path.join(_ROOT, "native", "block_reader.cpp")
+_SO_BR = os.path.join(_ROOT, "native", "_block_reader.so")
+_lib_br = None
+_lib_br_failed = False
+
+
+def _configure_block_reader(lib):
+    lib.br_open.restype = ctypes.c_void_p
+    lib.br_open.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+    ]
+    lib.br_next.restype = ctypes.c_int64
+    lib.br_next.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.br_close.restype = None
+    lib.br_close.argtypes = [ctypes.c_void_p]
+
+
+def load_block_reader():
+    """The threaded-readahead reader library; None if unavailable."""
+    global _lib_br, _lib_br_failed
+    with _lock:
+        if _lib_br is not None or _lib_br_failed:
+            return _lib_br
+        try:
+            _lib_br = _build_and_load(_SRC_BR, _SO_BR,
+                                      _configure_block_reader)
+        except Exception:
+            _lib_br_failed = True
+        return _lib_br
+
+
+class NativeBlockReader:
+    """Sequential fixed-size row blocks of a memmap-backed file, read
+    AHEAD by a C++ thread into a buffer ring (native/block_reader.cpp) —
+    disk latency overlaps the previous block's device_put + compute even
+    with a cold page cache."""
+
+    def __init__(self, mm: np.memmap, block_rows: int, depth: int = 2):
+        lib = load_block_reader()
+        if lib is None:
+            raise RuntimeError("native block reader unavailable")
+        self._lib = lib
+        self._shape_tail = mm.shape[1:]
+        self._dtype = mm.dtype
+        row_items = int(np.prod(self._shape_tail, dtype=np.int64) or 1)
+        self._row_bytes = int(mm.dtype.itemsize) * row_items
+        self._block_rows = int(block_rows)
+        self.n_rows = int(mm.shape[0])
+        self._buf = np.empty((self._block_rows,) + tuple(self._shape_tail),
+                             mm.dtype)
+        self._h = lib.br_open(
+            str(mm.filename).encode(), int(mm.offset), self._row_bytes,
+            self.n_rows, self._block_rows, int(depth),
+        )
+        if not self._h:
+            raise RuntimeError(f"br_open failed for {mm.filename}")
+
+    def next(self):
+        """Next block as an ndarray VIEW of the internal buffer (valid
+        until the following call), or None at end-of-stream."""
+        rows = self._lib.br_next(
+            self._h, self._buf.ctypes.data_as(ctypes.c_char_p)
+        )
+        if rows < 0:
+            raise IOError("native block reader failed mid-stream")
+        if rows == 0:
+            return None
+        return self._buf[: int(rows)]
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.br_close(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover - GC path
+        try:
+            self.close()
+        except Exception:
+            pass

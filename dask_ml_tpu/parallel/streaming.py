@@ -129,13 +129,68 @@ class BlockStream:
         )
         self._mask_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
 
-    def _block_host(self, b):
+    def _verify_native(self):
+        """Which arrays the C++ readahead reader can serve, verified by
+        comparing its block 0 against the numpy slice — catches sliced /
+        re-offset memmap views whose .offset no longer describes them."""
+        from ..io.native import NativeBlockReader, load_block_reader
+
+        oks = []
+        for a in self.arrays:
+            ok = False
+            if (type(a) is np.memmap and a.flags["C_CONTIGUOUS"]
+                    and getattr(a, "filename", None) is not None
+                    and load_block_reader() is not None):
+                try:
+                    # the offset/contiguity property is independent of
+                    # block size: verify with a SMALL block instead of
+                    # double-reading a full (possibly 256 MB) one.
+                    # equal_nan: datasets with missing values must not
+                    # silently lose the readahead path
+                    vb = min(self.block_rows, len(a), 4096)
+                    r = NativeBlockReader(a, vb)
+                    blk = r.next()
+                    ok = blk is not None and np.array_equal(
+                        blk, np.asarray(a[: len(blk)]),
+                        equal_nan=np.issubdtype(a.dtype, np.floating),
+                    )
+                    r.close()
+                except Exception:
+                    ok = False
+            oks.append(ok)
+        return oks
+
+    def _native_readers(self):
+        """Per-array readahead readers for a SEQUENTIAL pass (None where
+        inapplicable); the reader thread pread()s blocks ahead of the
+        consumer, overlapping disk latency with device transfer/compute
+        (native/block_reader.cpp)."""
+        if self.shuffle:
+            return None
+        if getattr(self, "_native_ok", None) is None:
+            self._native_ok = self._verify_native()
+        if not any(self._native_ok):
+            return None
+        from ..io.native import NativeBlockReader
+
+        return [
+            NativeBlockReader(a, self.block_rows) if ok else None
+            for ok, a in zip(self._native_ok, self.arrays)
+        ]
+
+    def _block_host(self, b, readers=None):
         lo = b * self.block_rows
         hi = min(lo + self.block_rows, self.n_rows)
         m = hi - lo
         outs = []
-        for a in self.arrays:
-            blk = np.asarray(a[lo:hi], dtype=self.dtype)
+        for i, a in enumerate(self.arrays):
+            if readers is not None and readers[i] is not None:
+                raw = readers[i].next()
+                # copy out: the reader's ring buffer is reused, and
+                # device_put reads the host buffer asynchronously
+                blk = raw.astype(self.dtype, copy=True)
+            else:
+                blk = np.asarray(a[lo:hi], dtype=self.dtype)
             if m < self.block_rows:  # fixed shape: pad the tail block
                 pad = [(0, self.block_rows - m)] + [(0, 0)] * (blk.ndim - 1)
                 blk = np.pad(blk, pad)
@@ -155,18 +210,30 @@ class BlockStream:
         order = np.arange(self.n_blocks)
         if self.shuffle:
             self.rng.shuffle(order)
+        readers = None
+        if not self.shuffle:
+            try:
+                readers = self._native_readers()
+            except Exception:
+                readers = None
         # k-deep prefetch: device_put is async, so issuing the next k
         # transfers before consuming the current block overlaps DMA with
         # compute (k=1 is the classic double buffer)
         from collections import deque
 
         pending = deque()
-        for b in order:
-            pending.append(self._put(self._block_host(b)))
-            if len(pending) > self.prefetch:
+        try:
+            for b in order:
+                pending.append(self._put(self._block_host(b, readers)))
+                if len(pending) > self.prefetch:
+                    yield pending.popleft()
+            while pending:
                 yield pending.popleft()
-        while pending:
-            yield pending.popleft()
+        finally:
+            if readers:
+                for r in readers:
+                    if r is not None:
+                        r.close()
 
     def __len__(self):
         return self.n_blocks

@@ -146,6 +146,10 @@ def device_binary_classes(y: ShardedArray) -> np.ndarray:
                 # fall back to native-dtype scalars (extra fetches, but
                 # the non-default mode pays for its precision)
                 return mn, mx, binary
+        elif vals.dtype.itemsize > 4:
+            # i64/u64 under x64: an int32 bitcast would WRAP wide class
+            # ids — same native-dtype fallback
+            return mn, mx, binary
         else:
             vals = jax.lax.bitcast_convert_type(
                 vals.astype(jnp.int32), jnp.float32
@@ -155,7 +159,7 @@ def device_binary_classes(y: ShardedArray) -> np.ndarray:
         )
 
     out = _scan(y.data, y.row_mask(jnp.float32))
-    if isinstance(out, tuple):  # f64 fallback path
+    if isinstance(out, tuple):  # wide-dtype (f64/i64) fallback path
         mn_h, mx_h, binary = np.asarray(out[0]), np.asarray(out[1]),             bool(out[2])
     else:
         out = np.asarray(out)

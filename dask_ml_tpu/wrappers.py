@@ -40,11 +40,18 @@ def _host_blocks(X, block_size=100_000):
 
 
 class ParallelPostFit(BaseEstimator):
-    """Ref: dask_ml/wrappers.py::ParallelPostFit."""
+    """Ref: dask_ml/wrappers.py::ParallelPostFit. The ``*_meta``
+    parameters are accepted for API parity: the reference uses them to
+    declare dask output metadata; here output types are concrete, so they
+    only pin the output dtype when given."""
 
-    def __init__(self, estimator=None, scoring=None):
+    def __init__(self, estimator=None, scoring=None, predict_meta=None,
+                 predict_proba_meta=None, transform_meta=None):
         self.estimator = estimator
         self.scoring = scoring
+        self.predict_meta = predict_meta
+        self.predict_proba_meta = predict_proba_meta
+        self.transform_meta = transform_meta
 
     # -- fit: plain in-memory fit of the wrapped estimator ---------------
     def fit(self, X, y=None, **kwargs):
@@ -91,6 +98,11 @@ class ParallelPostFit(BaseEstimator):
         else:
             parts = [fn(b) for b in blocks]
         out = np.concatenate(parts, axis=0)
+        meta = {"predict": self.predict_meta,
+                "predict_proba": self.predict_proba_meta,
+                "transform": self.transform_meta}.get(method)
+        if meta is not None and hasattr(meta, "dtype"):
+            out = out.astype(meta.dtype, copy=False)
         return as_sharded(out, mesh=mesh) if mesh is not None else out
 
     def predict(self, X):
@@ -124,12 +136,17 @@ class Incremental(ParallelPostFit):
     dask_ml/_partial.py::fit."""
 
     def __init__(self, estimator=None, scoring=None, shuffle_blocks=True,
-                 random_state=None, assume_equal_chunks=True):
+                 random_state=None, assume_equal_chunks=True,
+                 predict_meta=None, predict_proba_meta=None,
+                 transform_meta=None):
         self.estimator = estimator
         self.scoring = scoring
         self.shuffle_blocks = shuffle_blocks
         self.random_state = random_state
         self.assume_equal_chunks = assume_equal_chunks
+        self.predict_meta = predict_meta
+        self.predict_proba_meta = predict_proba_meta
+        self.transform_meta = transform_meta
 
     def _partial_fit_pass(self, est, X, y, block_size, rng, **fit_kwargs):
         if _is_device_estimator(est) and isinstance(X, ShardedArray):

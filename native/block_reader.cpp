@@ -1,0 +1,144 @@
+// Threaded readahead block reader for out-of-core streaming.
+//
+// Role: the host-side IO half of dask_ml_tpu's block streaming
+// (parallel/streaming.py). The reference's analog is dask's worker
+// threads materializing chunks from storage while other chunks compute;
+// here a reader thread pread()s fixed-size row blocks from the backing
+// file into a ring of buffers AHEAD of the consumer, so disk latency
+// overlaps with the device_put + compute of the previous blocks even
+// when the OS page cache is cold.
+//
+// C ABI (ctypes-friendly, no pybind11 in this image):
+//   void* br_open(path, offset, row_bytes, n_rows, block_rows, depth)
+//   int64 br_next(handle, out_buf)   -> rows copied, 0 at end, -1 error
+//   void  br_close(handle)
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <mutex>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct Block {
+  std::vector<char> data;
+  int64_t rows = 0;
+  bool ready = false;
+};
+
+struct Reader {
+  int fd = -1;
+  int64_t offset = 0;
+  int64_t row_bytes = 0;
+  int64_t n_rows = 0;
+  int64_t block_rows = 0;
+  int64_t n_blocks = 0;
+
+  std::vector<Block> ring;
+  int64_t produced = 0;  // next block index the reader will fill
+  int64_t consumed = 0;  // next block index the consumer will take
+  std::atomic<bool> error{false};
+  bool stop = false;
+  std::mutex mu;
+  std::condition_variable cv_can_produce, cv_can_consume;
+  std::thread worker;
+
+  void run() {
+    while (true) {
+      int64_t b;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_can_produce.wait(lk, [&] {
+          return stop || (produced < n_blocks &&
+                          produced - consumed < (int64_t)ring.size());
+        });
+        if (stop || produced >= n_blocks) return;
+        b = produced;
+      }
+      Block &slot = ring[b % ring.size()];
+      int64_t lo = b * block_rows;
+      int64_t rows = std::min(block_rows, n_rows - lo);
+      int64_t want = rows * row_bytes;
+      int64_t got = 0;
+      while (got < want) {
+        ssize_t r = pread(fd, slot.data.data() + got, want - got,
+                          offset + lo * row_bytes + got);
+        if (r <= 0) { error = true; break; }
+        got += r;
+      }
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        slot.rows = error ? -1 : rows;
+        slot.ready = true;
+        ++produced;
+      }
+      cv_can_consume.notify_one();
+      if (error) return;
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void *br_open(const char *path, int64_t offset, int64_t row_bytes,
+              int64_t n_rows, int64_t block_rows, int32_t depth) {
+  if (row_bytes <= 0 || n_rows <= 0 || block_rows <= 0) return nullptr;
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  auto *r = new Reader();
+  r->fd = fd;
+  r->offset = offset;
+  r->row_bytes = row_bytes;
+  r->n_rows = n_rows;
+  r->block_rows = block_rows;
+  r->n_blocks = (n_rows + block_rows - 1) / block_rows;
+  int32_t d = depth < 1 ? 1 : (depth > 16 ? 16 : depth);
+  r->ring.resize((size_t)d + 1);
+  for (auto &b : r->ring) b.data.resize((size_t)(block_rows * row_bytes));
+  r->worker = std::thread([r] { r->run(); });
+  return r;
+}
+
+int64_t br_next(void *h, char *out) {
+  auto *r = static_cast<Reader *>(h);
+  if (!r) return -1;
+  if (r->consumed >= r->n_blocks) return 0;
+  int64_t b = r->consumed;
+  Block &slot = r->ring[b % r->ring.size()];
+  {
+    std::unique_lock<std::mutex> lk(r->mu);
+    r->cv_can_consume.wait(lk, [&] { return slot.ready || r->error; });
+  }
+  if (r->error || slot.rows < 0) return -1;
+  int64_t rows = slot.rows;
+  std::memcpy(out, slot.data.data(), (size_t)(rows * r->row_bytes));
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    slot.ready = false;
+    ++r->consumed;
+  }
+  r->cv_can_produce.notify_one();
+  return rows;
+}
+
+void br_close(void *h) {
+  auto *r = static_cast<Reader *>(h);
+  if (!r) return;
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->stop = true;
+  }
+  r->cv_can_produce.notify_all();
+  if (r->worker.joinable()) r->worker.join();
+  if (r->fd >= 0) close(r->fd);
+  delete r;
+}
+
+}  // extern "C"
