@@ -180,12 +180,12 @@ class PCA(TransformerMixin, BaseEstimator):
         xc = (X.data - mean) * mask[:, None]
         solver = "full" if frac is not None else self._solver(k, n, d)
         if solver == "full":
-            u, s, vt = linalg.svd_tall(xc, X.mesh)
+            u, s, vt = linalg.svd_tall_jit(xc, X.mesh)
         else:
             key = jax.random.PRNGKey(
                 0 if self.random_state is None else int(self.random_state)
             )
-            u, s, vt = linalg.randomized_svd(
+            u, s, vt = linalg.randomized_svd_jit(
                 xc, k, key, X.mesh,
                 n_iter=max(int(self.iterated_power), 2),
             )
@@ -298,12 +298,12 @@ class TruncatedSVD(TransformerMixin, BaseEstimator):
         if self.algorithm == "tsqr":
             if n < d:
                 raise ValueError("tsqr algorithm requires n_samples >= n_features")
-            u, s, vt = linalg.svd_tall(data, X.mesh)
+            u, s, vt = linalg.svd_tall_jit(data, X.mesh)
         elif self.algorithm == "randomized":
             key = jax.random.PRNGKey(
                 0 if self.random_state is None else int(self.random_state)
             )
-            u, s, vt = linalg.randomized_svd(
+            u, s, vt = linalg.randomized_svd_jit(
                 data, k, key, X.mesh, n_iter=self.n_iter
             )
         else:

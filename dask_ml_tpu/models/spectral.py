@@ -95,7 +95,7 @@ class SpectralClustering(ClusterMixin, BaseEstimator):
         deg = jnp.where(deg > 1e-12, deg, 1.0)
         G = (B / jnp.sqrt(deg)[:, None]) @ inv_sqrt     # (n, c) sharded
 
-        u, s, _ = linalg.svd_tall(G, X.mesh)
+        u, s, _ = linalg.svd_tall_jit(G, X.mesh)
         emb = u[:, : self.n_clusters]
         norms = jnp.linalg.norm(emb, axis=1, keepdims=True)
         emb = emb / jnp.where(norms > 1e-12, norms, 1.0)

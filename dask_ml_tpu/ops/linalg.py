@@ -110,6 +110,16 @@ def randomized_svd(x, n_components, key, mesh, n_oversamples=10, n_iter=4):
     return u[:, :k], s[:k], vt[:k]
 
 
+# Jitted entry points: the eager versions above dispatch one program per
+# op — dozens of launches per SVD — which dominates wall clock on
+# runtimes with high per-launch overhead (tunneled TPU). These compile
+# the whole decomposition into one program; mesh/sizes are static.
+svd_tall_jit = jax.jit(svd_tall, static_argnums=(1,))
+randomized_svd_jit = jax.jit(
+    randomized_svd, static_argnums=(1, 3, 4, 5)
+)
+
+
 def svd_flip(u, vt):
     """Deterministic SVD signs, V-based (matches sklearn's
     ``svd_flip(u_based_decision=False)``): flip so each row of Vt has its
