@@ -1,0 +1,110 @@
+"""Classification/regression metrics + scorer registry parity with
+sklearn (SURVEY.md §2a Metrics row) — on host arrays AND sharded inputs
+with padding (the masked-reduction contract)."""
+
+import numpy as np
+import pytest
+import sklearn.metrics as skm
+
+from dask_ml_tpu.metrics import (
+    accuracy_score,
+    log_loss,
+    mean_absolute_error,
+    mean_squared_error,
+    r2_score,
+)
+from dask_ml_tpu.metrics.scorer import SCORERS, check_scoring, get_scorer
+from dask_ml_tpu.parallel import as_sharded
+
+
+@pytest.fixture(scope="module")
+def cls_data():
+    rng = np.random.RandomState(0)
+    y = rng.randint(0, 2, 301).astype(np.float64)  # odd n: real padding
+    p = np.clip(rng.uniform(size=301) * 0.8 + y * 0.2, 0.02, 0.98)
+    pred = (p > 0.5).astype(np.float64)
+    return y, pred, p
+
+
+@pytest.fixture(scope="module")
+def reg_data():
+    rng = np.random.RandomState(1)
+    y = rng.randn(301) * 3 + 1
+    pred = y + rng.randn(301) * 0.7
+    return y, pred
+
+
+def test_accuracy_parity(cls_data):
+    y, pred, _ = cls_data
+    ref = skm.accuracy_score(y, pred)
+    assert accuracy_score(y, pred) == pytest.approx(ref, abs=1e-6)
+    assert accuracy_score(
+        as_sharded(y), as_sharded(pred)
+    ) == pytest.approx(ref, abs=1e-6)
+
+
+def test_log_loss_parity(cls_data):
+    y, _, p = cls_data
+    proba = np.stack([1 - p, p], axis=1)
+    ref = skm.log_loss(y, proba)
+    assert log_loss(y, proba) == pytest.approx(ref, rel=1e-5)
+    assert log_loss(
+        as_sharded(y), as_sharded(proba)
+    ) == pytest.approx(ref, rel=1e-5)
+
+
+@pytest.mark.parametrize("ours,theirs", [
+    (mean_squared_error, skm.mean_squared_error),
+    (mean_absolute_error, skm.mean_absolute_error),
+    (r2_score, skm.r2_score),
+])
+def test_regression_metric_parity(reg_data, ours, theirs):
+    y, pred = reg_data
+    ref = theirs(y, pred)
+    assert ours(y, pred) == pytest.approx(ref, rel=1e-5)
+    assert ours(
+        as_sharded(y), as_sharded(pred)
+    ) == pytest.approx(ref, rel=1e-5)
+
+
+def test_scorer_registry(cls_data, reg_data):
+    assert set(SCORERS) >= {
+        "accuracy", "neg_mean_squared_error", "neg_mean_absolute_error",
+        "neg_log_loss", "r2",
+    }
+    with pytest.raises(ValueError, match="not a valid scoring"):
+        get_scorer("nope")
+
+    class Fixed:
+        def predict(self, X):
+            return np.asarray(X)[:, 0]
+
+        def score(self, X, y):
+            return 0.5
+
+    X = np.stack([reg_data[1], reg_data[1]], axis=1)
+    y = reg_data[0]
+    s = get_scorer("neg_mean_squared_error")(Fixed(), X, y)
+    assert s == pytest.approx(-skm.mean_squared_error(y, X[:, 0]), rel=1e-5)
+    # check_scoring falls back to est.score; callable passthrough
+    assert check_scoring(Fixed(), None)(Fixed(), X, y) == 0.5
+    assert check_scoring(Fixed(), lambda e, a, b: 7.0)(Fixed(), X, y) == 7.0
+
+    class NoScore:
+        pass
+
+    with pytest.raises(TypeError, match="no score method"):
+        check_scoring(NoScore(), None)
+
+
+def test_greater_is_better_signs(reg_data):
+    y, pred = reg_data
+
+    class P:
+        def predict(self, X):
+            return pred
+
+    assert get_scorer("neg_mean_absolute_error")(P(), None, y) < 0
+    assert get_scorer("r2")(P(), None, y) == pytest.approx(
+        skm.r2_score(y, pred), rel=1e-5
+    )
