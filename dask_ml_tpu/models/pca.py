@@ -147,7 +147,9 @@ class PCA(TransformerMixin, BaseEstimator):
         self.singular_values_ = np.sqrt(ev[:k] * (n - 1))
         self.mean_ = mean
         if k < min(n, d):
-            self.noise_variance_ = (total_var - ev[:k].sum()) / (min(n, d) - k)
+            self.noise_variance_ = max(
+                (total_var - ev[:k].sum()) / (min(n, d) - k), 0.0
+            )
         else:
             self.noise_variance_ = 0.0
         self.n_features_in_ = d
@@ -203,7 +205,9 @@ class PCA(TransformerMixin, BaseEstimator):
         self.singular_values_ = to_host(s)[:k].astype(np.float64)
         self.mean_ = to_host(mean).astype(np.float64)
         if k < min(n, d):
-            self.noise_variance_ = (total_var - ev[:k].sum()) / (min(n, d) - k)
+            self.noise_variance_ = max(
+                (total_var - ev[:k].sum()) / (min(n, d) - k), 0.0
+            )
         else:
             self.noise_variance_ = 0.0
         self.n_features_in_ = d
@@ -268,6 +272,75 @@ class PCA(TransformerMixin, BaseEstimator):
         out = scores @ comp + jnp.asarray(self.mean_, X.dtype)
         out = out * X.row_mask(out.dtype)[:, None]
         return ShardedArray(out, X.n_rows, X.mesh)
+
+    # -- probabilistic-PCA scoring (sklearn parity) -----------------------
+    def _scoring_components(self):
+        """(components, explained_variance) with sklearn's whiten
+        adjustment: whitened components_ are unit-scaled, so the model
+        covariance needs them rescaled by sqrt(ev)."""
+        comp = np.asarray(self.components_, np.float64)
+        ev = np.asarray(self.explained_variance_, np.float64)
+        if getattr(self, "whiten", False):
+            comp = comp * np.sqrt(ev)[:, None]
+        return comp, ev
+
+    def get_covariance(self):
+        """cov = components_ᵀ diag(ev - σ²) components_ + σ² I (small,
+        d×d, host — the data-sized work stays on device in score_samples)."""
+        check_is_fitted(self, "components_")
+        comp, ev = self._scoring_components()
+        sigma2 = float(self.noise_variance_)
+        cov = (comp.T * np.maximum(ev - sigma2, 0.0)) @ comp
+        cov[np.diag_indices_from(cov)] += max(sigma2, 0.0)
+        return cov
+
+    def get_precision(self):
+        check_is_fitted(self, "components_")
+        d = self.components_.shape[1]
+        sigma2 = float(self.noise_variance_)
+        if sigma2 <= 0.0:  # incl. roundoff-negative: Woodbury would flip sign
+            return np.linalg.pinv(self.get_covariance())
+        # Woodbury (sklearn's formula): avoids inverting the full cov
+        comp, ev = self._scoring_components()
+        scaled = comp * np.sqrt(np.maximum(ev - sigma2, 0.0))[:, None]
+        k = comp.shape[0]
+        inner = scaled @ scaled.T / sigma2 + np.eye(k)
+        precision = (np.eye(d) - scaled.T @ np.linalg.solve(inner, scaled)
+                     / sigma2) / sigma2
+        return precision
+
+    def score_samples(self, X):
+        """Per-sample log-likelihood under the probabilistic PCA model
+        (ref: sklearn/dask-ml PCA.score_samples). The d×d precision is
+        host math; the (n, d) quadratic form runs sharded on device."""
+        check_is_fitted(self, "components_")
+        precision = self.get_precision()
+        d = np.shape(X)[1]
+        sign, logdet = np.linalg.slogdet(precision)
+        const = -0.5 * (d * np.log(2.0 * np.pi) - sign * logdet)
+        from ..parallel.streaming import stream_plan, streamed_map
+
+        block_rows = stream_plan(X)
+        if block_rows is not None:  # out-of-core: block-wise quadratic form
+            mean = jnp.asarray(self.mean_, jnp.float32)
+            prec = jnp.asarray(precision, jnp.float32)
+
+            def block_ll(blk):
+                xc = (blk.arrays[0] - mean) * blk.mask[:, None]
+                return -0.5 * jnp.sum((xc @ prec) * xc, axis=1) + const
+
+            return streamed_map(X, block_rows, block_ll)
+        X = check_array(X, dtype=np.float32)
+        xc = (X.data - jnp.asarray(self.mean_, X.dtype)) \
+            * X.row_mask(X.dtype)[:, None]
+        quad = jnp.sum(
+            (xc @ jnp.asarray(precision, X.dtype)) * xc, axis=1
+        )
+        return to_host(-0.5 * quad + const)[: X.n_rows]
+
+    def score(self, X, y=None):
+        """Mean per-sample log-likelihood (sklearn parity)."""
+        return float(np.mean(self.score_samples(X)))
 
 
 class TruncatedSVD(TransformerMixin, BaseEstimator):
@@ -407,6 +480,10 @@ class IncrementalPCA(PCA):
         self.explained_variance_ = self.singular_values_ ** 2 / max(n - 1, 1)
         self.n_components_ = k
         self.n_features_in_ = d
+        # partial_fit streams never see total variance; fit() refines
+        # this from the full-pass variance
+        if not hasattr(self, "noise_variance_"):
+            self.noise_variance_ = 0.0
 
     def fit_transform(self, X, y=None):
         # PCA.fit_transform would run the batch SVD path; the incremental
@@ -421,8 +498,13 @@ class IncrementalPCA(PCA):
         # ratio needs the global variance, computed over the full pass
         X = check_array(X, dtype=np.float32)
         _, var = masked_mean_var(X.data, X.row_mask(X.dtype), X.n_rows, ddof=1)
-        self.explained_variance_ratio_ = self.explained_variance_ / float(
-            jnp.sum(var)
+        total_var = float(jnp.sum(var))
+        self.explained_variance_ratio_ = self.explained_variance_ / total_var
+        k, d = self.n_components_, self.n_features_in_
+        denom = min(X.n_rows, d) - k
+        self.noise_variance_ = (
+            max(total_var - self.explained_variance_.sum(), 0.0) / denom
+            if denom > 0 else 0.0
         )
         self.n_samples_ = X.n_rows
         return self

@@ -147,3 +147,69 @@ def test_take_rows_bounds_check():
         take_rows(sx, np.array([0, 10]))
     with pytest.raises(IndexError):
         take_rows(sx, np.array([-1]))
+
+
+def test_pca_probabilistic_scoring_parity():
+    """get_covariance/get_precision/score_samples/score match sklearn's
+    probabilistic-PCA formulas on the same fitted subspace."""
+    from sklearn.decomposition import PCA as SkPCA
+
+    from dask_ml_tpu.decomposition import PCA
+    from dask_ml_tpu.parallel import as_sharded
+
+    rng = np.random.RandomState(0)
+    n, d = 600, 8
+    X = (rng.randn(n, d) * np.linspace(3, 0.3, d)).astype(np.float64)
+
+    ours = PCA(n_components=3, svd_solver="full").fit(as_sharded(X))
+    sk = SkPCA(n_components=3, svd_solver="full").fit(X)
+
+    np.testing.assert_allclose(ours.get_covariance(), sk.get_covariance(),
+                               rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(ours.get_precision(), sk.get_precision(),
+                               rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(
+        ours.score_samples(as_sharded(X)), sk.score_samples(X),
+        rtol=1e-3, atol=1e-3,
+    )
+    assert ours.score(as_sharded(X)) == pytest.approx(sk.score(X),
+                                                      rel=1e-3)
+
+
+def test_pca_scoring_whiten_and_incremental():
+    from sklearn.decomposition import PCA as SkPCA
+
+    from dask_ml_tpu.decomposition import PCA, IncrementalPCA
+    from dask_ml_tpu.parallel import as_sharded
+
+    rng = np.random.RandomState(1)
+    X = (rng.randn(500, 6) * np.linspace(2, 0.4, 6)).astype(np.float64)
+    ours = PCA(n_components=3, whiten=True, svd_solver="full").fit(
+        as_sharded(X)
+    )
+    sk = SkPCA(n_components=3, whiten=True, svd_solver="full").fit(X)
+    np.testing.assert_allclose(ours.get_precision(), sk.get_precision(),
+                               rtol=1e-3, atol=1e-4)
+    assert ours.score(as_sharded(X)) == pytest.approx(sk.score(X),
+                                                      rel=1e-3)
+    # IncrementalPCA: scoring API usable after fit (noise_variance_ set)
+    ipca = IncrementalPCA(n_components=3).fit(as_sharded(X))
+    assert np.isfinite(ipca.score(as_sharded(X)))
+
+
+def test_pca_score_samples_streams_out_of_core(tmp_path):
+    from dask_ml_tpu import config
+    from dask_ml_tpu.decomposition import PCA
+
+    rng = np.random.RandomState(2)
+    X = (rng.randn(2000, 5) * [3, 2, 1, 0.5, 0.2]).astype(np.float32)
+    path = str(tmp_path / "X.f32")
+    mm = np.memmap(path, dtype=np.float32, mode="w+", shape=X.shape)
+    mm[:] = X
+    mm.flush()
+    mm = np.memmap(path, dtype=np.float32, mode="r", shape=X.shape)
+    with config.set(stream_block_rows=512):
+        p = PCA(n_components=2).fit(mm)
+        ll_stream = p.score_samples(mm)
+    ll_res = p.score_samples(X)
+    np.testing.assert_allclose(ll_stream, ll_res, rtol=1e-4, atol=1e-4)
