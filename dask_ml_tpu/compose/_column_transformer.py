@@ -18,9 +18,17 @@ from ..parallel.sharded import ShardedArray, as_sharded
 from ..utils.validation import check_is_fitted
 
 
+def _is_partitioned(X):
+    from ..parallel.frames import PartitionedFrame
+
+    return isinstance(X, PartitionedFrame)
+
+
 def _select(X, cols):
     if isinstance(X, pd.DataFrame):
         return X[cols] if isinstance(cols, list) else X[[cols]]
+    if _is_partitioned(X):
+        return X[cols if isinstance(cols, list) else [cols]]
     if isinstance(X, ShardedArray):
         idx = np.atleast_1d(np.asarray(cols, dtype=int))
         return ShardedArray(X.data[:, idx], X.n_rows, X.mesh)
@@ -30,10 +38,9 @@ def _select(X, cols):
 
 
 def _to_stackable(out):
-    if isinstance(out, ShardedArray):
+    if isinstance(out, ShardedArray) or isinstance(out, pd.DataFrame) \
+            or _is_partitioned(out):
         return out
-    if isinstance(out, pd.DataFrame):
-        return out.to_numpy()
     return np.asarray(out)
 
 
@@ -50,7 +57,7 @@ class ColumnTransformer(TransformerMixin, BaseEstimator):
         self.preserve_dataframe = preserve_dataframe
 
     def _all_columns(self, X):
-        if isinstance(X, pd.DataFrame):
+        if isinstance(X, pd.DataFrame) or _is_partitioned(X):
             return list(X.columns)
         return list(range(X.shape[1]))
 
@@ -114,13 +121,58 @@ class ColumnTransformer(TransformerMixin, BaseEstimator):
             data = jnp.concatenate([o.data for o in outs], axis=1)
             first = outs[0]
             return ShardedArray(data, first.n_rows, first.mesh)
-        host = [
-            o.to_numpy() if isinstance(o, ShardedArray) else o for o in outs
-        ]
+        frame_in = isinstance(X, pd.DataFrame) or _is_partitioned(X)
+        if frame_in and self.preserve_dataframe and all(
+            isinstance(o, pd.DataFrame) or _is_partitioned(o) for o in outs
+        ):
+            stacked = self._hstack_frames(outs, X)
+            if stacked is not None:
+                return stacked
+        host = []
+        for o in outs:
+            if isinstance(o, ShardedArray):
+                host.append(o.to_numpy())
+            elif _is_partitioned(o):
+                host.append(o.compute().to_numpy())
+            elif isinstance(o, pd.DataFrame):
+                host.append(o.to_numpy())
+            else:
+                host.append(o)
         out = np.concatenate(host, axis=1)
         if isinstance(X, ShardedArray):
             return as_sharded(out, mesh=X.mesh)
         return out
+
+    def _hstack_frames(self, outs, X):
+        """Column-concatenate frame branch outputs preserving the input's
+        frame type, index, and (for PartitionedFrame) partition boundaries
+        — the reference's dd frame-in/frame-out ColumnTransformer path.
+        Returns None when partition boundaries diverge (caller then falls
+        back to the host concat path)."""
+        if isinstance(X, pd.DataFrame):
+            frames = [
+                o if isinstance(o, pd.DataFrame) else o.compute()
+                for o in outs
+            ]
+            return pd.concat(frames, axis=1)
+        from ..parallel.frames import PartitionedFrame
+
+        bounds = [len(p) for p in X.partitions]
+        parts_per = []
+        for o in outs:
+            if isinstance(o, pd.DataFrame):
+                chunks, off = [], 0
+                for n in bounds:
+                    chunks.append(o.iloc[off:off + n])
+                    off += n
+                parts_per.append(chunks)
+            else:
+                if [len(p) for p in o.partitions] != bounds:
+                    return None
+                parts_per.append(list(o.partitions))
+        return PartitionedFrame(
+            [pd.concat(ps, axis=1) for ps in zip(*parts_per)]
+        )
 
     @property
     def named_transformers_(self):

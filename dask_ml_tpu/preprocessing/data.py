@@ -14,11 +14,13 @@ sketch-based upgrade.
 
 from __future__ import annotations
 
+import functools
 import itertools
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pandas as pd
 
 from ..base import BaseEstimator, TransformerMixin, to_host
 from ..ops import reductions
@@ -31,9 +33,123 @@ def _handle_zeros_in_scale(scale):
     return np.where(scale == 0.0, 1.0, scale)
 
 
+def _frame_parts(X):
+    """(partition list, kind) for frame inputs; (None, None) otherwise.
+
+    The reference's scalers consume dd.DataFrames natively and return
+    frames of the same type (ref: dask_ml/preprocessing/data.py — the
+    dd path of StandardScaler etc.); here the frame types are pandas
+    and :class:`~dask_ml_tpu.parallel.frames.PartitionedFrame`.
+    """
+    if isinstance(X, pd.DataFrame):
+        return [X], "pandas"
+    from ..parallel.frames import PartitionedFrame
+
+    if isinstance(X, PartitionedFrame):
+        return list(X.partitions), "partitioned"
+    return None, None
+
+
+def _frame_device(parts, cols):
+    """Place frame partitions on the mesh, rejecting unencoded columns.
+    Reuses PartitionedFrame.to_sharded as the single frame→device
+    bridge."""
+    from ..parallel.frames import PartitionedFrame
+
+    bad = [
+        c for c in cols
+        if not (pd.api.types.is_numeric_dtype(parts[0].dtypes[c])
+                or pd.api.types.is_bool_dtype(parts[0].dtypes[c]))
+    ]
+    if bad:
+        raise ValueError(
+            f"non-numeric columns {bad}: encode them first "
+            "(Categorizer + DummyEncoder/OrdinalEncoder)"
+        )
+    return PartitionedFrame(parts).to_sharded(columns=cols)
+
+
+def _frame_check_fitted_names(self, cols):
+    fitted = getattr(self, "feature_names_in_", None)
+    if fitted is not None and list(fitted) != list(cols):
+        raise ValueError(
+            f"feature names {list(cols)} do not match the names seen at "
+            f"fit time {list(fitted)}"
+        )
+
+
+def _frame_rebuild(self, parts, kind, cols, out):
+    """Rebuild the method result as the input's frame type with the
+    original partition boundaries and index."""
+    if not isinstance(out, ShardedArray):
+        return out
+    if out.shape[1] != len(cols):
+        # width-changing transform (PolynomialFeatures): honor the
+        # reference's preserve_dataframe switch
+        if not getattr(self, "preserve_dataframe", True):
+            return out
+        names = list(self.get_feature_names_out(cols))
+    else:
+        names = cols
+    arr = np.asarray(out.to_numpy())
+    rebuilt, off = [], 0
+    for p in parts:
+        rebuilt.append(pd.DataFrame(
+            arr[off:off + len(p)], index=p.index, columns=names
+        ))
+        off += len(p)
+    if kind == "pandas":
+        return rebuilt[0]
+    from ..parallel.frames import PartitionedFrame
+
+    return PartitionedFrame(rebuilt)
+
+
+def _frame_aware(method, name):
+    """Frame adapter for array-native transformer methods: frames are
+    placed on device (all columns must already be numeric — categorical
+    columns go through Categorizer/DummyEncoder/OrdinalEncoder first),
+    the array method runs on the mesh, and the result is rebuilt as the
+    SAME frame type with the original partition boundaries and index —
+    the reference's frame-in/frame-out contract."""
+
+    @functools.wraps(method)
+    def wrapper(self, X, *args, **kwargs):
+        parts, kind = _frame_parts(X)
+        if kind is None:
+            return method(self, X, *args, **kwargs)
+        cols = list(parts[0].columns)
+        if name != "fit":
+            _frame_check_fitted_names(self, cols)
+        out = method(self, _frame_device(parts, cols), *args, **kwargs)
+        if out is self:  # fit
+            self.feature_names_in_ = np.asarray(cols, dtype=object)
+            return self
+        return _frame_rebuild(self, parts, kind, cols, out)
+
+    return wrapper
+
+
 class _DeviceTransformer(TransformerMixin, BaseEstimator):
+    def __init_subclass__(cls, **kw):
+        # every subclass-defined fit/transform/inverse_transform gets the
+        # frame adapter; array inputs pass straight through
+        super().__init_subclass__(**kw)
+        for name in ("fit", "transform", "inverse_transform"):
+            if name in cls.__dict__:
+                setattr(cls, name, _frame_aware(cls.__dict__[name], name))
+
     def fit_transform(self, X, y=None, **kw):
-        return self.fit(X, y, **kw).transform(X)
+        parts, kind = _frame_parts(X)
+        if kind is None:
+            return self.fit(X, y, **kw).transform(X)
+        # frame input: one host-concat + one device placement for the
+        # whole fit+transform, then rebuild the frame once
+        cols = list(parts[0].columns)
+        Xs = _frame_device(parts, cols)
+        out = self.fit(Xs, y, **kw).transform(Xs)
+        self.feature_names_in_ = np.asarray(cols, dtype=object)
+        return _frame_rebuild(self, parts, kind, cols, out)
 
     def _sharded(self, X) -> ShardedArray:
         return check_array(X, dtype=np.float32)
@@ -287,7 +403,17 @@ class QuantileTransformer(_DeviceTransformer):
         def col(vals, qcol):
             if inverse:
                 return jnp.interp(vals, refs, qcol)
-            return jnp.interp(vals, qcol, refs)
+            # average of forward and reverse interpolation: sklearn's tie
+            # handling — on runs of equal values the one-sided interp is
+            # biased to the run's edge, the average lands mid-run
+            fwd = jnp.interp(vals, qcol, refs)
+            rev = -jnp.interp(-vals, -qcol[::-1], -refs[::-1])
+            out = 0.5 * (fwd + rev)
+            # boundary override, also sklearn: at/above the fitted max →
+            # exactly refs[-1], then at/below the fitted min → refs[0].
+            # Lower bound LAST so a constant column maps to refs[0]
+            out = jnp.where(vals >= qcol[-1], refs[-1], out)
+            return jnp.where(vals <= qcol[0], refs[0], out)
 
         out = jax.vmap(col, in_axes=(1, 1), out_axes=1)(data, quantiles)
         if not inverse and normal:

@@ -124,3 +124,119 @@ def test_train_test_split_frames(df):
 def test_mismatched_partitions_rejected(df):
     with pytest.raises(ValueError, match="mismatched columns"):
         PartitionedFrame([df[["a"]], df[["b"]]])
+
+
+def test_scalers_frame_in_frame_out(df):
+    """Scalers consume frames and return the SAME frame type with the
+    original columns/index/partition boundaries (the reference's dd
+    frame-in/frame-out scaler contract,
+    ref: dask_ml/preprocessing/data.py::StandardScaler dd path)."""
+    import sklearn.preprocessing as skp
+
+    from dask_ml_tpu.preprocessing import (
+        MinMaxScaler, QuantileTransformer, RobustScaler, StandardScaler,
+    )
+
+    num = df[["a", "b"]].astype(np.float64)
+    pf = from_pandas(num, npartitions=4)
+    cases = [
+        (StandardScaler(), skp.StandardScaler()),
+        (MinMaxScaler(), skp.MinMaxScaler()),
+        (RobustScaler(), skp.RobustScaler()),
+        (QuantileTransformer(n_quantiles=50),
+         skp.QuantileTransformer(n_quantiles=50)),
+    ]
+    for ours, ref in cases:
+        out = ours.fit(pf).transform(pf)
+        assert isinstance(out, PartitionedFrame)
+        assert [len(p) for p in out.partitions] == \
+            [len(p) for p in pf.partitions]
+        got = out.compute()
+        assert list(got.columns) == ["a", "b"]
+        assert got.index.equals(num.index)
+        want = ref.fit_transform(num)
+        np.testing.assert_allclose(got.to_numpy(), want,
+                                   rtol=2e-2, atol=2e-2)
+        # frame fit records the column names
+        np.testing.assert_array_equal(
+            ours.feature_names_in_, np.asarray(["a", "b"], dtype=object)
+        )
+        # pandas in → pandas out
+        assert isinstance(ours.fit(num).transform(num), pd.DataFrame)
+        # inverse round-trips back to the original values
+        back = ours.inverse_transform(out)
+        np.testing.assert_allclose(
+            back.compute().to_numpy(), num.to_numpy(), rtol=1e-2, atol=5e-2
+        )
+
+
+def test_scalers_reject_unencoded_categoricals(df):
+    from dask_ml_tpu.preprocessing import StandardScaler
+
+    pf = from_pandas(df, npartitions=3)  # column "c" holds strings
+    with pytest.raises(ValueError, match="encode"):
+        StandardScaler().fit(pf)
+
+
+def test_polynomial_features_preserve_dataframe(df):
+    from dask_ml_tpu.parallel import ShardedArray
+    from dask_ml_tpu.preprocessing import PolynomialFeatures
+
+    pf = from_pandas(df[["a", "b"]], npartitions=3)
+    out = PolynomialFeatures(degree=2, preserve_dataframe=True) \
+        .fit(pf).transform(pf)
+    assert isinstance(out, PartitionedFrame)
+    assert list(out.columns)[:3] == ["1", "a", "b"]
+    assert out.compute().shape == (len(df), 6)
+    # default preserve_dataframe=False returns a device array (the
+    # reference's default for frame input)
+    out2 = PolynomialFeatures(degree=2).fit(pf).transform(pf)
+    assert isinstance(out2, ShardedArray)
+
+
+def test_column_transformer_partitioned_frames(df):
+    """ColumnTransformer over PartitionedFrame: frame-in → frame-out with
+    partition boundaries preserved, scaled + passthrough columns."""
+    from dask_ml_tpu.compose import ColumnTransformer
+    from dask_ml_tpu.preprocessing import StandardScaler
+
+    num = df[["a", "b"]].astype(np.float64)
+    pf = from_pandas(num, npartitions=4)
+    ct = ColumnTransformer(
+        [("scale", StandardScaler(), ["a"])], remainder="passthrough"
+    )
+    out = ct.fit_transform(pf)
+    assert isinstance(out, PartitionedFrame)
+    assert list(out.columns) == ["a", "b"]
+    got = out.compute()
+    np.testing.assert_allclose(got["b"], num["b"])
+    assert abs(got["a"].mean()) < 1e-5
+    pd.testing.assert_frame_equal(ct.transform(pf).compute(), got)
+    # pandas input now yields a pandas frame as well
+    outp = ct.fit_transform(num)
+    assert isinstance(outp, pd.DataFrame)
+    np.testing.assert_allclose(outp.to_numpy(), got.to_numpy(),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_scaler_transform_validates_feature_names(df):
+    from dask_ml_tpu.preprocessing import StandardScaler
+
+    num = df[["a", "b"]].astype(np.float64)
+    scaler = StandardScaler().fit(from_pandas(num, npartitions=3))
+    flipped = from_pandas(num[["b", "a"]], npartitions=3)
+    with pytest.raises(ValueError, match="feature names"):
+        scaler.transform(flipped)
+
+
+def test_quantile_transformer_constant_column():
+    """sklearn maps a constant column to 0 (lower bound applied last)."""
+    import sklearn.preprocessing as skp
+
+    from dask_ml_tpu.preprocessing import QuantileTransformer
+
+    rng = np.random.RandomState(0)
+    Z = np.c_[np.full(300, 7.0), rng.randn(300)]
+    got = QuantileTransformer(n_quantiles=40).fit(Z).transform(Z).to_numpy()
+    want = skp.QuantileTransformer(n_quantiles=40).fit_transform(Z)
+    np.testing.assert_allclose(got, want, atol=1e-6)
