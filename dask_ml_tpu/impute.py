@@ -45,7 +45,7 @@ class SimpleImputer(TransformerMixin, BaseEstimator):
                 f"strategy must be one of {_STRATEGIES}, got "
                 f"{self.strategy!r}"
             )
-        X = check_array(X, dtype=np.float32)
+        X = check_array(X, dtype=np.float32, allow_nan=True)
         mask = X.row_mask(X.dtype)
         missing = self._missing_mask(X.data) | (mask[:, None] == 0)
         valid = (~missing).astype(X.dtype)
@@ -80,7 +80,7 @@ class SimpleImputer(TransformerMixin, BaseEstimator):
 
     def transform(self, X):
         check_is_fitted(self, "statistics_")
-        X = check_array(X, dtype=np.float32)
+        X = check_array(X, dtype=np.float32, allow_nan=True)
         missing = self._missing_mask(X.data)
         out = jnp.where(
             missing, jnp.asarray(self.statistics_, X.dtype)[None, :], X.data

@@ -28,6 +28,16 @@ from .solvers import regularizers
 from .solvers.solvers import solve
 
 
+def _check_poisson_targets(ymin):
+    """Shared non-negativity gate for BOTH Poisson fit paths (device-
+    resident and streamed) — one rule, one message."""
+    if ymin < 0:
+        raise ValueError(
+            "PoissonRegression requires non-negative targets; "
+            f"got min(y) = {ymin}"
+        )
+
+
 def add_intercept(X):
     """Append a ones column (ref: dask_ml/linear_model/utils.py::add_intercept).
 
@@ -177,6 +187,10 @@ class _GLMBase(BaseEstimator):
             X.data, y.data, mask, fit_intercept=self.fit_intercept,
             to_bf16=use_bf16, encode=self.family == "logistic",
         )
+        if self.family == "poisson":
+            _check_poisson_targets(
+                float(jnp.min(jnp.where(mask > 0, y_data, jnp.inf)))
+            )
         classes = None
         if self.family == "logistic":
             pk = np.asarray(packed)  # one small fetch: (mn, mx, binary)
@@ -276,6 +290,12 @@ class PoissonRegression(_GLMBase):
     """Ref: dask_ml/linear_model/glm.py::PoissonRegression."""
 
     family = "poisson"
+
+    def _encode_y_host(self, y):
+        y = np.asarray(y, np.float32)
+        if y.size:
+            _check_poisson_targets(float(y.min()))
+        return y, None
 
     def predict(self, X):
         check_is_fitted(self, "coef_")

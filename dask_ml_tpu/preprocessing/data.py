@@ -151,8 +151,14 @@ class _DeviceTransformer(TransformerMixin, BaseEstimator):
         self.feature_names_in_ = np.asarray(cols, dtype=object)
         return _frame_rebuild(self, parts, kind, cols, out)
 
+    # quantile-based transformers compute NaN-skipping statistics
+    # (nanquantile), so they accept NaN like sklearn's 'allow-nan' mode;
+    # moment-based scalers keep strict rejection (their masked reductions
+    # would silently propagate NaN into the fitted statistics)
+    _allow_nan = False
+
     def _sharded(self, X) -> ShardedArray:
-        return check_array(X, dtype=np.float32)
+        return check_array(X, dtype=np.float32, allow_nan=self._allow_nan)
 
 
 class StandardScaler(_DeviceTransformer):
@@ -312,6 +318,8 @@ class RobustScaler(_DeviceTransformer):
     """Ref: dask_ml/preprocessing/data.py::RobustScaler (approximate
     quantiles there; exact here)."""
 
+    _allow_nan = True
+
     def __init__(self, with_centering=True, with_scaling=True,
                  quantile_range=(25.0, 75.0), copy=True):
         self.with_centering = with_centering
@@ -358,6 +366,8 @@ class RobustScaler(_DeviceTransformer):
 class QuantileTransformer(_DeviceTransformer):
     """Ref: dask_ml/preprocessing/data.py::QuantileTransformer — maps each
     feature through its empirical CDF via interpolation."""
+
+    _allow_nan = True
 
     def __init__(self, n_quantiles=1000, output_distribution="uniform",
                  ignore_implicit_zeros=False, subsample=int(1e5),

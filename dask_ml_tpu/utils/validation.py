@@ -14,7 +14,24 @@ from ..parallel.mesh import resolve_mesh
 from ..parallel.sharded import ShardedArray, as_sharded
 
 
-def check_array(x, mesh=None, dtype=None, ensure_2d=True, copy=False) -> ShardedArray:
+def _assert_all_finite(arr, name="Input", allow_nan=False):
+    """sklearn-parity finiteness gate for HOST float arrays (the
+    reference inherits it from sklearn's check_array force_all_finite;
+    ``allow_nan`` is its 'allow-nan' mode — NaN passes, inf never does).
+    Device-resident inputs skip this — the solver-loop sanitizers
+    (SURVEY.md §5 row 2) guard those without an extra device pass."""
+    if not (isinstance(arr, np.ndarray)
+            and np.issubdtype(arr.dtype, np.floating)):
+        return
+    if allow_nan:
+        if np.isinf(arr).any():
+            raise ValueError(f"{name} contains infinity.")
+    elif not np.isfinite(arr).all():
+        raise ValueError(f"{name} contains NaN or infinity.")
+
+
+def check_array(x, mesh=None, dtype=None, ensure_2d=True, copy=False,
+                allow_nan=False) -> ShardedArray:
     if not isinstance(x, ShardedArray):
         arr = np.asarray(x)
         if arr.ndim == 1 and ensure_2d:
@@ -23,6 +40,11 @@ def check_array(x, mesh=None, dtype=None, ensure_2d=True, copy=False) -> Sharded
             )
         if arr.ndim > 2:
             raise ValueError(f"Expected <=2D array, got shape {arr.shape}.")
+        if dtype is not None and np.issubdtype(np.dtype(dtype), np.floating):
+            # validate AFTER the target-dtype cast: a finite float64 can
+            # overflow to inf in float32 (sklearn checks post-conversion)
+            arr = arr.astype(dtype, copy=False)
+        _assert_all_finite(arr, "X", allow_nan=allow_nan)
         x = arr
     return as_sharded(x, mesh=resolve_mesh(mesh), dtype=dtype)
 
@@ -34,6 +56,8 @@ def check_X_y(X, y, mesh=None, dtype=None):
     if n_X != n_y:
         raise ValueError(f"X and y have inconsistent lengths: {n_X} vs {n_y}")
     X = check_array(X, mesh=mesh, dtype=dtype)
+    if not isinstance(y, ShardedArray):
+        _assert_all_finite(np.asarray(y), "y")
     y = as_sharded(y, mesh=mesh, dtype=dtype)
     return X, y
 

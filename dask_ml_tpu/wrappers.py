@@ -188,6 +188,17 @@ class Incremental(ParallelPostFit):
                 f"{type(est).__name__} has no partial_fit; Incremental "
                 "requires a partial_fit-capable estimator"
             )
+        # classifiers need `classes` on the first partial_fit; the
+        # reference makes callers pass classes= explicitly (y is a lazy
+        # dask array there, a global unique is a cluster job) — here y is
+        # concrete, so infer it when omitted (explicit classes= still wins)
+        from sklearn.base import is_classifier
+
+        if (y is not None and "classes" not in fit_kwargs
+                and is_classifier(est)):
+            yh = y.to_numpy() if isinstance(y, ShardedArray) \
+                else np.asarray(y)
+            fit_kwargs["classes"] = np.unique(yh)
         rng = np.random.RandomState(self.random_state)
         self.estimator_ = self._partial_fit_pass(
             est, X, y, self._block_size(X), rng, **fit_kwargs

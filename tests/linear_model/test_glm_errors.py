@@ -1,0 +1,102 @@
+"""GLM error paths and degenerate inputs (ref: the reference's
+tests/linear_model/test_glm.py error cases and sklearn's validation
+behavior, which dask_ml/linear_model/glm.py inherits via check_X_y).
+
+Solvers must fail loudly on invalid configurations and stay finite on
+degenerate-but-legal inputs — a NaN that silently satisfies a
+``gnorm > tol`` while_loop would otherwise read as convergence
+(SURVEY.md §5 sanitizer row).
+"""
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu.linear_model import (
+    LinearRegression, LogisticRegression, PoissonRegression,
+)
+
+rng = np.random.RandomState(0)
+X = rng.randn(80, 6).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.float32)
+
+
+def test_unknown_solver_raises():
+    with pytest.raises(ValueError, match="solver"):
+        LogisticRegression(solver="sgdqn").fit(X, y)
+
+
+def test_l1_with_lbfgs_raises():
+    # smooth solvers cannot honor a non-smooth penalty
+    with pytest.raises(ValueError, match="penalty|l1"):
+        LogisticRegression(solver="lbfgs", penalty="l1").fit(X, y)
+
+
+def test_unknown_penalty_raises():
+    with pytest.raises(ValueError, match="penalty"):
+        LogisticRegression(penalty="l7").fit(X, y)
+
+
+def test_mismatched_lengths_raise():
+    with pytest.raises(ValueError):
+        LogisticRegression().fit(X, y[:-5])
+
+
+def test_1d_X_rejected():
+    with pytest.raises(ValueError):
+        LogisticRegression().fit(X[:, 0], y)
+
+
+def test_predict_before_fit_raises():
+    with pytest.raises((ValueError, AttributeError)):
+        LogisticRegression().predict(X)
+
+
+def test_more_than_two_classes_raises():
+    y3 = rng.randint(0, 3, len(X)).astype(np.float32)
+    with pytest.raises(ValueError, match="class"):
+        LogisticRegression(solver="lbfgs", max_iter=10).fit(X, y3)
+
+
+def test_single_class_raises():
+    y1 = np.zeros(len(X), np.float32)
+    with pytest.raises(ValueError, match="class"):
+        LogisticRegression(solver="lbfgs", max_iter=10).fit(X, y1)
+
+
+@pytest.mark.parametrize("solver", ["lbfgs", "newton", "gradient_descent"])
+def test_underdetermined_fit_stays_finite(solver):
+    # n < d: the normal equations are rank-deficient; coefficients must
+    # still come back finite (newton falls back to lstsq)
+    Xu = rng.randn(8, 20).astype(np.float32)
+    yu = (Xu[:, 0] > 0).astype(np.float32)
+    clf = LogisticRegression(solver=solver, max_iter=10).fit(Xu, yu)
+    assert np.isfinite(clf.coef_).all()
+    assert np.isfinite(clf.intercept_).all()
+
+
+def test_nonfinite_input_rejected():
+    Xbad = X.copy()
+    Xbad[3, 2] = np.inf
+    with pytest.raises(ValueError, match="finite|NaN|inf"):
+        LogisticRegression().fit(Xbad, y)
+
+
+def test_poisson_negative_targets_rejected():
+    with pytest.raises(ValueError, match="negative|non-negative"):
+        PoissonRegression(max_iter=5).fit(X, -np.abs(y) - 1.0)
+
+
+def test_linear_regression_constant_column_finite():
+    Xc = X.copy()
+    Xc[:, 1] = 3.0  # collinear with the intercept column
+    m = LinearRegression(solver="newton", max_iter=10).fit(Xc, X[:, 0])
+    assert np.isfinite(m.coef_).all()
+
+
+def test_float32_overflow_detected():
+    # finite in float64, inf after the float32 cast: must be rejected
+    # (validation runs post-conversion, as sklearn's check_array does)
+    Xo = X.astype(np.float64).copy()
+    Xo[0, 0] = 1e40
+    with pytest.raises(ValueError, match="infinity"):
+        LogisticRegression().fit(Xo, y)

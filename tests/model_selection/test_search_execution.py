@@ -23,28 +23,35 @@ def _search(**kw):
     )
 
 
-def test_threaded_matches_synchronous(xy_classification):
+@pytest.fixture(scope="module")
+def seq_search(xy_classification):
+    # ONE synchronous reference search shared by every comparison test
+    # (a single CPU runs each fit serially; recomputing the identical
+    # reference per test dominated this file's runtime)
     X, y = xy_classification
-    seq = _search(scheduler="synchronous").fit(X, y)
+    return _search(scheduler="synchronous").fit(X, y)
+
+
+def test_threaded_matches_synchronous(xy_classification, seq_search):
+    X, y = xy_classification
     par = _search(n_jobs=4).fit(X, y)  # default scheduler: threads
     np.testing.assert_allclose(
-        seq.cv_results_["mean_test_score"],
+        seq_search.cv_results_["mean_test_score"],
         par.cv_results_["mean_test_score"], rtol=1e-5,
     )
-    assert seq.best_params_ == par.best_params_
+    assert seq_search.best_params_ == par.best_params_
 
 
-def test_threaded_sharded_input(xy_classification):
+def test_threaded_sharded_input(xy_classification, seq_search):
     from dask_ml_tpu.parallel import as_sharded
 
     X, y = xy_classification
     Xs, ys = as_sharded(X.astype(np.float32)), as_sharded(
         y.astype(np.float32))
     par = _search(n_jobs=2).fit(Xs, ys)
-    seq = _search(scheduler="synchronous").fit(X, y)
     np.testing.assert_allclose(
         par.cv_results_["mean_test_score"],
-        seq.cv_results_["mean_test_score"], rtol=1e-4,
+        seq_search.cv_results_["mean_test_score"], rtol=1e-4,
     )
 
 
@@ -62,12 +69,11 @@ def test_invalid_scheduler_raises(xy_classification):
         _search(n_jobs=0).fit(X, y)
 
 
-def test_cache_cv_false_same_results(xy_classification):
+def test_cache_cv_false_same_results(xy_classification, seq_search):
     X, y = xy_classification
-    on = _search(cache_cv=True, scheduler="synchronous").fit(X, y)
     off = _search(cache_cv=False, scheduler="synchronous").fit(X, y)
     np.testing.assert_allclose(
-        on.cv_results_["mean_test_score"],
+        seq_search.cv_results_["mean_test_score"],
         off.cv_results_["mean_test_score"], rtol=1e-5,
     )
 

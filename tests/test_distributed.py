@@ -171,8 +171,8 @@ _SEARCH_WORKER = textwrap.dedent("""
                                n_informative=4, random_state=0)
     X = X.astype(np.float32); y = y.astype(np.float32)
     search = GridSearchCV(
-        LogisticRegression(solver="lbfgs", max_iter=50),
-        {{"C": [0.01, 0.1, 1.0, 10.0]}}, cv=3,
+        LogisticRegression(solver="lbfgs", max_iter=25),
+        {{"C": [0.01, 0.1, 1.0, 10.0]}}, cv=2,
         scheduler="synchronous", refit=True,
     )
     search.fit(X, y)
@@ -207,9 +207,11 @@ def test_two_process_distributed_search(tmp_path):
                                n_informative=4, random_state=0)
     X = X.astype(np.float32)
     y = y.astype(np.float32)
+    # cv=2/max_iter=25: one fold shape means ONE lbfgs compile per
+    # process; the distribution semantics under test are unchanged
     seq = GridSearchCV(
-        LogisticRegression(solver="lbfgs", max_iter=50),
-        {"C": [0.01, 0.1, 1.0, 10.0]}, cv=3,
+        LogisticRegression(solver="lbfgs", max_iter=25),
+        {"C": [0.01, 0.1, 1.0, 10.0]}, cv=2,
         scheduler="synchronous", refit=False,
     ).fit(X, y)
     expected_path = str(tmp_path / "expected.npy")
@@ -249,7 +251,7 @@ _HB_BODY = textwrap.dedent("""
     w = rng.randn(6)
     y = (X @ w > 0).astype(np.float32)
     params = {{"alpha": [1e-5, 1e-4, 1e-3, 1e-2],
-              "eta0": [0.01, 0.05, 0.1, 0.5]}}
+              "eta0": [0.05, 0.5]}}
     search = HyperbandSearchCV(
         SGDClassifier(tol=1e-3, random_state=0), params,
         max_iter=9, aggressiveness=3, random_state=0,

@@ -1,0 +1,125 @@
+"""Package-wide sklearn-contract sweep (ref: SURVEY.md §4 "sklearn API
+fidelity ... MUST, for clone/search compat" and the reference's reliance
+on sklearn's estimator checks across its suite).
+
+Every public estimator must satisfy: get_params/set_params round-trip,
+clone() yields an unfitted copy, fit returns self, fitted attributes are
+underscore-suffixed, and predict/transform before fit raises. This is the
+contract GridSearchCV/Incremental/Hyperband rely on to clone and re-fit
+candidates, so a violation here breaks every meta-estimator above it.
+"""
+
+import numpy as np
+import pytest
+from sklearn.exceptions import NotFittedError
+
+from dask_ml_tpu.base import clone
+
+rng = np.random.RandomState(0)
+Xc = rng.randn(64, 5).astype(np.float32)
+yc = (Xc[:, 0] + 0.3 * rng.randn(64) > 0).astype(np.float32)
+yr = (Xc @ rng.randn(5) + 0.1 * rng.randn(64)).astype(np.float32)
+
+
+def _cases():
+    from dask_ml_tpu.cluster import KMeans, SpectralClustering
+    from dask_ml_tpu.decomposition import PCA, IncrementalPCA, TruncatedSVD
+    from dask_ml_tpu.ensemble import (
+        BlockwiseVotingClassifier, BlockwiseVotingRegressor,
+    )
+    from dask_ml_tpu.impute import SimpleImputer
+    from dask_ml_tpu.linear_model import (
+        LinearRegression, LogisticRegression, PoissonRegression,
+        SGDClassifier, SGDRegressor,
+    )
+    from dask_ml_tpu.naive_bayes import GaussianNB
+    from dask_ml_tpu.preprocessing import (
+        MinMaxScaler, PolynomialFeatures, QuantileTransformer, RobustScaler,
+        StandardScaler,
+    )
+    from dask_ml_tpu.wrappers import Incremental, ParallelPostFit
+
+    # (estimator, y-or-None, fitted attribute, prediction method)
+    return [
+        (LogisticRegression(solver="lbfgs", max_iter=20), yc,
+         "coef_", "predict"),
+        (LinearRegression(solver="lbfgs", max_iter=20), yr,
+         "coef_", "predict"),
+        (PoissonRegression(solver="lbfgs", max_iter=20),
+         np.abs(yr).astype(np.float32), "coef_", "predict"),
+        (SGDClassifier(max_iter=3), yc, "coef_", "predict"),
+        (SGDRegressor(max_iter=3), yr, "coef_", "predict"),
+        (GaussianNB(), yc, "theta_", "predict"),
+        (KMeans(n_clusters=3, max_iter=5, random_state=0), None,
+         "cluster_centers_", "predict"),
+        (SpectralClustering(n_clusters=2, n_components=16, random_state=0),
+         None, "labels_", None),
+        (PCA(n_components=2, random_state=0), None,
+         "components_", "transform"),
+        (TruncatedSVD(n_components=2, random_state=0), None,
+         "components_", "transform"),
+        (IncrementalPCA(n_components=2), None, "components_", "transform"),
+        (StandardScaler(), None, "mean_", "transform"),
+        (MinMaxScaler(), None, "scale_", "transform"),
+        (RobustScaler(), None, "center_", "transform"),
+        (QuantileTransformer(n_quantiles=16), None,
+         "quantiles_", "transform"),
+        (PolynomialFeatures(degree=2), None,
+         "n_output_features_", "transform"),
+        (SimpleImputer(), None, "statistics_", "transform"),
+        (BlockwiseVotingClassifier(
+            LogisticRegression(solver="lbfgs", max_iter=10),
+            classes=[0, 1]), yc, "estimators_", "predict"),
+        (BlockwiseVotingRegressor(
+            LinearRegression(solver="lbfgs", max_iter=10)), yr,
+         "estimators_", "predict"),
+        (ParallelPostFit(LogisticRegression(solver="lbfgs", max_iter=10)),
+         yc, "estimator_", "predict"),
+        (Incremental(SGDClassifier(max_iter=2)), yc,
+         "estimator_", "predict"),
+    ]
+
+
+CASES = _cases()
+IDS = [type(c[0]).__name__ for c in CASES]
+
+
+@pytest.mark.parametrize("est,y,attr,pred", CASES, ids=IDS)
+def test_sklearn_contract(est, y, attr, pred):
+    # params round-trip through get/set (what clone/search depend on)
+    params = est.get_params(deep=False)
+    est.set_params(**params)
+    assert est.get_params(deep=False).keys() == params.keys()
+
+    # clone yields an UNfitted copy with identical params
+    c = clone(est)
+    assert type(c) is type(est)
+    assert not hasattr(c, attr)
+
+    # pre-fit prediction raises (NotFittedError or the package's
+    # check_is_fitted ValueError — both sklearn-compatible)
+    if pred is not None:
+        with pytest.raises((NotFittedError, ValueError, AttributeError)):
+            getattr(c, pred)(Xc)
+
+    # fit returns self, sets the advertised fitted attribute
+    fitted = c.fit(Xc) if y is None else c.fit(Xc, y)
+    assert fitted is c
+    assert hasattr(c, attr)
+
+    # prediction produces one row per input sample
+    if pred is not None:
+        out = getattr(c, pred)(Xc)
+        out = np.asarray(out.to_numpy() if hasattr(out, "to_numpy") else out)
+        assert out.shape[0] == Xc.shape[0]
+
+    # cloning a FITTED estimator still yields an unfitted one
+    c2 = clone(c)
+    assert not hasattr(c2, attr)
+
+
+@pytest.mark.parametrize("est,y,attr,pred", CASES, ids=IDS)
+def test_params_survive_double_clone(est, y, attr, pred):
+    a = clone(est)
+    b = clone(a)
+    assert repr(a.get_params()) == repr(b.get_params())

@@ -57,3 +57,25 @@ def test_custom_missing_value():
 def test_bad_strategy_raises():
     with pytest.raises(ValueError):
         SimpleImputer(strategy="nope").fit(np.ones((4, 2)))
+
+
+def test_imputer_rejects_infinity():
+    # NaN is the imputer's job; infinity is still invalid (sklearn's
+    # 'allow-nan' mode)
+    import pytest
+
+    from dask_ml_tpu.impute import SimpleImputer
+
+    X = np.array([[1.0, np.nan], [np.inf, 2.0]], np.float32)
+    with pytest.raises(ValueError, match="infinity"):
+        SimpleImputer(strategy="mean").fit(X)
+
+
+def test_quantile_scalers_accept_nan():
+    from dask_ml_tpu.preprocessing import QuantileTransformer, RobustScaler
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(200, 3).astype(np.float32)
+    X[::11, 1] = np.nan
+    for est in (RobustScaler(), QuantileTransformer(n_quantiles=20)):
+        est.fit(X)  # NaN-skipping statistics: must not raise

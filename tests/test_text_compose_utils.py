@@ -234,17 +234,21 @@ def test_count_vectorizer_all_pruned_raises():
         )
 
 
-def test_sketched_quantiles_parity_at_1m_rows():
-    """Histogram-sketch quantiles within tolerance of exact at 1e6 rows
-    (VERDICT r2 missing #7)."""
+def test_sketched_quantiles_parity(monkeypatch):
+    """Histogram-sketch quantiles within tolerance of exact (VERDICT r2
+    missing #7). 3e5 rows exercises the identical kernel the >1M auto
+    path runs (the sketch is row-count-oblivious); the dispatch boundary
+    itself is tested by lowering the threshold."""
     from dask_ml_tpu.parallel import as_sharded
+    from dask_ml_tpu.preprocessing import data as pdata
     from dask_ml_tpu.preprocessing.data import _masked_quantiles
 
     rng = np.random.RandomState(0)
+    n = 300_000
     X = np.stack([
-        rng.randn(1_000_000),
-        rng.exponential(2.0, 1_000_000),
-        rng.uniform(-5, 5, 1_000_000),
+        rng.randn(n),
+        rng.exponential(2.0, n),
+        rng.uniform(-5, 5, n),
     ], axis=1).astype(np.float32)
     Xs = as_sharded(X)
     qs = [0.25, 0.5, 0.75]
@@ -253,9 +257,13 @@ def test_sketched_quantiles_parity_at_1m_rows():
     # error bound: one bin width = (max-min)/4096 per column
     bin_w = (X.max(axis=0) - X.min(axis=0)) / 4096
     assert np.all(np.abs(sketch - exact) <= bin_w[None, :] + 1e-6)
-    # auto dispatch: exactly 1M rows is still exact; above goes sketch
-    auto = np.asarray(_masked_quantiles(Xs, qs))
-    np.testing.assert_allclose(auto, exact, atol=1e-6)
+    # auto dispatch flips from exact to sketch above the threshold
+    monkeypatch.setattr(pdata, "_SKETCH_THRESHOLD", n)
+    auto_at = np.asarray(_masked_quantiles(Xs, qs))  # n == threshold: exact
+    np.testing.assert_allclose(auto_at, exact, atol=1e-6)
+    monkeypatch.setattr(pdata, "_SKETCH_THRESHOLD", n - 1)
+    auto_above = np.asarray(_masked_quantiles(Xs, qs))  # n > threshold
+    np.testing.assert_allclose(auto_above, sketch, atol=1e-6)
 
 
 def test_robust_scaler_sketch_matches_exact_at_scale():
