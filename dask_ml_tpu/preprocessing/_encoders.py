@@ -128,6 +128,40 @@ class OneHotEncoder(TransformerMixin, BaseEstimator):
             for c in cats
         ], dtype=object)
 
+    def inverse_transform(self, X):
+        """Map one-hot columns back to the original categories (sklearn's
+        OneHotEncoder.inverse_transform; per-column argmax over each
+        category segment). All-zero segments (unknowns dropped by
+        handle_unknown='ignore') map to None, as in sklearn."""
+        check_is_fitted(self, "categories_")
+        Xh = X.to_numpy() if isinstance(X, ShardedArray) else np.asarray(X)
+        n_out = sum(len(c) for c in self.categories_)
+        if Xh.shape[1] != n_out:
+            raise ValueError(
+                f"Expected {n_out} one-hot columns, got {Xh.shape[1]}"
+            )
+        cols, start, any_unknown = [], 0, False
+        for cats in self.categories_:
+            seg = Xh[:, start:start + len(cats)]
+            vals = np.asarray(cats)[np.argmax(seg, axis=1)]
+            unknown = seg.max(axis=1) == 0
+            if unknown.any():
+                any_unknown = True
+                vals = vals.astype(object)
+                vals[unknown] = None
+            cols.append(vals)
+            start += len(cats)
+        dtypes = {c.dtype for c in cols}
+        if any_unknown or len(dtypes) > 1:
+            # object output preserves each column's native type (a plain
+            # stack would coerce, e.g. floats to strings next to a
+            # string column — sklearn returns object here)
+            out = np.empty((Xh.shape[0], len(cols)), dtype=object)
+            for j, c in enumerate(cols):
+                out[:, j] = c
+            return out
+        return np.stack(cols, axis=1)
+
 
 class OrdinalEncoder(TransformerMixin, BaseEstimator):
     """Ref: dask_ml/preprocessing/data.py::OrdinalEncoder — DataFrame

@@ -75,8 +75,32 @@ class ParallelPostFit(BaseEstimator):
         return self._est.classes_
 
     # -- parallel post-fit ops --------------------------------------------
+    def _pin_meta(self, out, method):
+        """Pin the output dtype when a *_meta hint was given (the
+        reference uses metas to declare dask output metadata; here output
+        types are concrete, so only the dtype survives)."""
+        meta = {"predict": self.predict_meta,
+                "predict_proba": self.predict_proba_meta,
+                "transform": self.transform_meta}.get(method)
+        if meta is not None and hasattr(meta, "dtype") \
+                and isinstance(out, np.ndarray):
+            out = out.astype(meta.dtype, copy=False)
+        return out
+
     def _apply(self, X, method):
         est = self._est
+        from .parallel.frames import PartitionedFrame
+
+        if isinstance(X, PartitionedFrame):
+            # the reference's dd path: map_partitions(est.<method>) —
+            # partitions run concurrently through the frame's thread pool
+            parts = X.map_partitions(getattr(est, method))
+            if isinstance(parts, PartitionedFrame):  # frame-in, frame-out
+                return parts
+            return self._pin_meta(
+                np.concatenate([np.asarray(p) for p in parts], axis=0),
+                method,
+            )
         if _is_device_estimator(est):
             return getattr(est, method)(X)
         mesh = X.mesh if isinstance(X, ShardedArray) else None
@@ -97,12 +121,7 @@ class ParallelPostFit(BaseEstimator):
                 parts = list(pool.map(fn, blocks))
         else:
             parts = [fn(b) for b in blocks]
-        out = np.concatenate(parts, axis=0)
-        meta = {"predict": self.predict_meta,
-                "predict_proba": self.predict_proba_meta,
-                "transform": self.transform_meta}.get(method)
-        if meta is not None and hasattr(meta, "dtype"):
-            out = out.astype(meta.dtype, copy=False)
+        out = self._pin_meta(np.concatenate(parts, axis=0), method)
         return as_sharded(out, mesh=mesh) if mesh is not None else out
 
     def predict(self, X):

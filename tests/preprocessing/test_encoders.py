@@ -154,3 +154,44 @@ def test_gaussian_nb_parity():
     assert ours.score(X, y) == pytest.approx(
         ref.score(X.to_numpy(), y.to_numpy()), abs=1e-6
     )
+
+
+def test_onehot_inverse_transform_roundtrip():
+    import sklearn.preprocessing as skp
+
+    from dask_ml_tpu.preprocessing import OneHotEncoder
+
+    rng = np.random.RandomState(0)
+    X = rng.randint(0, 4, (60, 2)).astype(np.float32)
+    enc = OneHotEncoder().fit(X)
+    ref = skp.OneHotEncoder(sparse_output=False).fit(X)
+    hot = enc.transform(X)
+    back = enc.inverse_transform(hot)
+    np.testing.assert_array_equal(back, X)
+    np.testing.assert_array_equal(back, ref.inverse_transform(ref.transform(X)))
+
+
+def test_onehot_inverse_transform_unknown_and_mixed():
+    import sklearn.preprocessing as skp
+
+    from dask_ml_tpu.preprocessing import OneHotEncoder
+
+    # all-zero rows (unknowns dropped by handle_unknown='ignore') → None
+    enc = OneHotEncoder(handle_unknown="ignore").fit(
+        np.array([[1.0], [2.0]])
+    )
+    hot = enc.transform(np.array([[9.0]]))
+    back = enc.inverse_transform(hot)
+    ref = skp.OneHotEncoder(sparse_output=False, handle_unknown="ignore") \
+        .fit(np.array([[1.0], [2.0]]))
+    ref_back = ref.inverse_transform(ref.transform(np.array([[9.0]])))
+    assert back[0, 0] is None and ref_back[0, 0] is None
+
+    # mixed category dtypes keep their native types (object output)
+    import pandas as pd
+
+    df = pd.DataFrame({"s": ["x", "y", "x"], "n": [1.0, 2.0, 1.0]})
+    enc2 = OneHotEncoder().fit(df)
+    back2 = enc2.inverse_transform(enc2.transform(df))
+    assert back2.dtype == object
+    assert back2[0, 0] == "x" and back2[0, 1] == 1.0

@@ -101,3 +101,28 @@ def test_incremental_scoring_param(data):
     )
     inc.fit(X, y, classes=[0.0, 1.0])
     assert 0.0 <= inc.score(X, y) <= 1.0
+
+
+def test_parallel_post_fit_partitioned_frame(data):
+    """predict/predict_proba over PartitionedFrame partitions (the
+    reference's dd map_partitions post-fit path)."""
+    import pandas as pd
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    from dask_ml_tpu.parallel import from_pandas
+    from dask_ml_tpu.wrappers import ParallelPostFit
+
+    X, y = data
+    Xh = X.to_numpy() if hasattr(X, "to_numpy") else np.asarray(X)
+    yh = y.to_numpy() if hasattr(y, "to_numpy") else np.asarray(y)
+    df = pd.DataFrame(np.asarray(Xh, np.float64))
+    df.columns = [str(c) for c in df.columns]
+    pf = from_pandas(df, npartitions=4)
+    sk = SkLR(max_iter=200).fit(Xh, yh)
+    wrapped = ParallelPostFit(estimator=sk)
+    wrapped.estimator_ = sk
+    pred = wrapped.predict(pf)
+    np.testing.assert_array_equal(pred, sk.predict(Xh))
+    proba = wrapped.predict_proba(pf)
+    # f64 frame partitions vs the f32 fit matrix: tolerance is absolute
+    np.testing.assert_allclose(proba, sk.predict_proba(Xh), atol=1e-6)
