@@ -31,6 +31,44 @@ def _padded_rows(n_rows: int, n_shards: int) -> int:
     return max(n_shards, math.ceil(n_rows / n_shards) * n_shards)
 
 
+def _scatter(x, mesh: Mesh, spec) -> jax.Array:
+    """Place an array onto ``mesh`` with ``spec`` — the ONE placement
+    primitive for host and device inputs, single- and multi-host meshes.
+
+    Multi-host meshes can't be reached by ``device_put`` (it only places
+    onto this process's devices): every process holds the same full array
+    (SPMD discipline) and materializes ONLY its addressable shards via
+    ``make_array_from_callback`` — the reference's scatter step with no
+    bytes over sockets beyond the runtime's own control plane.
+    """
+    sharding = NamedSharding(mesh, spec)
+    n_procs = len({d.process_index for d in mesh.devices.flat})
+    if n_procs > 1:
+        if isinstance(x, jax.Array):
+            if not x.is_fully_addressable:
+                raise NotImplementedError(
+                    "re-placing an already cross-process array onto "
+                    "another multi-host mesh is not supported; gather to "
+                    "host first (to_numpy)"
+                )
+            x = np.asarray(x)
+        return jax.make_array_from_callback(
+            x.shape, sharding, lambda idx: x[idx]
+        )
+    return jax.device_put(x, sharding)
+
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=32)
+def _replicator(mesh: Mesh):
+    """Cached replicating identity per mesh: the cross-host all-gather
+    program ``to_numpy`` uses — a fresh lambda per call would retrace and
+    recompile every time."""
+    return jax.jit(lambda v: v, out_shardings=NamedSharding(mesh, P()))
+
+
 class ShardedArray:
     """A logically (n_rows, *feature_dims) array, row-sharded over a mesh.
 
@@ -94,7 +132,7 @@ class ShardedArray:
             else None
         )
         spec = P(*((DATA_AXIS, feat) + (None,) * (x.ndim - 2))[: x.ndim])
-        data = jax.device_put(x, NamedSharding(mesh, spec))
+        data = _scatter(x, mesh, spec)
         return cls(data, n, mesh)
 
     # -- basic properties -------------------------------------------------
@@ -135,6 +173,12 @@ class ShardedArray:
 
     # -- host round-trip --------------------------------------------------
     def to_numpy(self) -> np.ndarray:
+        if not self.data.is_fully_addressable:
+            # multi-host mesh: replicate via an in-program all-gather
+            # (ICI/DCN), then read the local copy — np.asarray on a
+            # cross-process array would raise
+            rep = _replicator(self.mesh)(self.data)
+            return np.asarray(rep)[: self.n_rows]
         return np.asarray(self.data)[: self.n_rows]
 
     def astype(self, dtype) -> "ShardedArray":
@@ -217,7 +261,7 @@ def take_rows(x: ShardedArray, idx) -> ShardedArray:
     idx_padded[:n_out] = idx
     spec = P(*((DATA_AXIS,) + (None,) * (x.ndim - 1)))
     sharding = NamedSharding(x.mesh, spec)
-    idx_dev = jax.device_put(idx_padded, NamedSharding(x.mesh, P(DATA_AXIS)))
+    idx_dev = _scatter(idx_padded, x.mesh, P(DATA_AXIS))
 
     @jax.jit
     def gather(data, indices):
