@@ -17,6 +17,7 @@ ragged-final-chunk handling.
 
 from __future__ import annotations
 
+import functools
 import math
 
 import jax
@@ -42,14 +43,15 @@ def _scatter(x, mesh: Mesh, spec) -> jax.Array:
     bytes over sockets beyond the runtime's own control plane.
     """
     sharding = NamedSharding(mesh, spec)
-    n_procs = len({d.process_index for d in mesh.devices.flat})
-    if n_procs > 1:
+    if not sharding.is_fully_addressable:  # mesh spans other processes
         if isinstance(x, jax.Array):
+            if x.sharding == sharding:  # already placed as requested
+                return x
             if not x.is_fully_addressable:
                 raise NotImplementedError(
-                    "re-placing an already cross-process array onto "
-                    "another multi-host mesh is not supported; gather to "
-                    "host first (to_numpy)"
+                    "re-placing an already cross-process array onto a "
+                    "different multi-host sharding is not supported; "
+                    "gather to host first (to_numpy)"
                 )
             x = np.asarray(x)
         return jax.make_array_from_callback(
@@ -58,10 +60,7 @@ def _scatter(x, mesh: Mesh, spec) -> jax.Array:
     return jax.device_put(x, sharding)
 
 
-import functools as _functools
-
-
-@_functools.lru_cache(maxsize=32)
+@functools.lru_cache(maxsize=32)
 def _replicator(mesh: Mesh):
     """Cached replicating identity per mesh: the cross-host all-gather
     program ``to_numpy`` uses — a fresh lambda per call would retrace and
@@ -185,16 +184,13 @@ class ShardedArray:
         return ShardedArray(self.data.astype(dtype), self.n_rows, self.mesh)
 
 
-from functools import partial
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2, 3))
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
 def _row_mask(n_padded: int, n_rows: int, sharding, dtype) -> jax.Array:
     idx = jnp.arange(n_padded)
     return jax.lax.with_sharding_constraint((idx < n_rows).astype(dtype), sharding)
 
-
-import functools
 
 # result cache is bounded by SIZE, not just count: a cached (n,) f32 mask
 # pins n*4 bytes of device memory for the process lifetime

@@ -31,6 +31,8 @@ def _fit_on_shards(make_est, X, y, n_shards):
     return est
 
 
+_KM_INIT = np.random.RandomState(7).randn(3, 8).astype(np.float32)
+
 SWEEP_CASES = [
     ("logreg", lambda: _import_est("LogisticRegression")(
         solver="lbfgs", max_iter=100), True,
@@ -42,17 +44,32 @@ SWEEP_CASES = [
     ("pca", lambda: _import_est("PCA")(n_components=3, svd_solver="full"),
      False, ["components_", "explained_variance_", "mean_",
              "singular_values_"]),
+    # fixed init: shard count must not change the Lloyd trajectory
+    ("kmeans", lambda: _import_est("KMeans")(
+        n_clusters=3, init=_KM_INIT, max_iter=10, tol=0.0), False,
+     ["cluster_centers_", "inertia_"]),
+    ("gnb", lambda: _import_est("GaussianNB")(), True,
+     ["theta_", "var_", "class_prior_", "classes_"]),
+    ("minmax", lambda: _import_est("MinMaxScaler")(), False,
+     ["data_min_", "data_max_", "scale_", "min_"]),
+    ("tsvd", lambda: _import_est("TruncatedSVD")(
+        n_components=3, algorithm="tsqr"), False,
+     ["components_", "singular_values_"]),
 ]
 
 
 def _import_est(name):
-    from dask_ml_tpu.decomposition import PCA
+    from dask_ml_tpu.cluster import KMeans
+    from dask_ml_tpu.decomposition import PCA, TruncatedSVD
     from dask_ml_tpu.linear_model import LinearRegression, LogisticRegression
-    from dask_ml_tpu.preprocessing import StandardScaler
+    from dask_ml_tpu.naive_bayes import GaussianNB
+    from dask_ml_tpu.preprocessing import MinMaxScaler, StandardScaler
 
     return {"LogisticRegression": LogisticRegression,
             "LinearRegression": LinearRegression,
-            "StandardScaler": StandardScaler, "PCA": PCA}[name]
+            "StandardScaler": StandardScaler, "PCA": PCA,
+            "KMeans": KMeans, "GaussianNB": GaussianNB,
+            "MinMaxScaler": MinMaxScaler, "TruncatedSVD": TruncatedSVD}[name]
 
 
 @pytest.mark.parametrize("label,make_est,needs_y,attrs",
