@@ -56,6 +56,13 @@ def add_intercept(X):
 from functools import partial as _partial
 
 
+@jax.jit
+def _matvec_eta(data, coef, intercept):
+    """Decision values as ONE program: eager ``X @ w + b`` would pay a
+    dispatch round trip per op on a tunneled runtime."""
+    return data @ coef.astype(data.dtype) + intercept.astype(data.dtype)
+
+
 @_partial(jax.jit, static_argnames=("fit_intercept", "to_bf16", "encode"))
 def _prepare_fit(Xd, yd, mask, fit_intercept, to_bf16, encode):
     """ONE program for all fit prep: intercept column, bf16 cast, binary
@@ -243,6 +250,12 @@ class _GLMBase(BaseEstimator):
     def _coef_flat(self):
         return np.ravel(self.coef_)
 
+    def _intercept_scalar(self) -> np.float32:
+        """intercept_ as one scalar: binary LogisticRegression stores
+        shape (1,), the regressions store a plain float."""
+        return np.float32(np.ravel(self.intercept_)[0]
+                          if np.ndim(self.intercept_) else self.intercept_)
+
     def _set_coef(self, coef, classes):
         self.coef_ = coef
 
@@ -254,9 +267,7 @@ class _GLMBase(BaseEstimator):
         block_rows = stream_plan(X)
         if block_rows is not None:
             coef = jnp.asarray(self._coef_flat(), jnp.float32)
-            b0 = jnp.asarray(np.ravel(self.intercept_)[0]
-                             if np.ndim(self.intercept_) else self.intercept_,
-                             jnp.float32)
+            b0 = jnp.asarray(self._intercept_scalar())
             return streamed_map(
                 X, block_rows, lambda blk: blk.arrays[0] @ coef + b0
             )
@@ -265,9 +276,8 @@ class _GLMBase(BaseEstimator):
 
     def _decision(self, X):
         X = check_array(X, dtype=np.float32)
-        eta = X.data @ jnp.asarray(self._coef_flat(), X.data.dtype) + jnp.asarray(
-            self.intercept_, X.data.dtype
-        )
+        eta = _matvec_eta(X.data, np.asarray(self._coef_flat(), np.float32),
+                          self._intercept_scalar())
         return X, eta
 
 

@@ -33,6 +33,32 @@ def _handle_zeros_in_scale(scale):
     return np.where(scale == 0.0, 1.0, scale)
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("shift_first", "do_clip"))
+def _affine(data, mask, a, b, lo=0.0, hi=1.0, shift_first=True,
+            do_clip=False):
+    """One fused program for every scaler transform/inverse. A chain of
+    eager ops would pay one dispatch round-trip EACH on a tunneled
+    runtime; jitted, XLA fuses the whole transform into a single kernel
+    launch.
+
+    ``shift_first=True`` computes ``(data + b) * a`` — the
+    subtract-then-scale form, which keeps the benign cancellation for
+    features with |mean| >> std (``data * a + b`` would round at the
+    magnitude of data before b cancels it). ``shift_first=False``
+    computes ``data * a + b`` — the scale-then-shift form used by the
+    inverse direction. ``mask=None`` skips padding re-zeroing (only
+    valid when the shift term is zero)."""
+    a = jnp.asarray(a, data.dtype)
+    b = jnp.asarray(b, data.dtype)
+    out = (data + b) * a if shift_first else data * a + b
+    if do_clip:
+        out = jnp.clip(out, lo, hi)
+    if mask is not None:
+        out = out * mask[:, None].astype(data.dtype)
+    return out
+
+
 def _frame_parts(X):
     """(partition list, kind) for frame inputs; (None, None) otherwise.
 
@@ -185,25 +211,19 @@ class StandardScaler(_DeviceTransformer):
     def transform(self, X):
         check_is_fitted(self, "n_samples_seen_")
         X = self._sharded(X)
-        out = X.data
-        if self.with_mean:
-            out = out - jnp.asarray(self.mean_, out.dtype)
-        if self.with_std:
-            out = out / jnp.asarray(self.scale_, out.dtype)
-        if self.with_mean:  # keep padding rows exactly zero
-            out = out * X.row_mask(out.dtype)[:, None]
+        a = 1.0 / self.scale_ if self.with_std else np.float32(1.0)
+        b = -self.mean_ if self.with_mean else np.float32(0.0)
+        mask = X.row_mask() if self.with_mean else None
+        out = _affine(X.data, mask, a, b)
         return ShardedArray(out, X.n_rows, X.mesh)
 
     def inverse_transform(self, X):
         check_is_fitted(self, "n_samples_seen_")
         X = self._sharded(X)
-        out = X.data
-        if self.with_std:
-            out = out * jnp.asarray(self.scale_, out.dtype)
-        if self.with_mean:
-            out = (out + jnp.asarray(self.mean_, out.dtype)) * X.row_mask(
-                out.dtype
-            )[:, None]
+        a = self.scale_ if self.with_std else np.float32(1.0)
+        b = self.mean_ if self.with_mean else np.float32(0.0)
+        mask = X.row_mask() if self.with_mean else None
+        out = _affine(X.data, mask, a, b, shift_first=False)
         return ShardedArray(out, X.n_rows, X.mesh)
 
 
@@ -231,21 +251,15 @@ class MinMaxScaler(_DeviceTransformer):
     def transform(self, X):
         check_is_fitted(self, "scale_")
         X = self._sharded(X)
-        out = X.data * jnp.asarray(self.scale_, X.dtype) + jnp.asarray(
-            self.min_, X.dtype
-        )
-        if self.clip:
-            out = jnp.clip(out, self.feature_range[0], self.feature_range[1])
-        out = out * X.row_mask(out.dtype)[:, None]
+        out = _affine(X.data, X.row_mask(), self.scale_, self.min_,
+                      self.feature_range[0], self.feature_range[1],
+                      shift_first=False, do_clip=bool(self.clip))
         return ShardedArray(out, X.n_rows, X.mesh)
 
     def inverse_transform(self, X):
         check_is_fitted(self, "scale_")
         X = self._sharded(X)
-        out = (X.data - jnp.asarray(self.min_, X.dtype)) / jnp.asarray(
-            self.scale_, X.dtype
-        )
-        out = out * X.row_mask(out.dtype)[:, None]
+        out = _affine(X.data, X.row_mask(), 1.0 / self.scale_, -self.min_)
         return ShardedArray(out, X.n_rows, X.mesh)
 
 
@@ -343,23 +357,17 @@ class RobustScaler(_DeviceTransformer):
     def transform(self, X):
         check_is_fitted(self, "n_features_in_")
         X = self._sharded(X)
-        out = X.data
-        if self.with_centering:
-            out = out - jnp.asarray(self.center_, out.dtype)
-        if self.with_scaling:
-            out = out / jnp.asarray(self.scale_, out.dtype)
-        out = out * X.row_mask(out.dtype)[:, None]
+        a = 1.0 / self.scale_ if self.with_scaling else np.float32(1.0)
+        b = -self.center_ if self.with_centering else np.float32(0.0)
+        out = _affine(X.data, X.row_mask(), a, b)
         return ShardedArray(out, X.n_rows, X.mesh)
 
     def inverse_transform(self, X):
         check_is_fitted(self, "n_features_in_")
         X = self._sharded(X)
-        out = X.data
-        if self.with_scaling:
-            out = out * jnp.asarray(self.scale_, out.dtype)
-        if self.with_centering:
-            out = out + jnp.asarray(self.center_, out.dtype)
-        out = out * X.row_mask(out.dtype)[:, None]
+        a = self.scale_ if self.with_scaling else np.float32(1.0)
+        b = self.center_ if self.with_centering else np.float32(0.0)
+        out = _affine(X.data, X.row_mask(), a, b, shift_first=False)
         return ShardedArray(out, X.n_rows, X.mesh)
 
 

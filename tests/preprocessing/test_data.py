@@ -89,3 +89,21 @@ def test_pipeline_scaler_logreg(xy_classification):
     Xt = pre.StandardScaler().fit_transform(Xc)
     clf = LogisticRegression(solver="lbfgs", max_iter=300).fit(Xt, y)
     assert clf.score(Xt, y) > 0.85
+
+
+def test_standard_scaler_large_offset_precision():
+    """|mean| >> std in float32: the subtract-then-scale form keeps
+    cancellation; a scale-then-shift rewrite rounds at the data's
+    magnitude and produces garbage z-scores (timestamp-like features)."""
+    rng = np.random.RandomState(0)
+    # mean 1e7, std 1: x*(1/s) rounds at x's magnitude (~1.2 error per
+    # z-score); (x - mean)/s cancels first and stays at ulp level. Exact
+    # (f64) statistics are injected so the test isolates the TRANSFORM's
+    # arithmetic from the f32 fit-stat estimation error.
+    X32 = (1e7 + rng.randn(4000, 2)).astype(np.float32)
+    X64 = X32.astype(np.float64)
+    ref = skpre.StandardScaler().fit(X64)
+    ours = pre.StandardScaler().fit(X32)
+    ours.mean_, ours.var_, ours.scale_ = ref.mean_, ref.var_, ref.scale_
+    got = ours.transform(X32).to_numpy()
+    assert np.abs(got - ref.transform(X64)).max() < 0.05
