@@ -28,7 +28,9 @@ from ..utils.validation import check_array, check_is_fitted
 from .kmeans import KMeans, _gumbel_top_l
 
 
-def _affinity(name, x, z, gamma, degree, coef0):
+def _affinity(name, x, z, gamma, degree, coef0, kernel_params=None):
+    if callable(name):  # user kernel(X, Z, **kernel_params), ref contract
+        return name(x, z, **(kernel_params or {}))
     if name == "rbf":
         return pairwise.rbf_kernel(x, z, gamma=gamma)
     if name == "polynomial":
@@ -80,9 +82,9 @@ class SpectralClustering(ClusterMixin, BaseEstimator):
         Z = jnp.take(X.data, idx, axis=0)  # (c, d) replicated
 
         B = _affinity(self.affinity, X.data, Z, self.gamma, self.degree,
-                      self.coef0) * mask[:, None]          # (n, c) sharded
+                      self.coef0, self.kernel_params) * mask[:, None]
         A = _affinity(self.affinity, Z, Z, self.gamma, self.degree,
-                      self.coef0)                          # (c, c) replicated
+                      self.coef0, self.kernel_params)      # (c, c) replicated
 
         # A^{-1/2} via eigh with jitter (A is a PSD Gram matrix)
         w, V = jnp.linalg.eigh(A + 1e-6 * jnp.eye(c, dtype=A.dtype))

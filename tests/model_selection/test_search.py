@@ -168,3 +168,44 @@ def test_multimetric_refit_validation(xy_classification):
     ).fit(X, y)
     assert "mean_test_acc" in s.cv_results_
     assert not hasattr(s, "best_index_")
+
+
+def test_grid_search_list_of_grids(data):
+    """param_grid as a LIST of grids: candidates are the union, and
+    params absent from a sub-grid are masked in cv_results_ (sklearn and
+    the reference's contract)."""
+    X, y = data
+    s = GridSearchCV(
+        LogisticRegression(max_iter=30),
+        [{"C": [0.1, 1.0]}, {"solver": ["newton"], "C": [1.0]}],
+        cv=2,
+    ).fit(X, y)
+    r = s.cv_results_
+    assert len(r["params"]) == 3
+    col = r["param_solver"]
+    assert np.ma.is_masked(col[0]) and np.ma.is_masked(col[1])
+    assert col[2] == "newton"
+    assert s.best_index_ == int(np.argmax(r["mean_test_score"]))
+
+
+def test_randomized_search_reproducible(data):
+    X, y = data
+    from scipy.stats import loguniform
+
+    dists = {"C": loguniform(1e-3, 1e2)}
+    a = RandomizedSearchCV(LogisticRegression(max_iter=30), dists,
+                           n_iter=4, random_state=5, cv=2).fit(X, y)
+    b = RandomizedSearchCV(LogisticRegression(max_iter=30), dists,
+                           n_iter=4, random_state=5, cv=2).fit(X, y)
+    assert [p["C"] for p in a.cv_results_["params"]] == \
+        [p["C"] for p in b.cv_results_["params"]]
+    np.testing.assert_allclose(a.cv_results_["mean_test_score"],
+                               b.cv_results_["mean_test_score"], rtol=1e-6)
+
+
+def test_search_with_scorer_string(data):
+    X, y = data
+    s = GridSearchCV(LogisticRegression(max_iter=30), {"C": [0.5, 2.0]},
+                     cv=2, scoring="neg_log_loss").fit(X, y)
+    assert (s.cv_results_["mean_test_score"] <= 0).all()
+    assert s.best_score_ == s.cv_results_["mean_test_score"].max()

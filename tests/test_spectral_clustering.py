@@ -49,3 +49,33 @@ def test_spectral_linear_affinity_runs():
     sc = SpectralClustering(n_clusters=2, affinity="rbf", gamma=0.3,
                             n_components=60, random_state=0).fit(X)
     assert len(np.unique(sc.labels_.to_numpy())) == 2
+
+
+def test_spectral_callable_affinity():
+    """A user-supplied kernel callable is used verbatim (reference
+    accepts callables for affinity)."""
+    import jax.numpy as jnp
+
+    from dask_ml_tpu.cluster import SpectralClustering
+    from dask_ml_tpu.metrics import pairwise
+
+    rng = np.random.RandomState(0)
+    X = np.r_[rng.randn(60, 2), rng.randn(60, 2) + 6].astype(np.float32)
+
+    calls = []
+
+    def my_kernel(a, b, gamma=999.0):
+        calls.append(gamma)
+        return pairwise.rbf_kernel(a, b, gamma=gamma)
+
+    sc = SpectralClustering(n_clusters=2, n_components=24, random_state=0,
+                            affinity=my_kernel,
+                            kernel_params={"gamma": 0.5})
+    labels = np.asarray(sc.fit(X).labels_.to_numpy())
+    assert len(calls) >= 2  # B and A blocks both used the callable
+    assert set(calls) == {0.5}  # kernel_params forwarded, not defaults
+    # the two blobs separate
+    first, second = labels[:60], labels[60:]
+    assert (first == first[0]).mean() > 0.9
+    assert (second == second[0]).mean() > 0.9
+    assert first[0] != second[0]
