@@ -66,9 +66,11 @@ def is_coordinator() -> bool:
 
 
 def global_mesh(axis_names=("data",), shape=None):
-    """Mesh over ALL processes' devices (ICI within a slice, DCN across)."""
+    """Mesh over ALL processes' devices (ICI within a slice, DCN across:
+    topology-ordered so the DCN hop is the outer factor of the data
+    axis)."""
     return device_mesh(shape=shape, axis_names=axis_names,
-                       devices=jax.devices())
+                       devices=jax.devices(), topology_order=True)
 
 
 def local_mesh(axis_names=("data",), shape=None):
@@ -78,7 +80,7 @@ def local_mesh(axis_names=("data",), shape=None):
     hyperparameter search (SURVEY.md §3.5: 'trials pinned to
     hosts/mesh-subsets')."""
     return device_mesh(shape=shape, axis_names=axis_names,
-                       devices=jax.local_devices())
+                       devices=jax.local_devices(), topology_order=True)
 
 
 def allgather_object(obj):

@@ -28,12 +28,25 @@ MODEL_AXIS = "model"
 _state = threading.local()
 
 
-def device_mesh(shape=None, axis_names=(DATA_AXIS,), devices=None) -> Mesh:
+def device_mesh(shape=None, axis_names=(DATA_AXIS,), devices=None,
+                topology_order=None) -> Mesh:
     """Build a mesh over ``devices`` (default: all of ``jax.devices()``).
 
     ``shape=None`` gives a 1-D mesh over every device. ``shape`` may use -1
     for one axis (inferred), e.g. ``device_mesh((-1, 2), ("data", "model"))``.
+
+    On TPU the device order is TOPOLOGY-AWARE (``mesh_utils``): mesh
+    neighbors are ICI neighbors, and on multi-host runs the slow DCN hop
+    is the OUTER factor of the data axis — collectives then ride ICI
+    rings within a host/slice and cross DCN once, instead of ping-ponging
+    over DCN in enumeration order. CPU/GPU keep plain enumeration order.
+
+    ``topology_order`` — None (default): reorder only when ``devices`` is
+    omitted (explicit lists keep the caller's order, e.g. disjoint search
+    submeshes); True: force reordering even for an explicit full-device
+    list (``global_mesh``/``local_mesh`` pass this); False: never.
     """
+    explicit = devices is not None
     if devices is None:
         devices = jax.devices()
     devices = np.asarray(devices, dtype=object)
@@ -50,7 +63,37 @@ def device_mesh(shape=None, axis_names=(DATA_AXIS,), devices=None) -> Mesh:
         shape = tuple(n // known if s == -1 else s for s in shape)
     if int(np.prod(shape)) != n:
         raise ValueError(f"mesh shape {shape} needs {int(np.prod(shape))} devices, have {n}")
+    if topology_order is None:
+        topology_order = not explicit
+    if topology_order and devices.flat[0].platform == "tpu":
+        arranged = _topology_mesh(shape, list(devices.flat))
+        if arranged is not None:
+            return Mesh(arranged, axis_names)
     return Mesh(devices.reshape(shape), axis_names)
+
+
+def _topology_mesh(shape, devices):
+    """TPU device array in torus-aware order, or None when the topology
+    helpers decline (odd shapes, unsupported slice forms) — the caller
+    then falls back to enumeration order."""
+    try:
+        from jax.experimental import mesh_utils
+
+        n_procs = len({d.process_index for d in devices})
+        if n_procs > 1 and len(devices) % n_procs == 0:
+            if shape[0] % n_procs == 0:
+                # DCN outer on the (leading) data axis, ICI inner
+                ici = (shape[0] // n_procs,) + tuple(shape[1:])
+                dcn = (n_procs,) + (1,) * (len(shape) - 1)
+                # granule = process (we factor by process count), not the
+                # default slice granule — a multi-host single slice would
+                # otherwise mismatch dcn and raise
+                return mesh_utils.create_hybrid_device_mesh(
+                    ici, dcn, devices=devices, process_is_granule=True
+                )
+        return mesh_utils.create_device_mesh(shape, devices=devices)
+    except Exception:
+        return None
 
 
 def default_mesh() -> Mesh:

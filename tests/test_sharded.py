@@ -109,3 +109,24 @@ def test_reshard_1d_array():
     small = device_mesh(devices=jax.devices()[:2])
     b = reshard(as_sharded(y), small)
     np.testing.assert_array_equal(b.to_numpy(), y)
+
+
+def test_device_mesh_cpu_enumeration_order():
+    """Topology-aware reordering is TPU-only: CPU meshes keep plain
+    enumeration order (tests depend on deterministic shard placement)."""
+    import jax
+
+    if jax.default_backend() != "cpu":
+        import pytest
+
+        pytest.skip("enumeration-order assertion is CPU-specific")
+
+    from dask_ml_tpu.parallel.mesh import device_mesh
+
+    mesh = device_mesh()
+    assert [d.id for d in mesh.devices.flat] == \
+        [d.id for d in jax.devices()]
+    # explicit device lists are never reordered, any platform
+    sub = jax.devices()[:2]
+    mesh2 = device_mesh(devices=sub)
+    assert list(mesh2.devices.flat) == list(sub)
