@@ -1,0 +1,92 @@
+"""End-to-end user journeys — the composed paths a dask-ml user actually
+runs (ref: the reference's integration-style tests around pipelines and
+searches; SURVEY.md §3.4 pipeline prefix sharing).
+
+Each test walks a full chain, not one estimator: frame ingest →
+preprocessing → device placement → (search over a Pipeline) → post-fit.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+from sklearn.pipeline import Pipeline
+
+from dask_ml_tpu.compose import ColumnTransformer
+from dask_ml_tpu.linear_model import LogisticRegression
+from dask_ml_tpu.model_selection import GridSearchCV, train_test_split
+from dask_ml_tpu.parallel import PartitionedFrame, ShardedArray, from_pandas
+from dask_ml_tpu.preprocessing import (
+    Categorizer, DummyEncoder, StandardScaler,
+)
+
+
+@pytest.fixture(scope="module")
+def frame():
+    rng = np.random.RandomState(0)
+    n = 600
+    df = pd.DataFrame({
+        "x0": rng.randn(n),
+        "x1": rng.rand(n) * 10,
+        "city": rng.choice(["ams", "ber", "cdg"], n),
+    })
+    target = ((df["x0"] + 0.3 * df["x1"]
+               + (df["city"] == "ams") + 0.3 * rng.randn(n)) > 2.0)
+    return df, target.astype(np.float32).to_numpy()
+
+
+def test_frame_to_search_journey(frame):
+    """frame → categorize → dummy → column-scale → device → GridSearchCV
+    over a Pipeline → predict: every layer hands off to the next without
+    manual conversion."""
+    df, y = frame
+    pf = from_pandas(df, npartitions=6)
+    pf = Categorizer().fit(pf).transform(pf)
+    feats = DummyEncoder().fit(pf).transform(pf)
+    assert isinstance(feats, PartitionedFrame)
+    ct = ColumnTransformer(
+        [("num", StandardScaler(), ["x0", "x1"])], remainder="passthrough"
+    )
+    scaled = ct.fit_transform(feats)
+    assert isinstance(scaled, PartitionedFrame)
+    X = scaled.to_sharded()
+    assert isinstance(X, ShardedArray)
+
+    Xtr, Xte, ytr, yte = train_test_split(X, y, test_size=0.25,
+                                          random_state=0)
+    pipe = Pipeline([
+        ("scale", StandardScaler()),
+        ("clf", LogisticRegression(solver="lbfgs", max_iter=40)),
+    ])
+    search = GridSearchCV(pipe, {"clf__C": [0.1, 1.0]}, cv=2).fit(Xtr, ytr)
+    assert search.best_score_ > 0.7
+    pred = search.predict(Xte)
+    pred = np.asarray(pred.to_numpy() if hasattr(pred, "to_numpy") else pred)
+    assert pred.shape[0] == len(yte)
+    acc = (pred == np.asarray(
+        yte.to_numpy() if hasattr(yte, "to_numpy") else yte
+    )).mean()
+    assert acc > 0.75
+
+
+def test_memmap_to_fit_journey(tmp_path):
+    """disk memmap → streamed fit → streamed predict: the out-of-core
+    chain with nothing materialized on device (BASELINE >HBM design)."""
+    from dask_ml_tpu import config
+
+    rng = np.random.RandomState(1)
+    n, d = 6000, 8
+    Xh = rng.randn(n, d).astype(np.float32)
+    w = rng.randn(d).astype(np.float32)
+    yh = (Xh @ w > 0).astype(np.float32)
+    path = tmp_path / "X.f32"
+    np.asarray(Xh).tofile(path)
+    Xm = np.memmap(path, dtype=np.float32, mode="r", shape=(n, d))
+
+    with config.set(stream_block_rows=1000):
+        clf = LogisticRegression(solver="lbfgs", max_iter=40).fit(Xm, yh)
+        proba = clf.predict_proba(Xm)
+    resident = LogisticRegression(solver="lbfgs", max_iter=40).fit(Xh, yh)
+    np.testing.assert_allclose(np.ravel(clf.coef_),
+                               np.ravel(resident.coef_), atol=2e-2)
+    assert proba.shape == (n, 2)
+    assert ((proba[:, 1] > 0.5) == yh).mean() > 0.9
