@@ -69,3 +69,48 @@ def test_svd_flip_deterministic():
     # largest-|.| entry of each row of Vt is positive
     mx = np.argmax(np.abs(vt2), axis=1)
     assert (vt2[np.arange(4), mx] > 0).all()
+
+
+def test_tsqr_fewer_rows_than_shards_per_block():
+    """n barely above the shard count: per-shard blocks are extremely
+    short; TSQR must still produce orthonormal Q and upper R."""
+    mesh = default_mesh()
+    shards = mesh.devices.size
+    n, d = shards + 1, 3  # one shard gets 2 rows, rest get 1 (padded)
+    rng = np.random.RandomState(0)
+    Xs = ShardedArray.from_array(rng.randn(n, d).astype(np.float32))
+    q, r = linalg.tsqr(Xs.data, mesh)
+    qh, rh = np.asarray(q)[:n], np.asarray(r)
+    np.testing.assert_allclose(qh @ rh, Xs.to_numpy(), atol=1e-4)
+    np.testing.assert_allclose(qh.T @ qh, np.eye(d), atol=1e-4)
+
+
+def test_randomized_svd_components_near_rank():
+    """k + oversampling exceeding d must clamp, and recover the full
+    spectrum of an exactly low-rank matrix."""
+
+    mesh = default_mesh()
+    rng = np.random.RandomState(1)
+    n, d, true_rank = 512, 12, 4
+    A = (rng.randn(n, true_rank) @ rng.randn(true_rank, d)).astype(
+        np.float32
+    )
+    Xs = ShardedArray.from_array(A)
+    u, s, vt = linalg.randomized_svd(Xs.data, 8, jax.random.PRNGKey(0), mesh,
+                              n_oversamples=10, n_iter=4)
+    s = np.asarray(s)
+    ref = np.linalg.svd(A.astype(np.float64), compute_uv=False)
+    np.testing.assert_allclose(s[:true_rank], ref[:true_rank], rtol=1e-3)
+    # spectrum beyond the true rank is numerically zero
+    assert np.all(s[true_rank:] < ref[0] * 1e-4)
+
+
+def test_svd_tall_single_column():
+    mesh = default_mesh()
+    rng = np.random.RandomState(2)
+    x = rng.randn(256, 1).astype(np.float32)
+    Xs = ShardedArray.from_array(x)
+    u, s, vt = linalg.svd_tall(Xs.data, mesh)
+    np.testing.assert_allclose(
+        float(s[0]), np.linalg.norm(x), rtol=1e-4
+    )

@@ -61,3 +61,24 @@ def test_unfitted_raises(data):
     X, _ = data
     with pytest.raises(Exception):
         GaussianNB().predict(X)
+
+
+def test_explicit_priors_honored(data):
+    X, y = data
+    Xh = X.to_numpy() if hasattr(X, "to_numpy") else np.asarray(X)
+    yh = y.to_numpy() if hasattr(y, "to_numpy") else np.asarray(y)
+    priors = [0.6, 0.3, 0.1]
+    ours = GaussianNB(priors=priors).fit(X, y)
+    ref = SkGNB(priors=priors).fit(Xh, yh)
+    np.testing.assert_allclose(ours.class_prior_, ref.class_prior_)
+    np.testing.assert_array_equal(
+        np.asarray(ours.predict(X)), ref.predict(Xh)
+    )
+
+
+def test_var_smoothing_effect(data):
+    X, y = data
+    small = GaussianNB(var_smoothing=1e-9).fit(X, y)
+    big = GaussianNB(var_smoothing=10.0).fit(X, y)
+    # heavier smoothing inflates every variance
+    assert (big.var_ > small.var_).all()
