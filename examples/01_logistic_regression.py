@@ -1,0 +1,27 @@
+"""Fit a device-resident LogisticRegression on sharded data.
+
+Run anywhere: on a TPU VM this uses every chip of the slice; on a CPU
+host set XLA_FLAGS=--xla_force_host_platform_device_count=8 to simulate
+an 8-device mesh. (Equivalent dask-ml code needs a distributed cluster;
+here the mesh IS the cluster.)
+"""
+
+import numpy as np
+
+from dask_ml_tpu import datasets
+from dask_ml_tpu.linear_model import LogisticRegression
+from dask_ml_tpu.model_selection import train_test_split
+from dask_ml_tpu.preprocessing import StandardScaler
+
+X, y = datasets.make_classification(
+    n_samples=200_000, n_features=64, random_state=0
+)  # a ShardedArray pair, row-sharded over every device
+Xtr, Xte, ytr, yte = train_test_split(X, y, test_size=0.2, random_state=0)
+
+scaler = StandardScaler()
+Xtr = scaler.fit_transform(Xtr)
+Xte = scaler.transform(Xte)
+
+clf = LogisticRegression(solver="lbfgs", max_iter=100)
+clf.fit(Xtr, ytr)  # one compiled while_loop; zero per-iteration host syncs
+print("n_iter:", clf.n_iter_, "test accuracy:", clf.score(Xte, yte))

@@ -1,0 +1,26 @@
+"""Adaptive hyperparameter search: HyperbandSearchCV over device-resident
+SGD trials. Homogeneous surviving trials advance as ONE vmapped program
+(N models per step); under jax.distributed, brackets distribute across
+hosts automatically.
+"""
+
+import numpy as np
+
+from dask_ml_tpu.model_selection import HyperbandSearchCV
+from dask_ml_tpu.models.sgd import SGDClassifier
+
+rng = np.random.RandomState(0)
+X = rng.randn(50_000, 32).astype(np.float32)
+w = rng.randn(32)
+y = (X @ w > 0).astype(np.float32)
+
+search = HyperbandSearchCV(
+    SGDClassifier(tol=1e-3, random_state=0),
+    {"alpha": [1e-5, 1e-4, 1e-3, 1e-2], "eta0": [0.01, 0.1, 0.5]},
+    max_iter=9, aggressiveness=3, random_state=0,
+)
+search.fit(X, y, classes=[0.0, 1.0])
+print("best params:", search.best_params_)
+print("best score:", round(search.best_score_, 4))
+print("models trained:", search.metadata_["n_models"],
+      "| total partial_fit calls:", search.metadata_["partial_fit_calls"])
