@@ -35,9 +35,54 @@ SCORERS = {
 }
 
 
+import collections as _collections
+
+# host copies of recently-scored folds, keyed by id. The ShardedArray is
+# pinned in the value so a GC'd-and-reused id can never alias a stale
+# copy; bounded FIFO so memory stays ≈ a handful of test folds. Without
+# this, a search with N candidates gathers the SAME cached fold N times.
+_HOST_FOLD_CACHE: "_collections.OrderedDict" = _collections.OrderedDict()
+_HOST_FOLD_CACHE_MAX = 16
+
+
+def _to_host_cached(a):
+    key = id(a)
+    hit = _HOST_FOLD_CACHE.get(key)
+    if hit is not None and hit[0] is a:
+        return hit[1]
+    h = a.to_numpy()
+    _HOST_FOLD_CACHE[key] = (a, h)
+    while len(_HOST_FOLD_CACHE) > _HOST_FOLD_CACHE_MAX:
+        _HOST_FOLD_CACHE.popitem(last=False)
+    return h
+
+
+def _host_adapting(scorer):
+    """Wrap an EXTERNAL scorer callable (sklearn make_scorer object, user
+    function). The raw call runs first — sharded-aware scorers (built on
+    this package's metrics) keep their device-resident path untouched.
+    Only if the scorer rejects the inputs (sklearn's validation raises on
+    ShardedArray) is it retried with host-converted folds."""
+
+    def wrapped(estimator, X, y=None, **kwargs):
+        from ..parallel.sharded import ShardedArray
+
+        sharded = isinstance(X, ShardedArray) or isinstance(y, ShardedArray)
+        try:
+            return scorer(estimator, X, y, **kwargs)
+        except (ValueError, TypeError, AttributeError):
+            if not sharded:
+                raise
+        Xh = _to_host_cached(X) if isinstance(X, ShardedArray) else X
+        yh = _to_host_cached(y) if isinstance(y, ShardedArray) else y
+        return scorer(estimator, Xh, yh, **kwargs)
+
+    return wrapped
+
+
 def get_scorer(scoring, compute=True):
     if callable(scoring):
-        return scoring
+        return _host_adapting(scoring)
     try:
         return SCORERS[scoring]
     except KeyError:

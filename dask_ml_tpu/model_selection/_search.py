@@ -80,10 +80,9 @@ def _resolve_scorers(estimator, scoring, refit):
         scoring = {name: name for name in scoring}
     if not isinstance(scoring, dict) or not scoring:
         raise ValueError(f"cannot interpret scoring={scoring!r}")
-    scorers = {
-        name: sc if callable(sc) else get_scorer(sc)
-        for name, sc in scoring.items()
-    }
+    # get_scorer handles BOTH names and callables — callables get the
+    # host-adapting wrap so sklearn scorer objects work on sharded folds
+    scorers = {name: get_scorer(sc) for name, sc in scoring.items()}
     if refit not in (False, None) and refit not in scorers:
         raise ValueError(
             f"multimetric scoring requires refit to name one of "

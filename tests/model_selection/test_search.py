@@ -209,3 +209,47 @@ def test_search_with_scorer_string(data):
                      cv=2, scoring="neg_log_loss").fit(X, y)
     assert (s.cv_results_["mean_test_score"] <= 0).all()
     assert s.best_score_ == s.cv_results_["mean_test_score"].max()
+
+
+def test_search_with_custom_make_scorer(data):
+    """sklearn make_scorer objects plug straight in (the reference's
+    check_scoring passes them through)."""
+    from sklearn.metrics import f1_score, make_scorer
+
+    X, y = data
+    s = GridSearchCV(
+        LogisticRegression(max_iter=30), {"C": [0.5, 2.0]}, cv=2,
+        scoring=make_scorer(f1_score),
+    ).fit(X, y)
+    assert 0.0 <= s.best_score_ <= 1.0
+    assert len(s.cv_results_["mean_test_score"]) == 2
+
+
+def test_search_accepts_cv_splitter_objects(data):
+    """cv may be an int or any splitter instance (KFold/ShuffleSplit),
+    as in the reference."""
+    from dask_ml_tpu.model_selection import KFold, ShuffleSplit
+
+    X, y = data
+    for cv, n_splits in ((KFold(n_splits=3, shuffle=True, random_state=0), 3),
+                         (ShuffleSplit(n_splits=2, test_size=0.3,
+                                       random_state=0), 2)):
+        s = GridSearchCV(LogisticRegression(max_iter=20),
+                         {"C": [1.0]}, cv=cv).fit(X, y)
+        split_cols = [k for k in s.cv_results_
+                      if k.startswith("split") and k.endswith("test_score")]
+        assert len(split_cols) == n_splits
+
+
+def test_multimetric_custom_callable_on_sharded(data):
+    from sklearn.metrics import f1_score, make_scorer
+
+    X, y = data  # sharded fixture
+    s = GridSearchCV(
+        LogisticRegression(max_iter=25), {"C": [0.5, 2.0]}, cv=2,
+        scoring={"f1": make_scorer(f1_score), "acc": "accuracy"},
+        refit="f1",
+    ).fit(X, y)
+    assert "mean_test_f1" in s.cv_results_
+    assert "mean_test_acc" in s.cv_results_
+    assert 0.5 < s.best_score_ <= 1.0
