@@ -63,6 +63,20 @@ def _matvec_eta(data, coef, intercept):
     return data @ coef.astype(data.dtype) + intercept.astype(data.dtype)
 
 
+@jax.jit
+def _matvec_eta_multi(data, coef, intercept):
+    """(n, C) decision values against stacked OvR coefficients (C, d)."""
+    return data @ coef.T.astype(data.dtype) + intercept.astype(data.dtype)
+
+
+@jax.jit
+def _onehot_targets(yd, mask, classes_d):
+    """(C, n) one-vs-rest targets in one program (module-level jit: a
+    per-fit lambda would retrace+recompile every fit)."""
+    return (yd[None, :] == classes_d[:, None]).astype(jnp.float32) \
+        * mask[None, :]
+
+
 @_partial(jax.jit, static_argnames=("fit_intercept", "to_bf16", "encode"))
 def _prepare_fit(Xd, yd, mask, fit_intercept, to_bf16, encode):
     """ONE program for all fit prep: intercept column, bf16 cast, binary
@@ -116,6 +130,32 @@ class _GLMBase(BaseEstimator):
     def _encode_y_host(self, y):
         return np.asarray(y, np.float32), None
 
+    def _penalty_setup(self, d, n_rows):
+        """(pmask, lam): intercept unpenalized, sklearn's 1/(C*n) scaling
+        — the ONE place the regularization bookkeeping lives (shared by
+        the resident, streamed, and multiclass fit paths)."""
+        pmask = np.ones(d, np.float32)
+        if self.fit_intercept:
+            pmask[-1] = 0.0
+        lam = 1.0 / (self.C * n_rows) if self.penalty != "none" else 0.0
+        return pmask, lam
+
+    def _warm_beta0(self, d, xp):
+        """Shape-guarded warm start: a stale coef_ from a DIFFERENT
+        problem shape (e.g. a prior multiclass fit) must not leak into
+        this solve — silently starting from a malformed vector crashes
+        deep in the jitted loss."""
+        if self.warm_start and getattr(self, "coef_", None) is not None:
+            flat = self._coef_flat()
+            if flat.shape[0] == d - (1 if self.fit_intercept else 0) \
+                    and np.ndim(self.coef_) <= 1 + (
+                        np.shape(self.coef_)[0] == 1
+                        if np.ndim(self.coef_) == 2 else 0):
+                b = (np.r_[flat, np.ravel(self.intercept_)[:1]]
+                     if self.fit_intercept else flat)
+                return xp.asarray(b, dtype=np.float32)
+        return xp.zeros(d, np.float32)
+
     def _finish_fit(self, beta, classes, info, n_features):
         beta = np.asarray(beta, np.float64)
         if self.fit_intercept:
@@ -146,17 +186,8 @@ class _GLMBase(BaseEstimator):
         y_host, classes = self._encode_y_host(y)
         n, d_feat = X.shape[0], X.shape[1]
         d = d_feat + (1 if self.fit_intercept else 0)
-        pmask = np.ones(d, np.float32)
-        if self.fit_intercept:
-            pmask[-1] = 0.0
-        lam = 1.0 / (self.C * n) if self.penalty != "none" else 0.0
-        beta0 = (
-            np.asarray(np.r_[self._coef_flat(), self.intercept_]
-                       if self.fit_intercept else self._coef_flat(),
-                       dtype=np.float32)
-            if self.warm_start and hasattr(self, "coef_")
-            else np.zeros(d, np.float32)
-        )
+        pmask, lam = self._penalty_setup(d, n)
+        beta0 = self._warm_beta0(d, np)
         stream = BlockStream((X, y_host), block_rows=block_rows)
         kwargs = dict(self.solver_kwargs or {})
         l1_ratio = kwargs.pop("l1_ratio", 0.5)
@@ -202,25 +233,15 @@ class _GLMBase(BaseEstimator):
         if self.family == "logistic":
             pk = np.asarray(packed)  # one small fetch: (mn, mx, binary)
             if not bool(pk[2]) or pk[0] == pk[1]:
-                n_classes = len(np.unique(y.to_numpy()))  # error path only
-                raise ValueError(
-                    f"LogisticRegression supports binary targets; got "
-                    f"{n_classes} classes"
-                )
+                # >2 (or 1) classes: the one-vs-rest path (vmapped
+                # multi-target solve; beyond the reference's binary-only
+                # dask-glm logistic family)
+                return self._fit_multiclass(X, y, data, mask)
             classes = np.asarray(pk[:2])
             self.classes_ = classes
         d = data.shape[1]
-        pmask = np.ones(d, np.float32)
-        if self.fit_intercept:
-            pmask[-1] = 0.0
-        lam = 1.0 / (self.C * X.n_rows) if self.penalty != "none" else 0.0
-        beta0 = (
-            jnp.asarray(np.r_[self._coef_flat(), self.intercept_]
-                        if self.fit_intercept else self._coef_flat(),
-                        dtype=jnp.float32)
-            if self.warm_start and hasattr(self, "coef_")
-            else jnp.zeros(d, jnp.float32)
-        )
+        pmask, lam = self._penalty_setup(d, X.n_rows)
+        beta0 = jnp.asarray(self._warm_beta0(d, np))
         kwargs = dict(self.solver_kwargs or {})
         l1_ratio = kwargs.pop("l1_ratio", 0.5)
         from ..utils.observability import (
@@ -318,18 +339,87 @@ class PoissonRegression(_GLMBase):
 
 
 class LogisticRegression(_GLMBase):
-    """Ref: dask_ml/linear_model/glm.py::LogisticRegression (binary, as in
-    dask-glm's logistic family)."""
+    """Ref: dask_ml/linear_model/glm.py::LogisticRegression. The
+    reference (via dask-glm's logistic family) is binary-only; here >2
+    classes fit one-vs-rest, with the C per-class solves vmapped into a
+    single XLA program for smooth solvers."""
 
     family = "logistic"
+
+    def _fit_multiclass(self, X, y, data, mask):
+        if self.multi_class not in ("auto", "ovr"):
+            raise ValueError(
+                f"multi_class={self.multi_class!r} is not supported; "
+                "use 'ovr' (or 'auto')"
+            )
+        classes = np.unique(y.to_numpy())
+        if len(classes) < 2:
+            raise ValueError(
+                f"LogisticRegression needs at least 2 classes; got "
+                f"{len(classes)}"
+            )
+        from ..utils.observability import fit_logger
+        from .solvers.solvers import solve_multi
+
+        # (C, n) one-vs-rest targets in ONE program; padding rows zeroed
+        Y = _onehot_targets(y.data, mask, jnp.asarray(classes, y.dtype))
+        d = data.shape[1]
+        pmask, lam = self._penalty_setup(d, X.n_rows)
+        C = len(classes)
+        B0 = (
+            jnp.asarray(np.c_[self.coef_, np.ravel(self.intercept_)]
+                        if self.fit_intercept else self.coef_,
+                        dtype=jnp.float32)
+            if self.warm_start and getattr(self, "coef_", None) is not None
+            and np.shape(self.coef_)
+            == (C, d - (1 if self.fit_intercept else 0))
+            else jnp.zeros((C, d), jnp.float32)
+        )
+        kwargs = dict(self.solver_kwargs or {})
+        l1_ratio = kwargs.pop("l1_ratio", 0.5)
+        with fit_logger(type(self).__name__, solver=self.solver,
+                        n_rows=X.n_rows, n_classes=C) as logger:
+            beta, info = solve_multi(
+                self.solver, X=data, Y=Y, mask=mask, n_rows=X.n_rows,
+                B0=B0, family=self.family, reg=self.penalty,
+                lam=jnp.asarray(lam, jnp.float32), pmask=jnp.asarray(pmask),
+                l1_ratio=l1_ratio, max_iter=self.max_iter, tol=self.tol,
+                mesh=X.mesh, **kwargs,
+            )
+            if logger is not None:
+                logger.log(step=info.get("n_iter"), summary=True,
+                           **{k: v for k, v in info.items()
+                              if isinstance(v, (int, float))})
+        beta = np.asarray(beta, np.float64)
+        if self.fit_intercept:
+            self.intercept_ = beta[:, -1]
+            self.coef_ = beta[:, :-1]
+        else:
+            self.intercept_ = np.zeros(len(classes))
+            self.coef_ = beta
+        self.classes_ = classes
+        self.n_iter_ = info.get("n_iter")
+        self.solver_info_ = info
+        self.n_features_in_ = X.shape[1]
+        return self
+
+    def _is_multiclass(self):
+        return getattr(self, "coef_", None) is not None \
+            and np.ndim(self.coef_) == 2 and self.coef_.shape[0] > 1
 
     def _encode_y_host(self, y):
         y = np.asarray(y)
         classes = np.unique(y)
+        if len(classes) > 2:
+            raise ValueError(
+                f"multiclass ({len(classes)} classes) is not supported on "
+                "the streamed (out-of-core) fit path; fit in-core for "
+                "one-vs-rest, or reduce to binary targets"
+            )
         if len(classes) != 2:
             raise ValueError(
-                f"LogisticRegression supports binary targets; got "
-                f"{len(classes)} classes"
+                f"LogisticRegression needs at least 2 classes; got "
+                f"{len(classes)}"
             )
         self.classes_ = classes
         return (y == classes[1]).astype(np.float32), classes
@@ -338,18 +428,47 @@ class LogisticRegression(_GLMBase):
         self.coef_ = coef.reshape(1, -1)
         self.intercept_ = np.atleast_1d(self.intercept_)
 
+    def _eta_multi_host(self, X):
+        """(n, C) decision values — one matmul program against the
+        stacked OvR coefficient matrix; streams block-wise for
+        out-of-core inputs exactly like the binary path."""
+        from ..parallel.streaming import stream_plan, streamed_map
+
+        coef = np.asarray(self.coef_, np.float32)
+        b = np.asarray(self.intercept_, np.float32)
+        block_rows = stream_plan(X)
+        if block_rows is not None:
+            coef_d = jnp.asarray(coef.T)
+            b_d = jnp.asarray(b)
+            return streamed_map(
+                X, block_rows, lambda blk: blk.arrays[0] @ coef_d + b_d
+            )
+        X = check_array(X, dtype=np.float32)
+        eta = _matvec_eta_multi(X.data, coef, b)
+        return to_host(eta)[: X.n_rows]
+
     def decision_function(self, X):
         check_is_fitted(self, "coef_")
+        if self._is_multiclass():
+            return self._eta_multi_host(X)
         return self._eta_host(X)
 
     def predict_proba(self, X):
         from scipy.special import expit
 
         check_is_fitted(self, "coef_")
+        if self._is_multiclass():
+            # OvR probabilities: per-class sigmoids normalized to sum 1
+            # (sklearn's OvR contract)
+            p = expit(self._eta_multi_host(X))
+            return p / np.maximum(p.sum(axis=1, keepdims=True), 1e-12)
         p1 = expit(self._eta_host(X))
         return np.stack([1.0 - p1, p1], axis=1)
 
     def predict(self, X):
+        if self._is_multiclass():
+            eta = self._eta_multi_host(X)
+            return self.classes_[np.argmax(eta, axis=1)]
         proba = self.predict_proba(X)
         return self.classes_[(proba[:, 1] > 0.5).astype(int)]
 

@@ -51,10 +51,11 @@ def test_predict_before_fit_raises():
         LogisticRegression().predict(X)
 
 
-def test_more_than_two_classes_raises():
+def test_more_than_two_classes_fits_ovr():
+    # beyond the reference: >2 classes dispatch to the one-vs-rest path
     y3 = rng.randint(0, 3, len(X)).astype(np.float32)
-    with pytest.raises(ValueError, match="class"):
-        LogisticRegression(solver="lbfgs", max_iter=10).fit(X, y3)
+    clf = LogisticRegression(solver="lbfgs", max_iter=10).fit(X, y3)
+    assert clf.coef_.shape == (3, X.shape[1])
 
 
 def test_single_class_raises():
