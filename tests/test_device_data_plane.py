@@ -111,13 +111,17 @@ def test_device_fit_explicit_classes_kwarg(xy_device):
     assert host.score(X, ypm) > 0.7
 
 
-def test_glm_encode_y_non_binary_raises(xy_device):
+def test_glm_non_binary_dispatches_to_ovr(xy_device):
+    # the binary-scan packed check now routes >2 classes to the
+    # one-vs-rest path instead of raising (multiclass support)
     from dask_ml_tpu.linear_model import LogisticRegression
 
     X, _ = xy_device
     y3 = as_sharded(np.arange(len(X), dtype=np.float32) % 3)
-    with pytest.raises(ValueError, match="binary.*3 classes"):
-        LogisticRegression(solver="lbfgs").fit(as_sharded(X), y3)
+    clf = LogisticRegression(solver="lbfgs", max_iter=15).fit(
+        as_sharded(X), y3
+    )
+    assert clf.coef_.shape == (3, X.shape[1])
 
 
 def test_concurrent_gridsearch_sharded_stays_on_device(xy_device, spy):
