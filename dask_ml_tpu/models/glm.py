@@ -146,11 +146,9 @@ class _GLMBase(BaseEstimator):
         this solve — silently starting from a malformed vector crashes
         deep in the jitted loss."""
         if self.warm_start and getattr(self, "coef_", None) is not None:
+            single = np.ndim(self.coef_) == 1 or np.shape(self.coef_)[0] == 1
             flat = self._coef_flat()
-            if flat.shape[0] == d - (1 if self.fit_intercept else 0) \
-                    and np.ndim(self.coef_) <= 1 + (
-                        np.shape(self.coef_)[0] == 1
-                        if np.ndim(self.coef_) == 2 else 0):
+            if single and flat.shape[0] == d - int(self.fit_intercept):
                 b = (np.r_[flat, np.ravel(self.intercept_)[:1]]
                      if self.fit_intercept else flat)
                 return xp.asarray(b, dtype=np.float32)
