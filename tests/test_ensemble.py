@@ -45,3 +45,36 @@ def test_voting_regressor():
     reg = BlockwiseVotingRegressor(SkLinear()).fit(X, y)
     assert len(reg.estimators_) == default_mesh().devices.size
     assert reg.score(X, y) > 0.8
+
+
+def test_soft_voting_is_member_average():
+    """Soft voting = the mean of the per-block members' predict_proba
+    (the reference's blockwise averaging), verified against a manual
+    average over estimators_."""
+    X, y = make_classification(n_samples=400, n_features=8, random_state=1)
+    clf = BlockwiseVotingClassifier(
+        SkLogistic(max_iter=300), voting="soft"
+    ).fit(X, y)
+    Xh = X.to_numpy() if hasattr(X, "to_numpy") else np.asarray(X)
+    manual = np.mean(
+        [m.predict_proba(Xh) for m in clf.estimators_], axis=0
+    )
+    got = clf.predict_proba(X)
+    got = got.to_numpy() if hasattr(got, "to_numpy") else np.asarray(got)
+    np.testing.assert_allclose(got, manual, atol=1e-6)
+
+
+def test_hard_voting_majority():
+    """Hard voting picks the majority label across members."""
+    X, y = make_classification(n_samples=300, n_features=6, random_state=2)
+    clf = BlockwiseVotingClassifier(
+        SkLogistic(max_iter=200), classes=[0, 1]
+    ).fit(X, y)
+    Xh = X.to_numpy() if hasattr(X, "to_numpy") else np.asarray(X)
+    votes = np.stack([m.predict(Xh) for m in clf.estimators_])
+    majority = (votes.mean(axis=0) > 0.5).astype(float)
+    got = clf.predict(X)
+    got = got.to_numpy() if hasattr(got, "to_numpy") else np.asarray(got)
+    # ties (exact .5) may break either way; compare only clear majorities
+    clear = votes.mean(axis=0) != 0.5
+    np.testing.assert_array_equal(got[clear], majority[clear])

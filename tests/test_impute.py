@@ -79,3 +79,22 @@ def test_quantile_scalers_accept_nan():
     X[::11, 1] = np.nan
     for est in (RobustScaler(), QuantileTransformer(n_quantiles=20)):
         est.fit(X)  # NaN-skipping statistics: must not raise
+
+
+def test_imputer_on_partitioned_frame():
+    """SimpleImputer consumes frames through the ShardedArray bridge and
+    matches sklearn's statistics."""
+    import pandas as pd
+
+    from dask_ml_tpu.parallel import from_pandas
+
+    rng = np.random.RandomState(0)
+    df = pd.DataFrame({"a": rng.randn(120), "b": rng.rand(120)})
+    df.iloc[::7, 0] = np.nan
+    pf = from_pandas(df, npartitions=4)
+    Xs = pf.to_sharded()
+    imp = SimpleImputer(strategy="mean").fit(Xs)
+    ref = SkImputer(strategy="mean").fit(df)
+    np.testing.assert_allclose(imp.statistics_, ref.statistics_, rtol=1e-5)
+    out = imp.transform(Xs).to_numpy()
+    np.testing.assert_allclose(out, ref.transform(df), rtol=1e-5)
