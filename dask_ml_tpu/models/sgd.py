@@ -39,45 +39,68 @@ _LOSSES = ("log_loss", "hinge", "squared_error")
 _PENALTIES = ("l2", "l1", "elasticnet", None, "none")
 
 
+def _sgd_update_one(w, y, X, mask, n_valid, lr, alpha, l2w, l1w, iflag,
+                    loss):
+    """One minibatch-GD(+prox) update of one weight vector — the SINGLE
+    definition of the objective and update shared by the model-batched
+    and class-batched kernels (a divergence between them would silently
+    split binary and multiclass semantics)."""
+
+    def objective(w):
+        # iflag=0 zeroes the intercept's contribution to eta, so grad[-1]
+        # is already 0 and the intercept stays frozen at its init (0)
+        eta = X @ w[:-1] + w[-1] * iflag
+        if loss == "log_loss":
+            per = jax.nn.softplus(eta) - y * eta
+        elif loss == "hinge":
+            margins = (2.0 * y - 1.0) * eta
+            per = jnp.maximum(0.0, 1.0 - margins)
+        else:  # squared_error
+            per = 0.5 * (eta - y) ** 2
+        data_loss = jnp.sum(per * mask) / jnp.maximum(n_valid, 1.0)
+        reg = 0.5 * alpha * l2w * jnp.sum(w[:-1] ** 2)
+        return data_loss + reg
+
+    val, grad = jax.value_and_grad(objective)(w)
+    w = w - lr * grad
+    # proximal soft-threshold for the l1 part (intercept unpenalized)
+    thr = lr * alpha * l1w
+    coef = jnp.sign(w[:-1]) * jnp.maximum(jnp.abs(w[:-1]) - thr, 0.0)
+    return w.at[:-1].set(coef), val
+
+
 @partial(jax.jit, static_argnames=("loss",))
 def _sgd_step_many(X, y, mask, n_valid, W, lrs, alphas, l2_ws, l1_ws,
                    int_flags, loss):
-    """Advance N models one minibatch-GD(+prox) step in one program.
-
-    W: (N, d+1) stacked weights (last column = intercept). X/y/mask are
-    SHARED across models (vmap in_axes=None) — the block is read once.
-    Per-model dynamic scalars: lr, alpha, l2/l1 penalty weights, and an
-    intercept flag (0 freezes the intercept at its current value,
-    honoring fit_intercept without a static recompile per setting).
-    """
+    """Advance N models one step in one program. W: (N, d+1) stacked
+    weights (last column = intercept). X/y/mask are SHARED across models
+    — the block is read once; lr/alpha/penalty weights/intercept flag
+    are per-model dynamic scalars (no static recompile per setting)."""
 
     def one(w, lr, alpha, l2w, l1w, iflag):
-        def objective(w):
-            eta = X @ w[:-1] + w[-1] * iflag
-            if loss == "log_loss":
-                per = jax.nn.softplus(eta) - y * eta
-            elif loss == "hinge":
-                margins = (2.0 * y - 1.0) * eta
-                per = jnp.maximum(0.0, 1.0 - margins)
-            else:  # squared_error
-                per = 0.5 * (eta - y) ** 2
-            data_loss = jnp.sum(per * mask) / jnp.maximum(n_valid, 1.0)
-            reg = 0.5 * alpha * l2w * jnp.sum(w[:-1] ** 2)
-            return data_loss + reg
-
-        # iflag=0 zeroes the intercept's contribution to eta, so grad[-1]
-        # is already 0 and the intercept stays frozen at its init (0)
-        val, grad = jax.value_and_grad(objective)(w)
-        w = w - lr * grad
-        # proximal soft-threshold for the l1 part (intercept unpenalized)
-        thr = lr * alpha * l1w
-        coef = jnp.sign(w[:-1]) * jnp.maximum(jnp.abs(w[:-1]) - thr, 0.0)
-        w = w.at[:-1].set(coef)
-        return w, val
+        return _sgd_update_one(w, y, X, mask, n_valid, lr, alpha, l2w,
+                               l1w, iflag, loss)
 
     return jax.vmap(one, in_axes=(0, 0, 0, 0, 0, 0))(
         W, lrs, alphas, l2_ws, l1_ws, int_flags
     )
+
+
+@partial(jax.jit, static_argnames=("loss",))
+def _sgd_step_multi(X, y_codes, mask, n_valid, W, lr, alpha, l2w, l1w,
+                    iflag, loss):
+    """Advance the C one-vs-rest problems of ONE multiclass model in one
+    program. W: (C, d+1); ``y_codes`` holds class INDICES 0..C-1 (mapped
+    at encode time — float32 equality on raw labels would collapse
+    ID-like classes past 2**24), and each class's 0/1 target derives
+    in-kernel — no (C, n) target matrix ever materializes."""
+
+    def one(w, c):
+        y = (y_codes == c).astype(jnp.float32)
+        return _sgd_update_one(w, y, X, mask, n_valid, lr, alpha, l2w,
+                               l1w, iflag, loss)
+
+    return jax.vmap(one)(W, jnp.arange(W.shape[0], dtype=jnp.float32))
 
 
 @jax.jit
@@ -153,9 +176,18 @@ class _SGDBase(BaseEstimator):
             return 1.0 / (self.alpha * (1e3 + t))
         raise ValueError(f"Unknown learning_rate {self.learning_rate!r}")
 
+    def _n_out(self):
+        """Number of one-vs-rest rows for a multiclass classifier, else
+        None (binary / regression use a flat weight vector)."""
+        classes = getattr(self, "classes_", None)
+        return len(classes) if classes is not None and len(classes) > 2 \
+            else None
+
     def _ensure_state(self, d):
         if not hasattr(self, "_w") or self._w is None:
-            self._w = jnp.zeros((d + 1,), jnp.float32)
+            C = self._n_out()
+            shape = (C, d + 1) if C is not None else (d + 1,)
+            self._w = jnp.zeros(shape, jnp.float32)
             self._t = 0
         self._penalty_weights()  # validate penalty eagerly
 
@@ -180,15 +212,7 @@ class _SGDBase(BaseEstimator):
             self._set_classes(np.asarray(classes))
         X, y = self._block(X, y)
         self._ensure_state(X.shape[1])
-        mask = X.row_mask(jnp.float32)
-        lr, alpha, l2w, l1w, iflag = self._step_args()
-        W, losses = _sgd_step_many(
-            X.data, y.data, mask, jnp.float32(X.n_rows), self._w[None],
-            jnp.asarray([lr]), jnp.asarray([alpha]), jnp.asarray([l2w]),
-            jnp.asarray([l1w]), jnp.asarray([iflag]), self._loss(),
-        )
-        self._w = W[0]
-        self._last_loss = losses[0]
+        self._one_step(X.data, y.data, X.row_mask(jnp.float32), X.n_rows)
         self._publish(X.shape[1])
         return self
 
@@ -249,6 +273,17 @@ class _SGDBase(BaseEstimator):
 
     def _one_step(self, Xb, yb, mask, n_valid):
         lr, alpha, l2w, l1w, iflag = self._step_args()
+        if self._n_out() is not None:
+            # multiclass: C one-vs-rest rows advance in one program; yb
+            # holds class codes, per-class targets derive in-kernel
+            W, losses = _sgd_step_multi(
+                Xb, yb, mask, jnp.float32(n_valid), self._w,
+                jnp.float32(lr), jnp.float32(alpha), jnp.float32(l2w),
+                jnp.float32(l1w), jnp.float32(iflag), self._loss(),
+            )
+            self._w = W
+            self._last_loss = losses.sum()
+            return
         W, losses = _sgd_step_many(
             Xb, yb, mask, jnp.float32(n_valid), self._w[None],
             jnp.asarray([lr]), jnp.asarray([alpha]), jnp.asarray([l2w]),
@@ -274,7 +309,10 @@ class _SGDBase(BaseEstimator):
             elif getattr(self, "classes_", None) is None:
                 from ..utils.validation import device_binary_classes
 
-                self._set_classes(device_binary_classes(ys))
+                try:
+                    self._set_classes(device_binary_classes(ys))
+                except ValueError:  # >2 classes: host unique fallback
+                    self._set_classes(np.unique(ys.to_numpy()))
         y_enc = self._encode_y(ys)
         n = X.n_rows
         n_blocks = 8
@@ -354,11 +392,13 @@ class SGDClassifier(ClassifierMixin, _SGDBase):
             # solo path enforces the first-call classes contract (raises);
             # batching without classes would train on un-encoded labels
             return None
+        if self._n_out() is not None:
+            return None  # multiclass weights are (C, d+1): solo path
         return super()._batch_key()
 
     def _set_classes(self, classes):
-        if len(classes) != 2:
-            raise ValueError("SGDClassifier supports binary targets")
+        if len(classes) < 2:
+            raise ValueError("SGDClassifier needs at least 2 classes")
         have = getattr(self, "classes_", None)
         if have is not None and not np.array_equal(classes, have):
             # sklearn contract: classes must be identical across calls —
@@ -381,6 +421,23 @@ class SGDClassifier(ClassifierMixin, _SGDBase):
     def _encode_y(self, y):
         if getattr(self, "classes_", None) is None:
             return y if isinstance(y, ShardedArray) else np.asarray(y)
+        if self._n_out() is not None:
+            # multiclass: labels map to class CODES 0..C-1 (searchsorted
+            # over the sorted classes_, in the labels' NATIVE dtype —
+            # handles string labels and >2**24 integer ids exactly);
+            # the codes ride to the kernel as float32 (C-1 is tiny)
+            if isinstance(y, ShardedArray):
+                classes_d = jnp.asarray(
+                    np.asarray(self.classes_, np.dtype(str(y.dtype)))
+                )
+                return ShardedArray(
+                    jnp.searchsorted(classes_d, y.data)
+                    .astype(jnp.float32),
+                    y.n_rows, y.mesh,
+                )
+            return np.searchsorted(
+                self.classes_, np.asarray(y)
+            ).astype(np.float32)
         pos = self.classes_[1]
         if isinstance(y, ShardedArray):
             return ShardedArray(
@@ -391,8 +448,12 @@ class SGDClassifier(ClassifierMixin, _SGDBase):
 
     def _publish(self, d):
         w = to_host(self._w).astype(np.float64)
-        self.coef_ = w[:-1].reshape(1, -1)
-        self.intercept_ = np.atleast_1d(w[-1])
+        if self._n_out() is not None:
+            self.coef_ = w[:, :-1]
+            self.intercept_ = w[:, -1]
+        else:
+            self.coef_ = w[:-1].reshape(1, -1)
+            self.intercept_ = np.atleast_1d(w[-1])
 
     @classmethod
     def _batched_score_default(cls, models, X, y):
@@ -410,17 +471,28 @@ class SGDClassifier(ClassifierMixin, _SGDBase):
 
     def decision_function(self, X):
         check_is_fitted(self, "coef_")
+        if self._n_out() is not None:
+            Xs = as_sharded(X, dtype=np.float32)
+            eta = _batched_eta(Xs.data, self._w)   # (n, C)
+            return to_host(eta)[: Xs.n_rows]
         X, eta = self._decision(X)
         return to_host(eta)[: X.n_rows]
 
     def predict(self, X):
         scores = self.decision_function(X)
+        if self._n_out() is not None:
+            return self.classes_[np.argmax(scores, axis=1)]
         return self.classes_[(scores > 0).astype(int)]
 
     def predict_proba(self, X):
         if self._loss() != "log_loss":
             raise AttributeError("predict_proba requires loss='log_loss'")
         check_is_fitted(self, "coef_")
+        if self._n_out() is not None:
+            from scipy.special import expit
+
+            p = expit(self.decision_function(X))   # OvR sigmoids
+            return p / np.maximum(p.sum(axis=1, keepdims=True), 1e-12)
         X, eta = self._decision(X)
         p1 = to_host(jax.nn.sigmoid(eta))[: X.n_rows]
         return np.stack([1 - p1, p1], axis=1)
