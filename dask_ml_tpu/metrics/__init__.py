@@ -4,7 +4,8 @@ from .regression import (mean_absolute_error, mean_squared_error,
                          mean_squared_log_error, r2_score)
 from .pairwise import (cosine_distances, euclidean_distances,
                        linear_kernel, manhattan_distances,
-                       pairwise_distances, pairwise_distances_argmin_min,
+                       pairwise_distances, pairwise_distances_argmin,
+                       pairwise_distances_argmin_min,
                        pairwise_kernels, polynomial_kernel, rbf_kernel,
                        sigmoid_kernel)
 from .scorer import SCORERS, check_scoring, get_scorer

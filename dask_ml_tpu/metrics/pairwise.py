@@ -52,9 +52,18 @@ pairwise_distances_argmin_min = _public(
     _ops.pairwise_distances_argmin_min, n_outputs=2
 )
 
+
+def pairwise_distances_argmin(X, Y=None):
+    """sklearn's argmin-only variant: the labels half of
+    pairwise_distances_argmin_min (euclidean — the metric the device
+    kernel implements; an honest narrow signature beats a TypeError
+    deep in the ops layer)."""
+    return pairwise_distances_argmin_min(X, Y)[0]
+
 __all__ = [
     "cosine_distances", "euclidean_distances", "linear_kernel",
     "manhattan_distances", "pairwise_distances",
-    "pairwise_distances_argmin_min", "pairwise_kernels",
-    "polynomial_kernel", "rbf_kernel", "sigmoid_kernel",
+    "pairwise_distances_argmin", "pairwise_distances_argmin_min",
+    "pairwise_kernels", "polynomial_kernel", "rbf_kernel",
+    "sigmoid_kernel",
 ]

@@ -116,3 +116,15 @@ def test_pairwise_y_none_and_keyword():
         np.asarray(m.rbf_kernel(x, Y=yc)), skpw.rbf_kernel(x, Y=yc),
         rtol=1e-5,
     )
+
+
+def test_pairwise_distances_argmin_matches_sklearn():
+    import sklearn.metrics as skm
+
+    from dask_ml_tpu.metrics import pairwise_distances_argmin
+
+    rng = np.random.RandomState(3)
+    X = rng.randn(80, 5).astype(np.float32)
+    Y = rng.randn(9, 5).astype(np.float32)
+    got = np.asarray(pairwise_distances_argmin(X, Y))
+    np.testing.assert_array_equal(got, skm.pairwise_distances_argmin(X, Y))
