@@ -48,7 +48,7 @@ def test_logistic_l1_sparsity(xy_classification):
         solver="proximal_grad", penalty="l1", C=0.01, max_iter=2000, tol=1e-9
     ).fit(X, y)
     ref = sklm.LogisticRegression(
-        penalty="l1", C=0.01, solver="saga", max_iter=5000, tol=1e-10
+        l1_ratio=1.0, C=0.01, solver="saga", max_iter=5000, tol=1e-10
     ).fit(X, y)
     np.testing.assert_allclose(ours_zero := (np.abs(clf.coef_) < 1e-6),
                                np.abs(ref.coef_) < 1e-6)
@@ -61,7 +61,7 @@ def test_logistic_admm_l1(xy_classification):
         solver="admm", penalty="l1", C=0.01, max_iter=400, tol=1e-5
     ).fit(X, y)
     ref = sklm.LogisticRegression(
-        penalty="l1", C=0.01, solver="saga", max_iter=5000, tol=1e-10
+        l1_ratio=1.0, C=0.01, solver="saga", max_iter=5000, tol=1e-10
     ).fit(X, y)
     np.testing.assert_allclose(clf.coef_, ref.coef_, atol=0.03)
 
