@@ -141,3 +141,17 @@ def test_k_means_functional(blobs):
     assert inertia > 0 and n_iter >= 1
     centers3 = k_means(X, 4, init="random", random_state=0, max_iter=20)
     assert len(centers3) == 3
+
+
+def test_kmeans_score_is_negative_inertia(blobs):
+    import sklearn.cluster as skc
+
+    X, _ = blobs
+    Xh = X.to_numpy() if hasattr(X, "to_numpy") else np.asarray(X)
+    init = Xh[:4]
+    ours = KMeans(n_clusters=4, init=init, max_iter=20, tol=0.0).fit(X)
+    ref = skc.KMeans(n_clusters=4, init=init, n_init=1, max_iter=20,
+                     tol=0.0).fit(Xh)
+    # sklearn contract: score = -inertia of the assignment
+    assert ours.score(X) == pytest.approx(-ours.inertia_, rel=1e-5)
+    assert ours.inertia_ == pytest.approx(ref.inertia_, rel=1e-3)

@@ -109,3 +109,21 @@ def test_multiclass_string_labels(data3):
     pred = clf.predict(X)
     assert set(pred) <= set(names)
     assert (pred == ys).mean() > 0.8
+
+
+def test_multiclass_in_hyperband(data3):
+    from dask_ml_tpu.model_selection import HyperbandSearchCV
+
+    X, y = data3
+    search = HyperbandSearchCV(
+        SGDClassifier(tol=1e-3, random_state=0),
+        {"alpha": [1e-5, 1e-3], "eta0": [0.05, 0.2]},
+        max_iter=6, aggressiveness=3, random_state=0,
+    )
+    search.fit(X, y, classes=[0.0, 1.0, 2.0])
+    assert search.best_estimator_.coef_.shape == (3, X.shape[1])
+    assert search.best_score_ > 0.6
+    # multiclass trials ran on the solo path (no vmapped cohort steps)
+    assert {r["executor"] for r in search.history_} <= {
+        "sequential", "threads"
+    }
