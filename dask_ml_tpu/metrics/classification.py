@@ -41,10 +41,53 @@ def accuracy_score(y_true, y_pred, normalize=True, sample_weight=None):
     return float(hits / jnp.sum(w))
 
 
-def log_loss(y_true, y_prob, eps=1e-15, sample_weight=None):
+def log_loss(y_true, y_prob, eps=1e-15, sample_weight=None, labels=None):
     t, p, w, n = _canon(y_true, y_prob, sample_weight)
     p = jnp.clip(p, eps, 1.0 - eps)
+    if p.ndim == 2 and p.shape[1] > 2:
+        # multiclass: cross-entropy of the true-class probability, rows
+        # renormalized as sklearn does. Column c of y_prob corresponds to
+        # the c-th SORTED class — when a fold is missing a class that
+        # inference is ambiguous, so (like sklearn) explicit labels are
+        # required rather than silently misaligning columns
+        if labels is not None:
+            classes = np.sort(np.asarray(labels))
+        else:
+            host_t = (y_true.to_numpy() if isinstance(y_true, ShardedArray)
+                      else np.asarray(y_true))
+            classes = np.unique(host_t)
+        if len(classes) != p.shape[1]:
+            raise ValueError(
+                f"y_true has {len(classes)} classes but y_prob has "
+                f"{p.shape[1]} columns; pass labels= with every class"
+            )
+        p = p / jnp.sum(p, axis=1, keepdims=True)
+        idx = jnp.searchsorted(jnp.asarray(classes, t.dtype), t)
+        p_true = jnp.take_along_axis(
+            p, jnp.clip(idx, 0, p.shape[1] - 1)[:, None], axis=1
+        )[:, 0]
+        ll = -jnp.log(jnp.clip(p_true, eps, 1.0))
+        return float(jnp.sum(ll * w) / jnp.sum(w))
     if p.ndim == 2:  # (n, 2) probabilities: take class-1 column
         p = p[:, 1]
+    # binary labels need not be 0/1 (e.g. {10, 20}): map the POSITIVE
+    # (larger) class to 1 by a device min/max scan — one scalar fetch
+    if labels is not None:
+        lab = np.sort(np.asarray(labels))
+        if len(lab) != 2:
+            raise ValueError("binary y_prob needs exactly 2 labels")
+        mn_h, mx_h = float(lab[0]), float(lab[1])
+    else:
+        valid = w > 0
+        mn = jnp.min(jnp.where(valid, t, jnp.inf))
+        mx = jnp.max(jnp.where(valid, t, -jnp.inf))
+        mn_h, mx_h = float(mn), float(mx)
+    if mn_h == mx_h and not (mn_h in (0.0, 1.0)):
+        raise ValueError(
+            "y_true contains a single class; pass labels= to fix the "
+            "class order"
+        )
+    if (mn_h, mx_h) != (0.0, 1.0):
+        t = (t == mx_h).astype(jnp.float32)
     ll = -(t * jnp.log(p) + (1.0 - t) * jnp.log1p(-p))
     return float(jnp.sum(ll * w) / jnp.sum(w))

@@ -107,3 +107,46 @@ def test_scorer_greater_is_better_sign():
     est = LinearRegression().fit(X, y)
     val = get_scorer("neg_mean_squared_error")(est, X, y)
     assert float(val) <= 0.0
+
+
+def test_log_loss_multiclass_matches_sklearn():
+    import sklearn.metrics as skm
+
+    from dask_ml_tpu.metrics import log_loss
+
+    rng = np.random.RandomState(0)
+    y = rng.randint(0, 4, 300).astype(np.float64)
+    p = rng.dirichlet(np.ones(4), 300)
+    assert abs(float(log_loss(y, p)) - skm.log_loss(y, p)) < 1e-6
+    # non-contiguous labels map by sorted order, as sklearn does
+    y2 = np.choose(y.astype(int), [10.0, 20.0, 30.0, 40.0])
+    assert abs(float(log_loss(y2, p)) - skm.log_loss(y2, p)) < 1e-6
+
+
+def test_log_loss_binary_noncanonical_labels():
+    import sklearn.metrics as skm
+
+    from dask_ml_tpu.metrics import log_loss
+
+    rng = np.random.RandomState(1)
+    y = np.where(rng.rand(200) > 0.5, 20.0, 10.0)
+    p = rng.rand(200)
+    assert abs(float(log_loss(y, p)) - skm.log_loss(y, p)) < 1e-6
+
+
+def test_log_loss_missing_class_requires_labels():
+    import pytest
+
+    from dask_ml_tpu.metrics import log_loss
+
+    rng = np.random.RandomState(2)
+    p = rng.dirichlet(np.ones(4), 100)
+    y = rng.randint(0, 3, 100).astype(np.float64)  # class 3 never occurs
+    with pytest.raises(ValueError, match="labels"):
+        log_loss(y, p)
+    # explicit labels resolve the mapping
+    import sklearn.metrics as skm
+
+    got = float(log_loss(y, p, labels=[0.0, 1.0, 2.0, 3.0]))
+    want = skm.log_loss(y, p, labels=[0.0, 1.0, 2.0, 3.0])
+    assert abs(got - want) < 1e-6
