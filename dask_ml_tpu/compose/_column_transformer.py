@@ -180,7 +180,8 @@ class ColumnTransformer(TransformerMixin, BaseEstimator):
 
 
 def make_column_transformer(*transformers, remainder="drop",
-                            sparse_threshold=0.3, n_jobs=None):
+                            sparse_threshold=0.3, n_jobs=None,
+                            preserve_dataframe=True):
     """Ref: dask_ml/compose::make_column_transformer."""
     named = [
         (f"{type(t).__name__.lower()}-{i}" if not isinstance(t, str)
@@ -188,4 +189,5 @@ def make_column_transformer(*transformers, remainder="drop",
         for i, (t, cols) in enumerate(transformers, 1)
     ]
     return ColumnTransformer(named, remainder=remainder,
-                             sparse_threshold=sparse_threshold, n_jobs=n_jobs)
+                             sparse_threshold=sparse_threshold, n_jobs=n_jobs,
+                             preserve_dataframe=preserve_dataframe)

@@ -62,10 +62,14 @@ def log_loss(y_true, y_prob, eps=1e-15, sample_weight=None, labels=None):
                 f"{p.shape[1]} columns; pass labels= with every class"
             )
         p = p / jnp.sum(p, axis=1, keepdims=True)
-        idx = jnp.searchsorted(jnp.asarray(classes, t.dtype), t)
-        p_true = jnp.take_along_axis(
-            p, jnp.clip(idx, 0, p.shape[1] - 1)[:, None], axis=1
-        )[:, 0]
+        classes_d = jnp.asarray(classes, t.dtype)
+        idx = jnp.clip(jnp.searchsorted(classes_d, t), 0, p.shape[1] - 1)
+        # membership check: a y value absent from the classes (or falling
+        # between them) must raise, not silently score a neighbor class
+        ok = jnp.all((jnp.take(classes_d, idx) == t) | (w == 0))
+        if not bool(ok):
+            raise ValueError("y_true contains values not in labels")
+        p_true = jnp.take_along_axis(p, idx[:, None], axis=1)[:, 0]
         ll = -jnp.log(jnp.clip(p_true, eps, 1.0))
         return float(jnp.sum(ll * w) / jnp.sum(w))
     if p.ndim == 2:  # (n, 2) probabilities: take class-1 column
@@ -82,11 +86,16 @@ def log_loss(y_true, y_prob, eps=1e-15, sample_weight=None, labels=None):
         mn = jnp.min(jnp.where(valid, t, jnp.inf))
         mx = jnp.max(jnp.where(valid, t, -jnp.inf))
         mn_h, mx_h = float(mn), float(mx)
-    if mn_h == mx_h and not (mn_h in (0.0, 1.0)):
-        raise ValueError(
-            "y_true contains a single class; pass labels= to fix the "
-            "class order"
-        )
+        if mn_h == mx_h:
+            # single observed class: the 0/1 mapping is ambiguous and a
+            # silent guess scores the WRONG class half the time
+            raise ValueError(
+                "y_true contains a single class; pass labels= to fix "
+                "the class order"
+            )
+    ok = jnp.all((t == mn_h) | (t == mx_h) | (w == 0))
+    if not bool(ok):
+        raise ValueError("y_true contains values not in labels")
     if (mn_h, mx_h) != (0.0, 1.0):
         t = (t == mx_h).astype(jnp.float32)
     ll = -(t * jnp.log(p) + (1.0 - t) * jnp.log1p(-p))

@@ -16,8 +16,18 @@ def _make_scorer(metric, greater_is_better=True, needs_proba=False):
     sign = 1.0 if greater_is_better else -1.0
 
     def scorer(estimator, X, y):
-        pred = (estimator.predict_proba(X) if needs_proba
-                else estimator.predict(X))
+        if needs_proba:
+            pred = estimator.predict_proba(X)
+            # proba columns align to estimator.classes_ — forward them so
+            # a CV fold missing a class still scores (sklearn's scorer
+            # does the same); log_loss would otherwise raise
+            classes = getattr(estimator, "classes_", None)
+            if classes is not None:
+                import numpy as _np
+
+                return sign * metric(y, pred, labels=_np.asarray(classes))
+        else:
+            pred = estimator.predict(X)
         return sign * metric(y, pred)
 
     return scorer

@@ -150,3 +150,40 @@ def test_log_loss_missing_class_requires_labels():
     got = float(log_loss(y, p, labels=[0.0, 1.0, 2.0, 3.0]))
     want = skm.log_loss(y, p, labels=[0.0, 1.0, 2.0, 3.0])
     assert abs(got - want) < 1e-6
+
+
+def test_log_loss_single_class_and_out_of_label_raise():
+    import pytest
+
+    from dask_ml_tpu.metrics import log_loss
+
+    # all-one-class binary without labels: ambiguous mapping must raise
+    with pytest.raises(ValueError, match="single class"):
+        log_loss(np.zeros(5), np.full(5, 0.1))
+    # with labels the mapping is pinned and matches sklearn
+    import sklearn.metrics as skm
+
+    got = float(log_loss(np.zeros(5), np.full(5, 0.1), labels=[0.0, 1.0]))
+    want = skm.log_loss(np.zeros(5), np.full(5, 0.1), labels=[0, 1])
+    assert abs(got - want) < 1e-6
+    # y values outside the label set raise instead of scoring a neighbor
+    p4 = np.full((4, 4), 0.25)
+    with pytest.raises(ValueError, match="not in labels"):
+        log_loss(np.array([0.0, 1.0, 2.0, 5.0]), p4,
+                 labels=[0.0, 1.0, 2.0, 3.0])
+
+
+def test_neg_log_loss_scorer_fold_missing_class():
+    """The scorer forwards estimator.classes_, so a fold missing a class
+    still scores (the bare metric would raise)."""
+    from dask_ml_tpu.linear_model import LogisticRegression
+    from dask_ml_tpu.metrics.scorer import get_scorer
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(300, 6).astype(np.float32)
+    y = rng.randint(0, 3, 300).astype(np.float32)
+    clf = LogisticRegression(solver="lbfgs", max_iter=60).fit(X, y)
+    scorer = get_scorer("neg_log_loss")
+    sub = y < 2  # evaluation slice missing class 2
+    s = scorer(clf, X[sub], y[sub])
+    assert np.isfinite(s) and s <= 0

@@ -108,10 +108,12 @@ def test_column_transformer_dataframe_remainder():
 
 def test_make_column_transformer():
     ct = make_column_transformer(
-        (StandardScaler(), [0]), ("passthrough", [1])
+        (StandardScaler(), [0]), ("passthrough", [1]),
+        preserve_dataframe=False,
     )
     names = [name for name, _, _ in ct.transformers]
     assert len(names) == 2 and len(set(names)) == 2
+    assert ct.preserve_dataframe is False
 
 
 def test_column_transformer_bad_remainder():
