@@ -112,3 +112,22 @@ def test_hyperband_patience(data):
     )
     search.fit(X, y, classes=[0.0, 1.0])
     assert search.best_score_ > 0.5
+
+
+def test_inverse_decay_alias(data):
+    """InverseDecaySearchCV is the explicit-name alias of the decaying
+    IncrementalSearchCV (later dask-ml versions export both)."""
+    from dask_ml_tpu.model_selection import (
+        IncrementalSearchCV, InverseDecaySearchCV,
+    )
+
+    assert issubclass(InverseDecaySearchCV, IncrementalSearchCV)
+    X, y = data
+    s = InverseDecaySearchCV(
+        SGDClassifier(random_state=0),
+        {"alpha": [1e-4, 1e-3]}, n_initial_parameters="grid",
+        decay_rate=1.0, max_iter=4, random_state=0,
+    )
+    s.fit(X, y, classes=[0.0, 1.0])
+    assert s.best_score_ > 0.5
+    assert len(s.cv_results_["params"]) == 2

@@ -126,3 +126,16 @@ def test_parallel_post_fit_partitioned_frame(data):
     proba = wrapped.predict_proba(pf)
     # f64 frame partitions vs the f32 fit matrix: tolerance is absolute
     np.testing.assert_allclose(proba, sk.predict_proba(Xh), atol=1e-6)
+
+
+def test_incremental_shuffle_blocks_deterministic(data):
+    """shuffle_blocks=True with a fixed random_state reproduces the same
+    block order, hence identical fitted coefficients."""
+    X, y = data
+    a = Incremental(SGDClassifier(max_iter=2, random_state=0, tol=None),
+                    shuffle_blocks=True, random_state=42).fit(
+        X, y, classes=[0, 1])
+    b = Incremental(SGDClassifier(max_iter=2, random_state=0, tol=None),
+                    shuffle_blocks=True, random_state=42).fit(
+        X, y, classes=[0, 1])
+    np.testing.assert_array_equal(a.estimator_.coef_, b.estimator_.coef_)
