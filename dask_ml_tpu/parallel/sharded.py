@@ -183,6 +183,39 @@ class ShardedArray:
     def astype(self, dtype) -> "ShardedArray":
         return ShardedArray(self.data.astype(dtype), self.n_rows, self.mesh)
 
+    # -- pickling ---------------------------------------------------------
+    def __getstate__(self):
+        """Pickle as the logical HOST array (devices and meshes don't
+        pickle); unpickling re-shards onto the ambient mesh — a model
+        saved on an 8-chip slice loads on a 1-chip box and vice versa.
+        Fitted estimators holding ShardedArray attributes (KMeans.labels_
+        et al) become persistable exactly like the reference's estimators
+        holding dask arrays."""
+        if not self.data.is_fully_addressable:
+            # to_numpy on a multi-host array launches a COLLECTIVE; a
+            # rank-0-only pickle (the normal save pattern) would deadlock
+            # waiting for peers mid-pickle. Make the caller gather first,
+            # where every process can participate.
+            raise ValueError(
+                "cannot pickle a cross-process ShardedArray directly: "
+                "call to_numpy() on ALL processes first and pickle the "
+                "host array"
+            )
+        from .mesh import MODEL_AXIS
+
+        spec = getattr(self.data.sharding, "spec", ())
+        model_sharded = len(spec) > 1 and spec[1] == MODEL_AXIS
+        return {"host": self.to_numpy(), "n_rows": self.n_rows,
+                "model_sharded": model_sharded}
+
+    def __setstate__(self, state):
+        restored = ShardedArray.from_array(
+            state["host"], shard_features=state.get("model_sharded", False)
+        )
+        self.data = restored.data
+        self.n_rows = int(state["n_rows"])
+        self.mesh = restored.mesh
+
 
 
 

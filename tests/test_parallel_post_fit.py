@@ -139,3 +139,9 @@ def test_incremental_shuffle_blocks_deterministic(data):
                     shuffle_blocks=True, random_state=42).fit(
         X, y, classes=[0, 1])
     np.testing.assert_array_equal(a.estimator_.coef_, b.estimator_.coef_)
+    # contrast: a different shuffle seed yields a different block order,
+    # hence different coefficients — proving the shuffle actually runs
+    c = Incremental(SGDClassifier(max_iter=2, random_state=0, tol=None),
+                    shuffle_blocks=True, random_state=7).fit(
+        X, y, classes=[0, 1])
+    assert not np.allclose(a.estimator_.coef_, c.estimator_.coef_)
