@@ -12,11 +12,17 @@ from .regression import (
 )
 
 
-def _make_scorer(metric, greater_is_better=True, needs_proba=False):
-    sign = 1.0 if greater_is_better else -1.0
+class _MetricScorer:
+    """Picklable scorer (fitted searches store ``scorer_``; a closure
+    would make every fitted search unpicklable)."""
 
-    def scorer(estimator, X, y):
-        if needs_proba:
+    def __init__(self, metric, sign, needs_proba):
+        self.metric = metric
+        self.sign = sign
+        self.needs_proba = needs_proba
+
+    def __call__(self, estimator, X, y):
+        if self.needs_proba:
             pred = estimator.predict_proba(X)
             # proba columns align to estimator.classes_ — forward them so
             # a CV fold missing a class still scores (sklearn's scorer
@@ -25,12 +31,17 @@ def _make_scorer(metric, greater_is_better=True, needs_proba=False):
             if classes is not None:
                 import numpy as _np
 
-                return sign * metric(y, pred, labels=_np.asarray(classes))
+                return self.sign * self.metric(
+                    y, pred, labels=_np.asarray(classes)
+                )
         else:
             pred = estimator.predict(X)
-        return sign * metric(y, pred)
+        return self.sign * self.metric(y, pred)
 
-    return scorer
+
+def _make_scorer(metric, greater_is_better=True, needs_proba=False):
+    return _MetricScorer(metric, 1.0 if greater_is_better else -1.0,
+                         needs_proba)
 
 
 SCORERS = {
@@ -67,27 +78,34 @@ def _to_host_cached(a):
     return h
 
 
-def _host_adapting(scorer):
+class _HostAdaptingScorer:
     """Wrap an EXTERNAL scorer callable (sklearn make_scorer object, user
     function). The raw call runs first — sharded-aware scorers (built on
     this package's metrics) keep their device-resident path untouched.
     Only if the scorer rejects the inputs (sklearn's validation raises on
-    ShardedArray) is it retried with host-converted folds."""
+    ShardedArray) is it retried with host-converted folds. A class (not a
+    closure) so fitted searches holding it stay picklable when the
+    wrapped scorer itself pickles (sklearn scorer objects do)."""
 
-    def wrapped(estimator, X, y=None, **kwargs):
+    def __init__(self, scorer):
+        self.scorer = scorer
+
+    def __call__(self, estimator, X, y=None, **kwargs):
         from ..parallel.sharded import ShardedArray
 
         sharded = isinstance(X, ShardedArray) or isinstance(y, ShardedArray)
         try:
-            return scorer(estimator, X, y, **kwargs)
+            return self.scorer(estimator, X, y, **kwargs)
         except (ValueError, TypeError, AttributeError):
             if not sharded:
                 raise
         Xh = _to_host_cached(X) if isinstance(X, ShardedArray) else X
         yh = _to_host_cached(y) if isinstance(y, ShardedArray) else y
-        return scorer(estimator, Xh, yh, **kwargs)
+        return self.scorer(estimator, Xh, yh, **kwargs)
 
-    return wrapped
+
+def _host_adapting(scorer):
+    return _HostAdaptingScorer(scorer)
 
 
 def get_scorer(scoring, compute=True):
@@ -102,11 +120,17 @@ def get_scorer(scoring, compute=True):
         )
 
 
+def _default_scorer(estimator, X, y):
+    """Module-level (hence PICKLABLE — fitted searches store scorer_)
+    delegation to the estimator's own score method."""
+    return estimator.score(X, y)
+
+
 def check_scoring(estimator, scoring=None, **kwargs):
     if scoring is None:
         if not hasattr(estimator, "score"):
             raise TypeError(
                 f"estimator {estimator!r} has no score method; pass scoring="
             )
-        return lambda est, X, y: est.score(X, y)
+        return _default_scorer
     return get_scorer(scoring)

@@ -77,3 +77,27 @@ def test_pickle_preserves_model_axis_sharding():
         spec = back.data.sharding.spec
         assert len(spec) > 1 and spec[1] == MODEL_AXIS
         np.testing.assert_array_equal(back.to_numpy(), xs.to_numpy())
+
+
+def test_fitted_search_pickles():
+    from dask_ml_tpu.linear_model import LogisticRegression
+    from dask_ml_tpu.model_selection import GridSearchCV
+
+    s = GridSearchCV(LogisticRegression(solver="lbfgs", max_iter=20),
+                     {"C": [0.5, 2.0]}, cv=2).fit(X, y)
+    back = pickle.loads(pickle.dumps(s))
+    np.testing.assert_allclose(
+        back.cv_results_["mean_test_score"],
+        s.cv_results_["mean_test_score"],
+    )
+    np.testing.assert_array_equal(back.predict(X), s.predict(X))
+
+
+def test_fitted_search_with_named_scorer_pickles():
+    from dask_ml_tpu.linear_model import LogisticRegression
+    from dask_ml_tpu.model_selection import GridSearchCV
+
+    s = GridSearchCV(LogisticRegression(solver="lbfgs", max_iter=20),
+                     {"C": [0.5, 2.0]}, cv=2, scoring="accuracy").fit(X, y)
+    back = pickle.loads(pickle.dumps(s))
+    assert back.score(X, y) == pytest.approx(s.score(X, y), abs=1e-6)
