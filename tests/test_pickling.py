@@ -21,8 +21,16 @@ def _cases():
     from dask_ml_tpu.models.sgd import SGDClassifier
     from dask_ml_tpu.preprocessing import StandardScaler
 
+    from sklearn.linear_model import SGDClassifier as SkSGD
+
+    from dask_ml_tpu.wrappers import Incremental, ParallelPostFit
+
     return [
         (LogisticRegression(solver="lbfgs", max_iter=30), y, "predict"),
+        (Incremental(SGDClassifier(max_iter=2, random_state=0),
+                     random_state=0), y, "predict"),
+        (ParallelPostFit(SkSGD(random_state=0, max_iter=5, tol=None)), y,
+         "predict"),
         (LogisticRegression(solver="lbfgs", max_iter=30), y3, "predict"),
         (SGDClassifier(max_iter=3, random_state=0), y, "predict"),
         (KMeans(n_clusters=3, max_iter=10, random_state=0), None,
