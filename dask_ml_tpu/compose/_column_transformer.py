@@ -24,6 +24,24 @@ def _is_partitioned(X):
     return isinstance(X, PartitionedFrame)
 
 
+def _concat_positional(frames, index):
+    """hstack frames BY POSITION onto ``index``. pd.concat(axis=1) aligns
+    on index, so a user transformer returning a reset-index frame would
+    silently produce NaN-padded misaligned output; rows here correspond
+    positionally by construction (every branch transformed the same X)."""
+    out = []
+    for f in frames:
+        if len(f) != len(index):
+            raise ValueError(
+                f"transformer output has {len(f)} rows, expected "
+                f"{len(index)}"
+            )
+        if not f.index.equals(index):
+            f = f.set_axis(index, axis=0)
+        out.append(f)
+    return pd.concat(out, axis=1)
+
+
 def _select(X, cols):
     if isinstance(X, pd.DataFrame):
         return X[cols] if isinstance(cols, list) else X[[cols]]
@@ -154,7 +172,7 @@ class ColumnTransformer(TransformerMixin, BaseEstimator):
                 o if isinstance(o, pd.DataFrame) else o.compute()
                 for o in outs
             ]
-            return pd.concat(frames, axis=1)
+            return _concat_positional(frames, X.index)
         from ..parallel.frames import PartitionedFrame
 
         bounds = [len(p) for p in X.partitions]
@@ -170,8 +188,10 @@ class ColumnTransformer(TransformerMixin, BaseEstimator):
                 if [len(p) for p in o.partitions] != bounds:
                     return None
                 parts_per.append(list(o.partitions))
+        x_parts = list(X.partitions)
         return PartitionedFrame(
-            [pd.concat(ps, axis=1) for ps in zip(*parts_per)]
+            [_concat_positional(list(ps), x_parts[i].index)
+             for i, ps in enumerate(zip(*parts_per))]
         )
 
     @property

@@ -62,7 +62,9 @@ def log_loss(y_true, y_prob, eps=1e-15, sample_weight=None, labels=None):
                 f"{p.shape[1]} columns; pass labels= with every class"
             )
         p = p / jnp.sum(p, axis=1, keepdims=True)
-        classes_d = jnp.asarray(classes, t.dtype)
+        # cast on HOST: jnp.asarray(host_float64, ...) would request x64
+        # and warn on every call in a scoring loop
+        classes_d = jnp.asarray(classes.astype(np.dtype(str(t.dtype))))
         idx = jnp.clip(jnp.searchsorted(classes_d, t), 0, p.shape[1] - 1)
         # membership check: a y value absent from the classes (or falling
         # between them) must raise, not silently score a neighbor class

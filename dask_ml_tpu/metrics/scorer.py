@@ -57,25 +57,43 @@ SCORERS = {
 
 
 import collections as _collections
+import threading as _threading
 
 # host copies of recently-scored folds, keyed by id. The ShardedArray is
 # pinned in the value so a GC'd-and-reused id can never alias a stale
-# copy; bounded FIFO so memory stays ≈ a handful of test folds. Without
-# this, a search with N candidates gathers the SAME cached fold N times.
+# copy; bounded by BYTES (folds vary wildly in size — a count bound
+# could pin GBs) and evicted LRU. Searches score folds from worker
+# threads concurrently, hence the lock. Without the cache, a search
+# with N candidates gathers the SAME fold N times.
 _HOST_FOLD_CACHE: "_collections.OrderedDict" = _collections.OrderedDict()
-_HOST_FOLD_CACHE_MAX = 16
+_HOST_FOLD_CACHE_MAX_BYTES = 256 * 1024 * 1024
+_HOST_FOLD_CACHE_LOCK = _threading.Lock()
 
 
 def _to_host_cached(a):
     key = id(a)
-    hit = _HOST_FOLD_CACHE.get(key)
-    if hit is not None and hit[0] is a:
-        return hit[1]
+    with _HOST_FOLD_CACHE_LOCK:
+        hit = _HOST_FOLD_CACHE.get(key)
+        if hit is not None and hit[0] is a:
+            _HOST_FOLD_CACHE.move_to_end(key)
+            return hit[1]
     h = a.to_numpy()
-    _HOST_FOLD_CACHE[key] = (a, h)
-    while len(_HOST_FOLD_CACHE) > _HOST_FOLD_CACHE_MAX:
-        _HOST_FOLD_CACHE.popitem(last=False)
+    with _HOST_FOLD_CACHE_LOCK:
+        _HOST_FOLD_CACHE[key] = (a, h)
+        total = sum(v[1].nbytes for v in _HOST_FOLD_CACHE.values())
+        while total > _HOST_FOLD_CACHE_MAX_BYTES and len(_HOST_FOLD_CACHE) > 1:
+            _, (_, ev) = _HOST_FOLD_CACHE.popitem(last=False)
+            total -= ev.nbytes
     return h
+
+
+def clear_host_fold_cache():
+    """Drop all pinned fold copies (device buffers + host arrays).
+
+    Searches call this when a fit completes so fold memory doesn't
+    outlive the search."""
+    with _HOST_FOLD_CACHE_LOCK:
+        _HOST_FOLD_CACHE.clear()
 
 
 class _HostAdaptingScorer:

@@ -232,6 +232,15 @@ class _BaseSearchCV(BaseEstimator):
         )
 
     def fit(self, X, y=None, **fit_params):
+        from ..metrics.scorer import clear_host_fold_cache
+
+        try:
+            return self._fit(X, y, **fit_params)
+        finally:
+            # fold copies must not outlive the search, even a failed one
+            clear_host_fold_cache()
+
+    def _fit(self, X, y=None, **fit_params):
         candidates = list(self._candidates())
         if not candidates:
             raise ValueError("no parameter candidates")

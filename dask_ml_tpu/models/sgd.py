@@ -425,26 +425,55 @@ class SGDClassifier(ClassifierMixin, _SGDBase):
             # multiclass: labels map to class CODES 0..C-1 (searchsorted
             # over the sorted classes_, in the labels' NATIVE dtype —
             # handles string labels and >2**24 integer ids exactly);
-            # the codes ride to the kernel as float32 (C-1 is tiny)
+            # the codes ride to the kernel as float32 (C-1 is tiny).
+            # sklearn partial_fit contract: a label absent from classes_
+            # (e.g. first appearing in a later block) must raise, not
+            # silently train as a neighboring code — one host sync per
+            # block buys that check.
             if isinstance(y, ShardedArray):
                 classes_d = jnp.asarray(
                     np.asarray(self.classes_, np.dtype(str(y.dtype)))
                 )
+                idx = jnp.searchsorted(classes_d, y.data)
+                idx_c = jnp.clip(idx, 0, len(self.classes_) - 1)
+                ok = jnp.take(classes_d, idx_c) == y.data
+                bad = jnp.any(y.row_mask(jnp.bool_) & ~ok)
+                if bool(bad):
+                    raise ValueError(
+                        "y contains classes not passed via `classes` on "
+                        "the first partial_fit call"
+                    )
                 return ShardedArray(
-                    jnp.searchsorted(classes_d, y.data)
-                    .astype(jnp.float32),
-                    y.n_rows, y.mesh,
+                    idx_c.astype(jnp.float32), y.n_rows, y.mesh,
                 )
-            return np.searchsorted(
-                self.classes_, np.asarray(y)
-            ).astype(np.float32)
-        pos = self.classes_[1]
+            yh = np.asarray(y)
+            idx = np.clip(np.searchsorted(self.classes_, yh),
+                          0, len(self.classes_) - 1)
+            if not np.array_equal(np.take(self.classes_, idx), yh):
+                raise ValueError(
+                    "y contains classes not passed via `classes` on the "
+                    "first partial_fit call"
+                )
+            return idx.astype(np.float32)
+        neg, pos = self.classes_[0], self.classes_[1]
         if isinstance(y, ShardedArray):
+            is_pos = y.data == jnp.asarray(pos)
+            known = is_pos | (y.data == jnp.asarray(neg))
+            if bool(jnp.any(y.row_mask(jnp.bool_) & ~known)):
+                raise ValueError(
+                    "y contains classes not passed via `classes` on the "
+                    "first partial_fit call"
+                )
             return ShardedArray(
-                (y.data == jnp.asarray(pos)).astype(jnp.float32),
-                y.n_rows, y.mesh,
+                is_pos.astype(jnp.float32), y.n_rows, y.mesh,
             )
-        return (np.asarray(y) == pos).astype(np.float32)
+        yh = np.asarray(y)
+        if not np.isin(yh, self.classes_).all():
+            raise ValueError(
+                "y contains classes not passed via `classes` on the "
+                "first partial_fit call"
+            )
+        return (yh == pos).astype(np.float32)
 
     def _publish(self, d):
         w = to_host(self._w).astype(np.float64)

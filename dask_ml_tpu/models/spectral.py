@@ -74,6 +74,25 @@ class SpectralClustering(ClusterMixin, BaseEstimator):
         c = min(self.n_components, n)
         if self.assign_labels != "kmeans":
             raise ValueError("only assign_labels='kmeans' is supported")
+        # honest parameter surface: params the TSQR/Nyström formulation
+        # cannot honor RAISE instead of silently no-oping
+        if self.eigen_solver not in (None, "tsqr"):
+            raise ValueError(
+                f"eigen_solver={self.eigen_solver!r} is not supported: the "
+                "embedding is computed by an exact distributed TSQR SVD "
+                "(pass None or 'tsqr')"
+            )
+        if self.eigen_tol not in (0.0, 0, "auto"):
+            raise ValueError(
+                "eigen_tol is not supported: the TSQR SVD is exact, not "
+                "iterative (pass 0.0 or 'auto')"
+            )
+        if self.affinity == "nearest_neighbors":
+            raise ValueError(
+                "affinity='nearest_neighbors' (and hence n_neighbors) is "
+                "not supported; use 'rbf', 'polynomial', 'sigmoid', "
+                "'linear', or a callable"
+            )
         mask = X.row_mask(X.dtype)
         key = jax.random.PRNGKey(
             0 if self.random_state is None else int(self.random_state)
@@ -105,12 +124,35 @@ class SpectralClustering(ClusterMixin, BaseEstimator):
         embedding = ShardedArray(emb, X.n_rows, X.mesh)
 
         km_params = dict(self.kmeans_params or {})
-        km_params.setdefault("random_state", self.random_state)
-        km = KMeans(n_clusters=self.n_clusters, **km_params)
-        km.fit(embedding)
+        base_seed = (0 if self.random_state is None
+                     else int(self.random_state))
+        km_params.setdefault("random_state", base_seed)
+        # n_init restarts of the assignment KMeans (sklearn semantics:
+        # keep the run with the lowest inertia) — the embedding is (n, k)
+        # so restarts are cheap relative to building G. Restart seeds
+        # derive from the RESOLVED r=0 seed (which may come from
+        # kmeans_params) so no restart duplicates it.
+        seed0 = km_params["random_state"]
+        seed0 = 0 if seed0 is None else int(seed0)
+        n_init = max(int(self.n_init), 1)
+        best = None
+        for r in range(n_init):
+            params_r = dict(km_params)
+            if r > 0:
+                params_r["random_state"] = seed0 + r
+            km = KMeans(n_clusters=self.n_clusters, **params_r)
+            km.fit(embedding)
+            if best is None or km.inertia_ < best.inertia_:
+                best = km
+        km = best
         self.assign_labels_ = km
         self.labels_ = km.labels_
         self.eigenvalues_ = to_host(s[: self.n_clusters]).astype(np.float64)
+        if self.persist_embedding:
+            # reference persists the embedding in cluster memory; the
+            # analog here is keeping the device-resident ShardedArray on
+            # the fitted estimator instead of letting it free
+            self.embedding_ = embedding
         self.n_features_in_ = d
         return self
 

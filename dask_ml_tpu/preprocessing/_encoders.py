@@ -48,8 +48,6 @@ class OneHotEncoder(TransformerMixin, BaseEstimator):
                 "sparse_output=True is not supported on TPU; dense one-hot "
                 "only (reference requires scipy.sparse here)"
             )
-        if self.drop is not None:
-            raise NotImplementedError("drop is not yet supported")
         if isinstance(X, pd.DataFrame):
             self._frame = True
             self.categories_ = [
@@ -69,7 +67,55 @@ class OneHotEncoder(TransformerMixin, BaseEstimator):
             else:
                 self.categories_ = [np.asarray(c) for c in self.categories]
         self.n_features_in_ = len(self.categories_)
+        self.drop_idx_ = self._compute_drop_idx()
         return self
+
+    def _compute_drop_idx(self):
+        """sklearn's ``drop`` contract: None, 'first', 'if_binary', or an
+        array of one category per feature (entries may be None)."""
+        if self.drop is None:
+            return None
+        if isinstance(self.drop, str) and self.drop == "first":
+            return np.zeros(len(self.categories_), dtype=object)
+        if isinstance(self.drop, str) and self.drop == "if_binary":
+            return np.asarray(
+                [0 if len(c) == 2 else None for c in self.categories_],
+                dtype=object,
+            )
+        drop = np.asarray(self.drop, dtype=object)
+        if drop.shape != (len(self.categories_),):
+            raise ValueError(
+                f"drop should be of shape ({len(self.categories_)},), "
+                f"got {drop.shape}"
+            )
+        idx = []
+        for j, (d, cats) in enumerate(zip(drop, self.categories_)):
+            if d is None:
+                idx.append(None)
+                continue
+            where = np.flatnonzero(cats == d)
+            if len(where) == 0:
+                raise ValueError(
+                    f"drop[{j}]={d!r} is not a category of feature {j}: "
+                    f"{list(cats)}"
+                )
+            idx.append(int(where[0]))
+        return np.asarray(idx, dtype=object)
+
+    def _keep_indices(self):
+        """Global output-column indices kept after ``drop``, or None when
+        nothing is dropped (fast path: a gather is skipped entirely)."""
+        if getattr(self, "drop_idx_", None) is None:
+            return None
+        keep, start = [], 0
+        for j, cats in enumerate(self.categories_):
+            di = self.drop_idx_[j]
+            keep.extend(
+                start + k for k in range(len(cats))
+                if di is None or k != di
+            )
+            start += len(cats)
+        return np.asarray(keep, dtype=np.int32)
 
     def transform(self, X):
         check_is_fitted(self, "categories_")
@@ -84,6 +130,7 @@ class OneHotEncoder(TransformerMixin, BaseEstimator):
             cols = [X[:, j] for j in range(X.shape[1])]
             mesh = None
 
+        keep = self._keep_indices()
         if cols is not None:  # host path
             outs = []
             for col, cats in zip(cols, self.categories_):
@@ -94,9 +141,12 @@ class OneHotEncoder(TransformerMixin, BaseEstimator):
                     )
                 onehot = (col[:, None] == cats[None, :]).astype(self.dtype)
                 outs.append(onehot)
-            return np.concatenate(outs, axis=1)
+            full = np.concatenate(outs, axis=1)
+            return full if keep is None else full[:, keep]
 
-        # device path: fused comparisons per column
+        # device path: fused comparisons per column — unknown checks run
+        # over the FULL one-hot (a dropped category's all-zero row is
+        # legitimate), the ``drop`` gather comes after
         data = X.data
         mask = X.row_mask(data.dtype)
         outs = []
@@ -114,6 +164,8 @@ class OneHotEncoder(TransformerMixin, BaseEstimator):
                 if (seg.sum(axis=1) == 0).any():
                     raise ValueError("found unknown categories in input")
                 start += len(cats)
+        if keep is not None:
+            out = out[:, jnp.asarray(keep)]
         return ShardedArray(out, X.n_rows, X.mesh)
 
     def get_feature_names_out(self, input_features=None):
@@ -123,10 +175,15 @@ class OneHotEncoder(TransformerMixin, BaseEstimator):
                 self, "feature_names_in_",
                 [f"x{i}" for i in range(self.n_features_in_)],
             )
-        return np.asarray([
-            f"{f}_{c}" for f, cats in zip(input_features, self.categories_)
-            for c in cats
-        ], dtype=object)
+        names = []
+        for j, (f, cats) in enumerate(zip(input_features, self.categories_)):
+            di = (None if getattr(self, "drop_idx_", None) is None
+                  else self.drop_idx_[j])
+            names.extend(
+                f"{f}_{c}" for k, c in enumerate(cats)
+                if di is None or k != di
+            )
+        return np.asarray(names, dtype=object)
 
     def inverse_transform(self, X):
         """Map one-hot columns back to the original categories (sklearn's
@@ -135,22 +192,44 @@ class OneHotEncoder(TransformerMixin, BaseEstimator):
         handle_unknown='ignore') map to None, as in sklearn."""
         check_is_fitted(self, "categories_")
         Xh = X.to_numpy() if isinstance(X, ShardedArray) else np.asarray(X)
-        n_out = sum(len(c) for c in self.categories_)
+        drop_idx = getattr(self, "drop_idx_", None)
+        seg_cats = []  # per feature: (kept categories, dropped cat or None)
+        for j, cats in enumerate(self.categories_):
+            di = None if drop_idx is None else drop_idx[j]
+            if di is None:
+                seg_cats.append((np.asarray(cats), None))
+            else:
+                kept = np.asarray(
+                    [c for k, c in enumerate(cats) if k != di], dtype=cats.dtype
+                )
+                seg_cats.append((kept, cats[di]))
+        n_out = sum(len(kept) for kept, _ in seg_cats)
         if Xh.shape[1] != n_out:
             raise ValueError(
                 f"Expected {n_out} one-hot columns, got {Xh.shape[1]}"
             )
         cols, start, any_unknown = [], 0, False
-        for cats in self.categories_:
-            seg = Xh[:, start:start + len(cats)]
-            vals = np.asarray(cats)[np.argmax(seg, axis=1)]
-            unknown = seg.max(axis=1) == 0
-            if unknown.any():
-                any_unknown = True
-                vals = vals.astype(object)
-                vals[unknown] = None
+        for kept, dropped in seg_cats:
+            if len(kept) == 0:
+                # a single-category feature fully dropped: every row is
+                # the dropped constant (sklearn reconstructs it too)
+                cols.append(np.full(Xh.shape[0], dropped))
+                continue
+            seg = Xh[:, start:start + len(kept)]
+            vals = kept[np.argmax(seg, axis=1)]
+            zero = seg.max(axis=1) == 0
+            if zero.any():
+                if dropped is not None:
+                    # all-zero with a dropped category means THAT category
+                    # (sklearn's inverse under drop=), not unknown
+                    vals = vals.copy()
+                    vals[zero] = dropped
+                else:
+                    any_unknown = True
+                    vals = vals.astype(object)
+                    vals[zero] = None
             cols.append(vals)
-            start += len(cats)
+            start += len(kept)
         dtypes = {c.dtype for c in cols}
         if any_unknown or len(dtypes) > 1:
             # object output preserves each column's native type (a plain

@@ -57,7 +57,14 @@ def check_X_y(X, y, mesh=None, dtype=None):
         raise ValueError(f"X and y have inconsistent lengths: {n_X} vs {n_y}")
     X = check_array(X, mesh=mesh, dtype=dtype)
     if not isinstance(y, ShardedArray):
-        _assert_all_finite(np.asarray(y), "y")
+        yh = np.asarray(y)
+        if dtype is not None and np.issubdtype(np.dtype(dtype), np.floating):
+            # same post-cast rule as X: a finite float64 can overflow to
+            # inf in float32 and must be caught HERE, not by the solver
+            # sanitizer mid-fit
+            yh = yh.astype(dtype, copy=False)
+        _assert_all_finite(yh, "y")
+        y = yh
     y = as_sharded(y, mesh=mesh, dtype=dtype)
     return X, y
 

@@ -195,3 +195,45 @@ def test_onehot_inverse_transform_unknown_and_mixed():
     back2 = enc2.inverse_transform(enc2.transform(df))
     assert back2.dtype == object
     assert back2[0, 0] == "x" and back2[0, 1] == 1.0
+
+
+@pytest.mark.parametrize("drop", ["first", "if_binary"])
+def test_one_hot_encoder_drop(drop):
+    X = np.array([[0.0, 1.0], [1.0, 2.0], [0.0, 3.0], [1.0, 1.0]])
+    ohe = pre.OneHotEncoder(drop=drop).fit(X)
+    ref = skpre.OneHotEncoder(sparse_output=False, drop=drop).fit(X)
+    np.testing.assert_allclose(ohe.transform(X), ref.transform(X))
+    assert list(ohe.get_feature_names_out()) == list(
+        ref.get_feature_names_out()
+    )
+    # inverse round-trips, including the all-zero (dropped) rows
+    np.testing.assert_allclose(
+        np.asarray(ohe.inverse_transform(ohe.transform(X)), dtype=float), X
+    )
+
+
+def test_one_hot_encoder_drop_array_and_validation():
+    X = np.array([[0.0, 1.0], [1.0, 2.0], [0.0, 3.0]])
+    ohe = pre.OneHotEncoder(drop=[1.0, 3.0]).fit(X)
+    ref = skpre.OneHotEncoder(sparse_output=False,
+                              drop=np.array([1.0, 3.0])).fit(X)
+    np.testing.assert_allclose(ohe.transform(X), ref.transform(X))
+    with pytest.raises(ValueError, match="not a category"):
+        pre.OneHotEncoder(drop=[9.0, 1.0]).fit(X)
+    with pytest.raises(ValueError, match="shape"):
+        pre.OneHotEncoder(drop=[1.0]).fit(X)
+
+
+def test_one_hot_encoder_drop_sharded_device_path():
+    X = np.array([[0.0, 5.0], [1.0, 6.0], [2.0, 5.0], [1.0, 6.0],
+                  [0.0, 5.0]])
+    sx = ShardedArray.from_array(X)
+    ohe = pre.OneHotEncoder(drop="first").fit(sx)
+    out = ohe.transform(sx)
+    assert isinstance(out, ShardedArray)
+    ref = skpre.OneHotEncoder(sparse_output=False, drop="first")
+    np.testing.assert_allclose(out.to_numpy(), ref.fit_transform(X))
+    # unknown detection still works with drop (checked pre-drop)
+    bad = ShardedArray.from_array(np.array([[7.0, 5.0]]))
+    with pytest.raises(ValueError, match="unknown"):
+        ohe.transform(bad)
