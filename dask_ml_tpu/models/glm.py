@@ -130,6 +130,19 @@ class _GLMBase(BaseEstimator):
     def _encode_y_host(self, y):
         return np.asarray(y, np.float32), None
 
+    # hooks a family must provide when its _encode_y_host returns >2
+    # classes (today: logistic only) — base fits must fail with a clear
+    # contract, not an AttributeError deep in _fit_streamed
+    def _warm_B0(self, C, d):
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support multiclass targets"
+        )
+
+    def _finish_fit_multi(self, beta, classes, info, n_features):
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support multiclass targets"
+        )
+
     def _penalty_setup(self, d, n_rows):
         """(pmask, lam): intercept unpenalized, sklearn's 1/(C*n) scaling
         — the ONE place the regularization bookkeeping lives (shared by
@@ -185,10 +198,27 @@ class _GLMBase(BaseEstimator):
         n, d_feat = X.shape[0], X.shape[1]
         d = d_feat + (1 if self.fit_intercept else 0)
         pmask, lam = self._penalty_setup(d, n)
-        beta0 = self._warm_beta0(d, np)
         stream = BlockStream((X, y_host), block_rows=block_rows)
         kwargs = dict(self.solver_kwargs or {})
         l1_ratio = kwargs.pop("l1_ratio", 0.5)
+        if classes is not None and len(classes) > 2:
+            # one-vs-rest out-of-core: y_host carries class CODES; every
+            # epoch streams X once for all C classes
+            from .solvers.streamed import solve_streamed_multi
+
+            C = len(classes)
+            B0 = self._warm_B0(C, d)
+            with fit_logger(type(self).__name__, solver=self.solver,
+                            streamed=True, n_rows=n,
+                            n_classes=C) as logger:
+                Beta, info = solve_streamed_multi(
+                    self.solver, stream, n, B0, self.family, self.penalty,
+                    lam, pmask, l1_ratio=l1_ratio,
+                    intercept=self.fit_intercept, max_iter=self.max_iter,
+                    tol=self.tol, logger=logger, **kwargs,
+                )
+            return self._finish_fit_multi(Beta, classes, info, d_feat)
+        beta0 = self._warm_beta0(d, np)
         with fit_logger(type(self).__name__, solver=self.solver,
                         streamed=True, n_rows=n) as logger:
             beta, info = solve_streamed(
@@ -345,11 +375,7 @@ class LogisticRegression(_GLMBase):
     family = "logistic"
 
     def _fit_multiclass(self, X, y, data, mask):
-        if self.multi_class not in ("auto", "ovr"):
-            raise ValueError(
-                f"multi_class={self.multi_class!r} is not supported; "
-                "use 'ovr' (or 'auto')"
-            )
+        self._check_multi_class()
         classes = np.unique(y.to_numpy())
         if len(classes) < 2:
             raise ValueError(
@@ -364,15 +390,7 @@ class LogisticRegression(_GLMBase):
         d = data.shape[1]
         pmask, lam = self._penalty_setup(d, X.n_rows)
         C = len(classes)
-        B0 = (
-            jnp.asarray(np.c_[self.coef_, np.ravel(self.intercept_)]
-                        if self.fit_intercept else self.coef_,
-                        dtype=jnp.float32)
-            if self.warm_start and getattr(self, "coef_", None) is not None
-            and np.shape(self.coef_)
-            == (C, d - (1 if self.fit_intercept else 0))
-            else jnp.zeros((C, d), jnp.float32)
-        )
+        B0 = jnp.asarray(self._warm_B0(C, d))
         kwargs = dict(self.solver_kwargs or {})
         l1_ratio = kwargs.pop("l1_ratio", 0.5)
         with fit_logger(type(self).__name__, solver=self.solver,
@@ -388,6 +406,29 @@ class LogisticRegression(_GLMBase):
                 logger.log(step=info.get("n_iter"), summary=True,
                            **{k: v for k, v in info.items()
                               if isinstance(v, (int, float))})
+        return self._finish_fit_multi(to_host(beta), classes, info,
+                                      X.shape[1])
+
+    def _check_multi_class(self):
+        if self.multi_class not in ("auto", "ovr"):
+            raise ValueError(
+                f"multi_class={self.multi_class!r} is not supported; "
+                "use 'ovr' (or 'auto')"
+            )
+
+    def _warm_B0(self, C, d):
+        """(C, d) start: prior stacked OvR coefficients when warm_start
+        and the shape matches THIS problem, else zeros."""
+        if (self.warm_start and getattr(self, "coef_", None) is not None
+                and np.shape(self.coef_)
+                == (C, d - (1 if self.fit_intercept else 0))):
+            return np.asarray(
+                np.c_[self.coef_, np.ravel(self.intercept_)]
+                if self.fit_intercept else self.coef_, np.float32,
+            )
+        return np.zeros((C, d), np.float32)
+
+    def _finish_fit_multi(self, beta, classes, info, n_features):
         beta = np.asarray(beta, np.float64)
         if self.fit_intercept:
             self.intercept_ = beta[:, -1]
@@ -398,7 +439,7 @@ class LogisticRegression(_GLMBase):
         self.classes_ = classes
         self.n_iter_ = info.get("n_iter")
         self.solver_info_ = info
-        self.n_features_in_ = X.shape[1]
+        self.n_features_in_ = n_features
         return self
 
     def _is_multiclass(self):
@@ -408,17 +449,18 @@ class LogisticRegression(_GLMBase):
     def _encode_y_host(self, y):
         y = np.asarray(y)
         classes = np.unique(y)
-        if len(classes) > 2:
-            raise ValueError(
-                f"multiclass ({len(classes)} classes) is not supported on "
-                "the streamed (out-of-core) fit path; fit in-core for "
-                "one-vs-rest, or reduce to binary targets"
-            )
-        if len(classes) != 2:
+        if len(classes) < 2:
             raise ValueError(
                 f"LogisticRegression needs at least 2 classes; got "
                 f"{len(classes)}"
             )
+        if len(classes) > 2:
+            self._check_multi_class()
+            # class CODES 0..C-1 (float32, 1/d the bytes of X) — the
+            # streamed block kernels rebuild one-hot targets on device
+            self.classes_ = classes
+            codes = np.searchsorted(classes, y).astype(np.float32)
+            return codes, classes
         self.classes_ = classes
         return (y == classes[1]).astype(np.float32), classes
 

@@ -92,9 +92,10 @@ def test_multinomial_multi_class_rejected(data3):
         LogisticRegression(multi_class="multinomial", max_iter=10).fit(X, y)
 
 
-def test_ovr_streamed_predict_and_fit_message(tmp_path, data3):
-    """Multiclass predict streams block-wise over memmaps like the
-    binary path; the streamed FIT limitation raises its own message."""
+def test_ovr_streamed_predict_and_fit(tmp_path, data3):
+    """Multiclass predict AND fit stream block-wise over memmaps like
+    the binary path (VERDICT r3 missing #2): the streamed OvR fit
+    matches the in-core vmapped OvR solve."""
     from dask_ml_tpu import config
 
     X, y = data3
@@ -107,9 +108,38 @@ def test_ovr_streamed_predict_and_fit_message(tmp_path, data3):
         pred = clf.predict(Xm)
     assert eta.shape == (len(X), 3)
     np.testing.assert_array_equal(pred, clf.predict(X))
-    with pytest.raises(ValueError, match="out-of-core"):
-        with config.set(stream_block_rows=128):
-            LogisticRegression(max_iter=5).fit(Xm, y)
+    with config.set(stream_block_rows=128):
+        st = LogisticRegression(solver="lbfgs", max_iter=80,
+                                tol=1e-7).fit(Xm, y)
+    assert st.solver_info_["streamed"] is True
+    assert st.solver_info_["n_blocks"] > 1
+    assert st.solver_info_["n_classes"] == 3
+    ref = LogisticRegression(solver="lbfgs", max_iter=80, tol=1e-7).fit(X, y)
+    assert st.coef_.shape == ref.coef_.shape == (3, X.shape[1])
+    np.testing.assert_allclose(st.coef_, ref.coef_, rtol=5e-2, atol=5e-3)
+    np.testing.assert_allclose(st.intercept_, ref.intercept_, rtol=5e-2,
+                               atol=5e-3)
+    assert np.mean(st.predict(X) == ref.predict(X)) > 0.99
+
+
+@pytest.mark.parametrize("solver,penalty", [
+    ("newton", "l2"),
+    ("admm", "l1"),
+    ("proximal_grad", "elastic_net"),
+])
+def test_ovr_streamed_all_solvers(tmp_path, data3, solver, penalty):
+    """Every streamed solver family handles multiclass: one data pass
+    per epoch shared across the C one-vs-rest problems."""
+    from dask_ml_tpu import config
+
+    X, y = data3
+    kw = dict(solver=solver, penalty=penalty, C=1.0, max_iter=120, tol=1e-7)
+    ref = LogisticRegression(**kw).fit(X, y)
+    with config.set(stream_block_rows=128):
+        st = LogisticRegression(**kw).fit(X.copy(), y)
+    assert st.solver_info_["streamed"] is True
+    assert st.solver_info_["n_classes"] == 3
+    assert np.mean(st.predict(X) == ref.predict(X)) > 0.98
 
 
 def test_warm_start_binary_after_multiclass(data3):
