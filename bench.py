@@ -87,6 +87,61 @@ def _init_backend():
     return jax, jax.default_backend()
 
 
+# bf16 datasheet peaks per chip (TFLOP/s) by device_kind substring. The
+# MXU runs f32-input matmuls at bf16-pass rate under default precision,
+# so the bf16 peak is the honest denominator for BOTH dtypes (using it
+# for f32 yields a conservative MFU, never an inflated one).
+_DATASHEET_PEAKS = {
+    "v6": 918e12,       # Trillium / v6e
+    "v5p": 459e12,
+    "v5 lite": 197e12,  # v5e reports device_kind "TPU v5 lite"
+    "v5e": 197e12,
+    "v4": 275e12,
+    "v3": 123e12,
+    "v2": 45e12,
+}
+
+
+def _resolve_peak(jax, backend):
+    """Per-chip peak matmul FLOP/s: datasheet when the device_kind is
+    known, else MEASURED with a large square matmul (the only honest
+    option on CPU fallback — VERDICT r3 #2 wants MFU 'vs CPU peak on
+    fallback')."""
+    kind = getattr(jax.devices()[0], "device_kind", backend) or backend
+    if backend == "tpu":
+        for sub, peak in _DATASHEET_PEAKS.items():
+            if sub in kind.lower():
+                return {"flops": peak, "source": "datasheet",
+                        "device_kind": kind}
+    import jax.numpy as jnp
+
+    m = 4096 if backend == "tpu" else 1024
+    a = jnp.ones((m, m), jnp.bfloat16 if backend == "tpu" else jnp.float32)
+    f = jax.jit(lambda x: x @ x)
+    jax.block_until_ready(f(a))  # compile
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        a = jax.block_until_ready(f(a))
+    dt = time.perf_counter() - t0
+    return {"flops": 2.0 * m ** 3 * reps / dt, "source": "measured",
+            "device_kind": kind}
+
+
+def _mfu_fields(model_flops, elapsed, n_chips, peak):
+    """Achieved model FLOP/s and MFU vs per-chip peak (absolute perf
+    measures; model_flops counts the algorithm's useful matmul FLOPs)."""
+    fps = model_flops / elapsed
+    return {
+        "model_flops": round(model_flops),
+        "model_flop_per_s": round(fps, 1),
+        "mfu": round(fps / (peak["flops"] * n_chips), 5),
+        "peak": {"flop_per_s_per_chip": round(peak["flops"], 1),
+                 "source": peak["source"],
+                 "device_kind": peak["device_kind"]},
+    }
+
+
 def run():
     jax, backend = _init_backend()
     import jax.numpy as jnp
@@ -144,6 +199,11 @@ def run():
     with config.set(dtype=dtype, metrics_path=metrics_file):
         LogisticRegression(solver="lbfgs", max_iter=10, tol=0.0).fit(Xs, ys)
     value = n_rows * iters / elapsed / n_chips
+    peak = _resolve_peak(jax, backend)
+    # lbfgs data pass: eta = X@beta (2nd) + grad = X.T@resid (2nd) per
+    # counted iteration; line-search passes uncounted (consistent with
+    # the samples metric, so mfu undercounts like it does)
+    logreg_flops = 4.0 * n_rows * n_feat * iters
 
     # sklearn reference on a host subsample of the same data
     from sklearn.linear_model import LogisticRegression as SkLR
@@ -180,6 +240,7 @@ def run():
             "samples_per_sec": round(sk_value, 1),
         },
         "metrics_file": metrics_file,
+        **_mfu_fields(logreg_flops, elapsed, n_chips, peak),
     }
     # secondary BASELINE configs (VERDICT r2 #6) — each guarded so a
     # failure degrades to an error entry instead of killing the headline
@@ -196,9 +257,9 @@ def run():
     # free the headline design matrix BEFORE the kmeans/rsvd configs —
     # holding its HBM alongside their working sets OOMs a 16G chip
     del Xs, ys, X, y
-    _try(_bench_kmeans, jax, on_tpu, n_chips)
-    _try(_bench_rsvd, jax, on_tpu, n_chips)
-    _try(_bench_incremental_sgd, jax, on_tpu, n_chips)
+    _try(_bench_kmeans, jax, on_tpu, n_chips, peak)
+    _try(_bench_rsvd, jax, on_tpu, n_chips, peak)
+    _try(_bench_incremental_sgd, jax, on_tpu, n_chips, peak)
     _try(_bench_hyperband, jax, on_tpu, n_chips)
     result["extra_metrics"] = extras
     return result
@@ -232,7 +293,7 @@ def _bench_logreg_f32(jax, on_tpu, n_chips, Xs, ys):
     }
 
 
-def _bench_kmeans(jax, on_tpu, n_chips):
+def _bench_kmeans(jax, on_tpu, n_chips, peak):
     """BASELINE configs[1]: KMeans (k=64) Lloyd iterations/sec. d=128
     keeps the lane dimension at the TPU tile width (d=64 would pad 2x in
     HBM)."""
@@ -269,10 +330,13 @@ def _bench_kmeans(jax, on_tpu, n_chips):
         "n_features": d,
         "k": k,
         "samples_per_sec_per_chip": round(n * km.n_iter_ / elapsed / n_chips, 1),
+        # distance matmul only (2ndk per Lloyd iteration) — a lower bound
+        # that excludes the assignment reduce and center accumulation
+        **_mfu_fields(2.0 * n * d * k * km.n_iter_, elapsed, n_chips, peak),
     }
 
 
-def _bench_rsvd(jax, on_tpu, n_chips):
+def _bench_rsvd(jax, on_tpu, n_chips, peak):
     """BASELINE configs[2]: tall-skinny randomized SVD completes."""
     import time
 
@@ -291,17 +355,22 @@ def _bench_rsvd(jax, on_tpu, n_chips):
         return jax.random.normal(key, (n, d), jnp.float32)
 
     X = as_sharded(jax.block_until_ready(gen()))
+    q_iters = 4  # explicit so the flop model below matches what runs
     # cold run pays the (one-time, cached) XLA compile; the metric is the
     # warm completion — what a second call or a bigger same-shape matrix
     # experiences
-    TruncatedSVD(n_components=k, algorithm="randomized",
+    TruncatedSVD(n_components=k, algorithm="randomized", n_iter=q_iters,
                  random_state=0).fit(X)
     svd = TruncatedSVD(n_components=k, algorithm="randomized",
-                       random_state=0)
+                       n_iter=q_iters, random_state=0)
     t0 = time.perf_counter()
     svd.fit(X)
     elapsed = time.perf_counter() - t0
     assert np.isfinite(svd.singular_values_).all()
+    # Halko data passes: X@Omega + q power iters (X.T@Q, X@Qz each) +
+    # Q.T@X, all (n, d)x(d, l) with l = k + 10 oversamples = 2ndl(2q+2)
+    l = k + 10
+    rsvd_flops = 2.0 * n * d * l * (2 * q_iters + 2)
     return {
         "metric": "randomized_svd_seconds",
         "value": round(elapsed, 3),
@@ -311,10 +380,11 @@ def _bench_rsvd(jax, on_tpu, n_chips):
         "n_rows": n,
         "n_features": d,
         "n_components": k,
+        **_mfu_fields(rsvd_flops, elapsed, n_chips, peak),
     }
 
 
-def _bench_incremental_sgd(jax, on_tpu, n_chips):
+def _bench_incremental_sgd(jax, on_tpu, n_chips, peak):
     """BASELINE configs[3]: Incremental(SGDClassifier) streaming
     partial_fit over TPU-resident blocks — one full epoch, blocks gathered
     on device (take_rows), model state device-resident throughout."""
@@ -355,6 +425,8 @@ def _bench_incremental_sgd(jax, on_tpu, n_chips):
         "dtype": "float32",
         "n_rows": n,
         "n_features": d,
+        # one epoch: forward (2nd) + backward (2nd) over every sample
+        **_mfu_fields(4.0 * n * d, elapsed, n_chips, peak),
     }
 
 

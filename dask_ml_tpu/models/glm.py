@@ -72,9 +72,12 @@ def _matvec_eta_multi(data, coef, intercept):
 @jax.jit
 def _onehot_targets(yd, mask, classes_d):
     """(C, n) one-vs-rest targets in one program (module-level jit: a
-    per-fit lambda would retrace+recompile every fit)."""
-    return (yd[None, :] == classes_d[:, None]).astype(jnp.float32) \
-        * mask[None, :]
+    per-fit lambda would retrace+recompile every fit). The encoding
+    invariant itself lives in solvers/streamed.py::onehot_targets,
+    shared with the streamed block kernels."""
+    from .solvers.streamed import onehot_targets
+
+    return onehot_targets(yd, mask, classes_d)
 
 
 @_partial(jax.jit, static_argnames=("fit_intercept", "to_bf16", "encode"))

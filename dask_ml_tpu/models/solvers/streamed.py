@@ -104,13 +104,17 @@ def _finish_vg(val_sum, grad_sum, beta, n_rows, lam, pmask, l1_ratio, reg):
 # (VERDICT r3 missing #2 — the reference has one fit path for all label
 # sets; dask_ml/linear_model/glm.py::LogisticRegression).
 
-def _codes_onehot(y, mask, n_classes):
-    """(C, n) one-vs-rest targets from class codes; padding rows zeroed.
-    The ONE place the target-encoding invariant lives — every multiclass
-    block kernel builds its targets here."""
-    codes = jnp.arange(n_classes, dtype=y.dtype)
-    return (y[None, :] == codes[:, None]).astype(jnp.float32) \
+def onehot_targets(y, mask, classes_d):
+    """(C, n) one-vs-rest targets; padding rows zeroed. The ONE place
+    the target-encoding invariant lives — the in-core fit (glm.py's
+    jitted wrapper) and every multiclass block kernel build targets
+    here."""
+    return (y[None, :] == classes_d[:, None]).astype(jnp.float32) \
         * mask[None, :]
+
+
+def _codes_onehot(y, mask, n_classes):
+    return onehot_targets(y, mask, jnp.arange(n_classes, dtype=y.dtype))
 
 
 @partial(jax.jit, static_argnames=("family", "intercept", "n_classes"))
