@@ -634,8 +634,14 @@ class BaseIncrementalSearchCV(BaseEstimator):
         test_size = self.test_size
         if test_size is None:
             test_size = 0.15
+        # _split_random_state decouples the SPLIT seed from the SAMPLING
+        # seed: Hyperband's multi-process bracket SHAs sample with
+        # random_state + s but must split identically to the
+        # single-process interleaved fit (one shared split), or results
+        # would diverge by process count
+        split_seed = getattr(self, "_split_random_state", self.random_state)
         X_train, X_test, y_train, y_test = train_test_split(
-            X, y, test_size=test_size, random_state=self.random_state
+            X, y, test_size=test_size, random_state=split_seed
         )
         scorer_raw = check_scoring(self.estimator, self.scoring)
         # Device-resident data plane for estimators whose partial_fit
