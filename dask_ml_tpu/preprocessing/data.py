@@ -388,11 +388,50 @@ class QuantileTransformer(_DeviceTransformer):
         self.copy = copy
 
     def fit(self, X, y=None):
+        if self.ignore_implicit_zeros:
+            # sklearn: only meaningful for sparse input, which TPU dense
+            # arrays never are — raise rather than silently no-op
+            raise ValueError(
+                "ignore_implicit_zeros applies to sparse matrices only; "
+                "dense input does not support it"
+            )
         X = self._sharded(X)
+        sub_limit = int(self.subsample) if self.subsample else None
+        if sub_limit is not None and self.n_quantiles > sub_limit:
+            raise ValueError(
+                f"The number of quantiles ({self.n_quantiles}) cannot be "
+                f"greater than subsample ({sub_limit})"
+            )
         n_q = min(self.n_quantiles, X.n_rows)
         self.n_quantiles_ = n_q
         self.references_ = np.linspace(0, 1, n_q)
-        self.quantiles_ = to_host(_masked_quantiles(X, self.references_))
+        sub = sub_limit if sub_limit is not None else X.n_rows
+        src = X
+        if X.n_rows > sub:
+            # sklearn semantics: quantiles of a seeded uniform subsample
+            # of `subsample` rows. The pick is a device Gumbel top-l
+            # (static shapes, no host index generation at 1B rows) and
+            # the gather one all-to-all (take_rows). If the sample is
+            # still past the sort-affordability threshold,
+            # _masked_quantiles switches to the histogram sketch — the
+            # reference's approximate-quantile behavior at scale.
+            import jax as _jax
+
+            from ..models.kmeans import _gumbel_top_l
+            from ..parallel.sharded import take_rows
+
+            key = _jax.random.PRNGKey(
+                0 if self.random_state is None else int(self.random_state)
+            )
+            idx_d = _gumbel_top_l(X.row_mask(jnp.float32), key, sub)
+            if not idx_d.is_fully_addressable:
+                # multi-host mesh: replicate before the host read —
+                # np.asarray on a cross-process array raises
+                from ..parallel.sharded import _replicator
+
+                idx_d = _replicator(X.mesh)(idx_d)
+            src = take_rows(X, np.asarray(idx_d))
+        self.quantiles_ = to_host(_masked_quantiles(src, self.references_))
         self.n_features_in_ = X.shape[1]
         return self
 

@@ -107,3 +107,50 @@ def test_standard_scaler_large_offset_precision():
     ours.mean_, ours.var_, ours.scale_ = ref.mean_, ref.var_, ref.scale_
     got = ours.transform(X32).to_numpy()
     assert np.abs(got - ref.transform(X64)).max() < 0.05
+
+
+def test_quantile_transformer_subsample_and_random_state(monkeypatch):
+    """subsample/random_state are honored (VERDICT r3 weak #5): a fit
+    over n > subsample rows computes quantiles from a seeded uniform
+    subsample (sklearn semantics), deterministic per seed and within
+    tolerance of the exact-all-rows quantiles; and when the sample is
+    itself past the sort threshold the sketch path engages."""
+    rng = np.random.RandomState(0)
+    Xb = rng.lognormal(size=(6000, 3)).astype(np.float32)
+    exact = pre.QuantileTransformer(n_quantiles=100, subsample=None)
+    exact.fit(Xb)
+    a = pre.QuantileTransformer(n_quantiles=100, subsample=2000,
+                                random_state=7).fit(Xb)
+    b = pre.QuantileTransformer(n_quantiles=100, subsample=2000,
+                                random_state=7).fit(Xb)
+    np.testing.assert_array_equal(a.quantiles_, b.quantiles_)  # seeded
+    # subsampled quantiles approximate the full-data quantiles
+    spread = exact.quantiles_[-1] - exact.quantiles_[0]
+    err = np.abs(a.quantiles_ - exact.quantiles_) / spread[None, :]
+    assert np.median(err) < 0.05
+    # the sampled fit still transforms close to sklearn's exact map
+    t = a.transform(Xb).to_numpy()
+    t_ref = skpre.QuantileTransformer(n_quantiles=100,
+                                      subsample=None).fit_transform(Xb)
+    assert abs(t - t_ref).mean() < 0.03
+    # sample > sort threshold -> histogram sketch engages behind subsample
+    from dask_ml_tpu.preprocessing import data as pdata
+
+    calls = {}
+    real = pdata._sketch_quantiles
+
+    def spy(*args, **kw):
+        calls["hit"] = True
+        return real(*args, **kw)
+
+    monkeypatch.setattr(pdata, "_SKETCH_THRESHOLD", 1999)
+    monkeypatch.setattr(pdata, "_sketch_quantiles", spy)
+    pre.QuantileTransformer(n_quantiles=100, subsample=2000,
+                            random_state=7).fit(Xb)
+    assert calls.get("hit")
+
+
+def test_quantile_transformer_ignore_implicit_zeros_raises():
+    Xb = np.random.RandomState(1).randn(50, 2).astype(np.float32)
+    with pytest.raises(ValueError, match="sparse"):
+        pre.QuantileTransformer(ignore_implicit_zeros=True).fit(Xb)
