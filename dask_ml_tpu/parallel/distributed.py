@@ -130,6 +130,86 @@ def allgather_host(value: np.ndarray) -> np.ndarray:
     ])
 
 
+def array_from_process_local(local, mesh=None, dtype=np.float32):
+    """Global row-sharded ShardedArray from PER-PROCESS row blocks.
+
+    Each process contributes its OWN rows (global order = process
+    order); unlike ``ShardedArray.from_array`` (SPMD: every process
+    holds the full array), only the rows that land on a FOREIGN
+    process's shards travel over the control plane — at most one
+    shard's worth per process boundary, zero when counts divide evenly.
+    The reference's analog is dd's partition-locality (a worker's
+    partitions stay put; SURVEY.md §1 L2 dd row); here the multi-host
+    ingest for PartitionedFrame.to_sharded(mesh=global_mesh())."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .mesh import DATA_AXIS, data_shards
+    from .sharded import ShardedArray, _padded_rows
+
+    local = np.ascontiguousarray(np.asarray(local, dtype))
+    if mesh is None:
+        mesh = global_mesh()
+    me = jax.process_index()
+    shapes = allgather_object(
+        (tuple(local.shape[1:]), str(local.dtype))
+    )
+    if any(s != shapes[0] for s in shapes):
+        raise ValueError(
+            "array_from_process_local requires identical feature shape "
+            f"and dtype on every process; got {shapes}"
+        )
+    counts = np.asarray(allgather_object(int(local.shape[0])), np.int64)
+    n = int(counts.sum())
+    off = int(counts[:me].sum())
+    n_pad = _padded_rows(n, data_shards(mesh))
+    shape = (n_pad,) + local.shape[1:]
+    sharding = NamedSharding(
+        mesh, P(*((DATA_AXIS,) + (None,) * (local.ndim - 1)))
+    )
+    # exact global row range per device, then per process
+    imap = sharding.devices_indices_map(shape)
+    proc_ranges = {}
+    for dev, idx in imap.items():
+        sl = idx[0]
+        rng = (sl.start or 0, n_pad if sl.stop is None else sl.stop)
+        proc_ranges.setdefault(dev.process_index, set()).add(rng)
+    # ship the slices of MY rows that land on foreign shards
+    parcels = {}
+    for q, ranges in proc_ranges.items():
+        if q == me:
+            continue
+        for a, b in sorted(ranges):
+            lo, hi = max(a, off), min(b, off + local.shape[0])
+            if lo < hi:
+                parcels.setdefault(q, []).append(
+                    (lo, local[lo - off:hi - off])
+                )
+    received = allgather_object(parcels)
+    # assemble my shards: own overlap + foreign parcels; rows >= n stay
+    # zero (the trailing padding row_mask hides)
+    mine = {}
+    for a, b in sorted(proc_ranges.get(me, ())):
+        buf = np.zeros((b - a,) + local.shape[1:], dtype=local.dtype)
+        lo, hi = max(a, off), min(b, off + local.shape[0])
+        if lo < hi:
+            buf[lo - a:hi - a] = local[lo - off:hi - off]
+        for sender in received:
+            for g0, arr in sender.get(me, []):
+                l2, h2 = max(a, g0), min(b, g0 + arr.shape[0])
+                if l2 < h2:
+                    buf[l2 - a:h2 - a] = arr[l2 - g0:h2 - g0]
+        mine[(a, b)] = buf
+
+    def cb(idx):
+        sl = idx[0]
+        a = sl.start or 0
+        return mine[(a, n_pad if sl.stop is None else sl.stop)]
+
+    data = jax.make_array_from_callback(shape, sharding, cb)
+    return ShardedArray(data, n, mesh)
+
+
 def barrier(name="barrier"):
     """Cross-host sync point: a tiny psum over every device."""
     x = jnp.ones((jax.device_count(),))

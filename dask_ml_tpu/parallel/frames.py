@@ -132,7 +132,17 @@ class PartitionedFrame:
         """Place the (numeric) columns onto the device mesh as a
         ShardedArray — the frame→array handoff where TPU compute begins.
         Categorical columns must be encoded first (OrdinalEncoder /
-        DummyEncoder)."""
+        DummyEncoder).
+
+        With a mesh spanning MULTIPLE PROCESSES (``mesh=global_mesh()``),
+        each process contributes ITS local partitions: the global row
+        order is process order, column sets must agree, and only
+        shard-boundary rows travel cross-host
+        (``distributed.array_from_process_local``) — the multi-host
+        ingest story for frames (reference: dd partition locality,
+        SURVEY.md §1 L2)."""
+        import jax
+
         from .sharded import ShardedArray
 
         # pandas-aware dtype checks: np.issubdtype raises TypeError on
@@ -147,6 +157,27 @@ class PartitionedFrame:
         host = np.concatenate([
             p[cols].to_numpy(dtype=dtype) for p in self.partitions
         ], axis=0)
+        from .mesh import resolve_mesh
+
+        mesh = resolve_mesh(mesh)  # ambient/default meshes can ALSO span
+        # processes — detection must see the resolved mesh, or a
+        # multi-process to_sharded() with no mesh arg would take the
+        # SPMD path with per-process-different arrays
+        cross_process = any(
+            d.process_index != jax.process_index()
+            for d in mesh.devices.flat
+        )
+        if cross_process:
+            from .distributed import allgather_object, \
+                array_from_process_local
+
+            col_sets = allgather_object(list(map(str, cols)))
+            if any(cs != col_sets[0] for cs in col_sets):
+                raise ValueError(
+                    "cross-process to_sharded requires identical numeric "
+                    f"column sets on every process; got {col_sets}"
+                )
+            return array_from_process_local(host, mesh=mesh, dtype=dtype)
         return ShardedArray.from_array(host, mesh=mesh, dtype=dtype)
 
 

@@ -531,3 +531,85 @@ def test_two_process_global_mesh_fit(tmp_path):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+
+
+_FRAME_WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import pandas as pd
+    pid = int(sys.argv[1]); port = sys.argv[2]
+    jax.distributed.initialize(
+        coordinator_address="127.0.0.1:" + port,
+        num_processes=2, process_id=pid)
+    from dask_ml_tpu.parallel import distributed as dist
+    from dask_ml_tpu.parallel.frames import from_pandas
+    # each process holds a DIFFERENT local frame (uneven row counts so
+    # shard boundaries straddle the process boundary and parcels ship)
+    rows = [37, 23][pid]
+    rng = np.random.RandomState(pid)
+    df = pd.DataFrame({{
+        "a": np.arange(rows, dtype=np.float32) + 100.0 * pid,
+        "b": rng.randn(rows).astype(np.float32),
+        "s": ["x"] * rows,                       # non-numeric: dropped
+    }})
+    pf = from_pandas(df, npartitions=3)
+    mesh = dist.global_mesh()
+    sa = pf.to_sharded(mesh=mesh)
+    assert sa.n_rows == 60, sa.n_rows
+    assert sa.shape == (60, 2), sa.shape
+    assert not sa.data.is_fully_addressable   # genuinely cross-process
+    # global order = process order, content exact (column "a" encodes
+    # process + row index)
+    host = sa.to_numpy()
+    expect_a = np.concatenate([np.arange(37.0), np.arange(23.0) + 100.0])
+    assert np.allclose(host[:, 0], expect_a), host[:10]
+    # the ingested array feeds a real global-mesh fit
+    from dask_ml_tpu.linear_model import LinearRegression
+    y = host[:, 0] * 0.5 + 1.0
+    from dask_ml_tpu.parallel.sharded import ShardedArray
+    ys = ShardedArray.from_array(y, mesh=mesh)
+    est = LinearRegression(solver="lbfgs", max_iter=50).fit(sa, ys)
+    pred = est.predict(host[:5])
+    assert np.allclose(pred, y[:5], atol=1e-2), pred
+    print("proc", pid, "frames OK", flush=True)
+""")
+
+
+@pytest.mark.slow
+def test_two_process_frame_ingest(tmp_path):
+    """Cross-process frame ingest (VERDICT r3 missing #3): each process
+    contributes ITS local PartitionedFrame partitions to one global-mesh
+    ShardedArray via array_from_process_local, then fits on it."""
+    last = None
+    for _attempt in range(2):
+        port = str(_free_port())
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", _FRAME_WORKER.format(repo=REPO),
+                 str(i), port],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            )
+            for i in range(2)
+        ]
+        try:
+            outs = []
+            for p in procs:
+                out, _ = p.communicate(timeout=240)
+                outs.append(out)
+            ok = all(p.returncode == 0 for p in procs) and all(
+                f"proc {i} frames OK" in out for i, out in enumerate(outs)
+            )
+            if ok:
+                return
+            last = "\n---\n".join(outs)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+    raise AssertionError(f"both attempts failed:\n{last}")
