@@ -136,15 +136,18 @@ def array_from_process_local(local, mesh=None, dtype=np.float32):
     Each process contributes its OWN rows (global order = process
     order); unlike ``ShardedArray.from_array`` (SPMD: every process
     holds the full array), only the rows that land on a FOREIGN
-    process's shards travel over the control plane — at most one
-    shard's worth per process boundary, zero when counts divide evenly.
+    process's shards are exchanged — at most one shard's worth per
+    process boundary, zero when counts divide evenly. Wire cost note:
+    the exchange rides ``allgather_object`` (a broadcast), so each
+    boundary parcel reaches every process — O(P x boundary bytes) over
+    DCN, fine for the boundary-slice volumes this produces; a
+    per-destination channel would be the upgrade if parcels ever grow.
     The reference's analog is dd's partition-locality (a worker's
     partitions stay put; SURVEY.md §1 L2 dd row); here the multi-host
     ingest for PartitionedFrame.to_sharded(mesh=global_mesh())."""
     import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from .mesh import DATA_AXIS, data_shards
+    from .mesh import data_shards, row_sharding
     from .sharded import ShardedArray, _padded_rows
 
     local = np.ascontiguousarray(np.asarray(local, dtype))
@@ -164,9 +167,7 @@ def array_from_process_local(local, mesh=None, dtype=np.float32):
     off = int(counts[:me].sum())
     n_pad = _padded_rows(n, data_shards(mesh))
     shape = (n_pad,) + local.shape[1:]
-    sharding = NamedSharding(
-        mesh, P(*((DATA_AXIS,) + (None,) * (local.ndim - 1)))
-    )
+    sharding = row_sharding(mesh, local.ndim)
     # exact global row range per device, then per process
     imap = sharding.devices_indices_map(shape)
     proc_ranges = {}

@@ -152,11 +152,6 @@ class PartitionedFrame:
             if pd.api.types.is_numeric_dtype(self.dtypes[c])
             or pd.api.types.is_bool_dtype(self.dtypes[c])
         ]
-        if not cols:
-            raise ValueError("no numeric columns to place on device")
-        host = np.concatenate([
-            p[cols].to_numpy(dtype=dtype) for p in self.partitions
-        ], axis=0)
         from .mesh import resolve_mesh
 
         mesh = resolve_mesh(mesh)  # ambient/default meshes can ALSO span
@@ -171,13 +166,27 @@ class PartitionedFrame:
             from .distributed import allgather_object, \
                 array_from_process_local
 
+            # gather BEFORE any raise (including the empty-column one):
+            # a process erroring out pre-collective would leave its
+            # peers blocked in the allgather forever — every process
+            # must reach the collective, then raise together
             col_sets = allgather_object(list(map(str, cols)))
             if any(cs != col_sets[0] for cs in col_sets):
                 raise ValueError(
                     "cross-process to_sharded requires identical numeric "
                     f"column sets on every process; got {col_sets}"
                 )
+            if not cols:
+                raise ValueError("no numeric columns to place on device")
+            host = np.concatenate([
+                p[cols].to_numpy(dtype=dtype) for p in self.partitions
+            ], axis=0)
             return array_from_process_local(host, mesh=mesh, dtype=dtype)
+        if not cols:
+            raise ValueError("no numeric columns to place on device")
+        host = np.concatenate([
+            p[cols].to_numpy(dtype=dtype) for p in self.partitions
+        ], axis=0)
         return ShardedArray.from_array(host, mesh=mesh, dtype=dtype)
 
 

@@ -55,6 +55,7 @@ def test_ovr_loop_solvers(data3, solver):
     assert (clf.predict(X) == y).mean() > 0.6
 
 
+@pytest.mark.slow
 def test_ovr_in_grid_search(data3):
     from dask_ml_tpu.model_selection import GridSearchCV
 
@@ -92,6 +93,7 @@ def test_multinomial_multi_class_rejected(data3):
         LogisticRegression(multi_class="multinomial", max_iter=10).fit(X, y)
 
 
+@pytest.mark.slow
 def test_ovr_streamed_predict_and_fit(tmp_path, data3):
     """Multiclass predict AND fit stream block-wise over memmaps like
     the binary path (VERDICT r3 missing #2): the streamed OvR fit

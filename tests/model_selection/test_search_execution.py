@@ -32,6 +32,7 @@ def seq_search(xy_classification):
     return _search(scheduler="synchronous").fit(X, y)
 
 
+@pytest.mark.slow
 def test_threaded_matches_synchronous(xy_classification, seq_search):
     X, y = xy_classification
     par = _search(n_jobs=4).fit(X, y)  # default scheduler: threads

@@ -35,6 +35,7 @@ def _no_full_pulls(calls, n):
     assert not any(c >= n for c in calls), calls
 
 
+@pytest.mark.slow
 def test_sgd_fit_stays_on_device(xy_device, spy):
     from dask_ml_tpu.models.sgd import SGDClassifier
 
@@ -76,6 +77,7 @@ def test_glm_encode_y_stays_on_device(xy_device, spy):
     assert clf.score(Xs, ys) > 0.7
 
 
+@pytest.mark.slow
 def test_device_classes_integer_labels(xy_device):
     """Integer (and bool) label dtypes must work on the device path, as
     np.unique does on host, and classes_ keeps the label dtype."""
@@ -91,6 +93,7 @@ def test_device_classes_integer_labels(xy_device):
     assert set(np.unique(clf.predict(X))) <= {0, 1}
 
 
+@pytest.mark.slow
 def test_device_fit_explicit_classes_kwarg(xy_device):
     """fit(..., classes=[...]) must apply the classes on both data
     planes — labels like {-1, +1} would otherwise train un-encoded."""
@@ -124,6 +127,7 @@ def test_glm_non_binary_dispatches_to_ovr(xy_device):
     assert clf.coef_.shape == (3, X.shape[1])
 
 
+@pytest.mark.slow
 def test_concurrent_gridsearch_sharded_stays_on_device(xy_device, spy):
     """Sharded input + explicit n_jobs: trials run on disjoint submeshes
     with DEVICE-resharded folds (no host_folds materialization)."""

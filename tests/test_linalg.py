@@ -6,6 +6,7 @@ contract the reference uses with sklearn (SURVEY.md §4).
 
 import jax
 import numpy as np
+import pytest
 
 from dask_ml_tpu.ops import linalg
 from dask_ml_tpu.parallel import ShardedArray, default_mesh
@@ -45,6 +46,7 @@ def test_svd_tall_matches_numpy():
     np.testing.assert_allclose(rec, x, atol=1e-3)
 
 
+@pytest.mark.slow
 def test_randomized_svd_low_rank():
     rng = np.random.RandomState(0)
     base = rng.randn(200, 4) @ rng.randn(4, 16)
@@ -85,6 +87,7 @@ def test_tsqr_fewer_rows_than_shards_per_block():
     np.testing.assert_allclose(qh.T @ qh, np.eye(d), atol=1e-4)
 
 
+@pytest.mark.slow
 def test_randomized_svd_components_near_rank():
     """k + oversampling exceeding d must clamp, and recover the full
     spectrum of an exactly low-rank matrix."""

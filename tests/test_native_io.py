@@ -97,6 +97,7 @@ def test_native_block_reader_matches_numpy(tmp_path):
     np.testing.assert_allclose(np.concatenate(blocks2), X[100:], rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_streamed_fit_with_native_reader(tmp_path):
     """End-to-end: an out-of-core GLM fit through the native readahead
     path matches the in-memory fit."""

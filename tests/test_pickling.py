@@ -87,6 +87,7 @@ def test_pickle_preserves_model_axis_sharding():
         np.testing.assert_array_equal(back.to_numpy(), xs.to_numpy())
 
 
+@pytest.mark.slow
 def test_fitted_search_pickles():
     from dask_ml_tpu.linear_model import LogisticRegression
     from dask_ml_tpu.model_selection import GridSearchCV
@@ -101,6 +102,7 @@ def test_fitted_search_pickles():
     np.testing.assert_array_equal(back.predict(X), s.predict(X))
 
 
+@pytest.mark.slow
 def test_fitted_search_with_named_scorer_pickles():
     from dask_ml_tpu.linear_model import LogisticRegression
     from dask_ml_tpu.model_selection import GridSearchCV

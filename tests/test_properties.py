@@ -9,6 +9,8 @@ compile each time on one CPU)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # hypothesis fuzz: full-suite only
+
 hypothesis = pytest.importorskip("hypothesis")
 from hypothesis import given, settings, strategies as st  # noqa: E402
 

@@ -48,6 +48,7 @@ def test_multiclass_partial_fit_contract(data3):
     assert eta.shape == (len(X), 3)
 
 
+@pytest.mark.slow
 def test_multiclass_sharded_fit(data3):
     X, y = data3
     dev = SGDClassifier(max_iter=10, random_state=0, shuffle=False).fit(
@@ -87,6 +88,7 @@ def test_multiclass_in_incremental_search(data3):
     assert search.best_estimator_.coef_.shape == (3, X.shape[1])
 
 
+@pytest.mark.slow
 def test_multiclass_in_incremental_wrapper(data3):
     from dask_ml_tpu.wrappers import Incremental
 
@@ -123,7 +125,9 @@ def test_multiclass_in_hyperband(data3):
     search.fit(X, y, classes=[0.0, 1.0, 2.0])
     assert search.best_estimator_.coef_.shape == (3, X.shape[1])
     assert search.best_score_ > 0.6
-    # multiclass trials ran on the solo path (no vmapped cohort steps)
+    # multiclass trials ran on the solo paths — sequential or concurrent
+    # submesh placement — never as a vmapped cohort (the (C, d+1) weight
+    # shape has no batch key)
     assert {r["executor"] for r in search.history_} <= {
-        "sequential", "threads"
+        "sequential", "threads", "submesh"
     }

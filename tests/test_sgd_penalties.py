@@ -30,6 +30,7 @@ def test_l1_sparsifies_vs_l2():
     assert l1.score(X, y) > 0.8
 
 
+@pytest.mark.slow
 def test_elasticnet_between_l1_l2():
     X, y = _data(1)
     kw = dict(alpha=0.05, eta0=0.5, max_iter=40, random_state=0)

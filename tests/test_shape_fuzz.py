@@ -8,6 +8,8 @@ exactly in these off-size cases)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # hypothesis fuzz: full-suite only
+
 SIZES = [(5, 3), (9, 1), (17, 3), (64, 5), (101, 7), (256, 2)]
 
 

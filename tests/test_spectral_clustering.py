@@ -9,6 +9,7 @@ from dask_ml_tpu.cluster import KMeans, SpectralClustering
 from dask_ml_tpu.datasets import make_blobs
 
 
+@pytest.mark.slow
 def test_spectral_blobs():
     X, y = make_blobs(n_samples=300, n_features=4, centers=3, random_state=0,
                       cluster_std=0.5)
@@ -18,6 +19,7 @@ def test_spectral_blobs():
     assert ari > 0.9, ari
 
 
+@pytest.mark.slow
 def test_spectral_circles_beats_kmeans():
     """Non-convex clusters: spectral must separate what kmeans cannot."""
     Xh, y = make_circles(n_samples=400, factor=0.4, noise=0.04,
@@ -44,6 +46,7 @@ def test_spectral_affinity_validation():
         SpectralClustering(n_clusters=2, affinity="bogus").fit(X)
 
 
+@pytest.mark.slow
 def test_spectral_linear_affinity_runs():
     X, y = make_blobs(n_samples=120, n_features=4, centers=2, random_state=2)
     sc = SpectralClustering(n_clusters=2, affinity="rbf", gamma=0.3,
@@ -51,6 +54,7 @@ def test_spectral_linear_affinity_runs():
     assert len(np.unique(sc.labels_.to_numpy())) == 2
 
 
+@pytest.mark.slow
 def test_spectral_callable_affinity():
     """A user-supplied kernel callable is used verbatim (reference
     accepts callables for affinity)."""
@@ -97,6 +101,7 @@ def test_spectral_honest_params_raise():
                        n_components=30, random_state=0).fit(X)
 
 
+@pytest.mark.slow
 def test_spectral_persist_embedding_and_n_init():
     from dask_ml_tpu.parallel import ShardedArray
 

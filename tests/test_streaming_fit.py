@@ -59,6 +59,7 @@ def test_logreg_memmap_matches_resident(tmp_path, clf_data, solver, penalty,
     assert np.mean(streamed.predict(X) == resident.predict(X)) > 0.99
 
 
+@pytest.mark.slow
 def test_linear_regression_memmap(tmp_path):
     from dask_ml_tpu.linear_model import LinearRegression
 
@@ -142,6 +143,7 @@ def test_kmeans_memmap_matches_resident(tmp_path):
     assert streamed.n_iter_ >= 1
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("init", ["k-means||", "k-means++", "random"])
 def test_kmeans_streamed_inits_are_sane(tmp_path, init):
     from dask_ml_tpu.cluster import KMeans
@@ -197,6 +199,7 @@ def test_pca_memmap_matches_resident(tmp_path):
     np.testing.assert_allclose(t_str, t_res, rtol=5e-2, atol=5e-3)
 
 
+@pytest.mark.slow
 def test_streamed_inference_paths(tmp_path, clf_data):
     """predict/transform/score also stream for out-of-core inputs — the
     whole pipeline runs without materializing X on device."""

@@ -61,6 +61,7 @@ def test_glm_fit_parity_tensor_parallel(mesh2d, clf_data, solver):
     assert tp.score(Xtp, ytp) == pytest.approx(ref.score(X, y), abs=1e-6)
 
 
+@pytest.mark.slow
 def test_pca_fit_parity_tensor_parallel(mesh2d):
     from dask_ml_tpu.decomposition import PCA
 
