@@ -32,6 +32,15 @@ __all__ = [
 ]
 
 
+def log_proba(p):
+    """log of a probability matrix, sklearn ``predict_log_proba``
+    semantics: zero probabilities map to -inf, silently (no runtime
+    warning). THE one implementation — every classifier's
+    predict_log_proba delegates here so they cannot diverge."""
+    with np.errstate(divide="ignore"):
+        return np.log(p)
+
+
 def to_host(x):
     """Move a fitted attribute to host numpy (fitted attrs are small).
 

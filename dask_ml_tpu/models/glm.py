@@ -508,6 +508,13 @@ class LogisticRegression(_GLMBase):
         p1 = expit(self._eta_host(X))
         return np.stack([1.0 - p1, p1], axis=1)
 
+    def predict_log_proba(self, X):
+        """Log of predict_proba (sklearn API; the reference's glm lacks
+        it but sklearn users expect it on a classifier)."""
+        from ..base import log_proba
+
+        return log_proba(self.predict_proba(X))
+
     def predict(self, X):
         if self._is_multiclass():
             eta = self._eta_multi_host(X)

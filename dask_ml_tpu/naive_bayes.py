@@ -97,7 +97,9 @@ class GaussianNB(ClassifierMixin, BaseEstimator):
         return p
 
     def predict_log_proba(self, X):
-        return np.log(self.predict_proba(X))
+        from .base import log_proba
+
+        return log_proba(self.predict_proba(X))
 
     def score(self, X, y):
         y = y.to_numpy() if isinstance(y, ShardedArray) else np.asarray(y)

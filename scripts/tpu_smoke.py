@@ -164,6 +164,45 @@ def main():
             total += blk.n_rows
         assert total == len(Xh), total
 
+    def multiclass_round4():
+        """Round-4 surfaces: multiclass in-core AND streamed OvR GLM,
+        multiclass SGD submesh trials, OneHotEncoder(drop), sketched
+        QuantileTransformer subsample — all Mosaic-lowered here."""
+        from dask_ml_tpu import config
+        from dask_ml_tpu.linear_model import (
+            LogisticRegression, SGDClassifier,
+        )
+        from dask_ml_tpu.model_selection import IncrementalSearchCV
+        from dask_ml_tpu.preprocessing import (
+            OneHotEncoder, QuantileTransformer,
+        )
+
+        Xm, ym = datasets.make_classification(
+            n_samples=6000, n_features=16, n_classes=3, n_informative=8,
+            random_state=3,
+        )
+        clf = LogisticRegression(solver="lbfgs", max_iter=40).fit(Xm, ym)
+        assert clf.coef_.shape == (3, 16)
+        lp = clf.predict_log_proba(Xm)
+        assert lp.shape == (6000, 3) and (lp <= 0).all()
+        Xh, yh = Xm.to_numpy(), ym.to_numpy()
+        with config.set(stream_block_rows=1500):
+            st = LogisticRegression(solver="lbfgs", max_iter=40).fit(Xh, yh)
+        assert st.solver_info_.get("n_classes") == 3
+        assert np.mean(st.predict(Xh) == clf.predict(Xh)) > 0.98
+        s = IncrementalSearchCV(
+            SGDClassifier(random_state=0), {"alpha": [1e-4, 1e-3]},
+            n_initial_parameters="grid", decay_rate=None, max_iter=3,
+            random_state=0,
+        )
+        s.fit(Xm, ym, classes=[0.0, 1.0, 2.0])
+        assert s.best_estimator_.coef_.shape == (3, 16)
+        Xcat = np.array([[0.0, 1.0], [1.0, 2.0], [0.0, 1.0]])
+        o = OneHotEncoder(drop="first").fit(Xcat)
+        assert o.transform(Xcat).shape == (3, 2)
+        QuantileTransformer(n_quantiles=50, subsample=3000,
+                            random_state=0).fit_transform(Xm)
+
     for name, fn in [
         ("glm solvers x3 families", glms),
         ("device sgd", sgd),
@@ -176,6 +215,7 @@ def main():
         ("grid + hyperband search", search),
         ("wrappers + ensemble", wrappers_ensemble),
         ("block streaming", streaming),
+        ("round-4 multiclass/drop/subsample", multiclass_round4),
     ]:
         results.append(run(name, fn))
 

@@ -166,3 +166,17 @@ def test_solver_kwargs_checkpoint_takes_loop_path(tmp_path, data3):
         solver_kwargs={"checkpoint_path": p, "checkpoint_every": 4},
     ).fit(X, y)
     assert clf.coef_.shape == (3, X.shape[1])
+
+
+def test_predict_log_proba(data3):
+    """sklearn API: log of predict_proba, -inf allowed on exact zeros
+    (shared base.log_proba implementation, same as GaussianNB)."""
+    X, y = data3
+    clf = LogisticRegression(solver="lbfgs", max_iter=60).fit(X, y)
+    lp = clf.predict_log_proba(X)
+    assert lp.shape == (len(X), 3)
+    np.testing.assert_allclose(np.exp(lp), clf.predict_proba(X), atol=1e-7)
+    yb = (y > 0).astype(np.float32)
+    clfb = LogisticRegression(solver="lbfgs", max_iter=60).fit(X, yb)
+    np.testing.assert_allclose(np.exp(clfb.predict_log_proba(X)),
+                               clfb.predict_proba(X), atol=1e-7)
