@@ -103,6 +103,85 @@ def _sgd_step_multi(X, y_codes, mask, n_valid, W, lr, alpha, l2w, l1w,
     return jax.vmap(one)(W, jnp.arange(W.shape[0], dtype=jnp.float32))
 
 
+@partial(jax.jit, static_argnames=("loss", "schedule", "n_out"))
+def _sgd_epoch(Xr, yr, order, W, t0, eta0, power_t, alpha, l2w, l1w,
+               iflag, n_rows, bs_logical, loss, schedule, n_out):
+    """One FULL epoch as one program: ``lax.scan`` over the stacked
+    block view ``Xr (n_blocks, bs, d)`` / ``yr (n_blocks, bs)`` (axis 1
+    row-sharded, so every step uses the whole mesh). Replaces one
+    dispatch per block with one per epoch — on a tunneled runtime the
+    per-launch round trip dominates the math at streaming block sizes.
+    ``order`` holds the (possibly shuffled) block indices; the lr clock
+    advances per block exactly as the per-block loop does."""
+    bs = Xr.shape[1]
+
+    def lr_at(t):
+        t = jnp.maximum(t, 1.0)
+        if schedule == "constant":
+            return jnp.float32(eta0)
+        if schedule == "invscaling":
+            return eta0 / t ** power_t
+        return 1.0 / (alpha * (1e3 + t))  # "optimal"
+
+    def step(carry, b):
+        W, t = carry
+        Xb = jnp.take(Xr, b, axis=0)
+        yb = jnp.take(yr, b, axis=0)
+        # grid rows are padded up to a shardable multiple (bs >= the
+        # logical block size bs_logical): row r of block b is valid iff
+        # it is a real block row AND a real dataset row
+        r = jnp.arange(bs)
+        row_ids = b * bs_logical + r
+        mask = ((r < bs_logical) & (row_ids < n_rows)).astype(jnp.float32)
+        n_valid = jnp.sum(mask)
+        t = t + 1.0
+        lr = lr_at(t)
+        if n_out is not None:
+            def one(w, c):
+                yy = (yb == c).astype(jnp.float32)
+                return _sgd_update_one(w, yy, Xb, mask, n_valid, lr,
+                                       alpha, l2w, l1w, iflag, loss)
+
+            W2, _ = jax.vmap(one)(
+                W, jnp.arange(n_out, dtype=jnp.float32)
+            )
+        else:
+            W2, _ = _sgd_update_one(W, yb, Xb, mask, n_valid, lr, alpha,
+                                    l2w, l1w, iflag, loss)
+        return (W2, t), jnp.float32(0.0)
+
+    (W, t), _ = jax.lax.scan(step, (W, jnp.float32(t0)), order)
+    return W, t
+
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=32)
+def _grid_builders(mesh, D, bs_pad):
+    """Cached jitted block-grid gather programs per (mesh, grid shape) —
+    a fresh ``jax.jit(lambda ...)`` per fit would retrace and recompile
+    on every epoch, reintroducing the per-launch latency the fused path
+    exists to remove."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS
+
+    sh3 = NamedSharding(mesh, P(None, DATA_AXIS, None))
+    sh2 = NamedSharding(mesh, P(None, DATA_AXIS))
+    fX = jax.jit(
+        lambda a, src: jnp.take(a, src, axis=0).reshape(
+            D, bs_pad, a.shape[1]
+        ),
+        out_shardings=sh3,
+    )
+    fy = jax.jit(
+        lambda a, src: jnp.take(a, src, axis=0).reshape(D, bs_pad),
+        out_shardings=sh2,
+    )
+    return fX, fy
+
+
 @jax.jit
 def _batched_eta(X, W):
     """(n, N) decision values for N stacked models on one shared X."""
@@ -214,6 +293,69 @@ class _SGDBase(BaseEstimator):
         self._ensure_state(X.shape[1])
         self._one_step(X.data, y.data, X.row_mask(jnp.float32), X.n_rows)
         self._publish(X.shape[1])
+        return self
+
+    def _fused_epoch(self, X, y, order, block_size=None, classes=None):
+        """One full streaming epoch in ONE program (the Incremental
+        wrapper's fast path for device data): the dataset is reshaped
+        once into its (n_blocks, bs, d) block grid — axis 1 row-sharded,
+        one all-to-all — and ``_sgd_epoch`` scans the blocks in
+        ``order``. Semantically identical to ``order`` partial_fit calls
+        (same update, same lr clock, same masking), minus one dispatch
+        round trip per block. NOTE the grid is a second device copy of
+        the dataset for the epoch's duration — the wrapper falls back to
+        the block loop when HBM headroom is insufficient."""
+        if classes is not None:
+            self._set_classes(np.asarray(classes))
+        if isinstance(self, ClassifierMixin) and \
+                getattr(self, "classes_", None) is None:
+            raise ValueError(
+                "classes must be passed on the first call to partial_fit."
+            )
+        from ..parallel.mesh import data_shards
+
+        X = as_sharded(X, dtype=np.float32)
+        y_enc = as_sharded(self._encode_y(y), mesh=X.mesh,
+                           dtype=np.float32)
+        mesh = X.mesh
+        D = data_shards(mesh)
+        n_pad, d = X.data.shape
+        bs = n_pad // D
+        if block_size is not None and block_size != bs:
+            # ``order`` indexes the caller's block grid; a mismatched
+            # grid would silently clamp block ids (jnp.take) and train
+            # some blocks twice — refuse loudly instead
+            raise ValueError(
+                f"_fused_epoch grid is n_pad//data_shards = {bs} rows "
+                f"per block; caller streamed blocks of {block_size}"
+            )
+        self._ensure_state(d)
+        self._lr()  # validate the schedule name eagerly, like the loop
+        # grid block rows padded to a shardable multiple of the mesh's
+        # data axis; the pad rows are masked in-kernel
+        bs_pad = -(-bs // D) * D
+        src = np.minimum(
+            (np.arange(D * bs_pad) // bs_pad) * bs
+            + (np.arange(D * bs_pad) % bs_pad),
+            n_pad - 1,
+        ).astype(np.int32)
+        fX, fy = _grid_builders(mesh, D, bs_pad)
+        src_d = jnp.asarray(src)
+        Xr = fX(X.data, src_d)
+        yr = fy(y_enc.data, src_d)
+        l2w, l1w = self._penalty_weights()
+        W, _t = _sgd_epoch(
+            Xr, yr, jnp.asarray(np.asarray(order, np.int32)), self._w,
+            np.float32(self._t), np.float32(self.eta0),
+            np.float32(self.power_t), np.float32(self.alpha),
+            np.float32(l2w), np.float32(l1w),
+            np.float32(1.0 if self.fit_intercept else 0.0),
+            np.int32(X.n_rows), np.int32(bs), loss=self._loss(),
+            schedule=self.learning_rate, n_out=self._n_out(),
+        )
+        self._w = W
+        self._t += int(len(order))
+        self._publish(d)
         return self
 
     # -- batched-trial protocol (consumed by model_selection._incremental) --

@@ -28,6 +28,34 @@ from .parallel.sharded import ShardedArray, as_sharded
 __all__ = ["ParallelPostFit", "Incremental"]
 
 
+def _data_shards(mesh):
+    from .parallel.mesh import data_shards
+
+    return data_shards(mesh)
+
+
+def _device_headroom_for_copy(X, fraction=0.5):
+    """True when a full second device copy of ``X`` plausibly fits:
+    per-device free bytes (when the runtime reports memory_stats — TPU
+    does, CPU returns None and passes) must cover the copy's per-device
+    share with ``fraction`` slack."""
+    try:
+        devs = list(X.data.devices())
+        per_dev = X.data.nbytes / max(len(devs), 1)
+        for dev in devs:
+            stats = dev.memory_stats()
+            if not stats:
+                continue
+            free = stats.get("bytes_limit", 0) - stats.get(
+                "bytes_in_use", 0
+            )
+            if per_dev > fraction * free:
+                return False
+        return True
+    except Exception:
+        return True  # no reliable stats: assume fine (host-backed CPU)
+
+
 def _is_device_estimator(est):
     return est.__class__.__module__.startswith("dask_ml_tpu")
 
@@ -180,6 +208,24 @@ class Incremental(ParallelPostFit):
             starts = list(range(0, X.n_rows, block_size))
             if self.shuffle_blocks:
                 rng.shuffle(starts)
+            if (hasattr(est, "_fused_epoch") and ys is not None
+                    and len(starts) > 1
+                    and block_size == X.padded_shape[0] // max(
+                        _data_shards(X.mesh), 1)
+                    and set(fit_kwargs) <= {"classes"}
+                    and _device_headroom_for_copy(X)):
+                # fused-epoch fast path: the whole pass compiles into ONE
+                # scan program (same updates/order/lr clock as the block
+                # loop) — per-block dispatch round trips vanish. The
+                # grid is a second device copy of X for the epoch, hence
+                # the headroom gate (the loop gathers one block at a
+                # time and stays the fallback near HBM capacity).
+                est._fused_epoch(
+                    X, ys, [s // block_size for s in starts],
+                    block_size=block_size,
+                    classes=fit_kwargs.get("classes"),
+                )
+                return est
             for s in starts:
                 idx = np.arange(s, min(s + block_size, X.n_rows))
                 Xb = take_rows(X, idx)
