@@ -43,6 +43,23 @@ def _pick_tile(n):
     return 1024 if n % 1024 == 0 else 512
 
 
+_GLM_TILE_BUDGET = 4 * 1024 * 1024  # x-block bytes kept well under VMEM
+
+
+def glm_tile(n, d, itemsize):
+    """Row tile for the GLM kernel bounded by BOTH n and the x-block's
+    VMEM footprint (tile*d*itemsize); None when even a 128-row tile of a
+    very wide design would blow the budget — callers then keep the XLA
+    loss (its matmuls tile the feature dim freely)."""
+    tile = _pick_tile(n)
+    while tile > 128 and tile * d * itemsize > _GLM_TILE_BUDGET:
+        tile //= 2
+    tile = max(tile, 128)
+    if tile * d * itemsize > _GLM_TILE_BUDGET:
+        return None
+    return tile
+
+
 def _assign_update_kernel(x_ref, m_ref, c_ref, c2_ref, labels_ref, mind_ref,
                           sums_ref, counts_ref):
     i = pl.program_id(0)
@@ -166,6 +183,95 @@ def fused_lloyd_stats(x, n_valid, centers, interpret=False):
         interpret=interpret,
     )(x, nv, centers, c2)
     return sums, counts[0], inertia[0, 0]
+
+
+def _glm_value_grad_kernel(x_ref, y_ref, nv_ref, b_ref, loss_ref, grad_ref,
+                           *, tile, family):
+    """One X pass computing Σ pointwise-NLL AND Σ ∂NLL/∂β.
+
+    The XLA path reads X twice per value_and_grad (forward matvec +
+    gradient matmul) — at GLM arithmetic intensity the fit is HBM-bound,
+    so this halves the data traffic of every solver iteration. Same
+    layout rules as the Lloyd kernels: rank-2 everywhere, validity from
+    the global row index vs one scalar, accumulators revisited with a
+    constant index_map (sequential TPU grid: race-free)."""
+    i = pl.program_id(0)
+    x = x_ref[:]                       # (tile, d) — f32 or bf16
+    yv = y_ref[:]                      # (tile, 1) f32
+    b = b_ref[:]                       # (1, d) f32
+    row_ids = jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], 1), 0) \
+        + i * tile
+    m = (row_ids < nv_ref[0, 0]).astype(jnp.float32)    # (tile, 1)
+    # matvec at x's dtype (bf16 rides the MXU at bf16 rate), f32 accum —
+    # the same contract as solvers._smooth_loss
+    eta = jax.lax.dot_general(
+        x, b.astype(x.dtype), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                   # (tile, 1)
+    # the ONE set of family formulas (models/solvers/families.py) — pure
+    # jnp ops, so they lower inside the kernel; a hand-copied formula
+    # here could silently diverge from the XLA loss
+    from ..models.solvers.families import get_family
+
+    fam = get_family(family)
+    per = fam.pointwise(eta, yv)
+    resid = fam.mean(eta) - yv
+
+    @pl.when(i == 0)
+    def _init():
+        loss_ref[:] = jnp.zeros_like(loss_ref)
+        grad_ref[:] = jnp.zeros_like(grad_ref)
+
+    loss_ref[:] += jnp.sum(per * m, axis=0, keepdims=True)
+    grad_ref[:] += jax.lax.dot_general(
+        (resid * m).astype(x.dtype), x, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                   # (1, d) f32 accumulation
+
+
+@functools.partial(jax.jit, static_argnames=("family", "interpret"))
+def fused_glm_value_grad(x, n_valid, y, beta, family, interpret=False):
+    """(Σ pointwise-NLL, Σ ∂/∂β (d,)) of one (per-device) block in ONE
+    data pass. ``beta`` is f32 (d,); ``y`` f32 (n,); row validity is the
+    scalar prefix count ``n_valid`` (GLM padding is trailing per shard).
+    Callers psum both outputs across shards and add the penalty/mean
+    scaling in XLA."""
+    n, d = x.shape
+    y = y.astype(jnp.float32)
+    beta = beta.astype(jnp.float32)
+    tile = glm_tile(n, d, x.dtype.itemsize)
+    if tile is None:
+        raise ValueError(
+            f"design too wide for the fused GLM kernel VMEM budget "
+            f"(d={d}); use the XLA loss (use_pallas=False)"
+        )
+    n_pad = -(-n // tile) * tile
+    if n_pad != n:
+        x = jnp.pad(x, ((0, n_pad - n), (0, 0)))
+        y = jnp.pad(y, (0, n_pad - n))
+    grid = (n_pad // tile,)
+    nv = jnp.asarray(n_valid, jnp.int32).reshape(1, 1)
+    loss, grad = pl.pallas_call(
+        functools.partial(_glm_value_grad_kernel, tile=tile,
+                          family=family),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile, d), lambda i: (i, 0)),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((1, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, y[:, None], nv, beta[None, :])
+    return loss[0, 0], grad[0]
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

@@ -202,6 +202,21 @@ def main():
         assert o.transform(Xcat).shape == (3, 2)
         QuantileTransformer(n_quantiles=50, subsample=3000,
                             random_state=0).fit_transform(Xm)
+        # fused GLM value+grad Pallas kernel: on TPU the auto-gate runs
+        # it COMPILED in every smooth-solver fit above; assert parity
+        # against the XLA loss explicitly
+        interp = jax.default_backend() != "tpu"  # CPU dry-runs interpret
+        xla = LogisticRegression(solver="lbfgs", max_iter=30, tol=1e-8,
+                                 solver_kwargs={"use_pallas": False})
+        pal = LogisticRegression(solver="lbfgs", max_iter=30, tol=1e-8,
+                                 solver_kwargs={"use_pallas": True,
+                                                "pallas_interpret": interp})
+        yb2 = (ym.to_numpy() > 1).astype(np.float32)
+        xla.fit(Xm, yb2)
+        pal.fit(Xm, yb2)
+        assert np.allclose(pal.coef_, xla.coef_, atol=5e-3), (
+            np.abs(pal.coef_ - xla.coef_).max()
+        )
 
     for name, fn in [
         ("glm solvers x3 families", glms),
