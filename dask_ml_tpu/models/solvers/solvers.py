@@ -123,6 +123,44 @@ def _resolve_pallas(use_pallas, mesh, family, X=None):
     )
 
 
+def _pallas_fallback(make_run, use_pallas, auto, solver):
+    """Insurance for the AUTO-gated kernel: if the Pallas-enabled
+    program fails to compile/lower (an untested Mosaic shape corner),
+    the solve silently retries on the XLA loss instead of killing the
+    fit — but only when the kernel was auto-selected; an explicit
+    use_pallas=True surfaces the error."""
+    run = make_run(use_pallas)
+    if not (use_pallas and auto):
+        return run
+
+    state = {"run": run, "fell_back": False}
+
+    def guarded(**kw):
+        if state["fell_back"]:
+            return state["run"](**kw)
+        try:
+            # materialize INSIDE the try: jitted results dispatch
+            # asynchronously, so a post-compile runtime fault would
+            # otherwise surface later, outside this guard
+            return jax.block_until_ready(state["run"](**kw))
+        except Exception as exc:
+            import warnings
+
+            warnings.warn(
+                f"Pallas-enabled {solver} solve failed "
+                f"({type(exc).__name__}: {exc}); retrying on the XLA "
+                "loss — if the retry also fails, the original error was "
+                "not the kernel's", RuntimeWarning,
+            )
+            # LATCH the fallback: later chunks (checkpointed solves call
+            # run per chunk) must not re-attempt the failing compile
+            state["run"] = make_run(False)
+            state["fell_back"] = True
+            return state["run"](**kw)
+
+    return guarded
+
+
 def _host_scalars(*vals):
     """Fetch a handful of device result scalars in ONE device→host
     transfer — separate int()/float() pulls each pay a full round trip,
@@ -208,15 +246,21 @@ def lbfgs(X, y, mask, n_rows, beta0, family, reg, lam, pmask, l1_ratio=0.5,
     (beta, optimizer state, it) persisted after each — a killed 3-hour
     fit resumes mid-solve instead of from zero (VERDICT r2 #5)."""
     _check_smooth(reg, "lbfgs")
+    pallas_auto = use_pallas is None
     use_pallas = _resolve_pallas(use_pallas, mesh, family, X)
     opt = optax.lbfgs(memory_size=memory)
     carry = (beta0, opt.init(beta0), jnp.asarray(jnp.inf, beta0.dtype), 0)
     tol_a = jnp.asarray(tol, beta0.dtype)
-    run = partial(_lbfgs_chunk, X, y, mask, n_rows, lam=lam, pmask=pmask,
-                  l1_ratio=l1_ratio, tol=tol_a, family=family, reg=reg,
-                  memory=memory, log=log, use_pallas=use_pallas,
-                  mesh=mesh if use_pallas else None,
-                  interpret=pallas_interpret)
+
+    def make_run(with_pallas):
+        return partial(
+            _lbfgs_chunk, X, y, mask, n_rows, lam=lam, pmask=pmask,
+            l1_ratio=l1_ratio, tol=tol_a, family=family, reg=reg,
+            memory=memory, log=log, use_pallas=with_pallas,
+            mesh=mesh if with_pallas else None, interpret=pallas_interpret,
+        )
+
+    run = _pallas_fallback(make_run, use_pallas, pallas_auto, "lbfgs")
     resumed_from = 0
     if not (checkpoint_path and checkpoint_every):
         beta, state, gnorm, it = run(carry=carry,
@@ -305,13 +349,20 @@ def gradient_descent(X, y, mask, n_rows, beta0, family, reg, lam, pmask,
                      log=False, mesh=None, use_pallas=None,
                      pallas_interpret=False, **_):
     _check_smooth(reg, "gradient_descent")
+    pallas_auto = use_pallas is None
     use_pallas = _resolve_pallas(use_pallas, mesh, family, X)
-    beta, it, gnorm = _gd_run(
-        X, y, mask, n_rows, beta0, lam, pmask, l1_ratio,
-        jnp.asarray(max_iter), jnp.asarray(tol, beta0.dtype),
-        init_step, family, reg, log=log, use_pallas=use_pallas,
-        mesh=mesh if use_pallas else None, interpret=pallas_interpret,
-    )
+
+    def make_run(with_pallas):
+        return partial(
+            _gd_run, X, y, mask, n_rows, beta0, lam, pmask, l1_ratio,
+            jnp.asarray(max_iter), jnp.asarray(tol, beta0.dtype),
+            init_step, family, reg, log=log, use_pallas=with_pallas,
+            mesh=mesh if with_pallas else None, interpret=pallas_interpret,
+        )
+
+    beta, it, gnorm = _pallas_fallback(
+        make_run, use_pallas, pallas_auto, "gradient_descent"
+    )()
     it, gnorm = _host_scalars(it, gnorm)
     return beta, {"n_iter": int(it), "grad_norm": float(gnorm)}
 

@@ -75,3 +75,39 @@ def test_fused_glm_kernel_direct():
                                 interpret=True)
     np.testing.assert_allclose(float(v), v_ref, rtol=1e-5)
     np.testing.assert_allclose(np.asarray(g), g_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_auto_gate_falls_back_when_kernel_fails(monkeypatch):
+    """An auto-selected kernel that fails to compile must not kill the
+    fit: the solve retries on the XLA loss with a warning (an EXPLICIT
+    use_pallas=True still surfaces the error)."""
+    import warnings
+
+    from dask_ml_tpu.models.solvers import solvers as S
+
+    X, y = make_classification(n_samples=500, n_features=8, random_state=0)
+
+    real_chunk = S._lbfgs_chunk
+    calls = {"n": 0}
+
+    def flaky(*a, **kw):
+        if kw.get("use_pallas"):
+            calls["n"] += 1
+            raise RuntimeError("Mosaic lowering failed (simulated)")
+        return real_chunk(*a, **kw)
+
+    monkeypatch.setattr(S, "_lbfgs_chunk", flaky)
+    # force the auto gate open without a TPU: _resolve_pallas(None, ...)
+    monkeypatch.setattr(S, "_resolve_pallas",
+                        lambda up, mesh, fam, X=None: True if up is None
+                        else bool(up))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        clf = LogisticRegression(solver="lbfgs", max_iter=20).fit(X, y)
+    assert calls["n"] == 1
+    assert any("retrying on the XLA" in str(x.message) for x in w)
+    assert clf.score(X, y) > 0.7
+    # explicit opt-in: the error propagates
+    with pytest.raises(Exception, match="Mosaic"):
+        LogisticRegression(solver="lbfgs", max_iter=5,
+                           solver_kwargs={"use_pallas": True}).fit(X, y)
