@@ -79,14 +79,22 @@ def _pallas_loss(X, y, mask, n_rows, lam, pmask, l1_ratio, family, reg,
         )
         return f(beta, X, y, mask)
 
+    return _custom_vjp_loss(data_vg, n_rows, reg, lam, pmask, l1_ratio)
+
+
+def _custom_vjp_loss(data_vg, n_rows, reg, lam, pmask, l1_ratio):
+    """Wrap a kernel-backed ``beta -> (value, grad)`` into a scalar loss
+    whose autodiff uses the kernel's gradient (custom_vjp), plus the
+    penalty/mean scaling in XLA — the ONE copy of this scaffolding,
+    shared by the single- and multi-target Pallas paths."""
+
     @jax.custom_vjp
     def data_sum(beta):
         v, _ = data_vg(beta)
         return v
 
     def fwd(beta):
-        v, g = data_vg(beta)
-        return v, g
+        return data_vg(beta)
 
     def bwd(g, ct):
         return (ct * g,)
@@ -215,6 +223,12 @@ def _lbfgs_chunk(X, y, mask, n_rows, carry, lam, pmask, l1_ratio, stop_it,
         loss = partial(_smooth_loss, X=X, y=y, mask=mask, n_rows=n_rows,
                        lam=lam, pmask=pmask, l1_ratio=l1_ratio,
                        family=family, reg=reg)
+    return _lbfgs_loop(loss, carry, stop_it, tol, memory, log)
+
+
+def _lbfgs_loop(loss, carry, stop_it, tol, memory, log):
+    """The optax L-BFGS while_loop, shared by every loss flavor (XLA,
+    Pallas single-target, Pallas multi-target)."""
     opt = optax.lbfgs(memory_size=memory)
     value_and_grad = optax.value_and_grad_from_state(loss)
 
@@ -235,6 +249,46 @@ def _lbfgs_chunk(X, y, mask, n_rows, carry, lam, pmask, l1_ratio, stop_it,
         return beta, state, gnorm, it + 1
 
     return jax.lax.while_loop(cond, body, carry)
+
+
+@partial(jax.jit, static_argnames=("family", "reg", "memory", "log",
+                                   "mesh", "interpret", "n_classes"))
+def _lbfgs_multi_pallas_chunk(X, codes, mask, n_rows, carry, lam, pmask_t,
+                              l1_ratio, stop_it, tol, family, reg, mesh,
+                              n_classes, memory=10, log=False,
+                              interpret=False):
+    """Joint L-BFGS over the FLAT (C*d,) one-vs-rest vector whose data
+    term comes from the multi-target Pallas kernel: every iteration
+    reads X ONCE for all C classes (the vmapped XLA path reads it 2C
+    times — C forward matvecs + C gradient matmuls). The objective is
+    separable across classes, so the joint optimum equals the per-class
+    optima; ``pmask_t`` arrives tiled to (C*d,)."""
+    from ...ops.pallas_fused import fused_glm_multi_value_grad
+
+    d = pmask_t.shape[0] // n_classes
+
+    def data_vg(bflat):
+        B = bflat.reshape(n_classes, d)
+
+        def shard(Bs, xs, cs, ms):
+            nv = jnp.sum(ms.astype(jnp.int32))
+            v, g = fused_glm_multi_value_grad(
+                xs, nv, cs, Bs, family=family, interpret=interpret
+            )
+            return (jax.lax.psum(v, DATA_AXIS),
+                    jax.lax.psum(g, DATA_AXIS))
+
+        f = shard_map(
+            shard, mesh=mesh,
+            in_specs=(P(), P(DATA_AXIS, None), P(DATA_AXIS),
+                      P(DATA_AXIS)),
+            out_specs=(P(), P()),
+        )
+        v, g = f(B, X, codes, mask)
+        return v, g.reshape(-1)
+
+    loss = _custom_vjp_loss(data_vg, n_rows, reg, lam, pmask_t, l1_ratio)
+    return _lbfgs_loop(loss, carry, stop_it, tol, memory, log)
 
 
 def lbfgs(X, y, mask, n_rows, beta0, family, reg, lam, pmask, l1_ratio=0.5,
@@ -599,12 +653,69 @@ def solve_multi(solver, X, Y, mask, n_rows, B0, family, reg, lam, pmask,
     solvers fall back to a per-class loop of their single-target
     programs (correct, C launches)."""
     kwargs.pop("log", None)  # per-class step logs would interleave
+    use_pallas = kwargs.pop("use_pallas", None)
+    pallas_interpret = kwargs.pop("pallas_interpret", False)
+    pallas_auto = use_pallas is None
     # leftover kwargs (e.g. checkpoint_path/checkpoint_every) are only
     # honored by the single-target solver functions — fall back to the
     # per-class loop rather than silently dropping them
-    if solver in _VMAP_SOLVERS and not {
-        k for k in kwargs if k != "memory"
-    }:
+    plain_kwargs = not {k for k in kwargs if k != "memory"}
+    # fused multi-target path: logistic ONLY — the kernel rebuilds
+    # one-vs-rest 0/1 targets from class codes, which would destroy
+    # real-valued multi-output targets of other families
+    if (solver == "lbfgs" and plain_kwargs and family == "logistic"
+            and _resolve_pallas(use_pallas, mesh, family, None)):
+        from ...ops.pallas_fused import glm_multi_tile
+
+        C, d = B0.shape
+        fits_vmem = glm_multi_tile(X.shape[0], d, C,
+                                   X.dtype.itemsize) is not None
+        if fits_vmem:
+            _check_smooth(reg, solver)
+            memory = int(kwargs.get("memory", 10))
+            # class CODES from the one-hot target stack (padding rows
+            # are all-zero -> code 0, masked in-kernel)
+            codes = jnp.argmax(Y, axis=0).astype(jnp.float32)
+            pmask_t = jnp.tile(jnp.asarray(pmask), C)
+            b0 = B0.reshape(-1)
+            opt = optax.lbfgs(memory_size=memory)
+            carry = (b0, opt.init(b0),
+                     jnp.asarray(jnp.inf, b0.dtype), 0)
+            try:
+                beta, _state, gnorm, it = jax.block_until_ready(
+                    _lbfgs_multi_pallas_chunk(
+                        X, codes, mask, n_rows, carry, lam, pmask_t,
+                        l1_ratio, jnp.asarray(max_iter),
+                        jnp.asarray(tol, b0.dtype), family, reg, mesh,
+                        C, memory=memory, interpret=pallas_interpret,
+                    )
+                )
+            except Exception as exc:
+                if not pallas_auto:
+                    raise  # explicit opt-in surfaces the error
+                import warnings
+
+                warnings.warn(
+                    f"fused multi-target GLM solve failed "
+                    f"({type(exc).__name__}: {exc}); retrying on the "
+                    "vmapped XLA path", RuntimeWarning,
+                )
+            else:
+                it, gnorm = _host_scalars(it, gnorm)
+                info = {"n_iter": int(it), "grad_norm": float(gnorm),
+                        "fused_multi": True}
+                return check_finite_result(
+                    np.asarray(beta).reshape(C, d), info, solver
+                )
+        elif not pallas_auto:
+            raise ValueError(
+                f"design too wide for the fused multi-target GLM kernel "
+                f"(d={d}, C={C}) — explicit use_pallas=True cannot be "
+                "honored; unset it for the vmapped XLA path"
+            )
+    if solver in _VMAP_SOLVERS and plain_kwargs and not (
+        use_pallas and solver == "lbfgs"
+    ):
         _check_smooth(reg, solver)
         memory = int(kwargs.pop("memory", 10))
         opt = optax.lbfgs(memory_size=memory)
@@ -622,6 +733,13 @@ def solve_multi(solver, X, Y, mask, n_rows, B0, family, reg, lam, pmask,
         info = {"n_iter": int(np.max(np.asarray(it))),
                 "grad_norm": float(np.max(np.asarray(gnorm)))}
         return check_finite_result(beta, info, solver)
+    # per-class loop: forward the pallas knobs — the single-target
+    # solvers honor them (an explicit use_pallas request must not be
+    # silently dropped here)
+    if use_pallas is not None:
+        kwargs["use_pallas"] = use_pallas
+    if pallas_interpret:
+        kwargs["pallas_interpret"] = pallas_interpret
     betas, iters = [], []
     for c in range(Y.shape[0]):
         beta_c, info_c = solve(

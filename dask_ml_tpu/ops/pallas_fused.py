@@ -274,6 +274,111 @@ def fused_glm_value_grad(x, n_valid, y, beta, family, interpret=False):
     return loss[0, 0], grad[0]
 
 
+def _glm_multi_value_grad_kernel(x_ref, yc_ref, nv_ref, b_ref, loss_ref,
+                                 grad_ref, *, tile, family):
+    """Multi-target twin of ``_glm_value_grad_kernel``: ONE X pass
+    serves all C one-vs-rest problems. ``yc_ref`` holds class codes;
+    per-class 0/1 targets derive in-kernel from an iota compare, eta is
+    one (tile, C) MXU matmul against the stacked B, and the (C, d)
+    gradient accumulates with a second MXU contraction."""
+    i = pl.program_id(0)
+    x = x_ref[:]                       # (tile, d)
+    yc = yc_ref[:]                     # (tile, 1) f32 codes
+    B = b_ref[:]                       # (C, d) f32
+    C = B.shape[0]
+    row_ids = jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], 1), 0) \
+        + i * tile
+    m = (row_ids < nv_ref[0, 0]).astype(jnp.float32)    # (tile, 1)
+    eta = jax.lax.dot_general(
+        x, B.astype(x.dtype), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                   # (tile, C)
+    iota = jax.lax.broadcasted_iota(
+        jnp.int32, (x.shape[0], C), 1
+    ).astype(jnp.float32)
+    yv = (iota == yc).astype(jnp.float32)               # (tile, C)
+    from ..models.solvers.families import get_family
+
+    fam = get_family(family)
+    per = fam.pointwise(eta, yv) * m
+    resid = (fam.mean(eta) - yv) * m
+
+    @pl.when(i == 0)
+    def _init():
+        loss_ref[:] = jnp.zeros_like(loss_ref)
+        grad_ref[:] = jnp.zeros_like(grad_ref)
+
+    loss_ref[:] += jnp.sum(per, axis=0, keepdims=True).sum(
+        axis=1, keepdims=True
+    )
+    grad_ref[:] += jax.lax.dot_general(
+        resid.astype(x.dtype), x, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                   # (C, d)
+
+
+def glm_multi_tile(n, d, n_classes, itemsize):
+    """Row tile for the multi-target kernel bounded by the combined
+    VMEM footprint of the x block, the (tile, C) intermediates, and the
+    two (C, d) operands; None when no 128-row tile fits."""
+    tile = _pick_tile(n)
+
+    def cost(t):
+        return (t * d * itemsize + t * n_classes * 4 * 3
+                + 2 * n_classes * d * 4)
+
+    while tile > 128 and cost(tile) > _GLM_TILE_BUDGET:
+        tile //= 2
+    tile = max(tile, 128)
+    return tile if cost(tile) <= _GLM_TILE_BUDGET else None
+
+
+@functools.partial(jax.jit, static_argnames=("family", "interpret"))
+def fused_glm_multi_value_grad(x, n_valid, y_codes, B, family,
+                               interpret=False):
+    """(Σ pointwise-NLL over classes+rows, Σ ∂/∂B (C, d)) of one block
+    in ONE data pass — the reference analog would be C separate
+    dask-glm objective evaluations. ``y_codes`` holds class indices
+    0..C-1 (f32); callers psum both outputs across shards."""
+    n, d = x.shape
+    C = B.shape[0]
+    y_codes = y_codes.astype(jnp.float32)
+    B = B.astype(jnp.float32)
+    tile = glm_multi_tile(n, d, C, x.dtype.itemsize)
+    if tile is None:
+        raise ValueError(
+            f"design too wide for the fused multi-target GLM kernel "
+            f"(d={d}, C={C}); use the vmapped XLA path"
+        )
+    n_pad = -(-n // tile) * tile
+    if n_pad != n:
+        x = jnp.pad(x, ((0, n_pad - n), (0, 0)))
+        y_codes = jnp.pad(y_codes, (0, n_pad - n), constant_values=-1.0)
+    grid = (n_pad // tile,)
+    nv = jnp.asarray(n_valid, jnp.int32).reshape(1, 1)
+    loss, grad = pl.pallas_call(
+        functools.partial(_glm_multi_value_grad_kernel, tile=tile,
+                          family=family),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile, d), lambda i: (i, 0)),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+            pl.BlockSpec((C, d), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+            pl.BlockSpec((C, d), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((C, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, y_codes[:, None], nv, B)
+    return loss[0, 0], grad
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def fused_assign_update(x, mask, centers, interpret=False):
     """One Lloyd-iteration data pass over a (per-device) block.

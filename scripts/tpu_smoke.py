@@ -183,6 +183,11 @@ def main():
         )
         clf = LogisticRegression(solver="lbfgs", max_iter=40).fit(Xm, ym)
         assert clf.coef_.shape == (3, 16)
+        if jax.default_backend() == "tpu":
+            # auto-gate: the multi-target fused kernel (one X pass for
+            # all classes) must have carried the compiled solve
+            assert clf.solver_info_.get("fused_multi") is True, \
+                clf.solver_info_
         lp = clf.predict_log_proba(Xm)
         assert lp.shape == (6000, 3) and (lp <= 0).all()
         Xh, yh = Xm.to_numpy(), ym.to_numpy()

@@ -111,3 +111,19 @@ def test_auto_gate_falls_back_when_kernel_fails(monkeypatch):
     with pytest.raises(Exception, match="Mosaic"):
         LogisticRegression(solver="lbfgs", max_iter=5,
                            solver_kwargs={"use_pallas": True}).fit(X, y)
+
+
+def test_fused_multiclass_matches_vmapped():
+    """The flat multi-target kernel solve (one X pass for ALL classes
+    per iteration) converges to the vmapped per-class solution — the
+    objective is separable, so the joint optimum is the same."""
+    X, y = make_classification(n_samples=3000, n_features=16, n_classes=3,
+                               n_informative=9, random_state=1)
+    base = LogisticRegression(solver="lbfgs", max_iter=80,
+                              tol=1e-8).fit(X, y)
+    pal = LogisticRegression(solver="lbfgs", max_iter=80, tol=1e-8,
+                             solver_kwargs=PALLAS).fit(X, y)
+    assert pal.solver_info_.get("fused_multi") is True
+    assert base.solver_info_.get("fused_multi") is None
+    np.testing.assert_allclose(pal.coef_, base.coef_, atol=2e-3)
+    assert np.mean(pal.predict(X) == base.predict(X)) > 0.999
