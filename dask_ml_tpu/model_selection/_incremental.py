@@ -441,13 +441,42 @@ def _fit(model_factory, params_list, train_blocks, X_test, y_test, scorer,
         cohort = [models[mid] for mid in mids]
         cls = type(cohort[0])
         t0 = time.time()
-        for _ in range(n_calls):
-            cursor = meta[mids[0]]["block_cursor"] % n_blocks
-            Xb, yb = train_blocks[cursor]
-            cls._batched_partial_fit(cohort, Xb, yb)
+        fused = n_calls > 1 and hasattr(cls, "_batched_fused_calls")
+        if fused:
+            # the round's n_calls block steps collapse into ONE scan
+            # program (same updates and lr clocks as the call loop).
+            # Blocks are deduplicated — a multi-epoch rung revisits them
+            # through the order operand — and the stack must fit
+            # alongside the dataset (one block at a time otherwise).
+            cursor = meta[mids[0]]["block_cursor"]
+            idxs = [(cursor + i) % n_blocks for i in range(n_calls)]
+            uniq = sorted(set(idxs))
+            pos = {j: k for k, j in enumerate(uniq)}
+            stack_bytes = sum(
+                train_blocks[j][0].data.nbytes for j in uniq
+                if isinstance(train_blocks[j][0], ShardedArray)
+            )
+            from ..wrappers import _device_headroom_bytes
+
+            fused = _device_headroom_bytes(
+                stack_bytes, train_blocks[uniq[0]][0]
+            )
+        if fused:
+            cls._batched_fused_calls(
+                cohort, [train_blocks[j] for j in uniq],
+                order=[pos[j] for j in idxs],
+            )
             for mid in mids:
-                meta[mid]["block_cursor"] += 1
-                meta[mid]["partial_fit_calls"] += 1
+                meta[mid]["block_cursor"] += n_calls
+                meta[mid]["partial_fit_calls"] += n_calls
+        else:
+            for _ in range(n_calls):
+                cursor = meta[mids[0]]["block_cursor"] % n_blocks
+                Xb, yb = train_blocks[cursor]
+                cls._batched_partial_fit(cohort, Xb, yb)
+                for mid in mids:
+                    meta[mid]["block_cursor"] += 1
+                    meta[mid]["partial_fit_calls"] += 1
         cls._batch_publish(cohort, train_blocks[0][0].shape[1])
         fit_time = time.time() - t0
         t0 = time.time()

@@ -154,6 +154,42 @@ def _sgd_epoch(Xr, yr, order, W, t0, eta0, power_t, alpha, l2w, l1w,
     return W, t
 
 
+@partial(jax.jit, static_argnames=("loss",))
+def _sgd_cohort_scan(Xr, yr, NV, order, W, LRS, alphas, l2ws, l1ws,
+                     iflags, loss):
+    """Advance N cohort models through S block steps in ONE program:
+    ``lax.scan`` over ``order`` (indices into the DEDUPLICATED block
+    stack Xr (B, bs, d) — a rung asking for several epochs revisits
+    blocks without duplicating them in HBM) with the models vmapped
+    inside each step — the adaptive-search hot path's S separate
+    ``_batched_partial_fit`` dispatches collapse to one. ``LRS`` (S, N)
+    carries each model's host-precomputed lr schedule values; per-step
+    validity is the scalar prefix count ``NV[b]`` (take_rows blocks
+    have trailing padding)."""
+    bs = Xr.shape[1]
+    r = jnp.arange(bs)
+
+    def step(W, inp):
+        b, lrs = inp
+        Xb = jnp.take(Xr, b, axis=0)
+        yb = jnp.take(yr, b, axis=0)
+        nv = jnp.take(NV, b)
+        m = (r < nv).astype(jnp.float32)
+        n_valid = nv.astype(jnp.float32)
+
+        def one(w, lr, a, l2w, l1w, ifl):
+            return _sgd_update_one(w, yb, Xb, m, n_valid, lr, a, l2w,
+                                   l1w, ifl, loss)
+
+        W2, losses = jax.vmap(one, in_axes=(0, 0, 0, 0, 0, 0))(
+            W, lrs, alphas, l2ws, l1ws, iflags
+        )
+        return W2, losses
+
+    W, losses = jax.lax.scan(step, W, (order, LRS))
+    return W, losses[-1]
+
+
 import functools as _functools
 
 
@@ -412,6 +448,77 @@ class _SGDBase(BaseEstimator):
         the cohort, not one per model per step)."""
         for m in models:
             m._publish(d)
+
+    def _lr_schedule(self, n_calls):
+        """The next ``n_calls`` lr values this model's clock would
+        produce — EXACTLY ``_step_args``'s increment-then-``_lr``
+        sequence, precomputed on host so a fused multi-call program can
+        carry them as one (S,) operand."""
+        out = []
+        t0 = self._t
+        for i in range(n_calls):
+            self._t = t0 + i + 1
+            out.append(self._lr())
+        self._t = t0
+        return np.asarray(out, np.float32)
+
+    @classmethod
+    def _batched_fused_calls(cls, models, blocks, order=None):
+        """Advance the cohort through a sequence of block steps in ONE
+        scan program (``_sgd_cohort_scan``) — equivalent to that many
+        ``_batched_partial_fit`` calls (same updates, same per-model lr
+        clocks) minus the per-call dispatch round trips. ``blocks`` are
+        the DISTINCT blocks and ``order`` (default: each once, in
+        sequence) indexes the steps into them — a multi-epoch rung
+        revisits blocks without duplicating them on device. Blocks may
+        be ragged (the last data block is shorter): they stack padded
+        to the widest with per-block valid-row counts."""
+        if order is None:
+            order = list(range(len(blocks)))
+        S = len(order)
+        enc = models[0]
+        Xs_list, ys_list, nvs = [], [], []
+        for Xb, yb in blocks:
+            Xs = as_sharded(Xb, dtype=np.float32)
+            ys = as_sharded(enc._encode_y(yb), mesh=Xs.mesh,
+                            dtype=np.float32)
+            Xs_list.append(Xs)
+            ys_list.append(ys)
+            nvs.append(Xs.n_rows)
+        d = Xs_list[0].shape[1]
+        for m in models:
+            m._ensure_state(d)
+        bs_max = max(x.data.shape[0] for x in Xs_list)
+
+        def padded(a):
+            pad = bs_max - a.shape[0]
+            if pad:
+                a = jnp.pad(a, ((0, pad),) + ((0, 0),) * (a.ndim - 1))
+            return a
+
+        Xr = jnp.stack([padded(x.data) for x in Xs_list])
+        yr = jnp.stack([padded(y.data) for y in ys_list])
+        NV = jnp.asarray(nvs, jnp.int32)
+        LRS = jnp.asarray(np.stack(
+            [m._lr_schedule(S) for m in models], axis=1
+        ))                                   # (S, N)
+        args = np.asarray(
+            [(m.alpha,) + m._penalty_weights()
+             + (1.0 if m.fit_intercept else 0.0,) for m in models],
+            np.float32,
+        )
+        W = jnp.stack([m._w for m in models])
+        W, losses = _sgd_cohort_scan(
+            Xr, yr, NV, jnp.asarray(np.asarray(order, np.int32)), W,
+            LRS, jnp.asarray(args[:, 0]), jnp.asarray(args[:, 1]),
+            jnp.asarray(args[:, 2]), jnp.asarray(args[:, 3]),
+            enc._loss(),
+        )
+        for i, m in enumerate(models):
+            m._w = W[i]
+            m._last_loss = losses[i]
+            m._t += S
+        return models
 
     def _one_step(self, Xb, yb, mask, n_valid):
         lr, alpha, l2w, l1w, iflag = self._step_args()

@@ -34,14 +34,17 @@ def _data_shards(mesh):
     return data_shards(mesh)
 
 
-def _device_headroom_for_copy(X, fraction=0.5):
-    """True when a full second device copy of ``X`` plausibly fits:
-    per-device free bytes (when the runtime reports memory_stats — TPU
-    does, CPU returns None and passes) must cover the copy's per-device
-    share with ``fraction`` slack."""
+def _device_headroom_bytes(nbytes, sample, fraction=0.5):
+    """True when an extra device allocation of ``nbytes`` (sharded like
+    ``sample``) plausibly fits: per-device free bytes (when the runtime
+    reports memory_stats — TPU does, CPU returns None and passes) must
+    cover the per-device share with ``fraction`` slack."""
     try:
-        devs = list(X.data.devices())
-        per_dev = X.data.nbytes / max(len(devs), 1)
+        data = getattr(sample, "data", None)
+        if data is None:
+            return True  # host sample: no device copy involved
+        devs = list(data.devices())
+        per_dev = nbytes / max(len(devs), 1)
         for dev in devs:
             stats = dev.memory_stats()
             if not stats:
@@ -54,6 +57,11 @@ def _device_headroom_for_copy(X, fraction=0.5):
         return True
     except Exception:
         return True  # no reliable stats: assume fine (host-backed CPU)
+
+
+def _device_headroom_for_copy(X, fraction=0.5):
+    """True when a full second device copy of ``X`` plausibly fits."""
+    return _device_headroom_bytes(X.data.nbytes, X, fraction)
 
 
 def _is_device_estimator(est):

@@ -203,3 +203,44 @@ def test_cursor_diverged_device_models_progress(xy_classification):
     late = [r for r in history if r["partial_fit_calls"] >= 4]
     assert late and all(r["batch_size"] == 1 for r in late)
     assert all(r["executor"] == "sequential" for r in late)
+
+
+def test_cohort_fused_calls_match_loop():
+    """A cohort round's n_calls block steps fused into one scan program
+    (_batched_fused_calls) produce the SAME weights and lr clocks as
+    the per-call _batched_partial_fit loop, including ragged last
+    blocks and mixed lr schedules."""
+    import numpy as np
+
+    from dask_ml_tpu.models.sgd import SGDClassifier
+    from dask_ml_tpu.parallel import as_sharded
+    from dask_ml_tpu.parallel.sharded import take_rows
+
+    rng = np.random.RandomState(1)
+    n, d = 1300, 7
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    Xs, ys = as_sharded(X), as_sharded(y)
+    blocks = []
+    for s in range(0, n, 400):
+        idx = np.arange(s, min(s + 400, n))
+        blocks.append((take_rows(Xs, idx), take_rows(ys, idx)))
+
+    def cohort():
+        ms = [SGDClassifier(alpha=a, random_state=0, learning_rate=lr)
+              for a, lr in [(1e-4, "invscaling"), (1e-2, "optimal")]]
+        for m in ms:
+            m._batch_prepare({"classes": np.array([0.0, 1.0])})
+        return ms
+
+    loop = cohort()
+    for b in blocks:
+        SGDClassifier._batched_partial_fit(loop, *b)
+    SGDClassifier._batch_publish(loop, d)
+    fused = cohort()
+    SGDClassifier._batched_fused_calls(fused, blocks)
+    SGDClassifier._batch_publish(fused, d)
+    for l, f in zip(loop, fused):
+        np.testing.assert_allclose(f.coef_, l.coef_, atol=1e-6)
+        np.testing.assert_allclose(f.intercept_, l.intercept_, atol=1e-6)
+        assert l._t == f._t
