@@ -109,6 +109,19 @@ def _custom_vjp_loss(data_vg, n_rows, reg, lam, pmask, l1_ratio):
     return loss
 
 
+def _select_loss(use_pallas, X, y, mask, n_rows, lam, pmask, l1_ratio,
+                 family, reg, mesh, interpret):
+    """The ONE place a jitted solver body picks its smooth loss: the
+    fused Pallas value+grad (one X pass per evaluation) or the plain
+    XLA objective."""
+    if use_pallas:
+        return _pallas_loss(X, y, mask, n_rows, lam, pmask, l1_ratio,
+                            family, reg, mesh, interpret)
+    return partial(_smooth_loss, X=X, y=y, mask=mask, n_rows=n_rows,
+                   lam=lam, pmask=pmask, l1_ratio=l1_ratio,
+                   family=family, reg=reg)
+
+
 def _resolve_pallas(use_pallas, mesh, family, X=None):
     """Auto gate for the fused GLM kernel: real TPU backend, a plain
     data-parallel mesh (feature-sharded TP layouts keep the GSPMD
@@ -216,13 +229,8 @@ def _lbfgs_chunk(X, y, mask, n_rows, carry, lam, pmask, l1_ratio, stop_it,
     checkpointed path runs k-iteration chunks so (beta, optimizer state)
     hits stable storage between programs (SURVEY.md §5 checkpoint row —
     TPU slices fail whole, recovery is checkpoint-restart)."""
-    if use_pallas:
-        loss = _pallas_loss(X, y, mask, n_rows, lam, pmask, l1_ratio,
-                            family, reg, mesh, interpret)
-    else:
-        loss = partial(_smooth_loss, X=X, y=y, mask=mask, n_rows=n_rows,
-                       lam=lam, pmask=pmask, l1_ratio=l1_ratio,
-                       family=family, reg=reg)
+    loss = _select_loss(use_pallas, X, y, mask, n_rows, lam, pmask,
+                        l1_ratio, family, reg, mesh, interpret)
     return _lbfgs_loop(loss, carry, stop_it, tol, memory, log)
 
 
@@ -364,13 +372,8 @@ def lbfgs(X, y, mask, n_rows, beta0, family, reg, lam, pmask, l1_ratio=0.5,
 def _gd_run(X, y, mask, n_rows, beta0, lam, pmask, l1_ratio, max_iter, tol,
             init_step, family, reg, armijo=1e-4, backtrack=0.5, grow=2.0,
             log=False, use_pallas=False, mesh=None, interpret=False):
-    if use_pallas:
-        loss = _pallas_loss(X, y, mask, n_rows, lam, pmask, l1_ratio,
-                            family, reg, mesh, interpret)
-    else:
-        loss = partial(_smooth_loss, X=X, y=y, mask=mask, n_rows=n_rows,
-                       lam=lam, pmask=pmask, l1_ratio=l1_ratio,
-                       family=family, reg=reg)
+    loss = _select_loss(use_pallas, X, y, mask, n_rows, lam, pmask,
+                        l1_ratio, family, reg, mesh, interpret)
 
     def outer_cond(carry):
         beta, step, gnorm, it = carry
@@ -426,12 +429,14 @@ def gradient_descent(X, y, mask, n_rows, beta0, family, reg, lam, pmask,
 # non-smooth penalties via regularizers.prox
 # --------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("family", "reg", "log"))
+@partial(jax.jit, static_argnames=("family", "reg", "log", "use_pallas",
+                                   "mesh", "interpret"))
 def _pg_run(X, y, mask, n_rows, beta0, lam, pmask, l1_ratio, max_iter, tol,
-            init_step, family, reg, backtrack=0.5, grow=1.2, log=False):
-    smooth = partial(_smooth_loss, X=X, y=y, mask=mask, n_rows=n_rows,
-                     lam=lam * 0.0, pmask=pmask, l1_ratio=l1_ratio,
-                     family=family, reg="none")  # penalty handled by prox
+            init_step, family, reg, backtrack=0.5, grow=1.2, log=False,
+            use_pallas=False, mesh=None, interpret=False):
+    # penalty handled by the prox: the selected loss is smooth-only
+    smooth = _select_loss(use_pallas, X, y, mask, n_rows, lam * 0.0,
+                          pmask, l1_ratio, family, "none", mesh, interpret)
 
     def outer_cond(carry):
         beta, step, delta, it = carry
@@ -467,12 +472,22 @@ def _pg_run(X, y, mask, n_rows, beta0, lam, pmask, l1_ratio, max_iter, tol,
 
 def proximal_grad(X, y, mask, n_rows, beta0, family, reg, lam, pmask,
                   l1_ratio=0.5, max_iter=100, tol=1e-7, init_step=1.0,
-                  log=False, **_):
-    beta, it, delta = _pg_run(
-        X, y, mask, n_rows, beta0, lam, pmask, l1_ratio,
-        jnp.asarray(max_iter), jnp.asarray(tol, beta0.dtype),
-        init_step, family, reg, log=log,
-    )
+                  log=False, mesh=None, use_pallas=None,
+                  pallas_interpret=False, **_):
+    pallas_auto = use_pallas is None
+    use_pallas = _resolve_pallas(use_pallas, mesh, family, X)
+
+    def make_run(with_pallas):
+        return partial(
+            _pg_run, X, y, mask, n_rows, beta0, lam, pmask, l1_ratio,
+            jnp.asarray(max_iter), jnp.asarray(tol, beta0.dtype),
+            init_step, family, reg, log=log, use_pallas=with_pallas,
+            mesh=mesh if with_pallas else None, interpret=pallas_interpret,
+        )
+
+    beta, it, delta = _pallas_fallback(
+        make_run, use_pallas, pallas_auto, "proximal_grad"
+    )()
     it, delta = _host_scalars(it, delta)
     return beta, {"n_iter": int(it), "opt_residual": float(delta)}
 

@@ -127,3 +127,25 @@ def test_fused_multiclass_matches_vmapped():
     assert base.solver_info_.get("fused_multi") is None
     np.testing.assert_allclose(pal.coef_, base.coef_, atol=2e-3)
     assert np.mean(pal.predict(X) == base.predict(X)) > 0.999
+
+
+@pytest.mark.parametrize("Est,maker,pen", [
+    (LogisticRegression, make_classification, "l1"),
+    (LinearRegression, make_regression, "elastic_net"),
+])
+def test_fused_proximal_grad_matches_xla(Est, maker, pen):
+    """proximal_grad's smooth part through the fused kernel: relative
+    coefficient parity with the XLA loss. Support membership can flip
+    only for coefficients AT the prox threshold (near-zero on both
+    sides) — accumulation-order noise, not divergence."""
+    X, y = maker(n_samples=3000, n_features=18, random_state=0)
+    kw = dict(solver="proximal_grad", penalty=pen, max_iter=120, tol=1e-9)
+    base = Est(**kw).fit(X, y)
+    pal = Est(**kw, solver_kwargs=PALLAS).fit(X, y)
+    c0 = np.asarray(base.coef_, float)
+    c1 = np.asarray(pal.coef_, float)
+    scale = max(np.abs(c0).max(), 1e-12)
+    assert np.abs(c1 - c0).max() / scale < 5e-3
+    flipped = (np.abs(c0) > 1e-6) != (np.abs(c1) > 1e-6)
+    assert (np.abs(c0)[flipped] < 1e-3 * scale).all()
+    assert (np.abs(c1)[flipped] < 1e-3 * scale).all()
