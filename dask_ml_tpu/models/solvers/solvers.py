@@ -64,22 +64,33 @@ def _pallas_loss(X, y, mask, n_rows, lam, pmask, l1_ratio, family, reg,
     from ...ops.pallas_fused import fused_glm_value_grad
 
     def data_vg(beta):
-        def shard(bs, xs, ys, ms):
-            nv = jnp.sum(ms.astype(jnp.int32))
-            v, g = fused_glm_value_grad(xs, nv, ys, bs, family=family,
-                                        interpret=interpret)
-            return (jax.lax.psum(v, DATA_AXIS),
-                    jax.lax.psum(g, DATA_AXIS))
-
-        f = shard_map(
-            shard, mesh=mesh,
-            in_specs=(P(), P(DATA_AXIS, None), P(DATA_AXIS),
-                      P(DATA_AXIS)),
-            out_specs=(P(), P()),
+        return _shard_psum_call(
+            mesh,
+            lambda bs, xs, ys, ms, nv: fused_glm_value_grad(
+                xs, nv, ys, bs, family=family, interpret=interpret
+            ),
+            2, beta, X, y, mask,
         )
-        return f(beta, X, y, mask)
 
     return _custom_vjp_loss(data_vg, n_rows, reg, lam, pmask, l1_ratio)
+
+
+def _shard_psum_call(mesh, per_shard, n_out, beta, X, y, mask):
+    """Run a per-shard GLM kernel under shard_map and psum its
+    ``n_out`` partial outputs — the ONE copy of the (specs, prefix
+    valid-row count, psum) sharding contract used by every fused
+    solver path."""
+    def shard(bs, xs, ys, ms):
+        nv = jnp.sum(ms.astype(jnp.int32))
+        outs = per_shard(bs, xs, ys, ms, nv)
+        return tuple(jax.lax.psum(o, DATA_AXIS) for o in outs)
+
+    f = shard_map(
+        shard, mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=tuple(P() for _ in range(n_out)),
+    )
+    return f(beta, X, y, mask)
 
 
 def _custom_vjp_loss(data_vg, n_rows, reg, lam, pmask, l1_ratio):
@@ -276,23 +287,13 @@ def _lbfgs_multi_pallas_chunk(X, codes, mask, n_rows, carry, lam, pmask_t,
     d = pmask_t.shape[0] // n_classes
 
     def data_vg(bflat):
-        B = bflat.reshape(n_classes, d)
-
-        def shard(Bs, xs, cs, ms):
-            nv = jnp.sum(ms.astype(jnp.int32))
-            v, g = fused_glm_multi_value_grad(
+        v, g = _shard_psum_call(
+            mesh,
+            lambda Bs, xs, cs, ms, nv: fused_glm_multi_value_grad(
                 xs, nv, cs, Bs, family=family, interpret=interpret
-            )
-            return (jax.lax.psum(v, DATA_AXIS),
-                    jax.lax.psum(g, DATA_AXIS))
-
-        f = shard_map(
-            shard, mesh=mesh,
-            in_specs=(P(), P(DATA_AXIS, None), P(DATA_AXIS),
-                      P(DATA_AXIS)),
-            out_specs=(P(), P()),
+            ),
+            2, bflat.reshape(n_classes, d), X, codes, mask,
         )
-        v, g = f(B, X, codes, mask)
         return v, g.reshape(-1)
 
     loss = _custom_vjp_loss(data_vg, n_rows, reg, lam, pmask_t, l1_ratio)
@@ -496,14 +497,32 @@ def proximal_grad(X, y, mask, n_rows, beta0, family, reg, lam, pmask,
 # Newton (dask_glm::newton) with step-halving safeguard, fully on device
 # --------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("family", "reg", "log"))
+@partial(jax.jit, static_argnames=("family", "reg", "log", "use_pallas",
+                                   "mesh", "interpret"))
 def _newton_run(X, y, mask, n_rows, beta0, lam, pmask, l1_ratio, max_iter, tol,
-                family, reg, log=False):
+                family, reg, log=False, use_pallas=False, mesh=None,
+                interpret=False):
     fam = get_family(family)
-    loss = partial(_smooth_loss, X=X, y=y, mask=mask, n_rows=n_rows, lam=lam,
-                   pmask=pmask, l1_ratio=l1_ratio, family=family, reg=reg)
+    loss = _select_loss(use_pallas, X, y, mask, n_rows, lam, pmask,
+                        l1_ratio, family, reg, mesh, interpret)
     d = beta0.shape[0]
     ridge = (lam * pmask if reg == "l2" else jnp.zeros_like(pmask)) + 1e-8
+
+    if use_pallas:
+        from ...ops.pallas_fused import fused_glm_value_grad_hess
+
+        def vgh(beta):
+            vs, gs, hs = _shard_psum_call(
+                mesh,
+                lambda bs, xs, ys, ms, nv: fused_glm_value_grad_hess(
+                    xs, nv, ys, bs, family=family, interpret=interpret
+                ),
+                3, beta, X, y, mask,
+            )
+            pen, pen_g = jax.value_and_grad(
+                lambda b: regularizers.value(reg, b, lam, pmask, l1_ratio)
+            )(beta)
+            return (vs / n_rows + pen, gs / n_rows + pen_g, hs / n_rows)
 
     def cond(carry):
         beta, gnorm, it = carry
@@ -511,11 +530,18 @@ def _newton_run(X, y, mask, n_rows, beta0, lam, pmask, l1_ratio, max_iter, tol,
 
     def body(carry):
         beta, _, it = carry
-        val, grad = jax.value_and_grad(loss)(beta)
-        eta = X @ beta
-        w = fam.hess_weight(eta, y) * mask
-        # (d, d) Hessian: per-shard X^T W X + ICI psum, replicated solve
-        hess = (X * w[:, None]).T @ X / n_rows + jnp.diag(ridge)
+        if use_pallas:
+            # Newton's whole data touch in one X pass (eta + grad +
+            # weighted Hessian statistics come from the fused kernel)
+            val, grad, hess = vgh(beta)
+            hess = hess + jnp.diag(ridge)
+        else:
+            val, grad = jax.value_and_grad(loss)(beta)
+            eta = X @ beta
+            w = fam.hess_weight(eta, y) * mask
+            # (d, d) Hessian: per-shard X^T W X + ICI psum, replicated
+            # solve
+            hess = (X * w[:, None]).T @ X / n_rows + jnp.diag(ridge)
         # lstsq, not solve: stays finite on singular Hessians
         # (underdetermined n < d fits return the min-norm step)
         delta = jnp.linalg.lstsq(hess, grad)[0]
@@ -537,13 +563,31 @@ def _newton_run(X, y, mask, n_rows, beta0, lam, pmask, l1_ratio, max_iter, tol,
 
 
 def newton(X, y, mask, n_rows, beta0, family, reg, lam, pmask, l1_ratio=0.5,
-           max_iter=50, tol=1e-6, log=False, **_):
+           max_iter=50, tol=1e-6, log=False, mesh=None, use_pallas=None,
+           pallas_interpret=False, **_):
     _check_smooth(reg, "newton")
-    beta, it, gnorm = _newton_run(
-        X, y, mask, n_rows, beta0, lam, pmask, l1_ratio,
-        jnp.asarray(max_iter), jnp.asarray(tol, beta0.dtype), family, reg,
-        log=log,
-    )
+    pallas_auto = use_pallas is None
+    use_pallas = _resolve_pallas(use_pallas, mesh, family, X)
+    if use_pallas and pallas_auto:
+        # Newton's kernel also carries a (d, d) accumulator — its VMEM
+        # budget is tighter than the value+grad kernel's
+        from ...ops.pallas_fused import glm_newton_tile
+
+        use_pallas = glm_newton_tile(
+            X.shape[0], X.shape[1], X.dtype.itemsize
+        ) is not None
+
+    def make_run(with_pallas):
+        return partial(
+            _newton_run, X, y, mask, n_rows, beta0, lam, pmask, l1_ratio,
+            jnp.asarray(max_iter), jnp.asarray(tol, beta0.dtype), family,
+            reg, log=log, use_pallas=with_pallas,
+            mesh=mesh if with_pallas else None, interpret=pallas_interpret,
+        )
+
+    beta, it, gnorm = _pallas_fallback(
+        make_run, use_pallas, pallas_auto, "newton"
+    )()
     it, gnorm = _host_scalars(it, gnorm)
     return beta, {"n_iter": int(it), "grad_norm": float(gnorm)}
 

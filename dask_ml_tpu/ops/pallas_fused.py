@@ -46,18 +46,23 @@ def _pick_tile(n):
 _GLM_TILE_BUDGET = 4 * 1024 * 1024  # x-block bytes kept well under VMEM
 
 
-def glm_tile(n, d, itemsize):
-    """Row tile for the GLM kernel bounded by BOTH n and the x-block's
-    VMEM footprint (tile*d*itemsize); None when even a 128-row tile of a
-    very wide design would blow the budget — callers then keep the XLA
-    loss (its matmuls tile the feature dim freely)."""
+def _budget_tile(n, cost):
+    """Shrink the row tile until ``cost(tile)`` fits the VMEM budget
+    (128-row Mosaic floor); None when nothing fits — the ONE copy of
+    the halve-until-budget rule for every GLM kernel gate."""
     tile = _pick_tile(n)
-    while tile > 128 and tile * d * itemsize > _GLM_TILE_BUDGET:
+    while tile > 128 and cost(tile) > _GLM_TILE_BUDGET:
         tile //= 2
     tile = max(tile, 128)
-    if tile * d * itemsize > _GLM_TILE_BUDGET:
-        return None
-    return tile
+    return tile if cost(tile) <= _GLM_TILE_BUDGET else None
+
+
+def glm_tile(n, d, itemsize):
+    """Row tile for the GLM kernel bounded by BOTH n and the x-block's
+    VMEM footprint; None when even a 128-row tile of a very wide design
+    would blow the budget — callers then keep the XLA loss (its matmuls
+    tile the feature dim freely)."""
+    return _budget_tile(n, lambda t: t * d * itemsize)
 
 
 def _assign_update_kernel(x_ref, m_ref, c_ref, c2_ref, labels_ref, mind_ref,
@@ -185,6 +190,32 @@ def fused_lloyd_stats(x, n_valid, centers, interpret=False):
     return sums, counts[0], inertia[0, 0]
 
 
+def _tile_mask(x, nv_ref, i, tile):
+    """Per-tile prefix-validity mask from the global row index vs the
+    scalar valid-row count — shared by every GLM kernel."""
+    row_ids = jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], 1), 0) \
+        + i * tile
+    return (row_ids < nv_ref[0, 0]).astype(jnp.float32)  # (tile, 1)
+
+
+def _glm_eta_terms(x, yv, b, family):
+    """eta (matvec at x's dtype so bf16 rides the MXU at bf16 rate, f32
+    accum — solvers._smooth_loss's contract) plus the family's pointwise
+    NLL / residual. Family formulas come from
+    models/solvers/families.py — pure jnp ops that lower inside the
+    kernel, so the Pallas and XLA losses cannot diverge."""
+    eta = jax.lax.dot_general(
+        x, b.astype(x.dtype), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                   # (tile, 1)
+    from ..models.solvers.families import get_family
+
+    fam = get_family(family)
+    per = fam.pointwise(eta, yv)
+    resid = fam.mean(eta) - yv
+    return fam, eta, per, resid
+
+
 def _glm_value_grad_kernel(x_ref, y_ref, nv_ref, b_ref, loss_ref, grad_ref,
                            *, tile, family):
     """One X pass computing Σ pointwise-NLL AND Σ ∂NLL/∂β.
@@ -199,23 +230,8 @@ def _glm_value_grad_kernel(x_ref, y_ref, nv_ref, b_ref, loss_ref, grad_ref,
     x = x_ref[:]                       # (tile, d) — f32 or bf16
     yv = y_ref[:]                      # (tile, 1) f32
     b = b_ref[:]                       # (1, d) f32
-    row_ids = jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], 1), 0) \
-        + i * tile
-    m = (row_ids < nv_ref[0, 0]).astype(jnp.float32)    # (tile, 1)
-    # matvec at x's dtype (bf16 rides the MXU at bf16 rate), f32 accum —
-    # the same contract as solvers._smooth_loss
-    eta = jax.lax.dot_general(
-        x, b.astype(x.dtype), (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )                                   # (tile, 1)
-    # the ONE set of family formulas (models/solvers/families.py) — pure
-    # jnp ops, so they lower inside the kernel; a hand-copied formula
-    # here could silently diverge from the XLA loss
-    from ..models.solvers.families import get_family
-
-    fam = get_family(family)
-    per = fam.pointwise(eta, yv)
-    resid = fam.mean(eta) - yv
+    m = _tile_mask(x, nv_ref, i, tile)
+    _, _, per, resid = _glm_eta_terms(x, yv, b, family)
 
     @pl.when(i == 0)
     def _init():
@@ -274,6 +290,87 @@ def fused_glm_value_grad(x, n_valid, y, beta, family, interpret=False):
     return loss[0, 0], grad[0]
 
 
+def _glm_vgh_kernel(x_ref, y_ref, nv_ref, b_ref, loss_ref, grad_ref,
+                    hess_ref, *, tile, family):
+    """Newton's whole data touch in one X pass: Σ NLL, Σ ∂/∂β, AND the
+    Σ XᵀWX Gauss-Newton Hessian — the XLA path reads X ~3x per
+    iteration (forward, gradient, weighted Hessian matmul)."""
+    i = pl.program_id(0)
+    x = x_ref[:]                       # (tile, d)
+    yv = y_ref[:]                      # (tile, 1)
+    b = b_ref[:]                       # (1, d)
+    m = _tile_mask(x, nv_ref, i, tile)
+    fam, eta, per, resid = _glm_eta_terms(x, yv, b, family)
+    w = fam.hess_weight(eta, yv) * m                    # (tile, 1)
+
+    @pl.when(i == 0)
+    def _init():
+        loss_ref[:] = jnp.zeros_like(loss_ref)
+        grad_ref[:] = jnp.zeros_like(grad_ref)
+        hess_ref[:] = jnp.zeros_like(hess_ref)
+
+    loss_ref[:] += jnp.sum(per * m, axis=0, keepdims=True)
+    grad_ref[:] += jax.lax.dot_general(
+        (resid * m).astype(x.dtype), x, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    xw = x * w.astype(x.dtype)
+    hess_ref[:] += jax.lax.dot_general(
+        xw, x, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                   # (d, d)
+
+
+def glm_newton_tile(n, d, itemsize):
+    """Row tile for the Newton kernel: budget covers the x block, the
+    weighted copy, and the (d, d) Hessian accumulator."""
+    return _budget_tile(n, lambda t: 2 * t * d * itemsize + d * d * 4)
+
+
+@functools.partial(jax.jit, static_argnames=("family", "interpret"))
+def fused_glm_value_grad_hess(x, n_valid, y, beta, family,
+                              interpret=False):
+    """(Σ NLL, Σ ∂/∂β (d,), Σ XᵀWX (d, d)) of one block in ONE pass —
+    the per-shard Newton statistics; callers psum all three."""
+    n, d = x.shape
+    y = y.astype(jnp.float32)
+    beta = beta.astype(jnp.float32)
+    tile = glm_newton_tile(n, d, x.dtype.itemsize)
+    if tile is None:
+        raise ValueError(
+            f"design too wide for the fused Newton kernel (d={d}); use "
+            "the XLA path (use_pallas=False)"
+        )
+    n_pad = -(-n // tile) * tile
+    if n_pad != n:
+        x = jnp.pad(x, ((0, n_pad - n), (0, 0)))
+        y = jnp.pad(y, (0, n_pad - n))
+    grid = (n_pad // tile,)
+    nv = jnp.asarray(n_valid, jnp.int32).reshape(1, 1)
+    loss, grad, hess = pl.pallas_call(
+        functools.partial(_glm_vgh_kernel, tile=tile, family=family),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile, d), lambda i: (i, 0)),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((d, d), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((1, d), jnp.float32),
+            jax.ShapeDtypeStruct((d, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, y[:, None], nv, beta[None, :])
+    return loss[0, 0], grad[0], hess
+
+
 def _glm_multi_value_grad_kernel(x_ref, yc_ref, nv_ref, b_ref, loss_ref,
                                  grad_ref, *, tile, family):
     """Multi-target twin of ``_glm_value_grad_kernel``: ONE X pass
@@ -321,16 +418,9 @@ def glm_multi_tile(n, d, n_classes, itemsize):
     """Row tile for the multi-target kernel bounded by the combined
     VMEM footprint of the x block, the (tile, C) intermediates, and the
     two (C, d) operands; None when no 128-row tile fits."""
-    tile = _pick_tile(n)
-
-    def cost(t):
-        return (t * d * itemsize + t * n_classes * 4 * 3
-                + 2 * n_classes * d * 4)
-
-    while tile > 128 and cost(tile) > _GLM_TILE_BUDGET:
-        tile //= 2
-    tile = max(tile, 128)
-    return tile if cost(tile) <= _GLM_TILE_BUDGET else None
+    return _budget_tile(n, lambda t: (
+        t * d * itemsize + t * n_classes * 4 * 3 + 2 * n_classes * d * 4
+    ))
 
 
 @functools.partial(jax.jit, static_argnames=("family", "interpret"))

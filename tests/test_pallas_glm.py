@@ -149,3 +149,25 @@ def test_fused_proximal_grad_matches_xla(Est, maker, pen):
     flipped = (np.abs(c0) > 1e-6) != (np.abs(c1) > 1e-6)
     assert (np.abs(c0)[flipped] < 1e-3 * scale).all()
     assert (np.abs(c1)[flipped] < 1e-3 * scale).all()
+
+
+@pytest.mark.parametrize("name,maker,Est", [
+    ("logistic", make_classification, LogisticRegression),
+    ("normal", make_regression, LinearRegression),
+    ("poisson", make_counts, PoissonRegression),
+])
+def test_fused_newton_matches_xla(name, maker, Est):
+    """Newton through the fused value+grad+Hessian kernel (one X pass
+    for its whole data touch) matches the XLA path."""
+    X, y = maker(n_samples=3000, n_features=20, random_state=0)
+    base = Est(solver="newton", max_iter=40, tol=1e-9).fit(X, y)
+    pal = Est(solver="newton", max_iter=40, tol=1e-9,
+              solver_kwargs=PALLAS).fit(X, y)
+    np.testing.assert_allclose(pal.coef_, base.coef_, atol=5e-4)
+
+
+def test_newton_tile_budget():
+    from dask_ml_tpu.ops.pallas_fused import glm_newton_tile
+
+    assert glm_newton_tile(100_000, 128, 4) is not None
+    assert glm_newton_tile(100_000, 2000, 4) is None  # (d,d) too big
