@@ -8,10 +8,13 @@ else builds the vocabulary distributedly.
 
 TPU design decision (SURVEY.md §7 hard parts, "Sparse"): tokenization and
 hashing are host-side string work (sklearn's C kernels per block — same
-per-block engine as the reference); the TPU-facing contract is
-``to_sharded_dense``: hash to a *modest* ``n_features`` and densify onto
-the mesh, the representation GLM/KMeans consume. Sparse CSR stays on host
-otherwise.
+per-block engine as the reference); the TPU-facing bridge is STREAMING:
+a CSR corpus fed to any streamed fit (``LogisticRegression().fit(csr,
+y)``, ``Incremental(SGDClassifier())``) densifies ONE fixed-shape block
+at a time into the prefetched device buffer (``parallel.streaming``),
+so peak host/device memory is O(block) at any ``n_features`` — the
+analog of the reference streaming CSR chunks through per-block sklearn
+partial_fit. ``to_sharded_dense`` remains the small-corpus shortcut.
 """
 
 from __future__ import annotations

@@ -113,10 +113,13 @@ class PCA(TransformerMixin, BaseEstimator):
             frac, k = self.n_components, min(n, d)
         else:
             k = _resolve_n_components(self.n_components, n, d)
+        from ..parallel.streaming import _slice_dense
+
         stream = BlockStream((X,), block_rows=block_rows)
         # shift estimate from a small head slice (exactness not needed —
-        # any shift near the mean kills the cancellation)
-        shift = np.asarray(X[: min(4096, n)], np.float64).mean(axis=0)
+        # any shift near the mean kills the cancellation); _slice_dense
+        # handles sparse sources (one small densified slice)
+        shift = _slice_dense(X, 0, min(4096, n), np.float64).mean(axis=0)
         shift_dev = jnp.asarray(shift, jnp.float32)
         s = np.zeros(d, np.float64)
         g = np.zeros((d, d), np.float64)

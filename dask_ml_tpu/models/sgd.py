@@ -592,10 +592,13 @@ class _SGDBase(BaseEstimator):
                 self.classes_ = None  # fresh fit re-derives classes
         if isinstance(X, ShardedArray):
             return self._fit_device(X, y, kwargs)
-        n_blocks = 8
-        from ..parallel.streaming import BlockStream
+        from ..parallel.streaming import (BlockStream, _is_sparse_source,
+                                          fit_block_rows)
 
-        Xh = np.asarray(X)
+        # sparse X streams as-is: BlockStream densifies one block at a
+        # time (the text-pipeline bridge — a whole-corpus np.asarray
+        # would materialize the dense matrix this path exists to avoid)
+        Xh = X if _is_sparse_source(X) else np.asarray(X)
         yh = y.to_numpy() if isinstance(y, ShardedArray) else np.asarray(y)
         if isinstance(self, ClassifierMixin):
             classes = kwargs.get("classes")
@@ -605,7 +608,7 @@ class _SGDBase(BaseEstimator):
                 self._set_classes(np.unique(yh))
         stream = BlockStream(
             (Xh, np.asarray(self._encode_y(yh))),
-            block_rows=max(len(Xh) // n_blocks, 1),
+            block_rows=fit_block_rows(Xh),
             shuffle=self.shuffle, seed=self.random_state,
         )
         self._ensure_state(Xh.shape[1])
@@ -620,6 +623,21 @@ class _SGDBase(BaseEstimator):
         X = as_sharded(X, dtype=np.float32)
         w = self._w
         return X, X.data @ w[:-1] + w[-1]
+
+    def _eta_stream(self, X, block_rows):
+        """Decision values for out-of-core / sparse X: blocks stream
+        through the fitted weights, (n,) or (n, C) host result — same
+        bridge as the GLM predict paths."""
+        from ..parallel.streaming import streamed_map
+
+        W = self._w
+        if self._n_out() is not None:
+            return streamed_map(
+                X, block_rows, lambda blk: _batched_eta(blk.arrays[0], W)
+            )
+        return streamed_map(
+            X, block_rows, lambda blk: blk.arrays[0] @ W[:-1] + W[-1]
+        )
 
     def _encode_y(self, y):
         if isinstance(y, ShardedArray):
@@ -749,6 +767,11 @@ class SGDClassifier(ClassifierMixin, _SGDBase):
 
     def decision_function(self, X):
         check_is_fitted(self, "coef_")
+        from ..parallel.streaming import stream_plan
+
+        block_rows = stream_plan(X)
+        if block_rows is not None:
+            return self._eta_stream(X, block_rows)
         if self._n_out() is not None:
             Xs = as_sharded(X, dtype=np.float32)
             eta = _batched_eta(Xs.data, self._w)   # (n, C)
@@ -766,13 +789,12 @@ class SGDClassifier(ClassifierMixin, _SGDBase):
         if self._loss() != "log_loss":
             raise AttributeError("predict_proba requires loss='log_loss'")
         check_is_fitted(self, "coef_")
-        if self._n_out() is not None:
-            from scipy.special import expit
+        from scipy.special import expit
 
+        if self._n_out() is not None:
             p = expit(self.decision_function(X))   # OvR sigmoids
             return p / np.maximum(p.sum(axis=1, keepdims=True), 1e-12)
-        X, eta = self._decision(X)
-        p1 = to_host(jax.nn.sigmoid(eta))[: X.n_rows]
+        p1 = expit(self.decision_function(X))
         return np.stack([1 - p1, p1], axis=1)
 
     def score(self, X, y):
@@ -809,6 +831,11 @@ class SGDRegressor(RegressorMixin, _SGDBase):
 
     def predict(self, X):
         check_is_fitted(self, "coef_")
+        from ..parallel.streaming import stream_plan
+
+        block_rows = stream_plan(X)
+        if block_rows is not None:
+            return self._eta_stream(X, block_rows)
         X, eta = self._decision(X)
         return to_host(eta)[: X.n_rows]
 

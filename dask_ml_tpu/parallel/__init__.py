@@ -3,4 +3,5 @@ from .frames import PartitionedFrame, from_pandas
 from .mesh import (DATA_AXIS, MODEL_AXIS, default_mesh, device_mesh,
                    resolve_mesh, use_mesh)
 from .sharded import ShardedArray, as_sharded, reshard, row_mask, take_rows
-from .streaming import Block, BlockStream, stream_plan, streamed_map
+from .streaming import (Block, BlockStream, SparseBlocks, stream_plan,
+                        streamed_map)

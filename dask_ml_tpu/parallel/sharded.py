@@ -104,6 +104,19 @@ class ShardedArray:
         """
         if isinstance(x, ShardedArray):
             return x if dtype is None else cls(x.data.astype(dtype), x.n_rows, x.mesh)
+        import scipy.sparse as sp
+
+        if sp.issparse(x):
+            # densify-on-placement: correct for BLOCK-sized sparse inputs
+            # (an Incremental partial_fit block). Whole-corpus sparse fits
+            # never reach here — estimator fit paths route sparse through
+            # stream_plan/BlockStream, which densifies one block at a
+            # time. Cast the nnz values BEFORE toarray so the transient
+            # is one dense block at the target dtype, not a float64
+            # block plus its cast copy.
+            if dtype is not None and x.dtype != dtype:
+                x = x.astype(dtype)
+            x = x.toarray()
         mesh = resolve_mesh(mesh)
         on_device = isinstance(x, jax.Array) and not isinstance(
             x, jax.core.Tracer

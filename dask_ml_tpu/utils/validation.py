@@ -134,7 +134,16 @@ def data_fingerprint(a, n_sample=96) -> str:
 
         sample = take_rows(a, idx).to_numpy()
     else:
-        sample = np.asarray(a)[idx]
+        from ..parallel.streaming import _is_sparse_source, _slice_dense
+
+        if _is_sparse_source(a):
+            # sampled rows densify one at a time — O(sample), not O(n·d)
+            sample = np.concatenate([
+                _slice_dense(a, int(i), int(i) + 1, np.float32)
+                for i in idx
+            ]) if len(idx) else np.empty((0,) + a.shape[1:], np.float32)
+        else:
+            sample = np.asarray(a)[idx]
     return hashlib.sha1(
         np.ascontiguousarray(sample).tobytes()
     ).hexdigest()

@@ -68,10 +68,24 @@ def _is_device_estimator(est):
     return est.__class__.__module__.startswith("dask_ml_tpu")
 
 
+def _host_matrix(X):
+    """Host representation supporting arbitrary row slicing: CSR for any
+    sparse source (scipy matrix of any format, SparseBlocks), numpy
+    otherwise — the ONE sparse/dense coercion point for the block loops."""
+    import scipy.sparse as sp
+
+    from .parallel.streaming import SparseBlocks
+
+    if isinstance(X, SparseBlocks) or sp.issparse(X):
+        return X.tocsr()
+    return X.to_numpy() if isinstance(X, ShardedArray) else np.asarray(X)
+
+
 def _host_blocks(X, block_size=100_000):
-    """Yield host numpy row blocks of a ShardedArray / array."""
-    host = X.to_numpy() if isinstance(X, ShardedArray) else np.asarray(X)
-    for i in range(0, len(host), block_size):
+    """Yield host row blocks of a ShardedArray / array. Sparse X stays
+    sparse — host (sklearn) estimators consume CSR blocks natively."""
+    host = _host_matrix(X)
+    for i in range(0, host.shape[0], block_size):
         yield host[i:i + block_size]
 
 
@@ -91,8 +105,15 @@ class ParallelPostFit(BaseEstimator):
 
     # -- fit: plain in-memory fit of the wrapped estimator ---------------
     def fit(self, X, y=None, **kwargs):
+        from .parallel.streaming import SparseBlocks
+
         est = clone(self.estimator)
-        Xh = X.to_numpy() if isinstance(X, ShardedArray) else X
+        if isinstance(X, ShardedArray):
+            Xh = X.to_numpy()
+        elif isinstance(X, SparseBlocks):
+            Xh = X.tocsr()  # host estimators consume CSR, not the view
+        else:
+            Xh = X
         yh = y.to_numpy() if isinstance(y, ShardedArray) else y
         if yh is None:
             est.fit(Xh, **kwargs)
@@ -157,6 +178,11 @@ class ParallelPostFit(BaseEstimator):
                 parts = list(pool.map(fn, blocks))
         else:
             parts = [fn(b) for b in blocks]
+        import scipy.sparse as sp
+
+        if any(sp.issparse(p) for p in parts):
+            # sparse estimator output (e.g. a transformer): stays sparse
+            return sp.vstack(parts).tocsr()
         out = self._pin_meta(np.concatenate(parts, axis=0), method)
         return as_sharded(out, mesh=mesh) if mesh is not None else out
 
@@ -244,9 +270,13 @@ class Incremental(ParallelPostFit):
                         else ys[idx]
                     est.partial_fit(Xb, yb, **fit_kwargs)
             return est
-        Xh = X.to_numpy() if isinstance(X, ShardedArray) else np.asarray(X)
+        # sparse X blocks stay CSR host-side: a device estimator's
+        # partial_fit densifies ONE block at placement (as_sharded), a
+        # host estimator consumes the CSR block natively — either way
+        # peak memory is O(block), never the dense corpus
+        Xh = _host_matrix(X)
         yh = y.to_numpy() if isinstance(y, ShardedArray) else np.asarray(y)
-        starts = list(range(0, len(Xh), block_size))
+        starts = list(range(0, Xh.shape[0], block_size))
         if self.shuffle_blocks:
             rng.shuffle(starts)
         for s in starts:
@@ -294,4 +324,8 @@ class Incremental(ParallelPostFit):
             from .parallel.mesh import data_shards
 
             return max(X.padded_shape[0] // data_shards(X.mesh), 1)
-        return max(len(X) // 8, 1)
+        from .parallel.streaming import fit_block_rows
+
+        # n//8 epoch grid, capped by the dense-block byte budget for
+        # sparse/memmap sources (the text-pipeline bridge)
+        return fit_block_rows(X)

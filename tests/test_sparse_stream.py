@@ -1,0 +1,259 @@
+"""Sparse CSR → streamed-fit bridge (VERDICT r4 missing #2).
+
+Reference behavior being matched: dask-ml streams scipy CSR text blocks
+through per-block sklearn estimators end-to-end
+(``dask_ml/feature_extraction/text.py``; SURVEY.md §2a Text row, §7
+"Sparse" hard part). Here the bridge is ``parallel.streaming``: sparse
+sources densify ONE fixed-shape block at a time into the prefetched
+device buffer, so the dense corpus never materializes.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import dask_ml_tpu.config as config
+from dask_ml_tpu.feature_extraction.text import HashingVectorizer
+from dask_ml_tpu.linear_model import LogisticRegression
+from dask_ml_tpu.models.sgd import SGDClassifier
+from dask_ml_tpu.parallel.streaming import (BlockStream, SparseBlocks,
+                                            stream_plan)
+from dask_ml_tpu.wrappers import Incremental
+
+
+def _rand_csr(n, d, density=0.05, seed=0):
+    rng = np.random.RandomState(seed)
+    return sp.random(n, d, density=density, format="csr",
+                     random_state=rng, dtype=np.float64)
+
+
+@pytest.fixture(scope="module")
+def text_corpus():
+    rng = np.random.RandomState(7)
+    vocab = [f"w{i}" for i in range(300)]
+    docs, labels = [], []
+    for i in range(400):
+        cls = i % 2
+        # class-dependent word distribution so the task is learnable
+        lo = 0 if cls == 0 else 100
+        words = rng.choice(vocab[lo:lo + 200], size=12)
+        docs.append(" ".join(words))
+        labels.append(cls)
+    return docs, np.asarray(labels, np.float64)
+
+
+class TestSparseBlocks:
+    def test_slice_parity_with_vstack(self):
+        parts = [_rand_csr(37, 16, seed=s) for s in range(4)]
+        stacked = sp.vstack(parts).tocsr()
+        sb = SparseBlocks(parts)
+        assert sb.shape == stacked.shape
+        for lo, hi in [(0, 10), (30, 80), (36, 38), (100, 148), (0, 148)]:
+            np.testing.assert_allclose(
+                sb.slice_dense(lo, hi),
+                stacked[lo:hi].toarray().astype(np.float32),
+            )
+
+    def test_width_mismatch_raises(self):
+        with pytest.raises(ValueError, match="widths"):
+            SparseBlocks([_rand_csr(5, 4), _rand_csr(5, 6)])
+
+
+class TestBlockStreamSparse:
+    def test_blocks_match_dense(self):
+        Xs = _rand_csr(101, 8)
+        Xd = Xs.toarray()
+        got = [
+            (np.asarray(b.arrays[0]), b.n_rows, np.asarray(b.mask))
+            for b in BlockStream((Xs,), block_rows=32)
+        ]
+        want = [
+            (np.asarray(b.arrays[0]), b.n_rows, np.asarray(b.mask))
+            for b in BlockStream((Xd,), block_rows=32)
+        ]
+        assert len(got) == len(want)
+        for (ga, gn, gm), (wa, wn, wm) in zip(got, want):
+            assert gn == wn
+            np.testing.assert_allclose(ga, wa)
+            np.testing.assert_allclose(gm, wm)
+
+    def test_sparse_blocks_source(self):
+        parts = [_rand_csr(40, 8, seed=s) for s in range(3)]
+        sb = SparseBlocks(parts)
+        dense = sp.vstack(parts).toarray()
+        out = np.concatenate([
+            np.asarray(b.arrays[0])[: b.n_rows]
+            for b in BlockStream((sb,), block_rows=32)
+        ])
+        np.testing.assert_allclose(out, dense.astype(np.float32))
+
+    def test_stream_plan_always_streams_sparse(self):
+        assert stream_plan(_rand_csr(50, 4)) is not None
+        # dense-row HBM budget: a very wide sparse matrix gets small
+        # blocks (built directly — sp.random at this n*m is pathological)
+        rng = np.random.RandomState(0)
+        n, d, nnz = 10_000, 2 ** 18, 20_000
+        wide = sp.csr_matrix(
+            (rng.rand(nnz), (rng.randint(0, n, nnz),
+                             rng.randint(0, d, nnz))),
+            shape=(n, d),
+        )
+        rows = stream_plan(wide)
+        assert rows is not None
+        assert rows * 4 * 2 ** 18 <= 260 << 20  # ~one block ≤ budget
+
+
+class TestSparseEstimators:
+    def test_streamed_logreg_matches_dense(self):
+        Xs = _rand_csr(300, 12, density=0.3, seed=3)
+        s = np.asarray(Xs.sum(axis=1)).ravel()
+        y = (s > np.median(s)).astype(np.float64)
+        # same streamed solver, same block partition — the ONLY variable
+        # is the sparse densify-per-block source
+        with config.set(stream_block_rows=64):
+            dense = LogisticRegression(solver="lbfgs").fit(Xs.toarray(), y)
+            sparse = LogisticRegression(solver="lbfgs").fit(Xs, y)
+        assert sparse.solver_info_ is not None
+        np.testing.assert_allclose(
+            sparse.coef_, dense.coef_, rtol=1e-5, atol=1e-7
+        )
+        np.testing.assert_allclose(
+            sparse.predict_proba(Xs), dense.predict_proba(Xs.toarray()),
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_incremental_sgd_sparse_matches_dense(self):
+        Xs = _rand_csr(240, 10, density=0.4, seed=5)
+        y = (np.arange(240) % 2).astype(np.float64)
+        kw = dict(loss="log_loss", random_state=0, shuffle=False,
+                  max_iter=3)
+        inc_s = Incremental(SGDClassifier(**kw), shuffle_blocks=False)
+        inc_d = Incremental(SGDClassifier(**kw), shuffle_blocks=False)
+        inc_s.fit(Xs, y)
+        inc_d.fit(Xs.toarray(), y)
+        np.testing.assert_allclose(
+            inc_s.estimator_.coef_, inc_d.estimator_.coef_,
+            rtol=1e-5, atol=1e-6,
+        )
+        # streamed sparse predict matches the dense path
+        np.testing.assert_array_equal(
+            inc_s.estimator_.predict(Xs),
+            inc_d.estimator_.predict(Xs.toarray()),
+        )
+
+
+class TestSparseFormats:
+    def test_coo_and_csc_fit(self):
+        Xs = _rand_csr(120, 8, density=0.4, seed=9)
+        y = (np.arange(120) % 2).astype(np.float64)
+        ref = LogisticRegression(solver="lbfgs").fit(Xs, y)
+        for fmt in (Xs.tocoo(), Xs.tocsc()):
+            clf = LogisticRegression(solver="lbfgs").fit(fmt, y)
+            np.testing.assert_allclose(clf.coef_, ref.coef_, rtol=1e-6)
+
+    def test_sparse_blocks_source_fit(self):
+        parts = [_rand_csr(40, 8, density=0.4, seed=s) for s in range(3)]
+        sb = SparseBlocks(parts)
+        y = (np.arange(120) % 2).astype(np.float64)
+        kw = dict(loss="log_loss", random_state=0, shuffle=False,
+                  max_iter=2)
+        a = SGDClassifier(**kw).fit(sb, y)
+        b = SGDClassifier(**kw).fit(sp.vstack(parts).tocsr(), y)
+        np.testing.assert_allclose(a.coef_, b.coef_, rtol=1e-6)
+        np.testing.assert_array_equal(a.predict(sb), b.predict(sb))
+        # Incremental over a SparseBlocks source (host CSR block loop)
+        inc = Incremental(SGDClassifier(**kw), shuffle_blocks=False)
+        inc.fit(sb, y)
+        assert inc.estimator_.coef_.shape == (1, 8)
+
+    def test_pca_sparse_streams(self):
+        from dask_ml_tpu.decomposition import PCA
+
+        Xs = _rand_csr(400, 6, density=0.5, seed=2)
+        p_s = PCA(n_components=3).fit(Xs)
+        p_d = PCA(n_components=3).fit(Xs.toarray())
+        np.testing.assert_allclose(
+            np.abs(p_s.components_), np.abs(p_d.components_),
+            rtol=1e-3, atol=1e-5,
+        )
+
+    def test_fingerprint_sparse(self):
+        from dask_ml_tpu.utils.validation import data_fingerprint
+
+        Xs = _rand_csr(200, 5, density=0.5, seed=4)
+        f1 = data_fingerprint(Xs)
+        f2 = data_fingerprint(Xs.copy())
+        assert f1 == f2
+        Xmod = Xs.copy()
+        Xmod[0, 0] = 99.0
+        assert data_fingerprint(Xmod) != f1
+
+    def test_parallel_post_fit_fit_sparse_blocks(self):
+        from sklearn.feature_extraction.text import TfidfTransformer
+
+        from dask_ml_tpu.wrappers import ParallelPostFit
+
+        parts = [_rand_csr(20, 6, density=0.5, seed=s) for s in range(2)]
+        sb = SparseBlocks(parts)
+        out = ParallelPostFit(TfidfTransformer()).fit(sb).transform(sb)
+        assert sp.issparse(out) and out.shape == (40, 6)
+
+    def test_parallel_post_fit_sparse_output(self):
+        from sklearn.feature_extraction.text import TfidfTransformer
+
+        from dask_ml_tpu.wrappers import ParallelPostFit
+
+        Xs = _rand_csr(30, 6, density=0.5, seed=1)
+        ppf = ParallelPostFit(TfidfTransformer()).fit(Xs)
+        out = ppf.transform(Xs)
+        assert sp.issparse(out)
+        np.testing.assert_allclose(
+            out.toarray(),
+            TfidfTransformer().fit(Xs).transform(Xs).toarray(),
+        )
+
+
+class TestTextPipeline:
+    def test_hashing_to_incremental_sgd(self, text_corpus):
+        docs, y = text_corpus
+        hv = HashingVectorizer(n_features=2 ** 12)
+        Xs = hv.transform(docs)
+        assert sp.issparse(Xs)
+        clf = Incremental(
+            SGDClassifier(loss="log_loss", random_state=0, max_iter=5),
+            shuffle_blocks=False, random_state=0,
+        )
+        clf.fit(Xs, y)
+        acc = (clf.estimator_.predict(Xs) == y).mean()
+        assert acc > 0.9
+
+    def test_hashing_to_streamed_logreg(self, text_corpus):
+        docs, y = text_corpus
+        Xs = HashingVectorizer(n_features=2 ** 12).transform(docs)
+        clf = LogisticRegression(solver="lbfgs", max_iter=50).fit(Xs, y)
+        assert (clf.predict(Xs) == y).mean() > 0.9
+
+    def test_block_budget_bounds_host_memory(self, text_corpus):
+        """The whole point of the bridge: with a block budget set, a wide
+        corpus streams in O(block) dense memory. tracemalloc bounds the
+        numpy allocations the fit makes — the dense corpus (1600 × 2**16
+        × 4 B ≈ 420 MB) must never appear; observed peak is ~3.5 blocks
+        (prefetch + the block being built + zero-copy buffers pinned by
+        in-flight device_put)."""
+        import tracemalloc
+
+        docs, y = text_corpus
+        docs, y = docs * 4, np.tile(y, 4)
+        Xs = HashingVectorizer(n_features=2 ** 16).transform(docs)
+        dense_bytes = Xs.shape[0] * Xs.shape[1] * 4
+        block_bytes = 64 * Xs.shape[1] * 4
+        with config.set(stream_block_rows=64):
+            tracemalloc.start()
+            LogisticRegression(solver="gradient_descent", max_iter=3).fit(
+                Xs, y
+            )
+            _, peak = tracemalloc.get_traced_memory()
+            tracemalloc.stop()
+        # O(block), never O(corpus): ≤ ~6 blocks and ≪ the dense matrix
+        assert peak < 6 * block_bytes + (20 << 20), (peak, block_bytes)
+        assert peak < dense_bytes / 4, (peak, dense_bytes)
