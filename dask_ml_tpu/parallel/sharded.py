@@ -110,13 +110,11 @@ class ShardedArray:
             # densify-on-placement: correct for BLOCK-sized sparse inputs
             # (an Incremental partial_fit block). Whole-corpus sparse fits
             # never reach here — estimator fit paths route sparse through
-            # stream_plan/BlockStream, which densifies one block at a
-            # time. Cast the nnz values BEFORE toarray so the transient
-            # is one dense block at the target dtype, not a float64
-            # block plus its cast copy.
-            if dtype is not None and x.dtype != dtype:
-                x = x.astype(dtype)
-            x = x.toarray()
+            # stream_plan/BlockStream, which densifies one block at a time
+            from .streaming import _csr_dense
+
+            x = _csr_dense(x.tocsr(), 0, x.shape[0],
+                           x.dtype if dtype is None else dtype)
         mesh = resolve_mesh(mesh)
         on_device = isinstance(x, jax.Array) and not isinstance(
             x, jax.core.Tracer

@@ -94,13 +94,22 @@ def _csr_dense(a, lo, hi, dtype):
     return blk.toarray()
 
 
+def as_row_sliceable(a):
+    """Normalize a sparse source to a row-sliceable form (CSR) ONCE —
+    call this before a loop of ``_slice_dense`` calls; ``tocsr()`` is
+    identity for CSR but O(nnz) for COO/CSC/BSR."""
+    return a.tocsr() if sp.issparse(a) and not sp.isspmatrix_csr(a) else a
+
+
 def _slice_dense(a, lo, hi, dtype):
     """One host block of ``a`` as a dense array — the single densify
-    point for sparse sources (O(block) host memory, never the corpus)."""
+    point for sparse sources (O(block) host memory, never the corpus).
+    Non-CSR sparse is converted defensively (COO/BSR cannot row-slice);
+    loops should pre-normalize with ``as_row_sliceable``."""
     if isinstance(a, SparseBlocks):
         return a.slice_dense(lo, hi, dtype)
     if sp.issparse(a):
-        return _csr_dense(a, lo, hi, dtype)
+        return _csr_dense(a.tocsr(), lo, hi, dtype)
     return np.asarray(a[lo:hi], dtype=dtype)
 
 

@@ -134,10 +134,12 @@ def data_fingerprint(a, n_sample=96) -> str:
 
         sample = take_rows(a, idx).to_numpy()
     else:
-        from ..parallel.streaming import _is_sparse_source, _slice_dense
+        from ..parallel.streaming import (_is_sparse_source, _slice_dense,
+                                          as_row_sliceable)
 
         if _is_sparse_source(a):
             # sampled rows densify one at a time — O(sample), not O(n·d)
+            a = as_row_sliceable(a)  # once, not per sampled row
             sample = np.concatenate([
                 _slice_dense(a, int(i), int(i) + 1, np.float32)
                 for i in idx

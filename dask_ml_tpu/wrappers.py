@@ -136,11 +136,13 @@ class ParallelPostFit(BaseEstimator):
         """Pin the output dtype when a *_meta hint was given (the
         reference uses metas to declare dask output metadata; here output
         types are concrete, so only the dtype survives)."""
+        import scipy.sparse as sp
+
         meta = {"predict": self.predict_meta,
                 "predict_proba": self.predict_proba_meta,
                 "transform": self.transform_meta}.get(method)
         if meta is not None and hasattr(meta, "dtype") \
-                and isinstance(out, np.ndarray):
+                and (isinstance(out, np.ndarray) or sp.issparse(out)):
             out = out.astype(meta.dtype, copy=False)
         return out
 
@@ -182,7 +184,7 @@ class ParallelPostFit(BaseEstimator):
 
         if any(sp.issparse(p) for p in parts):
             # sparse estimator output (e.g. a transformer): stays sparse
-            return sp.vstack(parts).tocsr()
+            return self._pin_meta(sp.vstack(parts).tocsr(), method)
         out = self._pin_meta(np.concatenate(parts, axis=0), method)
         return as_sharded(out, mesh=mesh) if mesh is not None else out
 
