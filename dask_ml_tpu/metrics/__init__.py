@@ -1,5 +1,8 @@
 """Ref: dask_ml/metrics/__init__.py."""
-from .classification import accuracy_score, log_loss
+from .classification import (accuracy_score, balanced_accuracy_score,
+                             confusion_matrix, f1_score, log_loss,
+                             precision_score, recall_score,
+                             roc_auc_score)
 from .regression import (mean_absolute_error, mean_squared_error,
                          mean_squared_log_error, r2_score)
 from .pairwise import (cosine_distances, euclidean_distances,

@@ -1,0 +1,198 @@
+"""Device-side roc_auc / F1-family / balanced_accuracy parity vs sklearn
+(VERDICT r4 missing #4; ref dask_ml/metrics/scorer.py exposes the sklearn
+scorer table dask-aware). The point: adaptive search with these scoring
+strings must never fall to the host-adapting interop that gathers whole
+test folds."""
+
+import numpy as np
+import pytest
+import sklearn.metrics as skm
+
+from dask_ml_tpu import metrics
+from dask_ml_tpu.metrics.scorer import SCORERS, get_scorer
+from dask_ml_tpu.parallel import as_sharded
+
+rng = np.random.RandomState(0)
+
+
+class TestAucParity:
+    def test_auc_basic_and_ties(self):
+        y = rng.randint(0, 2, 500).astype(np.float64)
+        s = rng.rand(500)
+        s[::7] = 0.5  # heavy ties
+        np.testing.assert_allclose(
+            metrics.roc_auc_score(y, s), skm.roc_auc_score(y, s),
+            rtol=1e-6,
+        )
+
+    def test_auc_weighted(self):
+        y = rng.randint(0, 2, 300).astype(np.float64)
+        s = rng.rand(300)
+        w = rng.rand(300)
+        np.testing.assert_allclose(
+            metrics.roc_auc_score(y, s, sample_weight=w),
+            skm.roc_auc_score(y, s, sample_weight=w),
+            rtol=1e-5,
+        )
+
+    def test_auc_sharded_with_padding(self):
+        # n=101 pads on the 8-device mesh; padded rows must not score
+        y = rng.randint(0, 2, 101).astype(np.float64)
+        s = rng.rand(101)
+        np.testing.assert_allclose(
+            metrics.roc_auc_score(as_sharded(y), as_sharded(s)),
+            skm.roc_auc_score(y, s),
+            rtol=1e-6,
+        )
+
+    def test_auc_nonstandard_labels(self):
+        y = np.where(rng.rand(200) > 0.5, 10.0, 20.0)
+        s = rng.rand(200)
+        np.testing.assert_allclose(
+            metrics.roc_auc_score(y, s),
+            skm.roc_auc_score(y, s),  # sklearn: pos = larger label
+            rtol=1e-6,
+        )
+
+    def test_auc_one_class_raises(self):
+        with pytest.raises(ValueError, match="one class"):
+            metrics.roc_auc_score(np.ones(50), rng.rand(50))
+
+    def test_auc_multiclass_raises(self):
+        y = rng.randint(0, 3, 60).astype(np.float64)
+        with pytest.raises(ValueError, match="multiclass"):
+            metrics.roc_auc_score(y, rng.rand(60))
+
+
+class TestPRFParity:
+    @pytest.mark.parametrize("average", ["binary", "macro", "micro",
+                                         "weighted"])
+    def test_f1_binary_and_averages(self, average):
+        C = 2 if average == "binary" else 4
+        y = rng.randint(0, C, 400).astype(np.float64)
+        p = rng.randint(0, C, 400).astype(np.float64)
+        for ours, ref in [(metrics.f1_score, skm.f1_score),
+                          (metrics.precision_score, skm.precision_score),
+                          (metrics.recall_score, skm.recall_score)]:
+            np.testing.assert_allclose(
+                ours(y, p, average=average),
+                ref(y, p, average=average, zero_division=0),
+                rtol=1e-6, err_msg=f"{ref.__name__}/{average}",
+            )
+
+    def test_weighted_samples(self):
+        y = rng.randint(0, 3, 300).astype(np.float64)
+        p = rng.randint(0, 3, 300).astype(np.float64)
+        w = rng.rand(300)
+        np.testing.assert_allclose(
+            metrics.f1_score(y, p, average="weighted", sample_weight=w),
+            skm.f1_score(y, p, average="weighted", sample_weight=w,
+                         zero_division=0),
+            rtol=1e-6,
+        )
+
+    def test_balanced_accuracy(self):
+        y = rng.randint(0, 3, 400).astype(np.float64)
+        p = rng.randint(0, 3, 400).astype(np.float64)
+        np.testing.assert_allclose(
+            metrics.balanced_accuracy_score(y, p),
+            skm.balanced_accuracy_score(y, p),
+            rtol=1e-6,
+        )
+
+    def test_confusion_matrix(self):
+        y = rng.randint(0, 4, 300).astype(np.float64)
+        p = rng.randint(0, 4, 300).astype(np.float64)
+        np.testing.assert_array_equal(
+            metrics.confusion_matrix(y, p), skm.confusion_matrix(y, p)
+        )
+
+    def test_sharded_padding_excluded(self):
+        y = rng.randint(0, 3, 101).astype(np.float64)
+        p = rng.randint(0, 3, 101).astype(np.float64)
+        np.testing.assert_allclose(
+            metrics.f1_score(as_sharded(y), as_sharded(p),
+                             average="macro"),
+            skm.f1_score(y, p, average="macro", zero_division=0),
+            rtol=1e-6,
+        )
+
+    def test_binary_multiclass_guard(self):
+        y = rng.randint(0, 3, 60).astype(np.float64)
+        with pytest.raises(ValueError, match="binary"):
+            metrics.f1_score(y, y, average="binary")
+
+    def test_label_union_of_true_and_pred(self):
+        # y_pred contains a class y_true never mentions: sklearn scores
+        y = np.array([0.0, 1.0, 1.0, 0.0])
+        p = np.array([0.0, 2.0, 1.0, 0.0])
+        np.testing.assert_allclose(
+            metrics.f1_score(y, p, average="macro"),
+            skm.f1_score(y, p, average="macro", zero_division=0),
+            rtol=1e-6,
+        )
+        np.testing.assert_array_equal(
+            metrics.confusion_matrix(y, p), skm.confusion_matrix(y, p)
+        )
+
+    def test_missing_pos_label_raises(self):
+        y = np.array([2.0, 3.0, 3.0, 2.0])
+        p = np.array([2.0, 3.0, 2.0, 2.0])
+        with pytest.raises(ValueError, match="pos_label=1"):
+            metrics.f1_score(y, p)
+        np.testing.assert_allclose(
+            metrics.f1_score(y, p, pos_label=3),
+            skm.f1_score(y, p, pos_label=3),
+            rtol=1e-6,
+        )
+
+    def test_counts_chunked_exact(self, monkeypatch):
+        # force multi-chunk accumulation: results must match one-chunk
+        from dask_ml_tpu.metrics import classification as C
+
+        y = rng.randint(0, 3, 5000).astype(np.float64)
+        p = rng.randint(0, 3, 5000).astype(np.float64)
+        want = metrics.f1_score(y, p, average="weighted")
+        monkeypatch.setattr(C, "_COUNT_CHUNK", 512)
+        got = metrics.f1_score(y, p, average="weighted")
+        np.testing.assert_allclose(got, want, rtol=1e-12)
+        np.testing.assert_array_equal(
+            metrics.confusion_matrix(y, p), skm.confusion_matrix(y, p)
+        )
+
+
+class TestScorerIntegration:
+    def test_scorer_table_registered(self):
+        for name in ("roc_auc", "f1", "f1_macro", "balanced_accuracy",
+                     "precision", "recall_weighted"):
+            assert name in SCORERS
+            assert get_scorer(name) is SCORERS[name]
+
+    def test_roc_auc_scorer_on_estimator(self, xy_classification):
+        from dask_ml_tpu.linear_model import LogisticRegression
+
+        X, y = xy_classification
+        clf = LogisticRegression(solver="lbfgs", max_iter=30).fit(X, y)
+        got = get_scorer("roc_auc")(clf, as_sharded(X), as_sharded(y))
+        want = skm.roc_auc_score(y, clf.decision_function(X))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_search_scoring_no_host_folds(self, xy_classification):
+        """The VERDICT done-bar: adaptive search with scoring='roc_auc'
+        never routes folds through the host interop cache."""
+        from dask_ml_tpu.metrics import scorer as scorer_mod
+        from dask_ml_tpu.model_selection import IncrementalSearchCV
+        from dask_ml_tpu.models.sgd import SGDClassifier
+
+        X, y = xy_classification
+        scorer_mod.clear_host_fold_cache()
+        search = IncrementalSearchCV(
+            SGDClassifier(loss="log_loss", random_state=0),
+            {"alpha": [1e-4, 1e-3, 1e-2]},
+            n_initial_parameters=3, max_iter=3, scoring="roc_auc",
+            random_state=0,
+        )
+        search.fit(as_sharded(X), as_sharded(y), classes=np.unique(y))
+        assert len(scorer_mod._HOST_FOLD_CACHE) == 0
+        assert np.isfinite(search.best_score_)
+        assert 0.5 < search.best_score_ <= 1.0
