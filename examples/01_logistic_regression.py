@@ -6,7 +6,11 @@ an 8-device mesh. (Equivalent dask-ml code needs a distributed cluster;
 here the mesh IS the cluster.)
 """
 
+import os
+
 import numpy as np
+
+N = int(os.environ.get("DASK_ML_TPU_EXAMPLE_N", 200_000))
 
 from dask_ml_tpu import datasets
 from dask_ml_tpu.linear_model import LogisticRegression
@@ -14,7 +18,7 @@ from dask_ml_tpu.model_selection import train_test_split
 from dask_ml_tpu.preprocessing import StandardScaler
 
 X, y = datasets.make_classification(
-    n_samples=200_000, n_features=64, random_state=0
+    n_samples=N, n_features=64, random_state=0
 )  # a ShardedArray pair, row-sharded over every device
 Xtr, Xte, ytr, yte = train_test_split(X, y, test_size=0.2, random_state=0)
 

@@ -5,16 +5,19 @@ The reference streams dask chunks between workers; here blocks stream
 host RAM -> HBM with the optimizer state resident on device.
 """
 
+import os
+import tempfile
+
 import numpy as np
 
 from dask_ml_tpu import config
 from dask_ml_tpu.linear_model import LogisticRegression
 
-n, d = 500_000, 32
+n, d = int(os.environ.get("DASK_ML_TPU_EXAMPLE_N", 500_000)), 32
 rng = np.random.RandomState(0)
 w = rng.randn(d).astype(np.float32)
 
-path = "/tmp/example_X.f32"
+path = os.path.join(tempfile.mkdtemp(), "example_X.f32")
 X = np.memmap(path, dtype=np.float32, mode="w+", shape=(n, d))
 for lo in range(0, n, 100_000):  # write in chunks: no full matrix in RAM
     X[lo:lo + 100_000] = rng.randn(min(100_000, n - lo), d)
@@ -22,6 +25,6 @@ X.flush()
 y = (np.asarray(X) @ w > 0).astype(np.float32)
 
 X_ro = np.memmap(path, dtype=np.float32, mode="r", shape=(n, d))
-with config.set(stream_block_rows=100_000):
+with config.set(stream_block_rows=min(100_000, n // 4)):
     clf = LogisticRegression(solver="lbfgs", max_iter=50).fit(X_ro, y)
 print("train accuracy:", (clf.predict(X_ro) == y).mean())

@@ -4,13 +4,17 @@ SGD trials. Homogeneous surviving trials advance as ONE vmapped program
 hosts automatically.
 """
 
+import os
+
 import numpy as np
+
+N = int(os.environ.get("DASK_ML_TPU_EXAMPLE_N", 50_000))
 
 from dask_ml_tpu.model_selection import HyperbandSearchCV
 from dask_ml_tpu.models.sgd import SGDClassifier
 
 rng = np.random.RandomState(0)
-X = rng.randn(50_000, 32).astype(np.float32)
+X = rng.randn(N, 32).astype(np.float32)
 w = rng.randn(32)
 y = (X @ w > 0).astype(np.float32)
 

@@ -13,6 +13,9 @@ from local files and `to_sharded(mesh=global_mesh())` assembles the
 global design matrix with only shard-boundary rows crossing hosts.
 """
 
+import os
+import tempfile
+
 import numpy as np
 import pandas as pd
 
@@ -21,7 +24,7 @@ from dask_ml_tpu.parallel import from_pandas
 from dask_ml_tpu.preprocessing import Categorizer, DummyEncoder
 
 rng = np.random.RandomState(0)
-n = 60_000
+n = int(os.environ.get("DASK_ML_TPU_EXAMPLE_N", 60_000))
 df = pd.DataFrame({
     "x0": rng.randn(n).astype(np.float32),
     "x1": rng.randn(n).astype(np.float32),
@@ -43,7 +46,7 @@ proba = clf.predict_proba(X.to_numpy()[:4])
 print("proba rows sum to", proba.sum(axis=1))
 
 # the SAME estimator out-of-core: memmap in, streamed OvR fit
-mm_path = "/tmp/example_X.f32"
+mm_path = os.path.join(tempfile.mkdtemp(), "example_X.f32")
 Xh = X.to_numpy().astype(np.float32)
 Xh.tofile(mm_path)
 Xm = np.memmap(mm_path, dtype=np.float32, mode="r", shape=Xh.shape)
