@@ -260,6 +260,7 @@ def run():
     _try(_bench_kmeans, jax, on_tpu, n_chips, peak)
     _try(_bench_rsvd, jax, on_tpu, n_chips, peak)
     _try(_bench_incremental_sgd, jax, on_tpu, n_chips, peak)
+    _try(_bench_streamed_sgd, jax, on_tpu, n_chips, peak)
     _try(_bench_hyperband, jax, on_tpu, n_chips)
     result["extra_metrics"] = extras
     return result
@@ -396,7 +397,7 @@ def _bench_incremental_sgd(jax, on_tpu, n_chips, peak):
     from dask_ml_tpu.parallel import as_sharded
     from dask_ml_tpu.wrappers import Incremental
 
-    n = 2_000_000 if on_tpu else 100_000
+    n = 2_000_000 if on_tpu else 400_000
     d = 128
     key = jax.random.PRNGKey(3)
 
@@ -413,7 +414,10 @@ def _bench_incremental_sgd(jax, on_tpu, n_chips, peak):
     Xs, ys = as_sharded(X), as_sharded(y)
     inc = Incremental(SGDClassifier(max_iter=1, random_state=0),
                       shuffle_blocks=False)
-    inc.fit(Xs, ys)  # compile warmup at block shape
+    # two warmups: the first compiles at the fresh-zeros weight
+    # sharding, the second at the steady-state replicated one
+    inc.fit(Xs, ys)
+    inc.fit(Xs, ys)
     t0 = time.perf_counter()
     inc.fit(Xs, ys)
     elapsed = time.perf_counter() - t0
@@ -427,6 +431,90 @@ def _bench_incremental_sgd(jax, on_tpu, n_chips, peak):
         "n_features": d,
         # one epoch: forward (2nd) + backward (2nd) over every sample
         **_mfu_fields(4.0 * n * d, elapsed, n_chips, peak),
+    }
+
+
+def _bench_streamed_sgd(jax, on_tpu, n_chips, peak):
+    """Out-of-core SGD over a memmap through the instrumented
+    BlockStream (VERDICT r4 weak #2): reports measured overlap — how
+    much of each pass moved data (host slice + put + transfer wait) vs
+    computed — and the block autotune's growth across epochs."""
+    import os
+    import tempfile
+    import time
+
+    import numpy as np
+
+    from dask_ml_tpu import config
+    from dask_ml_tpu.models.sgd import SGDClassifier
+
+    n = 2_000_000 if on_tpu else 400_000
+    d = 128
+    epochs = 3
+    rng = np.random.RandomState(7)
+    path = os.path.join(tempfile.mkdtemp(), "bench_sgd_X.f32")
+    X = np.memmap(path, dtype=np.float32, mode="w+", shape=(n, d))
+    w = rng.randn(d).astype(np.float32)
+    y = np.empty(n, np.float32)
+    for lo in range(0, n, 200_000):
+        hi = min(lo + 200_000, n)
+        X[lo:hi] = rng.randn(hi - lo, d)
+        y[lo:hi] = (X[lo:hi] @ w > 0)
+    X.flush()
+    Xr = np.memmap(path, dtype=np.float32, mode="r", shape=(n, d))
+    # fix the block size so warmup compiles at EXACTLY the timed shape
+    # (autotune stays off: a resize would recompile inside the timed
+    # region and make the partition load-dependent)
+    from dask_ml_tpu.utils.observability import (MetricsLogger,
+                                                 active_logger)
+
+    with config.set(stream_block_rows=max(n // 32, 1),
+                    stream_autotune=False):
+        warm = SGDClassifier(max_iter=1, random_state=0, shuffle=False)
+        warm.fit(Xr, y)  # one full epoch at the timed block shape
+        clf = SGDClassifier(max_iter=epochs, random_state=0,
+                            shuffle=False)
+        # a bound logger turns on the readiness sync so wait_s (the
+        # transfer-stall component of "moving") is actually measured,
+        # and streams per-pass JSONL next to the memmap
+        with MetricsLogger(path + ".stream.jsonl") as lg, \
+                active_logger(lg):
+            t0 = time.perf_counter()
+            clf.fit(Xr, y)
+            elapsed = time.perf_counter() - t0
+    st = dict(getattr(clf, "_last_stream_stats", None) or {})
+    moving = st.get("host_s", 0) + st.get("put_s", 0) + st.get("wait_s", 0)
+    # demonstrate the opt-in autotune separately (not in the timed run):
+    # 2 epochs, report where the block size lands
+    with config.set(stream_block_rows=max(n // 32, 1),
+                    stream_autotune=True):
+        at = SGDClassifier(max_iter=2, random_state=0, shuffle=False)
+        at.fit(Xr, y)
+    at_st = dict(getattr(at, "_last_stream_stats", None) or {})
+    os.unlink(path)
+    return {
+        "metric": "streamed_sgd_samples_per_sec_per_chip",
+        "value": round(n * epochs / elapsed / n_chips, 1),
+        "unit": "samples/s/chip",
+        "backend": jax.default_backend(),
+        "dtype": "float32",
+        "n_rows": n,
+        "n_features": d,
+        "epochs": epochs,
+        "overlap": {
+            "block_rows": st.get("block_rows"),
+            "n_blocks": st.get("n_blocks"),
+            "last_pass_s": st.get("pass_s"),
+            "moving_s": round(moving, 4),
+            "compute_s": round(st.get("consume_s", 0.0), 4),
+            "moving_frac": round(
+                moving / max(st.get("pass_s", 0.0), 1e-9), 4
+            ),
+            # opt-in autotune's landing point after 2 epochs (untimed)
+            "autotuned_block_rows": at_st.get("block_rows"),
+            "autotuned_n_blocks": at_st.get("n_blocks"),
+        },
+        **_mfu_fields(4.0 * n * d * epochs, elapsed, n_chips, peak),
     }
 
 

@@ -24,6 +24,13 @@ class Config:
     stream_block_rows: int = 0
     # prefetch depth of the block streamer (1 = double buffering)
     stream_prefetch: int = 1
+    # grow streamed blocks between epochs when transfer time dominates
+    # compute (measured per pass; at most 2 doublings, ≥16 blocks).
+    # Default OFF: resizing from wall-clock measurements makes the
+    # minibatch partition — and hence a seeded fit's weights — depend on
+    # machine load, breaking random_state reproducibility. Opt in for
+    # throughput-bound production streaming.
+    stream_autotune: bool = False
     # JSONL metrics path ("" = disabled)
     metrics_path: str = ""
     # checkpoint directory for adaptive searches ("" = disabled)
@@ -38,11 +45,20 @@ def _from_env() -> Config:
     cfg = Config()
     for f in dataclasses.fields(Config):
         env = os.environ.get(_ENV_PREFIX + f.name.upper())
-        if env is not None:
-            value = f.type(env) if f.type is not str else env
-            if f.type is int:
-                value = int(env)
-            setattr(cfg, f.name, value)
+        if env is None:
+            continue
+        # f.type is the annotation STRING under `from __future__ import
+        # annotations` — dispatch on the declared default's type instead
+        kind = type(getattr(cfg, f.name))
+        if kind is bool:
+            value = env.strip().lower() in ("1", "true", "yes", "on")
+        elif kind is int:
+            value = int(env)
+        elif kind is float:
+            value = float(env)
+        else:
+            value = env
+        setattr(cfg, f.name, value)
     return cfg
 
 

@@ -105,15 +105,16 @@ def _sgd_step_multi(X, y_codes, mask, n_valid, W, lr, alpha, l2w, l1w,
 
 @partial(jax.jit, static_argnames=("loss", "schedule", "n_out"))
 def _sgd_epoch(Xr, yr, order, W, t0, eta0, power_t, alpha, l2w, l1w,
-               iflag, n_rows, bs_logical, loss, schedule, n_out):
-    """One FULL epoch as one program: ``lax.scan`` over the stacked
-    block view ``Xr (n_blocks, bs, d)`` / ``yr (n_blocks, bs)`` (axis 1
-    row-sharded, so every step uses the whole mesh). Replaces one
-    dispatch per block with one per epoch — on a tunneled runtime the
-    per-launch round trip dominates the math at streaming block sizes.
-    ``order`` holds the (possibly shuffled) block indices; the lr clock
-    advances per block exactly as the per-block loop does."""
-    bs = Xr.shape[1]
+               iflag, n_rows, loss, schedule, n_out):
+    """One FULL epoch as one program: ``lax.scan`` over the block grid
+    ``Xr (B, S, d)`` / ``yr (B, S)`` — block b is dataset rows
+    [b*S, (b+1)*S), axis 1 row-sharded so every step uses the whole
+    mesh. Replaces one dispatch per block with one per epoch — on a
+    tunneled runtime the per-launch round trip dominates the math at
+    streaming block sizes. ``order`` holds the (possibly shuffled)
+    block indices; the lr clock advances per block exactly as the
+    per-block loop does."""
+    S = Xr.shape[1]
 
     def lr_at(t):
         t = jnp.maximum(t, 1.0)
@@ -125,14 +126,12 @@ def _sgd_epoch(Xr, yr, order, W, t0, eta0, power_t, alpha, l2w, l1w,
 
     def step(carry, b):
         W, t = carry
-        Xb = jnp.take(Xr, b, axis=0)
+        Xb = jnp.take(Xr, b, axis=0)          # (S, d), axis 0 sharded
         yb = jnp.take(yr, b, axis=0)
-        # grid rows are padded up to a shardable multiple (bs >= the
-        # logical block size bs_logical): row r of block b is valid iff
-        # it is a real block row AND a real dataset row
-        r = jnp.arange(bs)
-        row_ids = b * bs_logical + r
-        mask = ((r < bs_logical) & (row_ids < n_rows)).astype(jnp.float32)
+        # grid row r of block b is dataset row b*S + r; pad rows (the
+        # tail the grid rounds up to) fail the bound and mask out
+        row_ids = b * S + jnp.arange(S)
+        mask = (row_ids < n_rows).astype(jnp.float32)
         n_valid = jnp.sum(mask)
         t = t + 1.0
         lr = lr_at(t)
@@ -193,12 +192,35 @@ def _sgd_cohort_scan(Xr, yr, NV, order, W, LRS, alphas, l2ws, l1ws,
 import functools as _functools
 
 
+def fused_blocks(X) -> tuple[int, int]:
+    """(n_blocks B, rows-per-block S) of the fused-epoch grid for a
+    ShardedArray: CONTIGUOUS blocks of S = padded/D rows rounded up to a
+    multiple of D (so the grid's row axis shards evenly), B = however
+    many cover the padded rows. The Incremental wrapper's per-block
+    fallback loop uses the same partition so both paths train identical
+    minibatches.
+
+    Layout note: a STRIDED partition ({r ≡ b mod B}, grid (S, B, d)
+    axis-0-sharded) would make the grid build collective-free, but each
+    scan step then reads d-length runs strided B·d apart — measured ~4x
+    slower per epoch than contiguous reads; the contiguous grid pays one
+    all-to-all at build and streams contiguously ever after, which wins
+    on CPU and maps better to TPU HBM burst reads."""
+    from ..parallel.mesh import data_shards
+    from ..parallel.streaming import grid_partition
+
+    return grid_partition(X.padded_shape[0], max(data_shards(X.mesh), 1))
+
+
 @_functools.lru_cache(maxsize=32)
-def _grid_builders(mesh, D, bs_pad):
-    """Cached jitted block-grid gather programs per (mesh, grid shape) —
-    a fresh ``jax.jit(lambda ...)`` per fit would retrace and recompile
-    on every epoch, reintroducing the per-launch latency the fused path
-    exists to remove."""
+def _grid_builders(mesh, B, S):
+    """Cached jitted block-grid programs per (mesh, grid shape): pad the
+    (n_pad, d) row-sharded array to B*S rows and reshape to (B, S, d)
+    with axis 1 sharded (every scan step uses the whole mesh). One
+    contiguous pad+reshape+reshard — the gather this replaced was ~6x
+    slower on the same data and dominated the whole fused fit. Cached
+    because a fresh ``jax.jit(lambda)`` per fit would retrace every
+    epoch."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from ..parallel.mesh import DATA_AXIS
@@ -206,13 +228,13 @@ def _grid_builders(mesh, D, bs_pad):
     sh3 = NamedSharding(mesh, P(None, DATA_AXIS, None))
     sh2 = NamedSharding(mesh, P(None, DATA_AXIS))
     fX = jax.jit(
-        lambda a, src: jnp.take(a, src, axis=0).reshape(
-            D, bs_pad, a.shape[1]
-        ),
+        lambda a: jnp.pad(
+            a, ((0, B * S - a.shape[0]), (0, 0))
+        ).reshape(B, S, a.shape[1]),
         out_shardings=sh3,
     )
     fy = jax.jit(
-        lambda a, src: jnp.take(a, src, axis=0).reshape(D, bs_pad),
+        lambda a: jnp.pad(a, (0, B * S - a.shape[0])).reshape(B, S),
         out_shardings=sh2,
     )
     return fX, fy
@@ -331,16 +353,18 @@ class _SGDBase(BaseEstimator):
         self._publish(X.shape[1])
         return self
 
-    def _fused_epoch(self, X, y, order, block_size=None, classes=None):
+    def _fused_epoch(self, X, y, order, n_blocks=None, classes=None):
         """One full streaming epoch in ONE program (the Incremental
-        wrapper's fast path for device data): the dataset is reshaped
-        once into its (n_blocks, bs, d) block grid — axis 1 row-sharded,
-        one all-to-all — and ``_sgd_epoch`` scans the blocks in
-        ``order``. Semantically identical to ``order`` partial_fit calls
-        (same update, same lr clock, same masking), minus one dispatch
-        round trip per block. NOTE the grid is a second device copy of
-        the dataset for the epoch's duration — the wrapper falls back to
-        the block loop when HBM headroom is insufficient."""
+        wrapper's fast path for device data): the dataset is padded and
+        reshaped once into its (B, S, d) contiguous block grid (axis 1
+        row-sharded; one all-to-all — see ``fused_blocks`` for why this
+        beats a collective-free strided layout) and ``_sgd_epoch`` scans
+        the blocks in ``order``. Semantically identical to ``order``
+        partial_fit calls over the same contiguous blocks (same update,
+        same lr clock, same masking), minus one dispatch round trip per
+        block. NOTE the grid is a second device copy of the dataset for
+        the epoch's duration — the wrapper falls back to the block loop
+        when HBM headroom is insufficient."""
         if classes is not None:
             self._set_classes(np.asarray(classes))
         if isinstance(self, ClassifierMixin) and \
@@ -348,45 +372,38 @@ class _SGDBase(BaseEstimator):
             raise ValueError(
                 "classes must be passed on the first call to partial_fit."
             )
-        from ..parallel.mesh import data_shards
-
         X = as_sharded(X, dtype=np.float32)
         y_enc = as_sharded(self._encode_y(y), mesh=X.mesh,
                            dtype=np.float32)
         mesh = X.mesh
-        D = data_shards(mesh)
-        n_pad, d = X.data.shape
-        bs = n_pad // D
-        if block_size is not None and block_size != bs:
-            # ``order`` indexes the caller's block grid; a mismatched
-            # grid would silently clamp block ids (jnp.take) and train
-            # some blocks twice — refuse loudly instead
+        d = X.data.shape[1]
+        B, S = fused_blocks(X)
+        if n_blocks is not None and n_blocks != B:
+            # ``order`` indexes the caller's block partition; a
+            # mismatched one would silently train wrong minibatches
             raise ValueError(
-                f"_fused_epoch grid is n_pad//data_shards = {bs} rows "
-                f"per block; caller streamed blocks of {block_size}"
+                f"_fused_epoch grid has {B} blocks of {S} rows; caller "
+                f"partitioned into {n_blocks}"
+            )
+        order = np.asarray(order, np.int32)
+        if order.size and (order.min() < 0 or order.max() >= B):
+            raise ValueError(
+                f"order indexes blocks 0..{B - 1}; got "
+                f"[{order.min()}, {order.max()}]"
             )
         self._ensure_state(d)
         self._lr()  # validate the schedule name eagerly, like the loop
-        # grid block rows padded to a shardable multiple of the mesh's
-        # data axis; the pad rows are masked in-kernel
-        bs_pad = -(-bs // D) * D
-        src = np.minimum(
-            (np.arange(D * bs_pad) // bs_pad) * bs
-            + (np.arange(D * bs_pad) % bs_pad),
-            n_pad - 1,
-        ).astype(np.int32)
-        fX, fy = _grid_builders(mesh, D, bs_pad)
-        src_d = jnp.asarray(src)
-        Xr = fX(X.data, src_d)
-        yr = fy(y_enc.data, src_d)
+        fX, fy = _grid_builders(mesh, B, S)
+        Xr = fX(X.data)
+        yr = fy(y_enc.data)
         l2w, l1w = self._penalty_weights()
         W, _t = _sgd_epoch(
-            Xr, yr, jnp.asarray(np.asarray(order, np.int32)), self._w,
+            Xr, yr, jnp.asarray(order), self._w,
             np.float32(self._t), np.float32(self.eta0),
             np.float32(self.power_t), np.float32(self.alpha),
             np.float32(l2w), np.float32(l1w),
             np.float32(1.0 if self.fit_intercept else 0.0),
-            np.int32(X.n_rows), np.int32(bs), loss=self._loss(),
+            np.int32(X.n_rows), loss=self._loss(),
             schedule=self.learning_rate, n_out=self._n_out(),
         )
         self._w = W
@@ -556,17 +573,18 @@ class _SGDBase(BaseEstimator):
             if classes is not None:
                 self._set_classes(np.asarray(classes))
             elif getattr(self, "classes_", None) is None:
-                from ..utils.validation import device_binary_classes
+                from ..utils.validation import device_classes
 
-                try:
-                    self._set_classes(device_binary_classes(ys))
-                except ValueError:  # >2 classes: host unique fallback
-                    self._set_classes(np.unique(ys.to_numpy()))
+                self._set_classes(device_classes(ys))
         y_enc = self._encode_y(ys)
         n = X.n_rows
-        n_blocks = 8
-        bs = max(int(np.ceil(n / n_blocks)), 1)
-        ranges = [np.arange(s, min(s + bs, n)) for s in range(0, n, bs)]
+        # the grid_partition blocks — the SAME minibatches a host-input
+        # fit or the Incremental wrapper trains (reproducibility across
+        # input residency)
+        _, S = fused_blocks(X)
+        ranges = [r for r in
+                  (np.arange(s, min(s + S, n)) for s in range(0, n, S))
+                  if len(r)]
         self._ensure_state(X.shape[1])
         rng = np.random.RandomState(self.random_state)
         order = np.arange(len(ranges))
@@ -615,6 +633,9 @@ class _SGDBase(BaseEstimator):
         for block in stream.epochs(self.max_iter):
             Xb, yb = block.arrays
             self._one_step(Xb, yb, block.mask, block.n_rows)
+        # last pass's overlap accounting (host/put/wait vs compute) for
+        # bench and diagnosis of transfer-bound fits
+        self._last_stream_stats = getattr(stream, "stats", None)
         self._publish(Xh.shape[1])
         self.n_iter_ = self.max_iter
         return self

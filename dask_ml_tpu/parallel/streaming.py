@@ -141,16 +141,35 @@ def auto_block_rows(n_rows: int, row_bytes: int = 4) -> int:
     return max(_AUTO_BLOCK_BYTES // max(int(row_bytes), 1), 1)
 
 
-def fit_block_rows(X, n_blocks: int = 8) -> int:
-    """Rows per block for an epoch-style fit over host data: the n//8
-    epoch grid, capped by ``stream_plan``'s byte budget when X is a
-    source that must stream in bounded dense blocks (sparse, memmap,
-    configured block rows) — the ONE block-size policy shared by the
-    SGD fit loop and ``Incremental._block_size``."""
-    n = X.shape[0] if hasattr(X, "shape") else len(X)
-    grid = max(n // n_blocks, 1)
+def grid_partition(n_pad: int, D: int) -> tuple[int, int]:
+    """(n_blocks B, rows-per-block S) for ``n_pad`` rows on a D-way data
+    axis: at least max(D, 8) blocks — the epoch must yield multiple
+    minibatch steps even on a 1-device mesh (a D-only split would
+    collapse a single-chip host fit to ONE gradient step per epoch) —
+    with S rounded up to a multiple of D so a (B, S, d) block grid's row
+    axis shards evenly. The one partition formula behind the fused-epoch
+    grid, the Incremental wrapper's block loops, and the SGD host fit —
+    device- and host-input fits of the same data train identical
+    minibatches."""
+    n_pad = max(n_pad, 1)
+    target = max(D, 8)
+    s = -(-n_pad // target)
+    S = max(-(-s // D) * D, 1)
+    return -(-n_pad // S), S
+
+
+def fit_block_rows(X, mesh=None) -> int:
+    """Rows per block for an epoch-style fit over host data: the
+    ``grid_partition`` size for the resolved mesh, capped by
+    ``stream_plan``'s byte budget when X is a source that must stream in
+    bounded dense blocks (sparse, memmap, configured block rows) — the
+    ONE block-size policy shared by the SGD fit loop and
+    ``Incremental._block_size``."""
+    n = int(X.shape[0]) if hasattr(X, "shape") else len(X)
+    D = max(data_shards(resolve_mesh(mesh)), 1)
+    S = max(grid_partition(-(-max(n, 1) // D) * D, D)[1], 1)
     budget = stream_plan(X)
-    return grid if budget is None else max(min(budget, grid), 1)
+    return S if budget is None else max(min(S, budget), 1)
 
 
 def stream_plan(X) -> int | None:
@@ -219,12 +238,15 @@ class BlockStream:
             if _n_rows_of(a) != n:
                 raise ValueError("arrays have inconsistent lengths")
         self.n_rows = n
+        # dense bytes-per-row of everything this stream puts on device —
+        # sizes the auto block AND caps autotune growth at the same
+        # byte budget (growth must not defeat the HBM bound)
+        self._row_bytes = sum(
+            4 * int(np.prod(a.shape[1:], dtype=np.int64) or 1)
+            for a in self.arrays
+        )
         if block_rows is None:
-            row_bytes = sum(
-                4 * int(np.prod(a.shape[1:], dtype=np.int64) or 1)
-                for a in self.arrays
-            )
-            block_rows = min(auto_block_rows(n, row_bytes), n)
+            block_rows = min(auto_block_rows(n, self._row_bytes), n)
         if prefetch is None:
             from ..config import get_config
 
@@ -322,6 +344,8 @@ class BlockStream:
         return Block(dev, m, jax.device_put(mask, self._mask_sharding))
 
     def __iter__(self):
+        import time as _time
+
         order = np.arange(self.n_blocks)
         if self.shuffle:
             self.rng.shuffle(order)
@@ -331,31 +355,128 @@ class BlockStream:
                 readers = self._native_readers()
             except Exception:
                 readers = None
+        # per-pass overlap accounting (SURVEY §7 B0: the double buffer is
+        # the heart of the system — measure it, don't assume it):
+        #   host_s   — disk/densify/pad time building host blocks
+        #   put_s    — host-side device_put issue time
+        #   wait_s   — time the CONSUMER would stall: popped block's
+        #              transfer not yet complete (overlap shortfall)
+        #   consume_s— time the consumer held each block (its compute)
+        stats = {"host_s": 0.0, "put_s": 0.0, "wait_s": 0.0,
+                 "consume_s": 0.0, "n_blocks": int(self.n_blocks),
+                 "block_rows": int(self.block_rows)}
+        t_pass = _time.perf_counter()
         # k-deep prefetch: device_put is async, so issuing the next k
         # transfers before consuming the current block overlaps DMA with
         # compute (k=1 is the classic double buffer)
         from collections import deque
 
         pending = deque()
+        # the readiness sync serializes the host loop behind each
+        # block's transfer, trading a little overlap for the wait_s
+        # signal — only pay it when someone consumes the signal (a bound
+        # metrics logger, or an autotune pass sizing blocks)
+        from ..utils.observability import _active_loggers
+
+        measure_wait = bool(_active_loggers) or getattr(
+            self, "_autotune_pass", False
+        )
+
+        def pop():
+            blk = pending.popleft()
+            if measure_wait:
+                t0 = _time.perf_counter()
+                jax.block_until_ready(blk.arrays)
+                stats["wait_s"] += _time.perf_counter() - t0
+            return blk
+
+        def emit(blk):
+            # consume = wall time the generator is SUSPENDED at this
+            # yield — exactly the consumer's per-block work
+            t_y = _time.perf_counter()
+            yield blk
+            stats["consume_s"] += _time.perf_counter() - t_y
+
         try:
             for b in order:
-                pending.append(self._put(self._block_host(b, readers)))
+                t0 = _time.perf_counter()
+                hb = self._block_host(b, readers)
+                t1 = _time.perf_counter()
+                stats["host_s"] += t1 - t0
+                pending.append(self._put(hb))
+                stats["put_s"] += _time.perf_counter() - t1
                 if len(pending) > self.prefetch:
-                    yield pending.popleft()
+                    yield from emit(pop())
             while pending:
-                yield pending.popleft()
+                yield from emit(pop())
         finally:
+            stats["pass_s"] = _time.perf_counter() - t_pass
+            self.stats = stats
+            self._passes = getattr(self, "_passes", 0) + 1
+            self._log_pass(stats)
             if readers:
                 for r in readers:
                     if r is not None:
                         r.close()
 
+    def _log_pass(self, stats):
+        """Emit the pass's overlap stats to the ambient fit logger (one
+        JSONL record per pass, nothing when no logger is bound)."""
+        try:
+            from ..utils.observability import _active_loggers
+
+            for lg in list(_active_loggers):
+                lg.log(stream_pass=self._passes,
+                       **{k: (round(v, 6) if isinstance(v, float) else v)
+                          for k, v in stats.items()})
+        except Exception:
+            pass
+
+    def _maybe_grow_blocks(self):
+        """Epoch-boundary block autotune: when a pass spends more HOST
+        time preparing blocks (slice/densify/pad + put issue) than the
+        consumer holds them, the per-block fixed costs dominate — double
+        the block so fewer, larger transfers amortize them. wait_s is
+        deliberately NOT part of the signal: under async dispatch the
+        device's compute backlog surfaces as transfer wait, and growing
+        blocks doesn't reduce bytes moved — it would misfire on
+        compute-bound fits. Only between ``epochs()`` passes (per-block
+        solver state like ADMM's never sees a resize), at most twice,
+        and only when there are enough blocks that halving their count
+        still keeps the mesh busy."""
+        st = getattr(self, "stats", None)
+        if st is None or self._passes > 2 or self.n_blocks < 16:
+            return
+        if st["host_s"] + st["put_s"] <= st["consume_s"]:
+            return
+        shards = data_shards(self.mesh)
+        # never grow past the byte budget that bounds device footprint
+        # (a block already AT the budget stays there)
+        budget_rows = max(_AUTO_BLOCK_BYTES // max(self._row_bytes, 1), 1)
+        cap = min(int(np.ceil(self.n_rows / shards)) * shards,
+                  max(budget_rows, self.block_rows))
+        new_rows = min(self.block_rows * 2, cap)
+        if new_rows <= self.block_rows:
+            return
+        self.block_rows = new_rows
+        self.n_blocks = int(np.ceil(self.n_rows / self.block_rows))
+
     def __len__(self):
         return self.n_blocks
 
-    def epochs(self, n_epochs):
-        for _ in range(n_epochs):
-            yield from self
+    def epochs(self, n_epochs, autotune=None):
+        if autotune is None:
+            from ..config import get_config
+
+            autotune = get_config().stream_autotune
+        self._autotune_pass = bool(autotune)  # enables wait_s measuring
+        try:
+            for e in range(n_epochs):
+                yield from self
+                if autotune and e < n_epochs - 1:
+                    self._maybe_grow_blocks()
+        finally:
+            self._autotune_pass = False
 
 
 def streamed_map(X, block_rows, fn):

@@ -151,12 +151,14 @@ def data_fingerprint(a, n_sample=96) -> str:
     ).hexdigest()
 
 
-def device_binary_classes(y: ShardedArray) -> np.ndarray:
-    """The two class values of a device label vector, WITHOUT pulling the
-    column to host (VERDICT r2 #4: ``_encode_y`` full-column round-trip).
-    One jitted masked reduction; only three scalars cross to host. Raises
-    ValueError for non-binary targets (the error path falls back to a
-    host ``np.unique`` for an exact class count in the message)."""
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=1)
+def _binary_class_scan():
+    """Module-cached jitted scan — defining the jit inside
+    ``device_binary_classes`` recompiled it (~0.3 s) on EVERY call,
+    which dominated every Incremental fit's wall clock."""
     import jax
     import jax.numpy as jnp
 
@@ -200,7 +202,19 @@ def device_binary_classes(y: ShardedArray) -> np.ndarray:
             [vals.astype(jnp.float32), binary.astype(jnp.float32)[None]]
         )
 
-    out = _scan(y.data, y.row_mask(jnp.float32))
+    return _scan
+
+
+def device_binary_classes(y: ShardedArray) -> np.ndarray:
+    """The two class values of a device label vector, WITHOUT pulling the
+    column to host (VERDICT r2 #4: ``_encode_y`` full-column round-trip).
+    One jitted masked reduction; only three scalars cross to host. Raises
+    ValueError for non-binary targets (the error path falls back to a
+    host ``np.unique`` for an exact class count in the message)."""
+    import jax
+    import jax.numpy as jnp
+
+    out = _binary_class_scan()(y.data, y.row_mask(jnp.float32))
     if isinstance(out, tuple):  # wide-dtype (f64/i64) fallback path
         mn_h, mx_h, binary = np.asarray(out[0]), np.asarray(out[1]),             bool(out[2])
     else:
@@ -213,13 +227,29 @@ def device_binary_classes(y: ShardedArray) -> np.ndarray:
         else:
             mn_h, mx_h = np.ascontiguousarray(out[:2]).view(np.int32)
     if not binary or mn_h == mx_h:
-        n_classes = len(np.unique(y.to_numpy()))  # error path only
-        raise ValueError(
-            f"expected binary targets; got {n_classes} classes"
+        classes = np.unique(y.to_numpy())  # error path only
+        err = ValueError(
+            f"expected binary targets; got {len(classes)} classes"
         )
+        # callers falling back to a host unique (the multiclass path)
+        # reuse this instead of a second full-column gather + sort
+        err.classes = classes
+        raise err
     # classes keep the label dtype (np.unique parity: int labels give
     # int classes, so predict() returns the caller's dtype)
     return np.stack([mn_h, mx_h]).astype(np.dtype(str(y.dtype)))
+
+
+def device_classes(y: ShardedArray) -> np.ndarray:
+    """All class values of a device label vector: the three-scalar
+    device scan when binary, falling back to the host unique the scan's
+    error path already computed (ONE column gather total, never two).
+    The ``err.classes`` handoff stays private to this module."""
+    try:
+        return device_binary_classes(y)
+    except ValueError as e:
+        c = getattr(e, "classes", None)
+        return c if c is not None else np.unique(y.to_numpy())
 
 
 def check_is_fitted(est, attr: str):

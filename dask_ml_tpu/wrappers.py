@@ -233,21 +233,26 @@ class Incremental(ParallelPostFit):
 
     def _partial_fit_pass(self, est, X, y, block_size, rng, **fit_kwargs):
         if _is_device_estimator(est) and isinstance(X, ShardedArray):
-            # device estimator + device data: blocks are sharded gathers
-            # (take_rows); the dataset never round-trips through host
-            # (VERDICT r2 #4 — the reference's partial_fit chain runs on
-            # worker-resident chunks the same way, SURVEY.md §3.6)
+            # device estimator + device data: blocks are the fused-epoch
+            # grid's contiguous S-row ranges (fused_blocks), so the
+            # fused and per-block paths train identical minibatches.
+            # Blocks materialize as sharded gathers (take_rows); the
+            # dataset never round-trips through host (VERDICT r2 #4 —
+            # the reference's partial_fit chain runs on worker-resident
+            # chunks the same way, SURVEY §3.6)
+            from .models.sgd import fused_blocks
             from .parallel.sharded import take_rows
 
             ys = y if isinstance(y, ShardedArray) or y is None \
                 else np.asarray(y)
-            starts = list(range(0, X.n_rows, block_size))
+            B, S = fused_blocks(X)
+            # the last grid block always holds ≥1 real row (padding < D
+            # and S*(B-1) is a multiple of D), so B IS the block count
+            order = list(range(B))
             if self.shuffle_blocks:
-                rng.shuffle(starts)
+                rng.shuffle(order)
             if (hasattr(est, "_fused_epoch") and ys is not None
-                    and len(starts) > 1
-                    and block_size == X.padded_shape[0] // max(
-                        _data_shards(X.mesh), 1)
+                    and B > 1
                     and set(fit_kwargs) <= {"classes"}
                     and _device_headroom_for_copy(X)):
                 # fused-epoch fast path: the whole pass compiles into ONE
@@ -257,13 +262,12 @@ class Incremental(ParallelPostFit):
                 # the headroom gate (the loop gathers one block at a
                 # time and stays the fallback near HBM capacity).
                 est._fused_epoch(
-                    X, ys, [s // block_size for s in starts],
-                    block_size=block_size,
+                    X, ys, order, n_blocks=B,
                     classes=fit_kwargs.get("classes"),
                 )
                 return est
-            for s in starts:
-                idx = np.arange(s, min(s + block_size, X.n_rows))
+            for b in order:
+                idx = np.arange(b * S, min((b + 1) * S, X.n_rows))
                 Xb = take_rows(X, idx)
                 if ys is None:
                     est.partial_fit(Xb, **fit_kwargs)
@@ -301,9 +305,13 @@ class Incremental(ParallelPostFit):
 
         if (y is not None and "classes" not in fit_kwargs
                 and is_classifier(est)):
-            yh = y.to_numpy() if isinstance(y, ShardedArray) \
-                else np.asarray(y)
-            fit_kwargs["classes"] = np.unique(yh)
+            if isinstance(y, ShardedArray):
+                # binary: a three-scalar device scan, no column gather
+                from .utils.validation import device_classes
+
+                fit_kwargs["classes"] = device_classes(y)
+            else:
+                fit_kwargs["classes"] = np.unique(np.asarray(y))
         rng = np.random.RandomState(self.random_state)
         self.estimator_ = self._partial_fit_pass(
             est, X, y, self._block_size(X), rng, **fit_kwargs
@@ -323,11 +331,15 @@ class Incremental(ParallelPostFit):
     @staticmethod
     def _block_size(X):
         if isinstance(X, ShardedArray):
-            from .parallel.mesh import data_shards
+            # the device branch of _partial_fit_pass derives its own
+            # contiguous fused_blocks partition and ignores this value;
+            # report that partition's row count for consistency
+            from .models.sgd import fused_blocks
 
-            return max(X.padded_shape[0] // data_shards(X.mesh), 1)
+            return max(fused_blocks(X)[1], 1)
+        # host inputs: the SAME grid partition the device path uses
+        # (capped by the byte budget for sparse/memmap sources), so
+        # host- and device-input fits train identical blocks
         from .parallel.streaming import fit_block_rows
 
-        # n//8 epoch grid, capped by the dense-block byte budget for
-        # sparse/memmap sources (the text-pipeline bridge)
         return fit_block_rows(X)

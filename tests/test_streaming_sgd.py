@@ -159,7 +159,7 @@ def test_fused_epoch_matches_block_loop():
     """The Incremental wrapper's fused-epoch program (one lax.scan per
     pass) produces the SAME weights as the per-block partial_fit loop —
     same updates, same block order, same lr clock, same masking."""
-    from dask_ml_tpu.models.sgd import SGDClassifier
+    from dask_ml_tpu.models.sgd import SGDClassifier, fused_blocks
     from dask_ml_tpu.parallel import as_sharded
     from dask_ml_tpu.parallel.sharded import take_rows
 
@@ -168,16 +168,15 @@ def test_fused_epoch_matches_block_loop():
     X = rng.randn(n, d).astype(np.float32)
     y = (X[:, 0] > 0).astype(np.float32)
     Xs, ys = as_sharded(X), as_sharded(y)
-    bs = Xs.padded_shape[0] // 8
-    starts = list(range(0, n, bs))
+    B, S = fused_blocks(Xs)  # the ONE block partition both paths use
 
     fused = SGDClassifier(random_state=0, learning_rate="invscaling")
-    fused._fused_epoch(Xs, ys, [s // bs for s in starts],
+    fused._fused_epoch(Xs, ys, list(range(B)),
                        classes=np.array([0.0, 1.0]))
     loop = SGDClassifier(random_state=0, learning_rate="invscaling")
-    for i, s in enumerate(starts):
-        idx = np.arange(s, min(s + bs, n))
-        kw = {"classes": np.array([0.0, 1.0])} if i == 0 else {}
+    for b in range(B):
+        idx = np.arange(b * S, min((b + 1) * S, n))
+        kw = {"classes": np.array([0.0, 1.0])} if b == 0 else {}
         loop.partial_fit(take_rows(Xs, idx), take_rows(ys, idx), **kw)
     np.testing.assert_allclose(fused.coef_, loop.coef_, atol=1e-6)
     np.testing.assert_allclose(fused.intercept_, loop.intercept_,
