@@ -62,6 +62,26 @@ def _from_env() -> Config:
     return cfg
 
 
+def mxu_dtype():
+    """The matmul compute dtype the current config asks for, or None for
+    plain f32 — the ONE mapping from ``config.dtype`` to the kernels'
+    ``mxu_dtype``/cast arguments (KMeans distances, PCA Gram, SGD epoch
+    grids, GLM design matrices). Unknown dtype strings raise — a typo
+    ("bf16") silently training f32 would corrupt every precision and
+    benchmark expectation downstream."""
+    dt = get_config().dtype
+    if dt == "bfloat16":
+        import jax.numpy as jnp
+
+        return jnp.bfloat16
+    if dt in ("float32", "f32"):
+        return None
+    raise ValueError(
+        f"config.dtype={dt!r} is not supported; use 'float32' or "
+        "'bfloat16'"
+    )
+
+
 def get_config() -> Config:
     stack = getattr(_state, "stack", None)
     if stack:

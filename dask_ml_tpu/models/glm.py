@@ -242,13 +242,13 @@ class _GLMBase(BaseEstimator):
         X, y = check_X_y(X, y, mesh=mesh, dtype=np.float32)
         if self.penalty not in regularizers.KNOWN:
             raise ValueError(f"Unknown penalty {self.penalty!r}")
-        from ..config import get_config
-
         # bf16 design matrix: the _smooth_loss matvec rides the MXU at
         # bf16 rate with f32 accumulation; solver state / y / mask stay
         # f32. Newton/ADMM are excluded — their Hessian matmuls would
         # silently upcast (no speedup) and bf16 Hessians risk conditioning
-        use_bf16 = get_config().dtype == "bfloat16" and self.solver in (
+        from ..config import mxu_dtype
+
+        use_bf16 = mxu_dtype() is not None and self.solver in (
             "lbfgs", "gradient_descent", "proximal_grad"
         )
         mask = X.row_mask(dtype=jnp.float32)

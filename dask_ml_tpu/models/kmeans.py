@@ -43,13 +43,21 @@ from ..utils.validation import check_array, check_is_fitted
 from ..utils.observability import emit_jit_step
 
 
-@partial(jax.jit, static_argnames=("log",))
-def _lloyd_run(X, mask, centers0, max_iter, tol2, log=False):
-    """Full Lloyd loop on device. Returns (centers, n_iter, final_shift2)."""
+@partial(jax.jit, static_argnames=("log", "mxu_dtype"))
+def _lloyd_run(X, mask, centers0, max_iter, tol2, log=False,
+               mxu_dtype=None):
+    """Full Lloyd loop on device. Returns (centers, n_iter, final_shift2).
+
+    ``mxu_dtype=jnp.bfloat16`` (config.dtype="bfloat16"): the distance
+    cross-term matmul — the loop's FLOPs — runs at bf16 with f32
+    accumulation; centroid sums/counts and the shift stay f32 (input
+    data is untouched). Center parity vs f32 is ~1e-2 relative (bf16
+    input rounding on distances can flip assignments of near-equidistant
+    points)."""
     k = centers0.shape[0]
 
     def assign(centers):
-        d2 = euclidean_distances_sq(X, centers)
+        d2 = euclidean_distances_sq(X, centers, mxu_dtype=mxu_dtype)
         return jnp.argmin(d2, axis=1), jnp.min(d2, axis=1)
 
     def cond(carry):
@@ -168,11 +176,13 @@ def _candidate_weights(X, mask, cands, cand_valid):
 # The reference's analog IS its normal mode: per-chunk tasks +
 # tree-reduce (SURVEY.md §3.1). One Lloyd iteration = one pass.
 
-@jax.jit
-def _block_assign_stats(X, mask, centers):
-    """(Σ_block x per label, count per label, Σ_block min-dist²)."""
+@partial(jax.jit, static_argnames=("mxu_dtype",))
+def _block_assign_stats(X, mask, centers, mxu_dtype=None):
+    """(Σ_block x per label, count per label, Σ_block min-dist²).
+    ``mxu_dtype``: same bf16 distance-matmul policy as ``_lloyd_run``;
+    stats stay f32."""
     k = centers.shape[0]
-    d2 = euclidean_distances_sq(X, centers)
+    d2 = euclidean_distances_sq(X, centers, mxu_dtype=mxu_dtype)
     labels = jnp.argmin(d2, axis=1)
     sums = jax.ops.segment_sum(X * mask[:, None], labels, num_segments=k)
     counts = jax.ops.segment_sum(mask, labels, num_segments=k)
@@ -265,12 +275,16 @@ def _streamed_lloyd(stream, centers0, max_iter, tol2, logger=None,
     """Host-loop Lloyd over streamed blocks; ``ckpt`` (a
     _LloydCheckpoint) persists every k passes so a killed multi-hour fit
     resumes mid-run, and clears on completion."""
+    from ..config import mxu_dtype
+
+    mxu = mxu_dtype()
     centers = jnp.asarray(centers0)
     n_iter = start_it
     for it in range(start_it, int(max_iter)):
         sums = counts = inertia = None
         for blk in stream:
-            s, c, i = _block_assign_stats(blk.arrays[0], blk.mask, centers)
+            s, c, i = _block_assign_stats(blk.arrays[0], blk.mask,
+                                          centers, mxu_dtype=mxu)
             sums = s if sums is None else sums + s
             counts = c if counts is None else counts + c
             inertia = i if inertia is None else inertia + i
@@ -614,9 +628,23 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         # sklearn-style tol scaling: tol * mean per-feature variance
         _, var = masked_mean_var(X.data, mask, X.n_rows)
         tol2 = jnp.asarray(self.tol, X.dtype) * jnp.mean(var)
+        from ..config import mxu_dtype as _mxu_dtype
+
+        mxu = _mxu_dtype()
         use_pallas = self.use_pallas
-        if use_pallas is None:  # auto: fused kernel on real TPU only
-            use_pallas = jax.default_backend() == "tpu"
+        if use_pallas is None:
+            # auto: fused kernel on real TPU only — unless the user
+            # asked for bf16, which only the XLA distance path honors
+            # (the Pallas kernel's VMEM tiling is f32)
+            use_pallas = jax.default_backend() == "tpu" and mxu is None
+        elif use_pallas and mxu is not None:
+            import warnings
+
+            warnings.warn(
+                "KMeans(use_pallas=True) runs the f32 Pallas kernel; "
+                "config.dtype='bfloat16' is ignored on this path",
+                RuntimeWarning,
+            )
         from ..utils.observability import (
             active_logger, fit_logger, jit_callbacks_supported,
         )
@@ -628,6 +656,10 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             # host callbacks); degrade to one summary record per fit
             log_steps = logger is not None and jit_callbacks_supported()
 
+            # bf16 distance matmuls (XLA path only, see use_pallas
+            # resolution above)
+            mxu_dtype = None if use_pallas else mxu
+
             def run_lloyd(c0, iters):
                 if use_pallas:
                     return _lloyd_run_pallas(
@@ -637,7 +669,7 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                     )
                 return _lloyd_run(
                     X.data, mask, c0, jnp.asarray(iters), tol2,
-                    log=log_steps,
+                    log=log_steps, mxu_dtype=mxu_dtype,
                 )
 
             ckpt = self._make_ckpt(X, X.n_rows, X.shape[1])

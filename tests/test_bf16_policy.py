@@ -1,0 +1,124 @@
+"""config.dtype="bfloat16" beyond the GLMs (VERDICT r4 missing #5):
+KMeans distances, the PCA streamed Gram, and the SGD epoch grid run
+their matmuls at bf16 with f32 accumulation. Parity tolerances here
+document the expected bf16 input-rounding error (~1e-2 relative)."""
+
+import numpy as np
+import pytest
+
+import dask_ml_tpu.config as config
+
+rng = np.random.RandomState(0)
+
+
+def test_kmeans_bf16_parity():
+    from dask_ml_tpu.cluster import KMeans
+    from dask_ml_tpu.parallel import as_sharded
+
+    # two well-separated blobs + near-true init: the converged partition
+    # is unambiguous, so parity isolates bf16 distance rounding (not
+    # Lloyd's local-minimum sensitivity)
+    X = rng.randn(4000, 16).astype(np.float32)
+    X[:2000] += 6.0
+    Xs = as_sharded(X)
+    init = np.stack([np.full(16, 5.5, np.float32),
+                     np.full(16, 0.5, np.float32)])
+    f32 = KMeans(n_clusters=2, init=init, max_iter=20, random_state=0,
+                 use_pallas=False).fit(Xs)
+    with config.set(dtype="bfloat16"):
+        b16 = KMeans(n_clusters=2, init=init, max_iter=20,
+                     random_state=0, use_pallas=False).fit(Xs)
+    np.testing.assert_allclose(
+        b16.cluster_centers_, f32.cluster_centers_, rtol=2e-2, atol=2e-2
+    )
+    # inertia within bf16 rounding of distances
+    assert abs(b16.inertia_ - f32.inertia_) / f32.inertia_ < 2e-2
+
+
+def test_kmeans_streamed_bf16_parity():
+    """The out-of-core Lloyd honors the dtype policy too — the policy
+    must not silently depend on whether the data fit in memory."""
+    from dask_ml_tpu.cluster import KMeans
+
+    X = rng.randn(4000, 8).astype(np.float32)
+    X[:2000] += 6.0
+    init = np.stack([np.full(8, 5.5, np.float32),
+                     np.full(8, 0.5, np.float32)])
+    with config.set(stream_block_rows=512):
+        f32 = KMeans(n_clusters=2, init=init, max_iter=10,
+                     random_state=0).fit(X)
+        with config.set(dtype="bfloat16"):
+            b16 = KMeans(n_clusters=2, init=init, max_iter=10,
+                         random_state=0).fit(X)
+    np.testing.assert_allclose(
+        b16.cluster_centers_, f32.cluster_centers_, rtol=2e-2, atol=2e-2
+    )
+
+
+def test_pca_streamed_gram_bf16_parity():
+    from dask_ml_tpu.decomposition import PCA
+
+    X = rng.randn(5000, 12).astype(np.float32)
+    with config.set(stream_block_rows=1024):
+        f32 = PCA(n_components=4).fit(X)
+        with config.set(dtype="bfloat16"):
+            b16 = PCA(n_components=4).fit(X)
+    np.testing.assert_allclose(b16.mean_, f32.mean_, atol=1e-3)
+    np.testing.assert_allclose(
+        np.abs(b16.components_ @ f32.components_.T), np.eye(4), atol=5e-2
+    )
+    np.testing.assert_allclose(
+        b16.explained_variance_ratio_, f32.explained_variance_ratio_,
+        rtol=5e-2,
+    )
+
+
+def test_sgd_fused_epoch_bf16_parity():
+    from dask_ml_tpu.models.sgd import SGDClassifier
+    from dask_ml_tpu.parallel import as_sharded
+    from dask_ml_tpu.wrappers import Incremental
+
+    X = rng.randn(2000, 10).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    Xs, ys = as_sharded(X), as_sharded(y)
+    kw = dict(loss="log_loss", random_state=0, max_iter=2)
+    f32 = Incremental(SGDClassifier(**kw), shuffle_blocks=False)
+    f32.fit(Xs, ys)
+    with config.set(dtype="bfloat16"):
+        b16 = Incremental(SGDClassifier(**kw), shuffle_blocks=False)
+        b16.fit(Xs, ys)
+    np.testing.assert_allclose(
+        b16.estimator_.coef_, f32.estimator_.coef_, rtol=5e-2, atol=1e-3
+    )
+    agree = (b16.estimator_.predict(Xs) == f32.estimator_.predict(Xs))
+    assert agree.mean() > 0.99
+
+
+def test_unknown_dtype_raises_and_pallas_warns():
+    from dask_ml_tpu.config import mxu_dtype
+
+    with config.set(dtype="bf16"):
+        with pytest.raises(ValueError, match="not supported"):
+            mxu_dtype()
+    # explicit Pallas + bf16: warned, not silently dropped
+    from dask_ml_tpu.cluster import KMeans
+    from dask_ml_tpu.parallel import as_sharded
+
+    X = as_sharded(rng.randn(200, 4).astype(np.float32))
+    with config.set(dtype="bfloat16"):
+        with pytest.warns(RuntimeWarning, match="Pallas"):
+            KMeans(n_clusters=2, random_state=0, max_iter=1,
+                   use_pallas=True).fit(X)
+
+
+def test_bf16_leaves_f32_defaults_untouched():
+    """Default config must not change dtypes anywhere (guards against a
+    latched global)."""
+    from dask_ml_tpu.models.sgd import _grid_builders
+    from dask_ml_tpu.parallel import as_sharded
+
+    assert config.get_config().dtype == "float32"
+    X = rng.randn(64, 4).astype(np.float32)
+    Xs = as_sharded(X)
+    fX, _ = _grid_builders(Xs.mesh, 8, 8, None)
+    assert fX(Xs.data).dtype == np.float32
