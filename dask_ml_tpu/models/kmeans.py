@@ -205,22 +205,56 @@ def _block_weighted_topl(X, weights, key, l):
     return kv, jnp.take(X, idx, axis=0)
 
 
+def _proc_key(key, b):
+    """Per-block Gumbel key, decorrelated ACROSS processes — identical
+    key sequences on every process would correlate the sampling noise of
+    different shards' rows. Nested fold_in (not an offset, which would
+    collide past the offset's stride)."""
+    from ..parallel import distributed as dist
+
+    pid = dist.process_index()
+    pkey = key if pid == 0 else jax.random.fold_in(key, 1_000_000 + pid)
+    return jax.random.fold_in(pkey, b)
+
+
+def _global_topl(kvs, rows, l):
+    """Top-l rows by Gumbel key across ALL processes: local top-l pads
+    to fixed l (−inf keys), one allgather, re-top — the exact global
+    weighted sample, identical on every process (the Gumbel top-l merge
+    is associative)."""
+    from ..parallel import distributed as dist
+
+    top = np.argsort(-kvs)[:l]
+    top = top[np.isfinite(kvs[top])]
+    if dist.process_count() == 1:
+        return rows[top]
+    d = rows.shape[1]
+    kv_p = np.full(l, -np.inf, np.float32)
+    kv_p[: top.size] = kvs[top]
+    rw_p = np.zeros((l, d), np.float32)
+    rw_p[: top.size] = rows[top]
+    kv_all = dist.allgather_host(kv_p).ravel()
+    rw_all = dist.allgather_host(rw_p).reshape(-1, d)
+    t = np.argsort(-kv_all)[:l]
+    t = t[np.isfinite(kv_all[t])]
+    return rw_all[t]
+
+
 def _streamed_sample(stream, weights_fn, key, l):
     """Draw l rows without replacement, P ∝ weights_fn(block), across a
-    BlockStream. Returns (l, d) host-merged rows."""
+    BlockStream — across every process's stream under a live multi-host
+    runtime. Returns (≤l, d) host-merged rows, identical everywhere."""
     kvs, rows = [], []
     for b, blk in enumerate(stream):
         Xb = blk.arrays[0]
         w = weights_fn(blk)
         lb = min(l, Xb.shape[0])
-        kv, r = _block_weighted_topl(Xb, w, jax.random.fold_in(key, b), lb)
+        kv, r = _block_weighted_topl(Xb, w, _proc_key(key, b), lb)
         kvs.append(np.asarray(kv))
         rows.append(np.asarray(r))
     kvs = np.concatenate(kvs)
     rows = np.concatenate(rows, axis=0)
-    top = np.argsort(-kvs)[:l]
-    top = top[np.isfinite(kvs[top])]
-    return rows[top]
+    return _global_topl(kvs, rows, l)
 
 
 class _LloydCheckpoint:
@@ -276,8 +310,10 @@ def _streamed_lloyd(stream, centers0, max_iter, tol2, logger=None,
     _LloydCheckpoint) persists every k passes so a killed multi-hour fit
     resumes mid-run, and clears on completion."""
     from ..config import mxu_dtype
+    from ..parallel import distributed as dist
 
     mxu = mxu_dtype()
+    multi = dist.process_count() > 1
     centers = jnp.asarray(centers0)
     n_iter = start_it
     for it in range(start_it, int(max_iter)):
@@ -288,6 +324,15 @@ def _streamed_lloyd(stream, centers0, max_iter, tol2, logger=None,
             sums = s if sums is None else sums + s
             counts = c if counts is None else counts + c
             inertia = i if inertia is None else inertia + i
+        if multi:
+            # per-process block stats → global (bit-identical on every
+            # process, so centers never diverge across hosts)
+            sums, counts, inertia = (
+                jnp.asarray(np.asarray(a, np.float32)) for a in
+                dist.psum_host(np.asarray(sums, np.float64),
+                               np.asarray(counts, np.float64),
+                               np.asarray(inertia, np.float64))
+            )
         new = jnp.where(counts[:, None] > 0, sums / counts[:, None], centers)
         shift2 = float(jnp.sum((new - centers) ** 2))
         centers = new
@@ -295,6 +340,7 @@ def _streamed_lloyd(stream, centers0, max_iter, tol2, logger=None,
         if logger is not None:
             logger.log(step=it, inertia=float(inertia), center_shift2=shift2)
         if ckpt is not None and n_iter % ckpt.every == 0:
+            # (multi-host passes ckpt=None — see _fit_streamed)
             ckpt.save(centers, n_iter)
         if shift2 <= tol2:
             break
@@ -327,19 +373,19 @@ def init_scalable_streamed(stream, n_clusters, random_state, max_iter=None,
             dmin, phi_b = _cost_to_candidates(Xb, blk.mask, cands, valid)
             phi += float(phi_b)
             lb = min(l, Xb.shape[0])
-            kv, rw = _block_weighted_topl(
-                Xb, dmin, jax.random.fold_in(kr, b), lb
-            )
+            kv, rw = _block_weighted_topl(Xb, dmin, _proc_key(kr, b), lb)
             kvs.append(np.asarray(kv))
             rows.append(np.asarray(rw))
+        from ..parallel import distributed as dist
+
+        phi = float(dist.psum_host(np.asarray(phi)))  # global cost
         if phi <= 0.0:
             break
         kvs = np.concatenate(kvs)
         rows = np.concatenate(rows, axis=0)
-        top = np.argsort(-kvs)[:l]
-        top = top[np.isfinite(kvs[top])]
-        if top.size:
-            cands_list.append(rows[top])
+        picked = _global_topl(kvs, rows, l)
+        if len(picked):
+            cands_list.append(picked)
     cands_h = np.concatenate(cands_list, axis=0)
     cands = jnp.asarray(cands_h)
     valid = jnp.ones((cands.shape[0],), jnp.float32)
@@ -347,11 +393,17 @@ def init_scalable_streamed(stream, n_clusters, random_state, max_iter=None,
     for blk in stream:
         w = _candidate_weights(blk.arrays[0], blk.mask, cands, valid)
         weights = w if weights is None else weights + w
-    w_h = np.asarray(weights)
+    from ..parallel import distributed as dist
+
+    w_h = np.asarray(dist.psum_host(np.asarray(weights, np.float64)))
     w_h = np.where(w_h > 0, w_h, 1e-6)
+    # DETERMINISTIC seed even when random_state is None: the candidate
+    # sampling above already pins PRNGKey(0) in that case, and under
+    # multi-host every process must reduce the (identical) candidate set
+    # to the IDENTICAL centers — an unseeded draw would diverge them
     local = SkKMeans(
         n_clusters=n_clusters, init="k-means++", n_init=1,
-        random_state=None if random_state is None else int(random_state),
+        random_state=0 if random_state is None else int(random_state),
     ).fit(cands_h, sample_weight=w_h)
     return jnp.asarray(local.cluster_centers_, cands.dtype)
 
@@ -540,11 +592,18 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                 ))
             from sklearn.cluster import kmeans_plusplus
 
-            m = min(stream.n_rows, max(10 * self.n_clusters, 500))
+            from ..parallel import distributed as dist
+
+            # GLOBAL row count sizes the sample so every process's
+            # _global_topl allgather payload has the same shape; the
+            # deterministic seed keeps centers0 identical everywhere
+            # (same rule as init_scalable_streamed)
+            n_glob = int(dist.psum_host(np.asarray(float(stream.n_rows))))
+            m = min(n_glob, max(10 * self.n_clusters, 500))
             sample = _streamed_sample(stream, lambda blk: blk.mask, key, m)
             centers, _ = kmeans_plusplus(
                 sample, self.n_clusters,
-                random_state=None if self.random_state is None
+                random_state=0 if self.random_state is None
                 else int(self.random_state),
             )
             return jnp.asarray(centers, jnp.float32)
@@ -560,7 +619,15 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         from ..parallel.streaming import BlockStream
         from ..utils.observability import fit_logger
 
-        n, d = X.shape
+        n_local, d = X.shape
+        from ..parallel import distributed as dist
+
+        multi = dist.process_count() > 1
+        # multi-host: X is the process-local memmap shard; every global
+        # statistic (n, variance, Lloyd stats, inertia, the k-means||
+        # sampling) merges over the psum/allgather plane
+        n = int(dist.psum_host(np.asarray(float(n_local)))) if multi \
+            else n_local
         if self.n_clusters > n:
             raise ValueError(
                 f"n_clusters={self.n_clusters} > n_samples={n}"
@@ -573,10 +640,17 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             bs, bss = _block_moments(blk.arrays[0], blk.mask)
             s = bs if s is None else s + bs
             ss = bss if ss is None else ss + bss
+        if multi:
+            s, ss = (np.asarray(a) for a in dist.psum_host(
+                np.asarray(s, np.float64), np.asarray(ss, np.float64)
+            ))
         mean = s / n
         var = ss / n - mean * mean
-        tol2 = float(self.tol * jnp.mean(var))
-        ckpt = self._make_ckpt(X, n, d)
+        tol2 = float(self.tol * jnp.mean(jnp.asarray(var)))
+        # multi-host checkpointing is OFF: resume must be a COLLECTIVE
+        # decision (a coordinator-only resume would desync every
+        # process's collective schedule); needs shared-FS coordination
+        ckpt = None if multi else self._make_ckpt(X, n, d)
         resume = ckpt.restore() if ckpt is not None else None
         if resume is not None:
             # resume SKIPS init entirely — k-means|| costs ~10 full
@@ -590,7 +664,7 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                 stream, centers0, self.max_iter, tol2, logger=logger,
                 ckpt=ckpt, start_it=start_it,
             )
-        labels = np.empty(n, np.int32)
+        labels = np.empty(n_local, np.int32)  # labels stay process-local
         inertia = 0.0
         cursor = 0
         for blk in stream:
@@ -599,6 +673,8 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             labels[cursor:cursor + m] = np.asarray(lb)[:m]
             inertia += float(ib)
             cursor += m
+        if multi:
+            inertia = float(dist.psum_host(np.asarray(inertia)))
         if not np.isfinite(inertia) or \
                 not bool(jnp.isfinite(centers).all()):
             raise FloatingPointError(

@@ -112,9 +112,15 @@ class PCA(TransformerMixin, BaseEstimator):
         randomized solvers of the resident path, with one pass where
         Halko needs two. Ref: the reference's ``da.linalg`` reductions
         over host-backed chunks (SURVEY.md §3.3)."""
-        from ..parallel.streaming import BlockStream
+        from ..parallel import distributed as dist
+        from ..parallel.streaming import BlockStream, _slice_dense
 
         n, d = X.shape
+        multi = dist.process_count() > 1
+        if multi:
+            # multi-host: X is the process-local shard; n/moments merge
+            # globally so every process computes the identical global PCA
+            n = int(dist.psum_host(np.asarray(float(n))))
         if n < d:
             raise ValueError(
                 "PCA requires tall data (n_samples >= n_features); got "
@@ -126,13 +132,18 @@ class PCA(TransformerMixin, BaseEstimator):
             frac, k = self.n_components, min(n, d)
         else:
             k = _resolve_n_components(self.n_components, n, d)
-        from ..parallel.streaming import _slice_dense
-
         stream = BlockStream((X,), block_rows=block_rows)
         # shift estimate from a small head slice (exactness not needed —
-        # any shift near the mean kills the cancellation); _slice_dense
-        # handles sparse sources (one small densified slice)
-        shift = _slice_dense(X, 0, min(4096, n), np.float64).mean(axis=0)
+        # any shift near the mean kills the cancellation, but it must be
+        # IDENTICAL on every process: block sums with different shifts
+        # cannot merge); _slice_dense handles sparse sources
+        head = _slice_dense(X, 0, min(4096, X.shape[0]), np.float64)
+        if multi:
+            hs, hn = dist.psum_host(head.sum(axis=0),
+                                    np.asarray(float(len(head))))
+            shift = hs / max(float(hn), 1.0)
+        else:
+            shift = head.mean(axis=0)
         shift_dev = jnp.asarray(shift, jnp.float32)
         from ..config import mxu_dtype
 
@@ -144,6 +155,8 @@ class PCA(TransformerMixin, BaseEstimator):
                                         shift_dev, mxu_dtype=mxu)
             s += np.asarray(bs, np.float64)
             g += np.asarray(bg, np.float64)
+        if multi:
+            s, g = dist.psum_host(s, g)
         mean_c = s / n  # mean of the SHIFTED data
         mean = shift + mean_c
         cov = (g - n * np.outer(mean_c, mean_c)) / (n - 1)
@@ -495,6 +508,7 @@ class IncrementalPCA(PCA):
             yield _slice_dense(X, i, min(i + bs, n), np.float32)
 
     def partial_fit(self, X, y=None, check_input=True):
+        self._reject_multihost()
         import scipy.sparse as sp
 
         if isinstance(X, ShardedArray):
@@ -545,7 +559,21 @@ class IncrementalPCA(PCA):
         # algorithm must fit block-wise then transform
         return self.fit(X, y).transform(X)
 
+    @staticmethod
+    def _reject_multihost():
+        from ..parallel import distributed as dist
+
+        if dist.process_count() > 1:
+            # the incremental SVD update is SEQUENTIAL and
+            # order-dependent — it cannot psum across shards; PCA's
+            # streamed moments fit is the multi-host path
+            raise NotImplementedError(
+                "IncrementalPCA is single-process; use PCA (streamed "
+                "moments psum globally) under a multi-host runtime"
+            )
+
     def fit(self, X, y=None):
+        self._reject_multihost()
         if hasattr(self, "n_samples_seen_"):
             del self.n_samples_seen_
         if not hasattr(X, "shape"):  # sklearn-style array-likes (lists)

@@ -621,9 +621,20 @@ class _SGDBase(BaseEstimator):
                 self.classes_ = None  # fresh fit re-derives classes
         if isinstance(X, ShardedArray):
             return self._fit_device(X, y, kwargs)
+        from ..parallel import distributed as dist
         from ..parallel.streaming import (BlockStream, _is_sparse_source,
                                           fit_block_rows)
 
+        if dist.process_count() > 1:
+            # sequential per-block updates are ORDER-dependent — unlike
+            # the additive GLM/KMeans/PCA accumulators they cannot psum
+            # into a global fit; silently fitting each shard separately
+            # would hand every process a different model
+            raise NotImplementedError(
+                "host-streamed SGD fit is single-process; under a "
+                "multi-host runtime use the streamed GLM fits (global "
+                "psum merge) or device-resident data on the global mesh"
+            )
         # sparse X streams as-is: BlockStream densifies one block at a
         # time (the text-pipeline bridge — a whole-corpus np.asarray
         # would materialize the dense matrix this path exists to avoid)

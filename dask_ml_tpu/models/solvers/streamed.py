@@ -234,12 +234,20 @@ def _block_admm_local_multi(X, y, mask, B, U, Z, rho, n_rows, local_iter,
 # ---------------------------------------------------------------------------
 
 class StreamedObjective:
-    """value_and_grad over a BlockStream; counts data passes."""
+    """value_and_grad over a BlockStream; counts data passes.
+
+    ``reduce``: optional cross-PROCESS sum of the per-pass accumulators
+    (``parallel.distributed.psum_host``) — under a live multi-host
+    runtime each process streams only its local shard, the raw
+    loss/gradient/Hessian sums merge once per pass, and every process
+    sees the identical GLOBAL objective (``n_rows`` is then the global
+    row count). The host solvers run replicated on identical inputs, so
+    their iterates never diverge across processes."""
 
     n_classes = None  # multiclass subclass overrides
 
     def __init__(self, stream, n_rows, lam, pmask, l1_ratio, family, reg,
-                 intercept, logger=None):
+                 intercept, logger=None, reduce=None):
         self.stream = stream
         self.n_rows = float(n_rows)
         self.lam = lam
@@ -250,6 +258,7 @@ class StreamedObjective:
         self.intercept = intercept
         self.passes = 0
         self.logger = logger
+        self.reduce = reduce
 
     def _smooth_clone(self):
         """Same objective with the penalty stripped (proximal solvers
@@ -259,8 +268,19 @@ class StreamedObjective:
         return type(self)(
             self.stream, self.n_rows, self.lam * 0.0, self.pmask,
             self.l1_ratio, self.family, "none", self.intercept,
-            logger=self.logger,
+            logger=self.logger, reduce=self.reduce,
         )
+
+    def _merge(self, *accs):
+        """Local pass sums → global sums (merged f64 on host, identical
+        on every process; back to f32 for the device epilogue so x64
+        stays untouched). Identity without a reduce."""
+        if self.reduce is None:
+            return accs if len(accs) > 1 else accs[0]
+        out = self.reduce(*(np.asarray(a, np.float64) for a in accs))
+        out = out if isinstance(out, tuple) else (out,)
+        out = tuple(np.asarray(o, np.float32) for o in out)
+        return out if len(out) > 1 else out[0]
 
     def value_and_grad(self, beta):
         self.passes += 1
@@ -272,6 +292,7 @@ class StreamedObjective:
                                    self.intercept)
             vs = v if vs is None else vs + v
             gs = g if gs is None else gs + g
+        vs, gs = self._merge(vs, gs)
         val, grad = _finish_vg(vs, gs, beta, self.n_rows, self.lam,
                                self.pmask, self.l1_ratio, self.reg)
         return float(val), np.asarray(grad, np.float64)
@@ -285,6 +306,7 @@ class StreamedObjective:
             v = _block_val(beta, Xb, yb, blk.mask, self.family,
                            self.intercept)
             vs = v if vs is None else vs + v
+        vs = self._merge(vs)
         pen = regularizers.value(self.reg, beta, self.lam, self.pmask,
                                  self.l1_ratio)
         return float(vs / self.n_rows + pen)
@@ -300,6 +322,7 @@ class StreamedObjective:
             vs = v if vs is None else vs + v
             gs = g if gs is None else gs + g
             hs = h if hs is None else hs + h
+        vs, gs, hs = self._merge(vs, gs, hs)
         val, grad = _finish_vg(vs, gs, beta, self.n_rows, self.lam,
                                self.pmask, self.l1_ratio, self.reg)
         return (float(val), np.asarray(grad, np.float64),
@@ -329,6 +352,7 @@ class MulticlassStreamedObjective(StreamedObjective):
             self.stream, self.n_rows, self.lam * 0.0, self.pmask,
             self.l1_ratio, self.family, "none", self.intercept,
             logger=self.logger, n_classes=self.n_classes,
+            reduce=self.reduce,
         )
 
     def _B(self, beta_flat):
@@ -346,7 +370,8 @@ class MulticlassStreamedObjective(StreamedObjective):
                                          self.intercept, self.n_classes)
             vs = v if vs is None else vs + v
             gs = g if gs is None else gs + g
-        val, grad = _finish_vg(vs, gs.ravel(),
+        vs, gs = self._merge(vs, gs)
+        val, grad = _finish_vg(vs, jnp.asarray(gs).ravel(),
                                jnp.asarray(beta, jnp.float32),
                                self.n_rows, self.lam, self.pmask,
                                self.l1_ratio, self.reg)
@@ -361,6 +386,7 @@ class MulticlassStreamedObjective(StreamedObjective):
             v = _block_val_multi(B, Xb, yb, blk.mask, self.family,
                                  self.intercept, self.n_classes)
             vs = v if vs is None else vs + v
+        vs = self._merge(vs)
         pen = regularizers.value(self.reg, jnp.asarray(beta, jnp.float32),
                                  self.lam, self.pmask, self.l1_ratio)
         return float(vs / self.n_rows + pen)
@@ -378,7 +404,8 @@ class MulticlassStreamedObjective(StreamedObjective):
             vs = v if vs is None else vs + v
             gs = g if gs is None else gs + g
             hs = h if hs is None else hs + h
-        val, grad = _finish_vg(vs, gs.ravel(),
+        vs, gs, hs = self._merge(vs, gs, hs)
+        val, grad = _finish_vg(vs, jnp.asarray(gs).ravel(),
                                jnp.asarray(beta, jnp.float32),
                                self.n_rows, self.lam, self.pmask,
                                self.l1_ratio, self.reg)
@@ -570,6 +597,10 @@ def admm(obj: StreamedObjective, beta0, max_iter=250, tol=1e-4, rho=1.0,
     if reg == "none":
         reg, lam = "l2", 0.0
     n_blocks = obj.stream.n_blocks
+    # consensus spans every process's blocks: the z-update and residuals
+    # use GLOBAL block sums/counts so all processes step identically
+    reduce = obj.reduce or (lambda *a: a[0] if len(a) == 1 else a)
+    glob_blocks = int(reduce(np.asarray(float(n_blocks))))
     d = len(np.asarray(beta0))
     B = np.tile(np.asarray(beta0, np.float32)[None], (n_blocks, 1))
     U = np.zeros((n_blocks, d), np.float32)
@@ -599,14 +630,18 @@ def admm(obj: StreamedObjective, beta0, max_iter=250, tol=1e-4, rho=1.0,
                     local_iter, obj.family, obj.intercept,
                 ))
             bi += 1
-        bu_mean = jnp.asarray((B + U).mean(axis=0))
+        bu_sum, = (reduce(np.asarray((B + U).sum(axis=0), np.float64)),)
+        bu_mean = jnp.asarray(np.asarray(bu_sum, np.float32) / glob_blocks)
         z_new = regularizers.prox(reg, bu_mean, lam,
-                                  1.0 / (rho_f * n_blocks), pmask_j,
+                                  1.0 / (rho_f * glob_blocks), pmask_j,
                                   obj.l1_ratio)
         z_h = np.asarray(z_new, np.float32)
         U = U + B - z_h[None, :]
-        primal = float(np.sqrt(((B - z_h[None, :]) ** 2).sum()))
-        dual = float(rho_f * np.sqrt(n_blocks)
+        primal2 = float(reduce(
+            np.asarray(((B - z_h[None, :]) ** 2).sum(), np.float64)
+        ))
+        primal = float(np.sqrt(primal2))
+        dual = float(rho_f * np.sqrt(glob_blocks)
                      * np.linalg.norm(z_h - np.asarray(z)))
         z = z_new
         obj.log(it, primal, dual)
@@ -635,14 +670,18 @@ STREAMED_SOLVERS = {
 
 def solve_streamed(solver, stream, n_rows, beta0, family, reg, lam, pmask,
                    l1_ratio=0.5, intercept=True, max_iter=100, tol=1e-6,
-                   logger=None, **kwargs):
+                   logger=None, reduce=None, **kwargs):
+    """``reduce`` (``distributed.psum_host``): merge per-pass block sums
+    across processes — each process streams its LOCAL shard, ``n_rows``
+    is the GLOBAL count, and the fit equals the single-process fit over
+    the concatenated data."""
     if solver not in STREAMED_SOLVERS:
         raise ValueError(
             f"Unknown solver {solver!r}; options: {sorted(STREAMED_SOLVERS)}"
         )
     obj = StreamedObjective(
         stream, n_rows, jnp.asarray(lam, jnp.float32), jnp.asarray(pmask),
-        l1_ratio, family, reg, intercept, logger=logger,
+        l1_ratio, family, reg, intercept, logger=logger, reduce=reduce,
     )
     beta, info = STREAMED_SOLVERS[solver](
         obj, beta0, max_iter=max_iter, tol=tol, **kwargs
@@ -656,7 +695,7 @@ def solve_streamed(solver, stream, n_rows, beta0, family, reg, lam, pmask,
 
 def solve_streamed_multi(solver, stream, n_rows, B0, family, reg, lam,
                          pmask, l1_ratio=0.5, intercept=True, max_iter=100,
-                         tol=1e-6, logger=None, **kwargs):
+                         tol=1e-6, logger=None, reduce=None, **kwargs):
     """One-vs-rest streamed fit: ``B0``/result are (C, d); ``pmask`` is
     the per-class (d,) mask, tiled here. Every epoch reads the data
     ONCE for all classes (class-stacked block kernels); the host solvers
@@ -671,7 +710,7 @@ def solve_streamed_multi(solver, stream, n_rows, B0, family, reg, lam,
     obj = MulticlassStreamedObjective(
         stream, n_rows, jnp.asarray(lam, jnp.float32),
         jnp.asarray(pmask_t), l1_ratio, family, reg, intercept,
-        logger=logger, n_classes=C,
+        logger=logger, n_classes=C, reduce=reduce,
     )
     beta, info = STREAMED_SOLVERS[solver](
         obj, B0.ravel(), max_iter=max_iter, tol=tol, **kwargs

@@ -225,6 +225,16 @@ class BlockStream:
 
     def __init__(self, arrays, block_rows=None, mesh=None, shuffle=False,
                  seed=None, dtype=np.float32, prefetch=None):
+        if mesh is None:
+            from . import distributed as dist
+
+            if dist.process_count() > 1:
+                # live multi-process runtime: blocks are PROCESS-LOCAL
+                # data — they shard over this process's devices only
+                # (a global-mesh device_put asserts value equality
+                # across processes); cross-process merging is the
+                # consumer's explicit psum_host of its block sums
+                mesh = dist.local_mesh()
         self.mesh = resolve_mesh(mesh)
         # sparse sources normalize to CSR once: COO/BSR don't support
         # row slicing at all and CSC slices rows in O(nnz)

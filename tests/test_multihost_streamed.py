@@ -1,0 +1,161 @@
+"""Multi-host OUT-OF-CORE fits (VERDICT r4 missing #3): each process
+streams its local memmap shard; per-pass block sums merge over the
+psum/allgather plane; the result matches the single-process fit over the
+concatenated data. Real 2-process jax.distributed bring-up, 4 virtual
+CPU devices per process (2 procs x 4 devices = the dryrun shape).
+
+Ref: SURVEY.md §1 L2 (the reference's dd-from-files ingest with
+per-worker partitions feeding one global fit) and §3.2."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
+    outdir = sys.argv[4]
+    jax.distributed.initialize(
+        coordinator_address="127.0.0.1:" + port,
+        num_processes=nproc, process_id=pid)
+    import dask_ml_tpu.config as config
+    from dask_ml_tpu.linear_model import LogisticRegression
+    from dask_ml_tpu.cluster import KMeans
+
+    # this process's shard: rows [pid*n_loc, (pid+1)*n_loc) of the
+    # deterministic global dataset the parent also generates
+    rng = np.random.RandomState(0)
+    n_glob, d = 4096, 6
+    Xg = rng.randn(n_glob, d).astype(np.float32)
+    w = rng.randn(d).astype(np.float32)
+    yg = (Xg @ w + 0.3 * rng.randn(n_glob) > 0).astype(np.float32)
+    Xg[yg > 0, :2] += 1.5   # separable-ish + cluster structure
+    n_loc = n_glob // nproc
+    lo, hi = pid * n_loc, (pid + 1) * n_loc
+    path = os.path.join(outdir, f"shard{{pid}}.f32")
+    m = np.memmap(path, dtype=np.float32, mode="w+", shape=(n_loc, d))
+    m[:] = Xg[lo:hi]
+    m.flush()
+    X = np.memmap(path, dtype=np.float32, mode="r", shape=(n_loc, d))
+    y = yg[lo:hi]
+
+    with config.set(stream_block_rows=256):
+        for solver in ("lbfgs", "admm"):
+            clf = LogisticRegression(solver=solver, max_iter=60).fit(X, y)
+            np.save(os.path.join(outdir, f"coef_{{solver}}_{{pid}}.npy"),
+                    np.r_[clf.coef_.ravel(), clf.intercept_])
+        km = KMeans(n_clusters=2, random_state=0, max_iter=20).fit(X)
+        np.save(os.path.join(outdir, f"centers_{{pid}}.npy"),
+                km.cluster_centers_)
+        np.save(os.path.join(outdir, f"inertia_{{pid}}.npy"),
+                np.asarray([km.inertia_]))
+        from dask_ml_tpu.decomposition import PCA
+        p = PCA(n_components=3).fit(X)
+        np.save(os.path.join(outdir, f"pca_{{pid}}.npy"),
+                np.r_[p.mean_[None], p.components_])
+    print("proc", pid, "OK", flush=True)
+""")
+
+
+@pytest.mark.slow
+def test_two_process_streamed_fits_match_single(tmp_path):
+    nproc = 2
+    last = None
+    for _attempt in range(2):
+        port = str(_free_port())
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", _WORKER.format(repo=REPO),
+                 str(pid), str(nproc), port, str(tmp_path)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True,
+            )
+            for pid in range(nproc)
+        ]
+        try:
+            outs = [p.communicate(timeout=600)[0] for p in procs]
+        except subprocess.TimeoutExpired:
+            # a hung collective is exactly the failure mode multi-host
+            # bugs produce — reap the workers, then retry/fail
+            for p in procs:
+                p.kill()
+            outs = [p.communicate()[0] for p in procs]
+        last = outs
+        if all(p.returncode == 0 for p in procs):
+            break
+    else:
+        pytest.fail("workers failed:\n" + "\n---\n".join(last))
+
+    # single-process reference over the CONCATENATED data (same blocks
+    # per process: each worker streamed 256-row blocks of its shard)
+    from dask_ml_tpu._platform import force_cpu_platform  # noqa: F401
+    import dask_ml_tpu.config as config
+    from dask_ml_tpu.cluster import KMeans
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    rng = np.random.RandomState(0)
+    n_glob, d = 4096, 6
+    Xg = rng.randn(n_glob, d).astype(np.float32)
+    w = rng.randn(d).astype(np.float32)
+    yg = (Xg @ w + 0.3 * rng.randn(n_glob) > 0).astype(np.float32)
+    Xg[yg > 0, :2] += 1.5
+
+    with config.set(stream_block_rows=256):
+        for solver, tol in (("lbfgs", 2e-3), ("admm", 2e-2)):
+            ref = LogisticRegression(solver=solver, max_iter=60).fit(
+                Xg, yg
+            )
+            ref_vec = np.r_[ref.coef_.ravel(), ref.intercept_]
+            for pid in range(nproc):
+                got = np.load(tmp_path / f"coef_{solver}_{pid}.npy")
+                np.testing.assert_allclose(
+                    got, ref_vec, rtol=tol, atol=tol,
+                    err_msg=f"{solver} proc {pid}",
+                )
+        ref_km = KMeans(n_clusters=2, random_state=0, max_iter=20).fit(Xg)
+        # both processes computed identical global centers
+        c0 = np.load(tmp_path / "centers_0.npy")
+        c1 = np.load(tmp_path / "centers_1.npy")
+        np.testing.assert_allclose(c0, c1, atol=1e-6)
+        # centers match the single-process fit up to cluster permutation
+        ref_sorted = ref_km.cluster_centers_[
+            np.argsort(ref_km.cluster_centers_[:, 0])
+        ]
+        got_sorted = c0[np.argsort(c0[:, 0])]
+        np.testing.assert_allclose(got_sorted, ref_sorted, rtol=2e-2,
+                                   atol=2e-2)
+        i0 = float(np.load(tmp_path / "inertia_0.npy")[0])
+        assert abs(i0 - ref_km.inertia_) / ref_km.inertia_ < 2e-2
+        # PCA: identical across processes AND matches single-process
+        from dask_ml_tpu.decomposition import PCA
+
+        ref_p = PCA(n_components=3).fit(Xg)
+        p0 = np.load(tmp_path / "pca_0.npy")
+        p1 = np.load(tmp_path / "pca_1.npy")
+        np.testing.assert_allclose(p0, p1, atol=1e-7)
+        np.testing.assert_allclose(p0[0], ref_p.mean_, atol=1e-4)
+        np.testing.assert_allclose(
+            np.abs(p0[1:] @ ref_p.components_.T), np.eye(3), atol=1e-3
+        )
