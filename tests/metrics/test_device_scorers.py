@@ -177,6 +177,7 @@ class TestScorerIntegration:
         want = skm.roc_auc_score(y, clf.decision_function(X))
         np.testing.assert_allclose(got, want, rtol=1e-5)
 
+    @pytest.mark.slow
     def test_search_scoring_no_host_folds(self, xy_classification):
         """The VERDICT done-bar: adaptive search with scoring='roc_auc'
         never routes folds through the host interop cache."""

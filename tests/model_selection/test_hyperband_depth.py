@@ -82,6 +82,7 @@ def test_hyperband_with_sklearn_estimator(data):
     assert 0 <= h.score(X, y) <= 1
 
 
+@pytest.mark.slow
 def test_hyperband_with_device_sgd(data):
     """Device-resident SGD (models/sgd.py) under the adaptive search,
     with classes passed through fit params (sklearn contract)."""

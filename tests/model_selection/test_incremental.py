@@ -133,6 +133,7 @@ def test_inverse_decay_alias(data):
     assert len(s.cv_results_["params"]) == 2
 
 
+@pytest.mark.slow
 def test_device_solo_trials_run_on_submeshes():
     """Heterogeneous device candidates (multiclass SGD has no batch key)
     advance CONCURRENTLY on disjoint submeshes instead of serializing on

@@ -170,6 +170,7 @@ def test_multimetric_refit_validation(xy_classification):
     assert not hasattr(s, "best_index_")
 
 
+@pytest.mark.slow
 def test_grid_search_list_of_grids(data):
     """param_grid as a LIST of grids: candidates are the union, and
     params absent from a sub-grid are masked in cv_results_ (sklearn and
@@ -225,6 +226,7 @@ def test_search_with_custom_make_scorer(data):
     assert len(s.cv_results_["mean_test_score"]) == 2
 
 
+@pytest.mark.slow
 def test_search_accepts_cv_splitter_objects(data):
     """cv may be an int or any splitter instance (KFold/ShuffleSplit),
     as in the reference."""

@@ -43,6 +43,7 @@ def test_threaded_matches_synchronous(xy_classification, seq_search):
     assert seq_search.best_params_ == par.best_params_
 
 
+@pytest.mark.slow
 def test_threaded_sharded_input(xy_classification, seq_search):
     from dask_ml_tpu.parallel import as_sharded
 

@@ -109,6 +109,7 @@ def test_standard_scaler_large_offset_precision():
     assert np.abs(got - ref.transform(X64)).max() < 0.05
 
 
+@pytest.mark.slow
 def test_quantile_transformer_subsample_and_random_state(monkeypatch):
     """subsample/random_state are honored (VERDICT r3 weak #5): a fit
     over n > subsample rows computes quantiles from a seeded uniform

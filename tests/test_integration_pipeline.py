@@ -34,6 +34,7 @@ def frame():
     return df, target.astype(np.float32).to_numpy()
 
 
+@pytest.mark.slow
 def test_frame_to_search_journey(frame):
     """frame → categorize → dummy → column-scale → device → GridSearchCV
     over a Pipeline → predict: every layer hands off to the next without

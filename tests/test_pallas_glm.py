@@ -113,6 +113,7 @@ def test_auto_gate_falls_back_when_kernel_fails(monkeypatch):
                            solver_kwargs={"use_pallas": True}).fit(X, y)
 
 
+@pytest.mark.slow
 def test_fused_multiclass_matches_vmapped():
     """The flat multi-target kernel solve (one X pass for ALL classes
     per iteration) converges to the vmapped per-class solution — the

@@ -62,6 +62,7 @@ def test_clean_input_unaffected(poisoned):
     assert np.isfinite(clf.coef_).all()
 
 
+@pytest.mark.slow
 def test_lbfgs_kill_and_resume(tmp_path, poisoned, monkeypatch):
     """Every-k-iteration checkpointing: a solve killed mid-run resumes
     from the last saved chunk and reaches the same answer as an

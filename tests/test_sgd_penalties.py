@@ -17,6 +17,7 @@ def _data(seed=0, n=400, d=20, informative=3):
     return X, y
 
 
+@pytest.mark.slow
 def test_l1_sparsifies_vs_l2():
     X, y = _data()
     l2 = SGDClassifier(penalty="l2", alpha=0.05, eta0=0.5, max_iter=40,
@@ -70,6 +71,7 @@ def test_fit_intercept_false_keeps_zero():
     assert isinstance(float(m2.intercept_[0]), float)
 
 
+@pytest.mark.slow
 def test_regressor_l1_sparsifies():
     rng = np.random.RandomState(4)
     X = rng.randn(300, 15).astype(np.float32)

@@ -85,6 +85,7 @@ def test_spectral_callable_affinity():
     assert first[0] != second[0]
 
 
+@pytest.mark.slow
 def test_spectral_honest_params_raise():
     """Params the TSQR/Nystrom formulation cannot honor raise instead of
     silently no-oping (VERDICT r3 weak #4)."""

@@ -155,6 +155,7 @@ def test_distributed_single_host():
     np.testing.assert_array_equal(v, [1.0, 2.0])
 
 
+@pytest.mark.slow
 def test_fused_epoch_matches_block_loop():
     """The Incremental wrapper's fused-epoch program (one lax.scan per
     pass) produces the SAME weights as the per-block partial_fit loop —

@@ -236,6 +236,7 @@ def test_count_vectorizer_all_pruned_raises():
         )
 
 
+@pytest.mark.slow
 def test_sketched_quantiles_parity(monkeypatch):
     """Histogram-sketch quantiles within tolerance of exact (VERDICT r2
     missing #7). 3e5 rows exercises the identical kernel the >1M auto
