@@ -6,6 +6,10 @@ host RAM -> HBM with the optimizer state resident on device.
 """
 
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import tempfile
 
 import numpy as np

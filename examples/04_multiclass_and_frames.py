@@ -14,6 +14,10 @@ global design matrix with only shard-boundary rows crossing hosts.
 """
 
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import tempfile
 
 import numpy as np

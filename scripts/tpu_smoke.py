@@ -164,6 +164,56 @@ def main():
             total += blk.n_rows
         assert total == len(Xh), total
 
+    def round5_surfaces():
+        """Round-5 surfaces on the real chip: sparse CSR streaming
+        bridge, device roc_auc/f1 scorers, bf16 matmul policy
+        (KMeans distances + fused SGD epoch grid at bf16/f32-acc),
+        streamed-SGD overlap stats."""
+        import scipy.sparse as sp
+
+        import dask_ml_tpu.config as config
+        from dask_ml_tpu.cluster import KMeans
+        from dask_ml_tpu.linear_model import LogisticRegression
+        from dask_ml_tpu.metrics import f1_score, roc_auc_score
+        from dask_ml_tpu.metrics.scorer import get_scorer
+        from dask_ml_tpu.models.sgd import SGDClassifier
+        from dask_ml_tpu.wrappers import Incremental
+
+        rng = np.random.RandomState(11)
+        Xcsr = sp.random(20_000, 512, density=0.05, format="csr",
+                         random_state=rng)
+        rowsum = np.asarray(Xcsr.sum(axis=1)).ravel()
+        ycsr = (rowsum > np.median(rowsum)).astype(np.float32)
+        with config.set(stream_block_rows=4096):
+            spc = LogisticRegression(solver="lbfgs", max_iter=20).fit(
+                Xcsr, ycsr
+            )
+        assert np.isfinite(spc.coef_).all()
+        clf = LogisticRegression(solver="lbfgs", max_iter=20).fit(X, y)
+        auc = get_scorer("roc_auc")(clf, X, y)
+        assert 0.5 < auc <= 1.0, auc
+        yh, ph = y.to_numpy(), clf.predict(X)
+        import sklearn.metrics as skm
+
+        assert abs(f1_score(yh, ph) - skm.f1_score(yh, ph)) < 1e-6
+        df = clf.decision_function(X)
+        assert abs(roc_auc_score(yh, df) - skm.roc_auc_score(yh, df)) \
+            < 1e-5
+        with config.set(dtype="bfloat16"):
+            km16 = KMeans(n_clusters=4, random_state=0, max_iter=5,
+                          use_pallas=False).fit(X)
+            assert np.isfinite(km16.cluster_centers_).all()
+            inc = Incremental(SGDClassifier(max_iter=1, random_state=0),
+                              shuffle_blocks=False)
+            inc.fit(X, y)
+            assert np.isfinite(inc.estimator_.coef_).all()
+        # streamed SGD with overlap stats on host blocks
+        Xh2 = np.asarray(X.to_numpy(), np.float32)
+        s2 = SGDClassifier(max_iter=2, random_state=0, shuffle=False)
+        s2.fit(Xh2, y.to_numpy())
+        st = s2._last_stream_stats
+        assert st and st["pass_s"] > 0
+
     def multiclass_round4():
         """Round-4 surfaces: multiclass in-core AND streamed OvR GLM,
         multiclass SGD submesh trials, OneHotEncoder(drop), sketched
@@ -236,6 +286,7 @@ def main():
         ("wrappers + ensemble", wrappers_ensemble),
         ("block streaming", streaming),
         ("round-4 multiclass/drop/subsample", multiclass_round4),
+        ("round-5 sparse/scorers/bf16/overlap", round5_surfaces),
     ]:
         results.append(run(name, fn))
 
