@@ -1,8 +1,9 @@
 """Ref: dask_ml/metrics/__init__.py."""
-from .classification import (accuracy_score, balanced_accuracy_score,
-                             confusion_matrix, f1_score, log_loss,
-                             precision_score, recall_score,
-                             roc_auc_score)
+from .classification import (accuracy_score, average_precision_score,
+                             balanced_accuracy_score, confusion_matrix,
+                             f1_score, log_loss,
+                             precision_recall_curve, precision_score,
+                             recall_score, roc_auc_score, roc_curve)
 from .regression import (mean_absolute_error, mean_squared_error,
                          mean_squared_log_error, r2_score)
 from .pairwise import (cosine_distances, euclidean_distances,

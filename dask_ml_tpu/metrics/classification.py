@@ -256,26 +256,7 @@ def roc_auc_score(y_true, y_score, sample_weight=None, labels=None):
                 f"{s.shape[1]}-column scores"
             )
         s = s[:, 1]
-    if labels is not None:
-        lab = np.sort(np.asarray(labels))
-        if len(lab) != 2:
-            raise ValueError("roc_auc_score needs exactly 2 labels")
-        mx_h = float(lab[1])
-        ok = jnp.all((t == float(lab[0])) | (t == mx_h) | (w == 0))
-        if not bool(ok):
-            raise ValueError("y_true contains values not in labels")
-    else:
-        valid = w > 0
-        mn_h = float(jnp.min(jnp.where(valid, t, jnp.inf)))
-        mx_h = float(jnp.max(jnp.where(valid, t, -jnp.inf)))
-        # raise rather than guess: binarizing multiclass y by "max label
-        # is positive" yields a plausible-looking but meaningless number
-        if not bool(jnp.all((t == mn_h) | (t == mx_h) | (w == 0))):
-            raise ValueError(
-                "multiclass format is not supported by roc_auc_score; "
-                "pass binary targets (or labels= with 2 classes)"
-            )
-    yt = (t == mx_h).astype(jnp.float32)
+    yt = _binary_targets(t, w, labels)
     num, wp, wn = _auc_stat(jnp.asarray(s, jnp.float32), yt,
                             jnp.asarray(w, jnp.float32))
     wp, wn = float(wp), float(wn)
@@ -285,6 +266,161 @@ def roc_auc_score(y_true, y_score, sample_weight=None, labels=None):
             "defined in that case."
         )
     return float(num) / (wp * wn)
+
+
+def _binary_targets(t, w, labels, what="roc_auc_score"):
+    """0/1 targets from arbitrary binary labels (device scan for the
+    class pair; explicit ``labels`` wins), shared by the rank-statistic
+    metrics."""
+    if labels is not None:
+        lab = np.sort(np.asarray(labels))
+        if len(lab) != 2:
+            raise ValueError(f"{what} needs exactly 2 labels")
+        mx_h = float(lab[1])
+        ok = jnp.all((t == float(lab[0])) | (t == mx_h) | (w == 0))
+        if not bool(ok):
+            raise ValueError("y_true contains values not in labels")
+    else:
+        valid = w > 0
+        mn_h = float(jnp.min(jnp.where(valid, t, jnp.inf)))
+        mx_h = float(jnp.max(jnp.where(valid, t, -jnp.inf)))
+        if not bool(jnp.all((t == mn_h) | (t == mx_h) | (w == 0))):
+            raise ValueError(
+                f"multiclass format is not supported by {what}; "
+                "pass binary targets (or labels= with 2 classes)"
+            )
+        if mn_h == mx_h and mx_h != 1.0:
+            # single observed class that isn't the conventional positive
+            # (sklearn's pos_label=1 default): NO positives — mapping the
+            # lone class to positive would score a perfect curve on
+            # all-negative data
+            return jnp.zeros_like(t, jnp.float32)
+    return (t == mx_h).astype(jnp.float32)
+
+
+@jax.jit
+def _curve_sorted(s, yt, w):
+    """Score-descending (scores, positive weight, negative weight,
+    valid flag) — the sort half of the curve statistics; prefix sums run
+    on host in chunked f64 (f32 cumsum saturates at 2**24, the same
+    hazard ``_chunked_f64`` guards in the count metrics)."""
+    order = jnp.argsort(-s)
+    ss = jnp.take(s, order)
+    yy = jnp.take(yt, order)
+    ww = jnp.take(w, order)
+    return ss, ww * yy, ww * (1.0 - yy), (ww != 0).astype(jnp.float32)
+
+
+def _curve_host(y_true, y_score, sample_weight, labels, what):
+    t, s, w, _ = _canon(y_true, y_score, sample_weight)
+    if s.ndim == 2:
+        if s.shape[1] != 2:
+            raise ValueError(f"{what} supports binary targets")
+        s = s[:, 1]
+    yt = _binary_targets(t, w, labels, what)
+    if isinstance(s, np.ndarray):
+        # host inputs: sort + prefix-sum entirely in f64 numpy, so the
+        # returned thresholds are EXACT y_score values (sklearn's
+        # documented contract) and near-equal f64 scores keep distinct
+        # threshold groups
+        order = np.argsort(-np.asarray(s, np.float64), kind="stable")
+        ss = np.asarray(s, np.float64)[order]
+        yo = np.asarray(yt, np.float64)[order]
+        wo = np.asarray(w, np.float64)[order]
+        pw, nw, vf = wo * yo, wo * (1.0 - yo), (wo != 0).astype(float)
+    else:
+        # sharded inputs: device sort (data is f32-native, so the
+        # thresholds ARE exact score values at the data's precision)
+        ss, pw, nw, vf = _curve_sorted(jnp.asarray(s, jnp.float32), yt,
+                                       jnp.asarray(w, jnp.float32))
+        ss = np.asarray(ss, np.float64)
+        pw, nw, vf = (np.asarray(a, np.float64) for a in (pw, nw, vf))
+    # f64 prefix sums on host — f32 cumsum would saturate at 2**24, the
+    # same hazard _chunked_f64 guards in the count metrics
+    tp, fp, cv = np.cumsum(pw), np.cumsum(nw), np.cumsum(vf)
+    P, N = float(tp[-1]), float(fp[-1])
+    # keep only the LAST index of each distinct score (the cumulative
+    # counts AT that threshold — sklearn's threshold de-dup) ...
+    keep = np.r_[ss[1:] != ss[:-1], True]
+    ss, tp, fp, cv = ss[keep], tp[keep], fp[keep], cv[keep]
+    # ... and drop threshold groups made ONLY of padding rows (w=0):
+    # their plateaus don't change the curve, but their scores are
+    # fabricated values no real sample has
+    real = np.diff(np.r_[0.0, cv]) > 0
+    return ss[real], tp[real], fp[real], P, N
+
+
+def _pr_points(tp, fp, P):
+    """(precision, recall) at each kept threshold — the ONE place the
+    zero-division guard lives (precision_recall_curve and
+    average_precision_score share it)."""
+    prec = tp / np.maximum(tp + fp, 1e-300)
+    rec = tp / P
+    return prec, rec
+
+
+def roc_curve(y_true, y_score, sample_weight=None, labels=None):
+    """(fpr, tpr, thresholds) — one jitted sort + prefix-sum program.
+    Matches sklearn's dropped-collinear-points behavior only in that
+    endpoints are present; intermediate collinear points are KEPT (the
+    curve is identical as a function)."""
+    ss, tp, fp, P, N = _curve_host(y_true, y_score, sample_weight,
+                                   labels, "roc_curve")
+    if P == 0.0 or N == 0.0:
+        raise ValueError(
+            "Only one class present in y_true. ROC is not defined."
+        )
+    fpr = np.r_[0.0, fp / N]
+    tpr = np.r_[0.0, tp / P]
+    thresholds = np.r_[np.inf, ss]
+    return fpr, tpr, thresholds
+
+
+def precision_recall_curve(y_true, y_score, sample_weight=None,
+                           labels=None):
+    """(precision, recall, thresholds), sklearn orientation (recall
+    descending to 0, final precision pinned to 1)."""
+    ss, tp, fp, P, _ = _curve_host(y_true, y_score, sample_weight,
+                                   labels, "precision_recall_curve")
+    if P == 0.0:
+        # sklearn: warn and return the degenerate curve (recall pinned
+        # to 1, precision 0) rather than abort a CV fold
+        import warnings
+
+        warnings.warn(
+            "No positive samples in y_true; recall is meaningless",
+            UserWarning,
+        )
+        prec = np.zeros_like(tp)
+        rec = np.ones_like(tp)
+        return (np.r_[prec[::-1], 1.0], np.r_[rec[::-1], 0.0], ss[::-1])
+    prec, rec = _pr_points(tp, fp, P)
+    # sklearn orientation: thresholds ascending, trailing (P=1, R=0)
+    prec = np.r_[prec[::-1], 1.0]
+    rec = np.r_[rec[::-1], 0.0]
+    thresholds = ss[::-1]
+    return prec, rec, thresholds
+
+
+def average_precision_score(y_true, y_score, sample_weight=None,
+                            labels=None):
+    """AP = Σ (R_i − R_{i−1}) · P_i over descending-score thresholds —
+    sklearn's step-wise integral, as one device program + a host fold."""
+    ss, tp, fp, P, _ = _curve_host(y_true, y_score, sample_weight,
+                                   labels, "average_precision_score")
+    if P == 0.0:
+        # sklearn: AP over a fold with no positives scores 0 with a
+        # warning — a raising scorer would abort the whole search
+        import warnings
+
+        warnings.warn(
+            "No positive samples in y_true; average precision is 0",
+            UserWarning,
+        )
+        return 0.0
+    prec, rec = _pr_points(tp, fp, P)
+    rec_prev = np.r_[0.0, rec[:-1]]
+    return float(np.sum((rec - rec_prev) * prec))
 
 
 def log_loss(y_true, y_prob, eps=1e-15, sample_weight=None, labels=None):

@@ -4,9 +4,10 @@ inputs."""
 
 from __future__ import annotations
 
-from .classification import (accuracy_score, balanced_accuracy_score,
-                             f1_score, log_loss, precision_score,
-                             recall_score, roc_auc_score)
+from .classification import (accuracy_score, average_precision_score,
+                             balanced_accuracy_score, f1_score, log_loss,
+                             precision_score, recall_score,
+                             roc_auc_score)
 from .regression import (
     mean_absolute_error,
     mean_squared_error,
@@ -76,6 +77,9 @@ SCORERS = {
     # gathers test folds — so every string here scores fold-resident
     "roc_auc": _make_scorer(roc_auc_score, needs_threshold=True,
                             forward_labels=True),
+    "average_precision": _make_scorer(average_precision_score,
+                                      needs_threshold=True,
+                                      forward_labels=True),
     "balanced_accuracy": _make_scorer(balanced_accuracy_score,
                                       forward_labels=True),
     "f1": _make_scorer(f1_score, forward_labels=True),

@@ -64,6 +64,90 @@ class TestAucParity:
             metrics.roc_auc_score(y, rng.rand(60))
 
 
+class TestCurves:
+    def test_roc_curve_same_function_as_sklearn(self):
+        # our curve KEEPS collinear points; compare as a function by
+        # interpolating tpr at sklearn's fpr grid
+        y = rng.randint(0, 2, 300).astype(np.float64)
+        s = rng.rand(300)
+        s[::5] = 0.5
+        fpr, tpr, thr = metrics.roc_curve(y, s)
+        sk_fpr, sk_tpr, _ = skm.roc_curve(y, s)
+        assert fpr[0] == 0.0 and tpr[0] == 0.0
+        assert fpr[-1] == 1.0 and tpr[-1] == 1.0
+        # every sklearn curve point appears among ours (ours keeps
+        # collinear points sklearn drops — same curve as a function)
+        ours = {(round(a, 9), round(b, 9)) for a, b in zip(fpr, tpr)}
+        missing = [(a, b) for a, b in zip(sk_fpr, sk_tpr)
+                   if (round(a, 9), round(b, 9)) not in ours]
+        assert not missing, missing[:5]
+        # thresholds are EXACT y_score values (sklearn contract)
+        assert set(thr[np.isfinite(thr)]) <= set(s)
+        # AUC of our curve equals sklearn's roc_auc (manual trapezoid:
+        # np.trapezoid is numpy>=2-only, np.trapz deprecated there)
+        auc = float(np.sum(np.diff(fpr) * (tpr[1:] + tpr[:-1]) / 2))
+        np.testing.assert_allclose(auc, skm.roc_auc_score(y, s),
+                                   rtol=1e-6)
+
+    def test_precision_recall_curve_and_ap(self):
+        y = rng.randint(0, 2, 400).astype(np.float64)
+        s = rng.rand(400)
+        prec, rec, thr = metrics.precision_recall_curve(y, s)
+        sk_p, sk_r, sk_t = skm.precision_recall_curve(y, s)
+        assert prec[-1] == 1.0 and rec[-1] == 0.0
+        np.testing.assert_allclose(prec, sk_p, atol=1e-12)
+        np.testing.assert_allclose(rec, sk_r, atol=1e-12)
+        np.testing.assert_allclose(thr, sk_t, atol=0)
+        np.testing.assert_allclose(
+            metrics.average_precision_score(y, s),
+            skm.average_precision_score(y, s), rtol=1e-9,
+        )
+        w = rng.rand(400)
+        np.testing.assert_allclose(
+            metrics.average_precision_score(y, s, sample_weight=w),
+            skm.average_precision_score(y, s, sample_weight=w),
+            rtol=1e-6,
+        )
+
+    def test_no_positive_fold_scores_zero_with_warning(self):
+        s = rng.rand(20)
+        with pytest.warns(UserWarning, match="No positive"):
+            assert metrics.average_precision_score(np.zeros(20), s) == 0.0
+        with pytest.warns(UserWarning, match="No positive"):
+            ap = metrics.average_precision_score(
+                np.zeros(20), s, labels=[0.0, 1.0]
+            )
+        assert ap == 0.0
+        with pytest.warns(UserWarning):
+            prec, rec, _ = metrics.precision_recall_curve(np.zeros(20), s)
+        assert prec[-1] == 1.0 and rec[0] == 1.0 and prec[0] == 0.0
+
+    def test_ap_scorer_registered_and_device(self, xy_classification):
+        from dask_ml_tpu.linear_model import LogisticRegression
+
+        X, y = xy_classification
+        clf = LogisticRegression(solver="lbfgs", max_iter=30).fit(X, y)
+        got = get_scorer("average_precision")(
+            clf, as_sharded(X), as_sharded(y)
+        )
+        want = skm.average_precision_score(y, clf.decision_function(X))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_curve_sharded_padding(self):
+        y = rng.randint(0, 2, 101).astype(np.float64)
+        s = rng.rand(101) + 0.5  # all real scores > 0: padding is 0.0
+        np.testing.assert_allclose(
+            metrics.average_precision_score(as_sharded(y), as_sharded(s)),
+            skm.average_precision_score(y, s), rtol=1e-6,
+        )
+        # padding rows must not fabricate a 0.0 threshold entry, and
+        # thresholds stay strictly decreasing real score values
+        _, _, thr = metrics.roc_curve(as_sharded(y), as_sharded(s))
+        finite = thr[np.isfinite(thr)]
+        assert finite.min() > 0.5, finite.min()
+        assert np.all(np.diff(thr) < 0)
+
+
 class TestPRFParity:
     @pytest.mark.parametrize("average", ["binary", "macro", "micro",
                                          "weighted"])
