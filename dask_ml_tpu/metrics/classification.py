@@ -8,6 +8,7 @@ sharded.
 
 from __future__ import annotations
 
+import warnings
 from functools import partial
 
 import jax
@@ -289,12 +290,30 @@ def _binary_targets(t, w, labels, what="roc_auc_score"):
                 f"multiclass format is not supported by {what}; "
                 "pass binary targets (or labels= with 2 classes)"
             )
-        if mn_h == mx_h and mx_h != 1.0:
-            # single observed class that isn't the conventional positive
-            # (sklearn's pos_label=1 default): NO positives — mapping the
-            # lone class to positive would score a perfect curve on
-            # all-negative data
-            return jnp.zeros_like(t, jnp.float32)
+        # positive-class inference is caller-dependent, matching sklearn:
+        # roc_auc_score label-binarizes (larger label = positive, any
+        # binary coding), but the pos_label-style curve metrics refuse to
+        # guess outside the conventional {0,1} / {-1,1} codings — AP/PR
+        # are strongly asymmetric in that guess, so e.g. {1,2} must be
+        # spelled out via labels=
+        strict = what != "roc_auc_score"
+        if mn_h == mx_h:
+            if mx_h in (0.0, -1.0) or (not strict and mx_h != 1.0):
+                # lone non-positive class: NO positives — mapping the
+                # lone class to positive would score a perfect curve on
+                # all-negative data
+                return jnp.zeros_like(t, jnp.float32)
+            if strict and mx_h != 1.0:
+                raise ValueError(
+                    f"y_true takes the value {{{mx_h}}} and the positive "
+                    f"class is ambiguous; pass labels=[neg, pos] to {what}"
+                )
+        elif strict and (mn_h, mx_h) not in ((0.0, 1.0), (-1.0, 1.0)):
+            raise ValueError(
+                f"y_true takes values in {{{mn_h}, {mx_h}}} and the "
+                "positive class is ambiguous; pass labels=[neg, pos] "
+                f"to {what}"
+            )
     return (t == mx_h).astype(jnp.float32)
 
 
@@ -366,12 +385,25 @@ def roc_curve(y_true, y_score, sample_weight=None, labels=None):
     curve is identical as a function)."""
     ss, tp, fp, P, N = _curve_host(y_true, y_score, sample_weight,
                                    labels, "roc_curve")
-    if P == 0.0 or N == 0.0:
-        raise ValueError(
-            "Only one class present in y_true. ROC is not defined."
+    # sklearn: a single-class fold warns and returns a NaN axis (so a CV
+    # or plotting loop can skip it) — same warn-don't-abort stance as the
+    # PR metrics below
+    if P == 0.0:
+        warnings.warn(
+            "No positive samples in y_true; true positive rate is "
+            "meaningless", UserWarning,
         )
-    fpr = np.r_[0.0, fp / N]
-    tpr = np.r_[0.0, tp / P]
+        tpr = np.full(tp.shape[0] + 1, np.nan)
+    else:
+        tpr = np.r_[0.0, tp / P]
+    if N == 0.0:
+        warnings.warn(
+            "No negative samples in y_true; false positive rate is "
+            "meaningless", UserWarning,
+        )
+        fpr = np.full(fp.shape[0] + 1, np.nan)
+    else:
+        fpr = np.r_[0.0, fp / N]
     thresholds = np.r_[np.inf, ss]
     return fpr, tpr, thresholds
 
@@ -385,8 +417,6 @@ def precision_recall_curve(y_true, y_score, sample_weight=None,
     if P == 0.0:
         # sklearn: warn and return the degenerate curve (recall pinned
         # to 1, precision 0) rather than abort a CV fold
-        import warnings
-
         warnings.warn(
             "No positive samples in y_true; recall is meaningless",
             UserWarning,
@@ -411,8 +441,6 @@ def average_precision_score(y_true, y_score, sample_weight=None,
     if P == 0.0:
         # sklearn: AP over a fold with no positives scores 0 with a
         # warning — a raising scorer would abort the whole search
-        import warnings
-
         warnings.warn(
             "No positive samples in y_true; average precision is 0",
             UserWarning,
