@@ -838,7 +838,11 @@ def main():
         env_tpu = dict(
             env, BENCH_SKIP_PROBE="1",
             BENCH_TOTAL_TIMEOUT=str(int(tpu_budget - 30)),
-            BENCH_INIT_TIMEOUT=str(int(min(_INIT_TIMEOUT, tpu_budget / 3))),
+            # floor at the probe timeout: an init as slow as one the
+            # probe just accepted must not be killed as "wedged"
+            BENCH_INIT_TIMEOUT=str(int(max(
+                min(_INIT_TIMEOUT, tpu_budget / 3), _PROBE_TIMEOUT
+            ))),
         )
         result = _run_child(env_tpu, tpu_budget)
         if result is not None and result.get("value") is not None:

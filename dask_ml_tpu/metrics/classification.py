@@ -274,9 +274,12 @@ def _binary_targets(t, w, labels, what="roc_auc_score"):
     class pair; explicit ``labels`` wins), shared by the rank-statistic
     metrics."""
     if labels is not None:
-        lab = np.sort(np.asarray(labels))
+        lab = np.asarray(labels, dtype=np.float64)
         if len(lab) != 2:
             raise ValueError(f"{what} needs exactly 2 labels")
+        # POSITIONAL: labels=[neg, pos] — the order is honored (not
+        # sorted), so a positive class numerically smaller than the
+        # negative is expressible, as the ambiguity errors below promise
         mx_h = float(lab[1])
         ok = jnp.all((t == float(lab[0])) | (t == mx_h) | (w == 0))
         if not bool(ok):

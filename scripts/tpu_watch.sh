@@ -21,9 +21,13 @@ for i in $(seq 1 60); do
       echo "[$(date +%H:%M:%S)] bench rc=$?" >> "$LOG"
       exit 0
     fi
-    # smoke failed or hung: if it produced no surface lines the backend
-    # wedged mid-run — loop back to probing; otherwise stop for triage
-    if grep -qE "OK|FAIL" /tmp/smoke_r5.log; then exit $rc; fi
+    # rc=124 is the timeout kill: the tunnel wedged at init or mid-run
+    # (even after some OK lines) — loop back to probing either way.
+    # Any other nonzero rc with surface results is a genuine FAIL: stop
+    # for triage rather than burning tunnel windows on broken code.
+    if [ $rc -ne 124 ] && grep -qE "OK|FAIL" /tmp/smoke_r5.log; then
+      exit $rc
+    fi
   fi
   sleep 90
 done

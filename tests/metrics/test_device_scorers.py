@@ -122,6 +122,42 @@ class TestCurves:
             prec, rec, _ = metrics.precision_recall_curve(np.zeros(20), s)
         assert prec[-1] == 1.0 and rec[0] == 1.0 and prec[0] == 0.0
 
+    def test_curve_metrics_refuse_ambiguous_labels(self):
+        # sklearn's pos_label rule: {1,2} is ambiguous for the curve
+        # family (roc_auc_score alone label-binarizes max-positive)
+        y12 = np.where(rng.rand(60) > 0.5, 1.0, 2.0)
+        s = rng.rand(60)
+        for fn in (metrics.roc_curve, metrics.precision_recall_curve,
+                   metrics.average_precision_score):
+            with pytest.raises(ValueError, match="ambiguous"):
+                fn(y12, s)
+        # explicit labels resolve it — POSITIONALLY ([neg, pos]), so a
+        # positive class smaller than the negative is expressible
+        prec, rec, _ = metrics.precision_recall_curve(
+            y12, s, labels=[1.0, 2.0]
+        )
+        assert prec[-1] == 1.0 and rec[-1] == 0.0
+        np.testing.assert_allclose(
+            metrics.average_precision_score(y12, s, labels=[2.0, 1.0]),
+            skm.average_precision_score(y12, s, pos_label=1),
+            rtol=1e-9,
+        )
+        # roc_auc_score keeps sklearn's larger-label binarization
+        np.testing.assert_allclose(
+            metrics.roc_auc_score(y12, s), skm.roc_auc_score(y12, s),
+            rtol=1e-9,
+        )
+
+    def test_roc_curve_single_class_warns_nan(self):
+        s = rng.rand(30)
+        with pytest.warns(UserWarning, match="No positive"):
+            fpr, tpr, thr = metrics.roc_curve(np.zeros(30), s)
+        assert np.isnan(tpr).all() and np.isfinite(fpr[1:]).all()
+        with pytest.warns(UserWarning, match="No negative"):
+            fpr, tpr, thr = metrics.roc_curve(np.ones(30), s)
+        assert np.isnan(fpr).all() and np.isfinite(tpr[1:]).all()
+        assert len(thr) == len(fpr)
+
     def test_ap_scorer_registered_and_device(self, xy_classification):
         from dask_ml_tpu.linear_model import LogisticRegression
 
