@@ -838,10 +838,13 @@ def main():
         env_tpu = dict(
             env, BENCH_SKIP_PROBE="1",
             BENCH_TOTAL_TIMEOUT=str(int(tpu_budget - 30)),
-            # floor at the probe timeout: an init as slow as one the
-            # probe just accepted must not be killed as "wedged"
-            BENCH_INIT_TIMEOUT=str(int(max(
-                min(_INIT_TIMEOUT, tpu_budget / 3), _PROBE_TIMEOUT
+            # floor at the probe timeout (an init as slow as one the
+            # probe just accepted must not be killed as "wedged"), but
+            # never past the child's own total deadline — a huge probe
+            # timeout must not disable the early-fallback init watchdog
+            BENCH_INIT_TIMEOUT=str(int(min(
+                max(min(_INIT_TIMEOUT, tpu_budget / 3), _PROBE_TIMEOUT),
+                tpu_budget - 30,
             ))),
         )
         result = _run_child(env_tpu, tpu_budget)

@@ -8,6 +8,7 @@ with a TPU attached:
     python scripts/tpu_smoke.py
 """
 
+import json
 import os
 import sys
 import time
@@ -17,12 +18,39 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
+# Resumable runs: with TPU_SMOKE_STATE=<path>, every passing surface is
+# recorded write-through, and a rerun skips surfaces already green —
+# so a tunnel that wedges mid-suite only costs the surface it died in,
+# not the ones before it. Delete the state file for a full rerun.
+_STATE_PATH = os.environ.get("TPU_SMOKE_STATE", "")
 
-def run(name, fn):
+
+def _load_state():
+    if _STATE_PATH and os.path.exists(_STATE_PATH):
+        try:
+            with open(_STATE_PATH) as f:
+                return set(json.load(f))
+        except (ValueError, OSError):
+            return set()
+    return set()
+
+
+def _record_pass(passed):
+    if _STATE_PATH:
+        with open(_STATE_PATH, "w") as f:
+            json.dump(sorted(passed), f)
+
+
+def run(name, fn, passed):
+    if name in passed:
+        print(f"  SKIP {name} (passed in an earlier resumable run)")
+        return True
     t0 = time.perf_counter()
     try:
         fn()
         print(f"  OK   {name} ({time.perf_counter() - t0:.1f}s)")
+        passed.add(name)
+        _record_pass(passed)
         return True
     except Exception:
         print(f"  FAIL {name}")
@@ -273,6 +301,7 @@ def main():
             np.abs(pal.coef_ - xla.coef_).max()
         )
 
+    passed = _load_state()
     for name, fn in [
         ("glm solvers x3 families", glms),
         ("device sgd", sgd),
@@ -288,7 +317,7 @@ def main():
         ("round-4 multiclass/drop/subsample", multiclass_round4),
         ("round-5 sparse/scorers/bf16/overlap", round5_surfaces),
     ]:
-        results.append(run(name, fn))
+        results.append(run(name, fn, passed))
 
     n_fail = results.count(False)
     print(f"{len(results) - n_fail}/{len(results)} surfaces OK")

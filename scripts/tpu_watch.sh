@@ -4,16 +4,28 @@
 # in a killable subprocess. Logs to /tmp/tpu_watch.log.
 LOG=/tmp/tpu_watch.log
 : > "$LOG"
+STATE=/tmp/smoke_r5_state.json
+# the resumable-smoke state is only valid for the code it passed on:
+# invalidate it when HEAD moves so fixed code re-runs every surface
+SHA=$(git -C "$(dirname "$0")/.." rev-parse HEAD 2>/dev/null)
+if [ -f "$STATE.sha" ] && [ "$(cat "$STATE.sha")" != "$SHA" ]; then
+  rm -f "$STATE" "$STATE.sha"
+fi
+echo "$SHA" > "$STATE.sha"
 for i in $(seq 1 60); do
   echo "[$(date +%H:%M:%S)] probe $i" >> "$LOG"
   if timeout 150 python -c "import jax; d=jax.devices(); assert d" \
       >> "$LOG" 2>&1; then
     echo "[$(date +%H:%M:%S)] tunnel UP — launching smoke" >> "$LOG"
-    timeout 3300 python -u scripts/tpu_smoke.py > /tmp/smoke_r5.log 2>&1
+    TPU_SMOKE_STATE="$STATE" \
+      timeout 3300 python -u scripts/tpu_smoke.py > /tmp/smoke_r5.log 2>&1
     rc=$?
     echo "rc=$rc" >> /tmp/smoke_r5.log
     echo "[$(date +%H:%M:%S)] smoke rc=$rc" >> "$LOG"
     if [ $rc -eq 0 ]; then
+      # the state has served its purpose — clear it so the NEXT launch
+      # re-runs everything instead of reporting green without executing
+      rm -f "$STATE" "$STATE.sha"
       # bank TPU bench numbers while the tunnel window is open
       echo "[$(date +%H:%M:%S)] smoke green — running bench" >> "$LOG"
       BENCH_CHILD=1 BENCH_SKIP_PROBE=1 timeout 2000 \
@@ -23,9 +35,10 @@ for i in $(seq 1 60); do
     fi
     # rc=124 is the timeout kill: the tunnel wedged at init or mid-run
     # (even after some OK lines) — loop back to probing either way.
-    # Any other nonzero rc with surface results is a genuine FAIL: stop
-    # for triage rather than burning tunnel windows on broken code.
-    if [ $rc -ne 124 ] && grep -qE "OK|FAIL" /tmp/smoke_r5.log; then
+    # Any other nonzero rc with surface results (incl. a resumed run
+    # that only printed SKIPs before a native crash) is a genuine FAIL:
+    # stop for triage rather than burning tunnel windows on broken code.
+    if [ $rc -ne 124 ] && grep -qE "OK|FAIL|SKIP" /tmp/smoke_r5.log; then
       exit $rc
     fi
   fi

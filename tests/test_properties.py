@@ -102,3 +102,55 @@ def test_add_intercept_appends_ones(x):
     h = out.to_numpy()
     np.testing.assert_array_equal(h[:, :-1], x)
     np.testing.assert_array_equal(h[:, -1], np.ones(x.shape[0]))
+
+
+def _binary_scored(draw, n_min=8, n_max=40):
+    """(y, s) with both classes present and strictly distinct scores."""
+    n = draw(st.integers(n_min, n_max))
+    y = np.asarray(draw(st.lists(st.integers(0, 1), min_size=n,
+                                 max_size=n)), np.float64)
+    if y.min() == y.max():
+        y[0] = 1.0 - y[0]
+    s = np.asarray(draw(st.lists(finite, min_size=n, max_size=n,
+                                 unique=True)), np.float64)
+    return y, s
+
+
+@settings(max_examples=15, deadline=None)
+@given(st.data())
+def test_auc_invariant_under_monotone_score_transform(data):
+    """AUC is a rank statistic: any strictly increasing transform of the
+    scores leaves it unchanged; negating the scores complements it."""
+    from dask_ml_tpu.metrics import roc_auc_score
+
+    y, s = _binary_scored(data.draw)
+    auc = roc_auc_score(y, s)
+    assert 0.0 <= auc <= 1.0
+    # rank substitution is the canonical strictly-increasing transform,
+    # and stays exactly representable at the device's f32 (a smooth
+    # squash like tanh can collapse near-equal scores in f32)
+    s2 = np.empty_like(s)
+    s2[np.argsort(s)] = np.arange(len(s), dtype=np.float64)
+    s2 = 0.5 * s2 - 3.0
+    np.testing.assert_allclose(roc_auc_score(y, s2), auc, atol=1e-9)
+    np.testing.assert_allclose(roc_auc_score(y, -s), 1.0 - auc,
+                               atol=1e-9)
+
+
+@settings(max_examples=15, deadline=None)
+@given(st.data())
+def test_curve_invariants(data):
+    """roc_curve axes are monotone in [0,1] ending at (1,1); PR curve
+    recall is monotone with AP inside [0,1]."""
+    from dask_ml_tpu.metrics import (average_precision_score,
+                                     precision_recall_curve, roc_curve)
+
+    y, s = _binary_scored(data.draw)
+    fpr, tpr, thr = roc_curve(y, s)
+    assert np.all(np.diff(fpr) >= 0) and np.all(np.diff(tpr) >= 0)
+    assert fpr[0] == 0.0 and tpr[0] == 0.0
+    assert fpr[-1] == 1.0 and tpr[-1] == 1.0
+    assert np.all(np.diff(thr) < 0)  # strictly decreasing thresholds
+    prec, rec, _ = precision_recall_curve(y, s)
+    assert np.all(np.diff(rec) <= 0)  # sklearn orientation: descending
+    assert 0.0 <= average_precision_score(y, s) <= 1.0
