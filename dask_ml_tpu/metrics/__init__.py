@@ -4,8 +4,10 @@ from .classification import (accuracy_score, average_precision_score,
                              f1_score, log_loss,
                              precision_recall_curve, precision_score,
                              recall_score, roc_auc_score, roc_curve)
-from .regression import (mean_absolute_error, mean_squared_error,
-                         mean_squared_log_error, r2_score)
+from .regression import (explained_variance_score, max_error,
+                         mean_absolute_error, mean_squared_error,
+                         mean_squared_log_error, median_absolute_error,
+                         r2_score)
 from .pairwise import (cosine_distances, euclidean_distances,
                        linear_kernel, manhattan_distances,
                        pairwise_distances, pairwise_distances_argmin,

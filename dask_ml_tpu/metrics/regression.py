@@ -4,6 +4,7 @@
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from .classification import _canon
 
@@ -25,10 +26,62 @@ def r2_score(y_true, y_pred, sample_weight=None):
     mean = jnp.sum(t * w) / wsum
     ss_res = jnp.sum(((t - p) ** 2) * w)
     ss_tot = jnp.sum(((t - mean) ** 2) * w)
-    return float(1.0 - ss_res / ss_tot)
+    return _force_finite_ratio(ss_res, ss_tot)
+
+
+def _force_finite_ratio(num, den):
+    """1 - num/den with sklearn's force_finite semantics: a constant
+    target (den == 0) scores 1.0 when the residual term is also 0
+    (perfect fit) and 0.0 otherwise, instead of nan/-inf that would
+    poison a CV search."""
+    num, den = float(num), float(den)
+    if den == 0.0:
+        return 1.0 if num == 0.0 else 0.0
+    return 1.0 - num / den
 
 
 def mean_squared_log_error(y_true, y_pred, sample_weight=None):
     t, p, w, n = _canon(y_true, y_pred, sample_weight)
     err = (jnp.log1p(t) - jnp.log1p(p)) ** 2
     return float(jnp.sum(err * w) / jnp.sum(w))
+
+
+def explained_variance_score(y_true, y_pred, sample_weight=None):
+    t, p, w, n = _canon(y_true, y_pred, sample_weight)
+    wsum = jnp.sum(w)
+    err = t - p
+    err_mean = jnp.sum(err * w) / wsum
+    var_err = jnp.sum(((err - err_mean) ** 2) * w) / wsum
+    t_mean = jnp.sum(t * w) / wsum
+    var_t = jnp.sum(((t - t_mean) ** 2) * w) / wsum
+    return _force_finite_ratio(var_err, var_t)
+
+
+def max_error(y_true, y_pred):
+    """Largest absolute residual (sklearn takes no sample_weight here);
+    padded rows are masked out via the validity weights."""
+    t, p, w, n = _canon(y_true, y_pred)
+    return float(jnp.max(jnp.abs(t - p) * (w > 0)))
+
+
+def median_absolute_error(y_true, y_pred, sample_weight=None):
+    """Weighted median of |err| with sklearn 1.9's *averaged* weighted
+    percentile: mean of the lower ("first x whose cdf reaches 1/2") and
+    upper (symmetric from the top) percentiles — reduces to np.median's
+    middle-two average for unit weights. One device sort + cumsum."""
+    t, p, w, n = _canon(y_true, y_pred, sample_weight)
+    err = jnp.abs(t - p)
+    order = jnp.argsort(err)
+    # device sort, HOST f64 prefix sums: an f32 cumsum of unit weights
+    # saturates at 2**24 rows (the same hazard the curve metrics guard)
+    es = np.asarray(jnp.take(err, order), np.float64)
+    ws = np.asarray(jnp.take(w, order), np.float64)
+    cw = np.cumsum(ws)
+    half = 0.5 * cw[-1]
+    lo = es[int(np.argmax(cw >= half))]
+    # upper percentile: LAST valid row whose cumulative weight below it
+    # stays within half; zero-weight rows (padding, user zeros) are
+    # excluded so they can never contribute their error value
+    cand = ((cw - ws) <= half) & (ws > 0)
+    idx_hi = len(es) - 1 - int(np.argmax(cand[::-1]))
+    return float(0.5 * (lo + es[idx_hi]))

@@ -9,8 +9,12 @@ from .classification import (accuracy_score, average_precision_score,
                              precision_score, recall_score,
                              roc_auc_score)
 from .regression import (
+    explained_variance_score,
+    max_error,
     mean_absolute_error,
     mean_squared_error,
+    mean_squared_log_error,
+    median_absolute_error,
     r2_score,
 )
 
@@ -68,6 +72,14 @@ SCORERS = {
                                            greater_is_better=False),
     "neg_mean_absolute_error": _make_scorer(mean_absolute_error,
                                             greater_is_better=False),
+    "neg_root_mean_squared_error": _make_scorer(
+        mean_squared_error, greater_is_better=False, squared=False),
+    "neg_mean_squared_log_error": _make_scorer(
+        mean_squared_log_error, greater_is_better=False),
+    "neg_median_absolute_error": _make_scorer(
+        median_absolute_error, greater_is_better=False),
+    "explained_variance": _make_scorer(explained_variance_score),
+    "max_error": _make_scorer(max_error, greater_is_better=False),
     "neg_log_loss": _make_scorer(log_loss, greater_is_better=False,
                                  needs_proba=True),
     "r2": _make_scorer(r2_score),

@@ -5,17 +5,19 @@
 LOG=/tmp/tpu_watch.log
 : > "$LOG"
 STATE=/tmp/smoke_r5_state.json
-# the resumable-smoke state is only valid for the code it passed on:
-# invalidate it when HEAD moves so fixed code re-runs every surface
-SHA=$(git -C "$(dirname "$0")/.." rev-parse HEAD 2>/dev/null)
-if [ -f "$STATE.sha" ] && [ "$(cat "$STATE.sha")" != "$SHA" ]; then
-  rm -f "$STATE" "$STATE.sha"
-fi
-echo "$SHA" > "$STATE.sha"
+REPO=$(dirname "$0")/..
 for i in $(seq 1 60); do
   echo "[$(date +%H:%M:%S)] probe $i" >> "$LOG"
   if timeout 150 python -c "import jax; d=jax.devices(); assert d" \
       >> "$LOG" 2>&1; then
+    # the resumable-smoke state is only valid for the code it passed
+    # on: re-check HEAD at EVERY launch (commits land while the loop
+    # probes) so changed code re-runs every surface
+    SHA=$(git -C "$REPO" rev-parse HEAD 2>/dev/null)
+    if [ -f "$STATE.sha" ] && [ "$(cat "$STATE.sha")" != "$SHA" ]; then
+      rm -f "$STATE"
+    fi
+    echo "$SHA" > "$STATE.sha"
     echo "[$(date +%H:%M:%S)] tunnel UP — launching smoke" >> "$LOG"
     TPU_SMOKE_STATE="$STATE" \
       timeout 3300 python -u scripts/tpu_smoke.py > /tmp/smoke_r5.log 2>&1

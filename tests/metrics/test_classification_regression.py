@@ -187,3 +187,76 @@ def test_neg_log_loss_scorer_fold_missing_class():
     sub = y < 2  # evaluation slice missing class 2
     s = scorer(clf, X[sub], y[sub])
     assert np.isfinite(s) and s <= 0
+
+
+def test_extended_regression_metrics_match_sklearn():
+    from dask_ml_tpu.metrics import (explained_variance_score, max_error,
+                                     median_absolute_error)
+    from dask_ml_tpu.parallel import as_sharded
+
+    rng = np.random.RandomState(3)
+    for n in (101, 200):  # odd and even valid counts
+        t = rng.randn(n).astype(np.float64)
+        p = t + 0.3 * rng.randn(n)
+        w = rng.rand(n) + 0.05
+        np.testing.assert_allclose(
+            explained_variance_score(t, p),
+            skm.explained_variance_score(t, p), rtol=1e-6)
+        np.testing.assert_allclose(
+            explained_variance_score(t, p, sample_weight=w),
+            skm.explained_variance_score(t, p, sample_weight=w),
+            rtol=1e-5)
+        np.testing.assert_allclose(
+            max_error(t, p), skm.max_error(t, p), rtol=1e-6)
+        np.testing.assert_allclose(
+            median_absolute_error(t, p),
+            skm.median_absolute_error(t, p), rtol=1e-5)
+        np.testing.assert_allclose(
+            median_absolute_error(t, p, sample_weight=w),
+            skm.median_absolute_error(t, p, sample_weight=w), rtol=1e-5)
+        # sharded (padded) inputs agree with the host result
+        np.testing.assert_allclose(
+            median_absolute_error(as_sharded(np.float32(t)),
+                                  as_sharded(np.float32(p))),
+            skm.median_absolute_error(np.float32(t), np.float32(p)),
+            rtol=1e-5)
+    # zero-weight rows contribute nothing, even with extreme errors
+    t2 = np.array([0.0, 0.0, 0.0, 0.0, 100.0])
+    p2 = np.array([1.0, 2.0, 3.0, 4.0, 0.0])
+    w2 = np.array([1.0, 1.0, 1.0, 1.0, 0.0])
+    np.testing.assert_allclose(
+        median_absolute_error(t2, p2, sample_weight=w2),
+        skm.median_absolute_error(t2, p2, sample_weight=w2), rtol=1e-9)
+
+
+def test_extended_scorer_strings_device_resident():
+    from dask_ml_tpu.datasets import make_regression
+    from dask_ml_tpu.linear_model import LinearRegression
+    from dask_ml_tpu.metrics.scorer import SCORERS, get_scorer
+
+    X, y = make_regression(n_samples=2000, n_features=8, random_state=0)
+    est = LinearRegression(solver="lbfgs", max_iter=50).fit(X, y)
+    for name in ("neg_root_mean_squared_error",
+                 "neg_mean_squared_log_error", "neg_median_absolute_error",
+                 "explained_variance", "max_error"):
+        assert name in SCORERS
+        if name == "neg_mean_squared_log_error":
+            continue  # needs nonnegative targets; registry check enough
+        s = get_scorer(name)(est, X, y)
+        assert np.isfinite(s)
+    # rmse/medae/max_error are negated; explained_variance is not
+    assert get_scorer("neg_root_mean_squared_error")(est, X, y) <= 0
+    assert get_scorer("explained_variance")(est, X, y) > 0.9
+
+
+def test_constant_target_force_finite():
+    from dask_ml_tpu.metrics import explained_variance_score, r2_score
+
+    t = np.ones(6)
+    assert explained_variance_score(t, np.arange(6.0)) == \
+        skm.explained_variance_score(t, np.arange(6.0)) == 0.0
+    assert explained_variance_score(t, t) == \
+        skm.explained_variance_score(t, t) == 1.0
+    assert r2_score(t, np.arange(6.0)) == \
+        skm.r2_score(t, np.arange(6.0)) == 0.0
+    assert r2_score(t, t) == skm.r2_score(t, t) == 1.0
