@@ -240,7 +240,58 @@ class _BaseSearchCV(BaseEstimator):
             # fold copies must not outlive the search, even a failed one
             clear_host_fold_cache()
 
+    def _try_C_grid_fast(self, candidates, cache, scorers, scores,
+                         train_scores, n_folds, fit_params):
+        """True iff every (candidate, fold) score was filled by the
+        stacked C-grid solve; False leaves the grids NaN-reset for the
+        general path."""
+        import jax as _jax
+
+        from ..models.glm import _GLMBase
+
+        est = self.estimator
+        if (fit_params or _jax.process_count() > 1 or len(candidates) < 2
+                or not isinstance(est, _GLMBase)
+                or any(set(p) != {"C"} for p in candidates)):
+            return False
+        Cs = [p["C"] for p in candidates]
+        if not all(isinstance(c, numbers.Real) and c > 0 for c in Cs):
+            return False
+        try:
+            for fi in range(n_folds):
+                Xtr, ytr, Xte, yte = cache.fold(fi)
+                models = est._fit_C_grid(Xtr, ytr, Cs)
+                if models is None:
+                    return False
+                for ci, m in enumerate(models):
+                    for name, sc in scorers.items():
+                        scores[name][ci, fi] = sc(m, Xte, yte)
+                    if train_scores is not None:
+                        for name, sc in scorers.items():
+                            train_scores[name][ci, fi] = sc(m, Xtr, ytr)
+        except Exception as exc:
+            import warnings
+
+            # fall back, but LOUDLY: a genuine fast-path defect must be
+            # diagnosable, not hidden behind a silent 2x-cost refit
+            warnings.warn(
+                f"C-grid fast path failed ({type(exc).__name__}: {exc}); "
+                "falling back to per-candidate fits", RuntimeWarning,
+            )
+            self._c_grid_fallback_ = repr(exc)
+            for grid in (scores, train_scores or {}):
+                for arr in grid.values():
+                    arr[:] = np.nan
+            return False
+        self._c_grid_vmapped_ = len(Cs)
+        return True
+
     def _fit(self, X, y=None, **fit_params):
+        # per-fit diagnostics must not survive a re-fit that takes a
+        # different path (same policy as _memo_stats, which is re-set)
+        for attr in ("_c_grid_vmapped_", "_c_grid_fallback_"):
+            if hasattr(self, attr):
+                delattr(self, attr)
         candidates = list(self._candidates())
         if not candidates:
             raise ValueError("no parameter candidates")
@@ -282,6 +333,16 @@ class _BaseSearchCV(BaseEstimator):
 
         tasks = [(ci, fi) for ci in range(len(candidates))
                  for fi in range(n_folds)]
+
+        # Homogeneous-GLM fast path (SURVEY.md §3.4 'combos batched with
+        # vmap'): a grid varying ONLY C over a device GLM solves every
+        # candidate in ONE vmapped L-BFGS program per fold — one X pass
+        # per iteration for the whole grid. Any failure (or ineligible
+        # shape) resets the score grid and falls back to the general
+        # per-candidate machinery, where error_score= applies.
+        if self._try_C_grid_fast(candidates, cache, scorers, scores,
+                                 train_scores, n_folds, fit_params):
+            tasks = []
 
         # Multi-process distribution (SURVEY.md §3.5 'trials pinned to
         # hosts', §5 comm row): under a live jax.distributed runtime each

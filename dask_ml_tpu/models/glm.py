@@ -243,6 +243,64 @@ class _GLMBase(BaseEstimator):
             )
         return self._finish_fit(beta, classes, info, d_feat)
 
+    def _fit_C_grid(self, X, y, Cs):
+        """Fit ``len(Cs)`` clones differing only in ``C`` as ONE vmapped
+        L-BFGS program over a shared design matrix (GridSearchCV's
+        homogeneous-trial fast path; SURVEY.md §3.4). Returns the fitted
+        clones in ``Cs`` order, or None when this fit shape isn't
+        eligible (caller falls back to per-candidate fits)."""
+        from ..parallel.streaming import stream_plan
+
+        if (self.solver != "lbfgs" or self.penalty not in ("l2", "none")
+                or self.solver_kwargs or self.warm_start
+                or stream_plan(X) is not None):
+            return None
+        mesh = resolve_mesh(getattr(X, "mesh", None))
+        X, y = check_X_y(X, y, mesh=mesh, dtype=np.float32)
+        from ..config import mxu_dtype
+
+        mask = X.row_mask(dtype=jnp.float32)
+        data, y_data, packed = _prepare_fit(
+            X.data, y.data, mask, fit_intercept=self.fit_intercept,
+            to_bf16=mxu_dtype() is not None,
+            encode=self.family == "logistic",
+        )
+        if self.family == "poisson":
+            _check_poisson_targets(
+                float(jnp.min(jnp.where(mask > 0, y_data, jnp.inf)))
+            )
+        classes = None
+        if self.family == "logistic":
+            pk = np.asarray(packed)
+            if not bool(pk[2]) or pk[0] == pk[1]:
+                return None  # multiclass/degenerate: general path
+            classes = np.asarray(pk[:2])
+        d = data.shape[1]
+        from ..base import clone
+        from .solvers.solvers import solve_lam_grid
+
+        # per-C (pmask, lam) through _penalty_setup — the ONE place the
+        # regularization bookkeeping lives; pmask is C-independent
+        per_c = [clone(self).set_params(C=c)._penalty_setup(d, X.n_rows)
+                 for c in Cs]
+        pmask = per_c[0][0]
+        lams = [lam for _, lam in per_c]
+
+        B, info = solve_lam_grid(
+            data, y_data, mask, X.n_rows, lams, pmask, self.family,
+            self.penalty, max_iter=self.max_iter, tol=self.tol,
+        )
+        B = np.asarray(B, np.float64)
+        fitted = []
+        for i, c in enumerate(Cs):
+            est = clone(self).set_params(C=c)
+            if classes is not None:
+                est.classes_ = classes
+            est._finish_fit(B[i], classes, dict(info),
+                            d - int(self.fit_intercept))
+            fitted.append(est)
+        return fitted
+
     def fit(self, X, y):
         from ..parallel.streaming import stream_plan
 

@@ -810,3 +810,60 @@ def solve_multi(solver, X, Y, mask, n_rows, B0, family, reg, lam, pmask,
         betas.append(np.asarray(beta_c))
         iters.append(info_c.get("n_iter") or 0)
     return np.stack(betas), {"n_iter": int(max(iters))}
+
+
+@partial(jax.jit, static_argnames=("family", "reg", "k", "memory"))
+def _lam_grid_chunk(X, y, mask, n_rows, carry, lams, pmask, stop_it, tol,
+                    family, reg, k, memory=10):
+    """Joint L-BFGS over the FLAT (k*d,) stacked-lam vector: the k
+    forward matvecs batch into ONE (n,d)x(d,k) matmul (and the gradient
+    into one (d,n)x(n,k)) — real MXU contractions, unlike vmapping the
+    single-target while_loop, whose batched-loop lowering measured ~5x
+    slower PER LANE on XLA:CPU. The objective is separable across lams,
+    so the joint optimum equals the per-lam optima (same argument as the
+    multi-target OvR chunk above)."""
+    d = X.shape[1]
+
+    def loss(bflat):
+        B = bflat.reshape(k, d)
+        eta = jax.lax.dot_general(
+            X, B.astype(X.dtype), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                       # (n, k)
+        pw = get_family(family).pointwise(eta, y[:, None])
+        base = jnp.sum(pw * mask[:, None]) / n_rows
+        if reg == "none":
+            return base
+        bp = B * pmask[None, :]
+        return base + 0.5 * jnp.sum(lams * jnp.sum(bp * bp, axis=1))
+
+    return _lbfgs_loop(loss, carry, stop_it, tol, memory, False)
+
+
+def solve_lam_grid(X, y, mask, n_rows, lams, pmask, family, reg,
+                   max_iter=100, tol=1e-6, memory=10):
+    """k independent GLM solves differing ONLY in the l2 strength, as
+    ONE compiled program sharing the design matrix — a whole C grid
+    costs one X pass per iteration instead of k (SURVEY.md §3.4 'combos
+    batched when homogeneous'; the reference's analog is k separate
+    dask-glm solves). Returns ((k, d) betas, info); raises on
+    non-finite results (callers fall back to per-candidate fits where
+    error_score= applies individually)."""
+    _check_smooth(reg, "lbfgs")
+    lams = jnp.asarray(lams, jnp.float32)
+    k = int(lams.shape[0])
+    d = X.shape[1]
+    opt = optax.lbfgs(memory_size=memory)
+    b0 = jnp.zeros((k * d,), jnp.float32)
+    carry = (b0, opt.init(b0), jnp.asarray(jnp.inf, b0.dtype), 0)
+    beta, _state, gnorm, it = _lam_grid_chunk(
+        X, y, mask, n_rows, carry, lams, jnp.asarray(pmask),
+        jnp.asarray(max_iter), jnp.asarray(tol, jnp.float32),
+        family, reg, k, memory=memory,
+    )
+    it_h, gnorm_h = _host_scalars(it, gnorm)
+    info = {"n_iter": int(it_h), "grad_norm": float(gnorm_h),
+            "lam_grid": k}
+    return check_finite_result(
+        np.asarray(beta).reshape(k, d), info, "lbfgs"
+    )

@@ -162,10 +162,14 @@ def main():
         from dask_ml_tpu.linear_model import LogisticRegression
 
         train_test_split(X, y, test_size=0.2, random_state=0)
-        GridSearchCV(
+        gs = GridSearchCV(
             LogisticRegression(solver="lbfgs", max_iter=10),
             {"C": [0.1, 1.0]}, cv=2,
         ).fit(X, y)
+        # a pure-C grid must take the stacked-lam fast path (one
+        # compiled solve for the whole grid per fold)
+        assert getattr(gs, "_c_grid_vmapped_", None) == 2, \
+            "C-grid fast path not taken"
         HyperbandSearchCV(
             SkSGD(tol=1e-3), {"alpha": [1e-4, 1e-3, 1e-2]},
             max_iter=4, aggressiveness=2, random_state=0,

@@ -255,3 +255,83 @@ def test_multimetric_custom_callable_on_sharded(data):
     assert "mean_test_f1" in s.cv_results_
     assert "mean_test_acc" in s.cv_results_
     assert 0.5 < s.best_score_ <= 1.0
+
+
+class TestCGridFastPath:
+    """Homogeneous C-grid fast path: every candidate solved in ONE
+    compiled stacked-lam program per fold (SURVEY.md §3.4)."""
+
+    def _data(self):
+        from dask_ml_tpu.datasets import make_classification
+
+        return make_classification(n_samples=4000, n_features=10,
+                                   random_state=0)
+
+    def test_matches_general_path_and_sklearn_selection(self):
+        from dask_ml_tpu.linear_model import LogisticRegression
+        from dask_ml_tpu.model_selection import GridSearchCV
+
+        X, y = self._data()
+        grid = {"C": [0.01, 0.1, 1.0, 10.0]}
+        fast = GridSearchCV(
+            LogisticRegression(solver="lbfgs", max_iter=80), grid, cv=3
+        ).fit(X, y)
+        assert fast._c_grid_vmapped_ == 4
+        # general path: an extra constant key defeats the key-set gate
+        slow = GridSearchCV(
+            LogisticRegression(solver="lbfgs", max_iter=80),
+            {"C": grid["C"], "intercept_scaling": [1.0]}, cv=3,
+        ).fit(X, y)
+        assert not hasattr(slow, "_c_grid_vmapped_")
+        np.testing.assert_allclose(
+            fast.cv_results_["mean_test_score"],
+            slow.cv_results_["mean_test_score"], atol=2e-3,
+        )
+        assert fast.best_params_["C"] == slow.best_params_["C"]
+        np.testing.assert_allclose(
+            fast.best_estimator_.coef_, slow.best_estimator_.coef_,
+            atol=1e-3,
+        )
+
+    def test_fallback_cases_still_fit(self):
+        from dask_ml_tpu.linear_model import LogisticRegression
+        from dask_ml_tpu.model_selection import GridSearchCV
+
+        X, y = self._data()
+        grid = {"C": [0.1, 1.0]}
+        # non-lbfgs solver, l1 penalty, multiclass: all take the
+        # general path and still produce a fitted search
+        for est in (
+            LogisticRegression(solver="admm", max_iter=20),
+            LogisticRegression(solver="proximal_grad", penalty="l1",
+                               max_iter=20),
+        ):
+            s = GridSearchCV(est, grid, cv=2).fit(X, y)
+            assert not hasattr(s, "_c_grid_vmapped_")
+            assert np.isfinite(s.best_score_)
+        from dask_ml_tpu.datasets import make_classification
+
+        Xm, ym = make_classification(n_samples=3000, n_features=8,
+                                     n_classes=3, n_informative=6,
+                                     random_state=1)
+        s = GridSearchCV(
+            LogisticRegression(solver="lbfgs", max_iter=40), grid, cv=2
+        ).fit(Xm, ym)
+        assert not hasattr(s, "_c_grid_vmapped_")  # multiclass bails
+        assert s.best_estimator_.coef_.shape == (3, 8)
+
+    def test_regression_families(self):
+        from dask_ml_tpu.datasets import make_counts, make_regression
+        from dask_ml_tpu.linear_model import (LinearRegression,
+                                              PoissonRegression)
+        from dask_ml_tpu.model_selection import GridSearchCV
+
+        Xr, yr = make_regression(n_samples=3000, n_features=8,
+                                 random_state=0)
+        s = GridSearchCV(LinearRegression(solver="lbfgs", max_iter=60),
+                         {"C": [0.1, 1.0, 10.0]}, cv=2).fit(Xr, yr)
+        assert s._c_grid_vmapped_ == 3 and np.isfinite(s.best_score_)
+        Xc, yc = make_counts(n_samples=3000, n_features=6, random_state=0)
+        s2 = GridSearchCV(PoissonRegression(solver="lbfgs", max_iter=60),
+                          {"C": [0.1, 1.0]}, cv=2).fit(Xc, yc)
+        assert s2._c_grid_vmapped_ == 2 and np.isfinite(s2.best_score_)
