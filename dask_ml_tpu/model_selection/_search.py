@@ -257,11 +257,21 @@ class _BaseSearchCV(BaseEstimator):
         Cs = [p["C"] for p in candidates]
         if not all(isinstance(c, numbers.Real) and c > 0 for c in Cs):
             return False
+        def reset():
+            for grid in (scores, train_scores or {}):
+                for arr in grid.values():
+                    arr[:] = np.nan
+
         try:
             for fi in range(n_folds):
                 Xtr, ytr, Xte, yte = cache.fold(fi)
                 models = est._fit_C_grid(Xtr, ytr, Cs)
                 if models is None:
+                    # a later fold can be ineligible (e.g. single-class
+                    # train split) after earlier folds were scored —
+                    # those partial cells must not leak into the
+                    # general path's grid
+                    reset()
                     return False
                 for ci, m in enumerate(models):
                     for name, sc in scorers.items():
@@ -279,9 +289,7 @@ class _BaseSearchCV(BaseEstimator):
                 "falling back to per-candidate fits", RuntimeWarning,
             )
             self._c_grid_fallback_ = repr(exc)
-            for grid in (scores, train_scores or {}):
-                for arr in grid.values():
-                    arr[:] = np.nan
+            reset()
             return False
         self._c_grid_vmapped_ = len(Cs)
         return True
@@ -334,12 +342,13 @@ class _BaseSearchCV(BaseEstimator):
         tasks = [(ci, fi) for ci in range(len(candidates))
                  for fi in range(n_folds)]
 
-        # Homogeneous-GLM fast path (SURVEY.md §3.4 'combos batched with
-        # vmap'): a grid varying ONLY C over a device GLM solves every
-        # candidate in ONE vmapped L-BFGS program per fold — one X pass
-        # per iteration for the whole grid. Any failure (or ineligible
-        # shape) resets the score grid and falls back to the general
-        # per-candidate machinery, where error_score= applies.
+        # Homogeneous-GLM fast path (SURVEY.md §3.4 'combos batched
+        # when homogeneous'): a grid varying ONLY C over a device GLM
+        # solves every candidate in ONE stacked-lam L-BFGS program per
+        # fold — one X pass per iteration for the whole grid. Any
+        # failure (or ineligible shape) resets the score grid and falls
+        # back to the general per-candidate machinery, where
+        # error_score= applies.
         if self._try_C_grid_fast(candidates, cache, scorers, scores,
                                  train_scores, n_folds, fit_params):
             tasks = []

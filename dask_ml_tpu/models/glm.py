@@ -146,6 +146,18 @@ class _GLMBase(BaseEstimator):
             f"{type(self).__name__} does not support multiclass targets"
         )
 
+    def _check_unsupported(self):
+        """Honest-raise for accepted-but-unimplemented params (same
+        policy as SpectralClustering's): silently ignoring
+        class_weight="balanced" would return unweighted fits that LOOK
+        like weighted ones. The reference wrapper ignores it silently —
+        a non-parity we fix on purpose."""
+        if self.class_weight is not None:
+            raise ValueError(
+                "class_weight is not supported; reweight via "
+                "sample-level resampling, or leave class_weight=None"
+            )
+
     def _penalty_setup(self, d, n_rows):
         """(pmask, lam): intercept unpenalized, sklearn's 1/(C*n) scaling
         — the ONE place the regularization bookkeeping lives (shared by
@@ -244,15 +256,20 @@ class _GLMBase(BaseEstimator):
         return self._finish_fit(beta, classes, info, d_feat)
 
     def _fit_C_grid(self, X, y, Cs):
-        """Fit ``len(Cs)`` clones differing only in ``C`` as ONE vmapped
-        L-BFGS program over a shared design matrix (GridSearchCV's
-        homogeneous-trial fast path; SURVEY.md §3.4). Returns the fitted
-        clones in ``Cs`` order, or None when this fit shape isn't
-        eligible (caller falls back to per-candidate fits)."""
+        """Fit ``len(Cs)`` clones differing only in ``C`` as ONE
+        stacked-lam L-BFGS program over a shared design matrix
+        (GridSearchCV's homogeneous-trial fast path; SURVEY.md §3.4).
+        Returns the fitted clones in ``Cs`` order, or None when this fit
+        shape isn't eligible (caller falls back to per-candidate
+        fits)."""
         from ..parallel.streaming import stream_plan
 
+        # class_weight != None is an ELIGIBILITY bail, not a raise: the
+        # caller's general path re-runs est.fit(), which raises the
+        # clean unsupported-param error instead of a fast-path warning
         if (self.solver != "lbfgs" or self.penalty not in ("l2", "none")
                 or self.solver_kwargs or self.warm_start
+                or self.class_weight is not None
                 or stream_plan(X) is not None):
             return None
         mesh = resolve_mesh(getattr(X, "mesh", None))
@@ -286,10 +303,18 @@ class _GLMBase(BaseEstimator):
         pmask = per_c[0][0]
         lams = [lam for _, lam in per_c]
 
-        B, info = solve_lam_grid(
-            data, y_data, mask, X.n_rows, lams, pmask, self.family,
-            self.penalty, max_iter=self.max_iter, tol=self.tol,
-        )
+        from ..utils.observability import fit_logger
+
+        with fit_logger(type(self).__name__, solver=self.solver,
+                        n_rows=X.n_rows, lam_grid=len(Cs)) as logger:
+            B, info = solve_lam_grid(
+                data, y_data, mask, X.n_rows, lams, pmask, self.family,
+                self.penalty, max_iter=self.max_iter, tol=self.tol,
+            )
+            if logger is not None:
+                logger.log(step=info.get("n_iter"), summary=True,
+                           **{k: v for k, v in info.items()
+                              if isinstance(v, (int, float))})
         B = np.asarray(B, np.float64)
         fitted = []
         for i, c in enumerate(Cs):
@@ -304,6 +329,7 @@ class _GLMBase(BaseEstimator):
     def fit(self, X, y):
         from ..parallel.streaming import stream_plan
 
+        self._check_unsupported()
         block_rows = stream_plan(X)
         if block_rows is not None:
             return self._fit_streamed(X, y, block_rows)

@@ -123,3 +123,15 @@ def test_bfloat16_config_parity(xy_classification):
     assert abs(f32.score(X, y) - bf16.score(X, y)) < 0.02
     denom = np.linalg.norm(f32.coef_) + 1e-12
     assert np.linalg.norm(f32.coef_ - bf16.coef_) / denom < 0.15
+
+
+def test_class_weight_raises_not_silently_ignored():
+    from dask_ml_tpu.datasets import make_classification
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    X, y = make_classification(n_samples=500, n_features=5, random_state=0)
+    with pytest.raises(ValueError, match="class_weight"):
+        LogisticRegression(solver="lbfgs",
+                           class_weight="balanced").fit(X, y)
+    # None stays allowed
+    LogisticRegression(solver="lbfgs", max_iter=5).fit(X, y)

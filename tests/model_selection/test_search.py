@@ -335,3 +335,18 @@ class TestCGridFastPath:
         s2 = GridSearchCV(PoissonRegression(solver="lbfgs", max_iter=60),
                           {"C": [0.1, 1.0]}, cv=2).fit(Xc, yc)
         assert s2._c_grid_vmapped_ == 2 and np.isfinite(s2.best_score_)
+
+    def test_randomized_search_C_distribution_takes_fast_path(self):
+        import scipy.stats as ss
+
+        from dask_ml_tpu.linear_model import LogisticRegression
+        from dask_ml_tpu.model_selection import RandomizedSearchCV
+
+        X, y = self._data()
+        s = RandomizedSearchCV(
+            LogisticRegression(solver="lbfgs", max_iter=40),
+            {"C": ss.expon(scale=1.0)}, n_iter=5, cv=2, random_state=0,
+        ).fit(X, y)
+        assert s._c_grid_vmapped_ == 5
+        assert len({p["C"] for p in s.cv_results_["params"]}) == 5
+        assert np.isfinite(s.best_score_)
