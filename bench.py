@@ -274,8 +274,79 @@ def run():
     _try(_bench_incremental_sgd, jax, on_tpu, n_chips, peak)
     _try(_bench_streamed_sgd, jax, on_tpu, n_chips, peak)
     _try(_bench_hyperband, jax, on_tpu, n_chips)
+    _try(_bench_c_grid_search, jax, on_tpu, n_chips)
     result["extra_metrics"] = extras
     return result
+
+
+def _bench_c_grid_search(jax, on_tpu, n_chips):
+    """GridSearchCV over a pure-C logreg grid: the stacked-lam fast path
+    (all candidates in one compiled solve per fold) vs the general
+    per-candidate path (same fits, forced by an extra constant grid
+    key). Reports both so the speedup is on record per backend."""
+    import time
+
+    import jax.numpy as jnp
+
+    from dask_ml_tpu.linear_model import LogisticRegression
+    from dask_ml_tpu.model_selection import GridSearchCV
+    from dask_ml_tpu.parallel import as_sharded
+
+    n = 1_000_000 if on_tpu else 100_000
+    d = 64
+    key = jax.random.PRNGKey(5)
+
+    @jax.jit
+    def gen():
+        kx, ky = jax.random.split(key)
+        X = jax.random.normal(kx, (n, d), jnp.float32)
+        y = (X[:, 0] + 0.5 * jax.random.normal(ky, (n,)) > 0).astype(
+            jnp.float32
+        )
+        return X, y
+
+    X, y = jax.block_until_ready(gen())
+    Xs, ys = as_sharded(X), as_sharded(y)
+    Cs = [10.0 ** e for e in range(-4, 4)]
+
+    def run(params):
+        s = GridSearchCV(
+            LogisticRegression(solver="lbfgs", max_iter=20, tol=0.0),
+            params, cv=2, refit=False, scheduler="synchronous",
+        )
+        s.fit(Xs, ys)
+        return s
+
+    run({"C": Cs})  # compile warmup
+    t0 = time.perf_counter()
+    fast = run({"C": Cs})
+    t_fast = time.perf_counter() - t0
+    # fail BEFORE paying for the general-path runs, and with a real
+    # raise (assert vanishes under -O): a silent fallback would label
+    # general-path timing as the fast path
+    if getattr(fast, "_c_grid_vmapped_", None) != len(Cs):
+        raise RuntimeError(
+            "C-grid fast path not taken: "
+            f"{getattr(fast, '_c_grid_fallback_', 'ineligible')}"
+        )
+    general = {"C": Cs, "intercept_scaling": [1.0]}
+    run(general)
+    t0 = time.perf_counter()
+    run(general)
+    t_general = time.perf_counter() - t0
+    return {
+        "metric": "c_grid_search_seconds",
+        "value": round(t_fast, 3),
+        "unit": "s",
+        "backend": jax.default_backend(),
+        "dtype": "float32",
+        "n_rows": n,
+        "n_features": d,
+        "n_candidates": len(Cs),
+        "cv": 2,
+        "general_path_seconds": round(t_general, 3),
+        "speedup_vs_general": round(t_general / t_fast, 3),
+    }
 
 
 def _bench_logreg_f32(jax, on_tpu, n_chips, Xs, ys):
