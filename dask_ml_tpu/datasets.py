@@ -55,9 +55,19 @@ def _classification_parts(n_samples, n_features, n_informative, n_classes,
                 "distinct hypercube vertices"
             )
         # distinct hypercube vertices per class (sampling with replacement
-        # can hand two classes the same center → zero class signal)
-        chosen = rs.choice(2 ** min(n_informative, 62), size=n_classes,
-                           replace=False)
+        # can hand two classes the same center → zero class signal).
+        # NOT np.random.choice(pop, replace=False): that MATERIALIZES a
+        # pop-sized permutation — 2**32 vertices is a ~34 GB allocation
+        # that looks like a hang. sklearn's reservoir-style sampler
+        # draws k distinct values from 2**62 without touching the pool.
+        from sklearn.utils.random import sample_without_replacement
+
+        chosen = np.asarray(
+            sample_without_replacement(
+                2 ** min(n_informative, 62), n_classes, random_state=rs
+            ),
+            dtype=np.int64,
+        )
         bits = ((chosen[:, None] >> np.arange(min(n_informative, 62))) & 1)
         if n_informative > 62:  # pad extra dims with fixed signs
             bits = np.concatenate(

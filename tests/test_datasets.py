@@ -104,3 +104,26 @@ def test_make_classification_df_predictability_response_rate():
         make_classification_df(response_rate=0.0)
     with pytest.raises(TypeError):
         make_classification_df(bogus_arg=1)
+
+
+def test_make_classification_wide_informative_is_fast():
+    """n_informative=32 means 2**32 hypercube vertices; vertex choice
+    must not materialize that population (a ~34 GB allocation that
+    looked like a hang). Distinctness and determinism still hold."""
+    import time
+
+    from dask_ml_tpu import datasets
+
+    t0 = time.perf_counter()
+    X, y = datasets.make_classification(
+        n_samples=2000, n_features=64, n_classes=5, n_informative=32,
+        random_state=0,
+    )
+    assert time.perf_counter() - t0 < 30
+    assert X.shape == (2000, 64)
+    assert len(np.unique(y.to_numpy())) == 5
+    X2, y2 = datasets.make_classification(
+        n_samples=2000, n_features=64, n_classes=5, n_informative=32,
+        random_state=0,
+    )
+    np.testing.assert_array_equal(X.to_numpy(), X2.to_numpy())
