@@ -467,7 +467,7 @@ class PoissonRegression(_GLMBase):
 class LogisticRegression(_GLMBase):
     """Ref: dask_ml/linear_model/glm.py::LogisticRegression. The
     reference (via dask-glm's logistic family) is binary-only; here >2
-    classes fit one-vs-rest, with the C per-class solves vmapped into a
+    classes fit one-vs-rest, with the C per-class solves stacked into a
     single XLA program for smooth solvers."""
 
     family = "logistic"

@@ -278,8 +278,8 @@ def _lbfgs_multi_pallas_chunk(X, codes, mask, n_rows, carry, lam, pmask_t,
                               interpret=False):
     """Joint L-BFGS over the FLAT (C*d,) one-vs-rest vector whose data
     term comes from the multi-target Pallas kernel: every iteration
-    reads X ONCE for all C classes (the vmapped XLA path reads it 2C
-    times — C forward matvecs + C gradient matmuls). The objective is
+    reads X ONCE for all C classes (the stacked XLA path reads it twice
+    — one batched forward matmul + one gradient matmul). The objective is
     separable across classes, so the joint optimum equals the per-class
     optima; ``pmask_t`` arrives tiled to (C*d,)."""
     from ...ops.pallas_fused import fused_glm_multi_value_grad
@@ -706,10 +706,10 @@ def solve_multi(solver, X, Y, mask, n_rows, B0, family, reg, lam, pmask,
     multiclass): ``Y`` is (C, n) targets, ``B0`` (C, d) starts; returns
     ((C, d) betas, info).
 
-    For L-BFGS the C solves run as a SINGLE vmapped XLA program — the
-    per-class matvecs batch into one (C·n·d) contraction on the MXU, the
-    reference's closest analog being C separate dask-glm solves. Other
-    solvers fall back to a per-class loop of their single-target
+    For L-BFGS the C solves run as a SINGLE stacked XLA program — the
+    per-class matvecs batch into one (n,d)x(d,C) contraction on the MXU,
+    the reference's closest analog being C separate dask-glm solves.
+    Other solvers fall back to a per-class loop of their single-target
     programs (correct, C launches)."""
     kwargs.pop("log", None)  # per-class step logs would interleave
     use_pallas = kwargs.pop("use_pallas", None)
@@ -760,7 +760,7 @@ def solve_multi(solver, X, Y, mask, n_rows, B0, family, reg, lam, pmask,
                 warnings.warn(
                     f"fused multi-target GLM solve failed "
                     f"({type(exc).__name__}: {exc}); retrying on the "
-                    "vmapped XLA path", RuntimeWarning,
+                    "stacked XLA path", RuntimeWarning,
                 )
             else:
                 it, gnorm = _host_scalars(it, gnorm)
@@ -773,7 +773,7 @@ def solve_multi(solver, X, Y, mask, n_rows, B0, family, reg, lam, pmask,
             raise ValueError(
                 f"design too wide for the fused multi-target GLM kernel "
                 f"(d={d}, C={C}) — explicit use_pallas=True cannot be "
-                "honored; unset it for the vmapped XLA path"
+                "honored; unset it for the stacked XLA path"
             )
     if solver in _VMAP_SOLVERS and plain_kwargs and not (
         use_pallas and solver == "lbfgs"

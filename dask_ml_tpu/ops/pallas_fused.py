@@ -438,7 +438,7 @@ def fused_glm_multi_value_grad(x, n_valid, y_codes, B, family,
     if tile is None:
         raise ValueError(
             f"design too wide for the fused multi-target GLM kernel "
-            f"(d={d}, C={C}); use the vmapped XLA path"
+            f"(d={d}, C={C}); use the stacked XLA path"
         )
     n_pad = -(-n // tile) * tile
     if n_pad != n:
