@@ -245,11 +245,16 @@ def _lbfgs_chunk(X, y, mask, n_rows, carry, lam, pmask, l1_ratio, stop_it,
     return _lbfgs_loop(loss, carry, stop_it, tol, memory, log)
 
 
-def _lbfgs_loop(loss, carry, stop_it, tol, memory, log):
+def _lbfgs_loop(loss, carry, stop_it, tol, memory, log, gnorm_fn=None):
     """The optax L-BFGS while_loop, shared by every loss flavor (XLA,
-    Pallas single-target, Pallas multi-target)."""
+    Pallas single-target, Pallas multi-target). ``gnorm_fn`` lets the
+    stacked multi-target solves test the MAX per-block gradient norm —
+    "every block converged to tol" — instead of the flat joint norm,
+    matching the single-target criterion exactly."""
     opt = optax.lbfgs(memory_size=memory)
     value_and_grad = optax.value_and_grad_from_state(loss)
+    if gnorm_fn is None:
+        gnorm_fn = jnp.linalg.norm
 
     def cond(carry):
         beta, state, gnorm, it = carry
@@ -262,12 +267,21 @@ def _lbfgs_loop(loss, carry, stop_it, tol, memory, log):
             grad, state, beta, value=value, grad=grad, value_fn=loss
         )
         beta = optax.apply_updates(beta, updates)
-        gnorm = jnp.linalg.norm(grad)
+        gnorm = gnorm_fn(grad)
         if log:  # static: the silent trace has no callback at all
             emit_jit_step(it, loss=value, grad_norm=gnorm)
         return beta, state, gnorm, it + 1
 
     return jax.lax.while_loop(cond, body, carry)
+
+
+def _block_max_norm(C):
+    """max over C row-blocks of the flat gradient's per-block l2 norm."""
+
+    def fn(g):
+        return jnp.max(jnp.linalg.norm(g.reshape(C, -1), axis=1))
+
+    return fn
 
 
 @partial(jax.jit, static_argnames=("family", "reg", "memory", "log",
@@ -297,7 +311,8 @@ def _lbfgs_multi_pallas_chunk(X, codes, mask, n_rows, carry, lam, pmask_t,
         return v, g.reshape(-1)
 
     loss = _custom_vjp_loss(data_vg, n_rows, reg, lam, pmask_t, l1_ratio)
-    return _lbfgs_loop(loss, carry, stop_it, tol, memory, log)
+    return _lbfgs_loop(loss, carry, stop_it, tol, memory, log,
+                       gnorm_fn=_block_max_norm(n_classes))
 
 
 def lbfgs(X, y, mask, n_rows, beta0, family, reg, lam, pmask, l1_ratio=0.5,
@@ -745,10 +760,7 @@ def solve_multi(solver, X, Y, mask, n_rows, B0, family, reg, lam, pmask,
                     _lbfgs_multi_pallas_chunk(
                         X, codes, mask, n_rows, carry, lam, pmask_t,
                         l1_ratio, jnp.asarray(max_iter),
-                        # joint-gradient stop scaled to preserve the
-                        # per-class criterion (see the stacked XLA path)
-                        jnp.asarray(tol * np.sqrt(C), b0.dtype),
-                        family, reg, mesh,
+                        jnp.asarray(tol, b0.dtype), family, reg, mesh,
                         C, memory=memory, interpret=pallas_interpret,
                     )
                 )
@@ -793,10 +805,7 @@ def solve_multi(solver, X, Y, mask, n_rows, B0, family, reg, lam, pmask,
         beta, _state, gnorm, it = _multi_stacked_chunk(
             X, Y, mask, n_rows, carry, lam, jnp.asarray(pmask),
             l1_ratio, jnp.asarray(max_iter),
-            # the stop test sees the JOINT (C*d,) gradient norm — C
-            # per-class norms each at tol join to ~sqrt(C)*tol, so the
-            # threshold scales to preserve the per-class criterion
-            jnp.asarray(tol * np.sqrt(C), jnp.float32), family, reg, C,
+            jnp.asarray(tol, jnp.float32), family, reg, C,
             memory=memory,
         )
         it_h, gnorm_h = _host_scalars(it, gnorm)
@@ -846,7 +855,10 @@ def _multi_stacked_chunk(X, Y, mask, n_rows, carry, lam, pmask, l1_ratio,
             reg, bflat, lam, jnp.tile(pmask, C), l1_ratio
         )
 
-    return _lbfgs_loop(loss, carry, stop_it, tol, memory, False)
+    # stop when EVERY class block has converged to tol (max per-block
+    # norm) — identical criterion to the per-class solves
+    return _lbfgs_loop(loss, carry, stop_it, tol, memory, False,
+                       gnorm_fn=_block_max_norm(C))
 
 
 @partial(jax.jit, static_argnames=("family", "reg", "k", "memory"))
@@ -874,7 +886,10 @@ def _lam_grid_chunk(X, y, mask, n_rows, carry, lams, pmask, stop_it, tol,
         bp = B * pmask[None, :]
         return base + 0.5 * jnp.sum(lams * jnp.sum(bp * bp, axis=1))
 
-    return _lbfgs_loop(loss, carry, stop_it, tol, memory, False)
+    # stop when EVERY candidate's block has converged to tol (max
+    # per-block norm) — identical criterion to per-candidate solves
+    return _lbfgs_loop(loss, carry, stop_it, tol, memory, False,
+                       gnorm_fn=_block_max_norm(k))
 
 
 def solve_lam_grid(X, y, mask, n_rows, lams, pmask, family, reg,
@@ -895,9 +910,7 @@ def solve_lam_grid(X, y, mask, n_rows, lams, pmask, family, reg,
     carry = (b0, opt.init(b0), jnp.asarray(jnp.inf, b0.dtype), 0)
     beta, _state, gnorm, it = _lam_grid_chunk(
         X, y, mask, n_rows, carry, lams, jnp.asarray(pmask),
-        # joint-gradient stop scaled like the multi-target solve: k
-        # per-candidate norms at tol join to ~sqrt(k)*tol
-        jnp.asarray(max_iter), jnp.asarray(tol * np.sqrt(k), jnp.float32),
+        jnp.asarray(max_iter), jnp.asarray(tol, jnp.float32),
         family, reg, k, memory=memory,
     )
     it_h, gnorm_h = _host_scalars(it, gnorm)
