@@ -146,6 +146,40 @@ class _GLMBase(BaseEstimator):
             f"{type(self).__name__} does not support multiclass targets"
         )
 
+    def _fit_C_grid_multiclass(self, X, y, data, mask, Cs):
+        """Multiclass arm of the C-grid fast path; only the logistic
+        family overrides it (other families have no multiclass fit)."""
+        return None
+
+    def _run_C_grid(self, X, Cs, d, solve_fn, finish, **log_fields):
+        """Shared tail of BOTH C-grid arms: per-C (pmask, lam) through
+        _penalty_setup (the ONE place the regularization bookkeeping
+        lives), one logged stacked solve, then fitted clones in ``Cs``
+        order. ``solve_fn(lams, pmask) -> (B, info)``;
+        ``finish(est, B_i, info)`` publishes one candidate's result."""
+        from ..base import clone
+        from ..utils.observability import fit_logger
+
+        per_c = [clone(self).set_params(C=c)._penalty_setup(d, X.n_rows)
+                 for c in Cs]
+        pmask = per_c[0][0]
+        lams = [lam for _, lam in per_c]
+        with fit_logger(type(self).__name__, solver=self.solver,
+                        n_rows=X.n_rows, lam_grid=len(Cs),
+                        **log_fields) as logger:
+            B, info = solve_fn(lams, pmask)
+            if logger is not None:
+                logger.log(step=info.get("n_iter"), summary=True,
+                           **{k: v for k, v in info.items()
+                              if isinstance(v, (int, float))})
+        B = np.asarray(B, np.float64)
+        fitted = []
+        for i, c in enumerate(Cs):
+            est = clone(self).set_params(C=c)
+            finish(est, B[i], info)
+            fitted.append(est)
+        return fitted
+
     def _check_unsupported(self):
         """Honest-raise for accepted-but-unimplemented params (same
         policy as SpectralClustering's): silently ignoring
@@ -290,41 +324,28 @@ class _GLMBase(BaseEstimator):
         if self.family == "logistic":
             pk = np.asarray(packed)
             if not bool(pk[2]) or pk[0] == pk[1]:
-                return None  # multiclass/degenerate: general path
+                # >2 classes: the grid stacks k*C one-vs-rest blocks in
+                # one program (degenerate single-class keeps None — the
+                # general path raises the clean error)
+                return self._fit_C_grid_multiclass(X, y, data, mask, Cs)
             classes = np.asarray(pk[:2])
         d = data.shape[1]
-        from ..base import clone
         from .solvers.solvers import solve_lam_grid
 
-        # per-C (pmask, lam) through _penalty_setup — the ONE place the
-        # regularization bookkeeping lives; pmask is C-independent
-        per_c = [clone(self).set_params(C=c)._penalty_setup(d, X.n_rows)
-                 for c in Cs]
-        pmask = per_c[0][0]
-        lams = [lam for _, lam in per_c]
-
-        from ..utils.observability import fit_logger
-
-        with fit_logger(type(self).__name__, solver=self.solver,
-                        n_rows=X.n_rows, lam_grid=len(Cs)) as logger:
-            B, info = solve_lam_grid(
-                data, y_data, mask, X.n_rows, lams, pmask, self.family,
-                self.penalty, max_iter=self.max_iter, tol=self.tol,
-            )
-            if logger is not None:
-                logger.log(step=info.get("n_iter"), summary=True,
-                           **{k: v for k, v in info.items()
-                              if isinstance(v, (int, float))})
-        B = np.asarray(B, np.float64)
-        fitted = []
-        for i, c in enumerate(Cs):
-            est = clone(self).set_params(C=c)
+        def finish(est, Bi, info):
             if classes is not None:
                 est.classes_ = classes
-            est._finish_fit(B[i], classes, dict(info),
+            est._finish_fit(Bi, classes, dict(info),
                             d - int(self.fit_intercept))
-            fitted.append(est)
-        return fitted
+
+        return self._run_C_grid(
+            X, Cs, d,
+            lambda lams, pmask: solve_lam_grid(
+                data, y_data, mask, X.n_rows, lams, pmask, self.family,
+                self.penalty, max_iter=self.max_iter, tol=self.tol,
+            ),
+            finish,
+        )
 
     def fit(self, X, y):
         from ..parallel.streaming import stream_plan
@@ -506,6 +527,32 @@ class LogisticRegression(_GLMBase):
                               if isinstance(v, (int, float))})
         return self._finish_fit_multi(to_host(beta), classes, info,
                                       X.shape[1])
+
+    def _fit_C_grid_multiclass(self, X, y, data, mask, Cs):
+        """k candidates x C one-vs-rest classes solved as ONE stacked
+        program per fold (the multiclass arm of GridSearchCV's pure-C
+        fast path). Returns fitted clones in ``Cs`` order, or None for
+        degenerate targets (the general path raises cleanly)."""
+        if self.multi_class not in ("auto", "ovr"):
+            return None  # general path raises the clean error
+        classes = np.unique(y.to_numpy())
+        if len(classes) < 2:
+            return None
+        from .solvers.solvers import solve_lam_grid_multi
+
+        Y = _onehot_targets(y.data, mask, jnp.asarray(classes, y.dtype))
+        d = data.shape[1]
+        return self._run_C_grid(
+            X, Cs, d,
+            lambda lams, pmask: solve_lam_grid_multi(
+                data, Y, mask, X.n_rows, lams, pmask, self.family,
+                self.penalty, max_iter=self.max_iter, tol=self.tol,
+            ),
+            lambda est, Bi, info: est._finish_fit_multi(
+                Bi, classes, dict(info), d - int(self.fit_intercept)
+            ),
+            n_classes=len(classes),
+        )
 
     def _check_multi_class(self):
         if self.multi_class not in ("auto", "ovr"):

@@ -892,6 +892,60 @@ def _lam_grid_chunk(X, y, mask, n_rows, carry, lams, pmask, stop_it, tol,
                        gnorm_fn=_block_max_norm(k))
 
 
+@partial(jax.jit, static_argnames=("family", "reg", "k", "C", "memory"))
+def _lam_grid_multi_chunk(X, Y, mask, n_rows, carry, lams, pmask, stop_it,
+                          tol, family, reg, k, C, memory=10):
+    """C-grid x one-vs-rest: k candidates x C classes as ONE stacked
+    (k*C*d,) joint solve. ``Y`` is (C, n) one-hot targets shared by all
+    candidates; block j = i*C + c solves class c at lam_i. One
+    (n,d)x(d,k*C) matmul per iteration serves the whole search fold."""
+    d = X.shape[1]
+
+    def loss(bflat):
+        B = bflat.reshape(k * C, d)
+        eta = jax.lax.dot_general(
+            X, B.astype(X.dtype), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                     # (n, k*C)
+        targets = jnp.tile(Y.T, (1, k))                       # (n, k*C)
+        pw = get_family(family).pointwise(eta, targets)
+        base = jnp.sum(pw * mask[:, None]) / n_rows
+        if reg == "none":
+            return base
+        bp = B * pmask[None, :]
+        lam_rep = jnp.repeat(lams, C)                         # (k*C,)
+        return base + 0.5 * jnp.sum(lam_rep * jnp.sum(bp * bp, axis=1))
+
+    return _lbfgs_loop(loss, carry, stop_it, tol, memory, False,
+                       gnorm_fn=_block_max_norm(k * C))
+
+
+def solve_lam_grid_multi(X, Y, mask, n_rows, lams, pmask, family, reg,
+                         max_iter=100, tol=1e-6, memory=10):
+    """Multiclass variant of :func:`solve_lam_grid`: returns
+    ((k, C, d) betas, info) for k lam values over the shared (C, n)
+    one-vs-rest targets."""
+    _check_smooth(reg, "lbfgs")
+    lams = jnp.asarray(lams, jnp.float32)
+    k = int(lams.shape[0])
+    C = int(Y.shape[0])
+    d = X.shape[1]
+    opt = optax.lbfgs(memory_size=memory)
+    b0 = jnp.zeros((k * C * d,), jnp.float32)
+    carry = (b0, opt.init(b0), jnp.asarray(jnp.inf, b0.dtype), 0)
+    beta, _state, gnorm, it = _lam_grid_multi_chunk(
+        X, Y, mask, n_rows, carry, lams, jnp.asarray(pmask),
+        jnp.asarray(max_iter), jnp.asarray(tol, jnp.float32),
+        family, reg, k, C, memory=memory,
+    )
+    it_h, gnorm_h = _host_scalars(it, gnorm)
+    info = {"n_iter": int(it_h), "grad_norm": float(gnorm_h),
+            "lam_grid": k, "n_classes": C}
+    return check_finite_result(
+        np.asarray(beta).reshape(k, C, d), info, "lbfgs"
+    )
+
+
 def solve_lam_grid(X, y, mask, n_rows, lams, pmask, family, reg,
                    max_iter=100, tol=1e-6, memory=10):
     """k independent GLM solves differing ONLY in the l2 strength, as

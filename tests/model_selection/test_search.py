@@ -299,8 +299,9 @@ class TestCGridFastPath:
 
         X, y = self._data()
         grid = {"C": [0.1, 1.0]}
-        # non-lbfgs solver, l1 penalty, multiclass: all take the
-        # general path and still produce a fitted search
+        # non-lbfgs solver and l1 penalty take the general path and
+        # still produce a fitted search; multiclass (below) takes the
+        # stacked k*C arm of the fast path
         for est in (
             LogisticRegression(solver="admm", max_iter=20),
             LogisticRegression(solver="proximal_grad", penalty="l1",
@@ -317,8 +318,40 @@ class TestCGridFastPath:
         s = GridSearchCV(
             LogisticRegression(solver="lbfgs", max_iter=40), grid, cv=2
         ).fit(Xm, ym)
-        assert not hasattr(s, "_c_grid_vmapped_")  # multiclass bails
+        # multiclass takes the stacked k*C arm of the fast path
+        assert s._c_grid_vmapped_ == 2
         assert s.best_estimator_.coef_.shape == (3, 8)
+
+    def test_multiclass_grid_matches_general_path(self):
+        from dask_ml_tpu.datasets import make_classification
+        from dask_ml_tpu.linear_model import LogisticRegression
+        from dask_ml_tpu.model_selection import GridSearchCV
+
+        Xm, ym = make_classification(n_samples=4000, n_features=10,
+                                     n_classes=4, n_informative=8,
+                                     random_state=1)
+        grid = {"C": [0.01, 0.1, 1.0]}
+        fast = GridSearchCV(
+            LogisticRegression(solver="lbfgs", max_iter=60), grid, cv=2
+        ).fit(Xm, ym)
+        assert fast._c_grid_vmapped_ == 3
+        slow = GridSearchCV(
+            LogisticRegression(solver="lbfgs", max_iter=60),
+            {"C": grid["C"], "intercept_scaling": [1.0]}, cv=2,
+        ).fit(Xm, ym)
+        np.testing.assert_allclose(
+            fast.cv_results_["mean_test_score"],
+            slow.cv_results_["mean_test_score"], atol=3e-3,
+        )
+        # near-tied scores may flip the argmax between paths; the model
+        # quality must match regardless
+        assert abs(fast.best_score_ - slow.best_score_) < 3e-3
+        ref = LogisticRegression(solver="lbfgs", max_iter=60,
+                                 C=fast.best_params_["C"]).fit(Xm, ym)
+        np.testing.assert_allclose(fast.best_estimator_.coef_, ref.coef_,
+                                   atol=2e-3)
+        p = np.asarray(fast.predict_proba(Xm))
+        np.testing.assert_allclose(p.sum(axis=1), 1.0, atol=1e-5)
 
     def test_regression_families(self):
         from dask_ml_tpu.datasets import make_counts, make_regression
