@@ -153,38 +153,43 @@ class _PrefixMemo:
         value = self._memo[key] = compute()
         return value
 
-    def fit_pipeline(self, pipe, fold_id, X, y):
-        """Fit a pipeline reusing cached fitted prefixes + transformed data."""
+    def fit_prefix(self, steps, fold_id, X, y):
+        """Fit-transform the TRANSFORMER steps, sharing cached fitted
+        prefixes + transformed outputs across candidates; returns
+        ([(name, fitted_step), ...], Xt, key_so_far)."""
         key = (fold_id,)
         Xt = X
         fitted_steps = []
-        n = len(pipe.steps)
-        for i, (name, step) in enumerate(pipe.steps):
+        for name, step in steps:
             key = key + (estimator_token(step),)
-            if i == n - 1:
-                # final step fits on the (cached) transformed data
-                Xt_in = Xt
+            Xt_in = Xt
 
-                def fit_last(step=step, Xt_in=Xt_in):
-                    est = clone(step)
-                    est.fit(Xt_in, y)
-                    return est
+            def fit_one(step=step, Xt_in=Xt_in):
+                est = clone(step)
+                if hasattr(est, "fit_transform"):
+                    Xt_new = est.fit_transform(Xt_in, y)
+                else:
+                    Xt_new = est.fit(Xt_in, y).transform(Xt_in)
+                return est, Xt_new
 
-                est = self._get_or_compute(key, fit_last)
-                fitted_steps.append((name, est))
-            else:
-                Xt_in = Xt
+            est, Xt = self._get_or_compute(key, fit_one)
+            fitted_steps.append((name, est))
+        return fitted_steps, Xt, key
 
-                def fit_prefix(step=step, Xt_in=Xt_in):
-                    est = clone(step)
-                    if hasattr(est, "fit_transform"):
-                        Xt_new = est.fit_transform(Xt_in, y)
-                    else:
-                        Xt_new = est.fit(Xt_in, y).transform(Xt_in)
-                    return est, Xt_new
+    def fit_pipeline(self, pipe, fold_id, X, y):
+        """Fit a pipeline reusing cached fitted prefixes + transformed data."""
+        fitted_steps, Xt, key = self.fit_prefix(pipe.steps[:-1], fold_id,
+                                                X, y)
+        name, step = pipe.steps[-1]
+        key = key + (estimator_token(step),)
 
-                est, Xt = self._get_or_compute(key, fit_prefix)
-                fitted_steps.append((name, est))
+        def fit_last(step=step, Xt_in=Xt):
+            est = clone(step)
+            est.fit(Xt_in, y)
+            return est
+
+        fitted_steps = fitted_steps + [(name,
+                                        self._get_or_compute(key, fit_last))]
         fitted = clone(pipe)
         fitted.steps = fitted_steps
         return fitted
@@ -241,20 +246,49 @@ class _BaseSearchCV(BaseEstimator):
             clear_host_fold_cache()
 
     def _try_C_grid_fast(self, candidates, cache, scorers, scores,
-                         train_scores, n_folds, fit_params):
+                         train_scores, n_folds, fit_params, memo):
         """True iff every (candidate, fold) score was filled by the
         stacked C-grid solve; False leaves the grids NaN-reset for the
-        general path."""
+        general path.
+
+        Two eligible shapes: a bare GLM with a pure-``C`` grid, and a
+        Pipeline whose LAST step is a GLM with a pure ``<last>__C``
+        grid — the transformer prefix fits once per fold (shared via
+        ``memo``, exactly as the general pipeline path would) and the
+        stacked solve runs on the transformed fold. Scoring uses the
+        bare GLM against the transformed folds (equivalent to scoring
+        the assembled pipeline on the raw folds, minus k re-transforms
+        of the test fold)."""
         import jax as _jax
 
         from ..models.glm import _GLMBase
 
         est = self.estimator
-        if (fit_params or _jax.process_count() > 1 or len(candidates) < 2
-                or not isinstance(est, _GLMBase)
-                or any(set(p) != {"C"} for p in candidates)):
+        pipeline_mode = (_is_pipeline(est) and len(est.steps) >= 2
+                         and isinstance(est.steps[-1][1], _GLMBase))
+        if pipeline_mode:
+            from ..metrics.scorer import _MetricScorer, _default_scorer
+
+            # the pipeline arm scores the bare GLM against TRANSFORMED
+            # folds — equivalent only for prediction-only scorers. The
+            # registry scorers and the default est.score delegate are
+            # prediction-only by construction; a custom callable could
+            # read X's raw values, so it keeps the general path.
+            if not all(isinstance(sc, _MetricScorer)
+                       or sc is _default_scorer
+                       for sc in scorers.values()):
+                return False
+            c_key = f"{est.steps[-1][0]}__C"
+            glm = est.steps[-1][1]
+        elif isinstance(est, _GLMBase):
+            c_key = "C"
+            glm = est
+        else:
             return False
-        Cs = [p["C"] for p in candidates]
+        if (fit_params or _jax.process_count() > 1 or len(candidates) < 2
+                or any(set(p) != {c_key} for p in candidates)):
+            return False
+        Cs = [p[c_key] for p in candidates]
         if not all(isinstance(c, numbers.Real) and c > 0 for c in Cs):
             return False
         def reset():
@@ -265,7 +299,12 @@ class _BaseSearchCV(BaseEstimator):
         try:
             for fi in range(n_folds):
                 Xtr, ytr, Xte, yte = cache.fold(fi)
-                models = est._fit_C_grid(Xtr, ytr, Cs)
+                if pipeline_mode:
+                    prefix, Xtr, _ = memo.fit_prefix(est.steps[:-1], fi,
+                                                     Xtr, ytr)
+                    for _, t in prefix:
+                        Xte = t.transform(Xte)
+                models = glm._fit_C_grid(Xtr, ytr, Cs)
                 if models is None:
                     # a later fold can be ineligible (e.g. single-class
                     # train split) after earlier folds were scored —
@@ -350,7 +389,7 @@ class _BaseSearchCV(BaseEstimator):
         # back to the general per-candidate machinery, where
         # error_score= applies.
         if self._try_C_grid_fast(candidates, cache, scorers, scores,
-                                 train_scores, n_folds, fit_params):
+                                 train_scores, n_folds, fit_params, memo):
             tasks = []
 
         # Multi-process distribution (SURVEY.md §3.5 'trials pinned to

@@ -82,11 +82,24 @@ def test_grid_search_pipeline_prefix_sharing(data):
         ("scale", StandardScaler()),
         ("clf", LogisticRegression(solver="lbfgs", max_iter=200)),
     ])
-    grid = {"clf__C": [0.1, 1.0, 10.0]}
-    search = GridSearchCV(pipe, grid, cv=2).fit(X, y)
+    # a pure clf__C grid takes the stacked fast path: the prefix is fit
+    # exactly ONCE per fold (2 misses, zero hits needed) and all
+    # candidates solve in one program
+    search = GridSearchCV(pipe, {"clf__C": [0.1, 1.0, 10.0]}, cv=2)
+    search.fit(X, y)
+    assert search._c_grid_vmapped_ == 3
     hits, misses = search._memo_stats
-    # scaler fit once per fold (2 misses) then shared across the other
-    # 2 candidates x 2 folds = 4 hits; classifiers never shared
+    assert misses == 2 and hits == 0, (hits, misses)
+    assert search.best_score_ > 0.7
+    # a mixed grid takes the general path: scaler fit once per fold
+    # (2 misses) then shared across the other 2 candidates x 2 folds
+    # = 4 hits; classifiers never shared
+    search = GridSearchCV(
+        pipe, {"clf__C": [0.1, 1.0, 10.0],
+               "clf__intercept_scaling": [1.0]}, cv=2,
+    ).fit(X, y)
+    assert not hasattr(search, "_c_grid_vmapped_")
+    hits, misses = search._memo_stats
     assert hits == 4, (hits, misses)
     assert search.best_score_ > 0.7
 
@@ -383,3 +396,39 @@ class TestCGridFastPath:
         assert s._c_grid_vmapped_ == 5
         assert len({p["C"] for p in s.cv_results_["params"]}) == 5
         assert np.isfinite(s.best_score_)
+
+    def test_pipeline_last_step_C_grid(self):
+        from sklearn.pipeline import Pipeline
+
+        from dask_ml_tpu.datasets import make_classification
+        from dask_ml_tpu.linear_model import LogisticRegression
+        from dask_ml_tpu.model_selection import GridSearchCV
+        from dask_ml_tpu.preprocessing import StandardScaler
+
+        X, y = make_classification(n_samples=4000, n_features=10,
+                                   random_state=0)
+        pipe = Pipeline([
+            ("scale", StandardScaler()),
+            ("clf", LogisticRegression(solver="lbfgs", max_iter=60)),
+        ])
+        grid = {"clf__C": [0.01, 0.1, 1.0]}
+        fast = GridSearchCV(pipe, grid, cv=2).fit(X, y)
+        assert fast._c_grid_vmapped_ == 3
+        slow = GridSearchCV(
+            pipe, {"clf__C": grid["clf__C"],
+                   "clf__intercept_scaling": [1.0]}, cv=2,
+        ).fit(X, y)
+        np.testing.assert_allclose(
+            fast.cv_results_["mean_test_score"],
+            slow.cv_results_["mean_test_score"], atol=3e-3,
+        )
+        assert abs(fast.best_score_ - slow.best_score_) < 3e-3
+        # refit pipeline scores on RAW inputs (prefix re-applied)
+        assert fast.best_estimator_.score(X, y) > 0.9
+        # multiclass flows through the pipeline arm too
+        Xm, ym = make_classification(n_samples=3000, n_features=8,
+                                     n_classes=3, n_informative=6,
+                                     random_state=2)
+        fm = GridSearchCV(pipe, {"clf__C": [0.1, 1.0]}, cv=2).fit(Xm, ym)
+        assert fm._c_grid_vmapped_ == 2
+        assert fm.best_estimator_.named_steps["clf"].coef_.shape == (3, 8)
