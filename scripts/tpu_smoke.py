@@ -170,6 +170,19 @@ def main():
         # compiled solve for the whole grid per fold)
         assert getattr(gs, "_c_grid_vmapped_", None) == 2, \
             "C-grid fast path not taken"
+        # and through a Pipeline (prefix once per fold + stacked solve)
+        from sklearn.pipeline import Pipeline
+
+        from dask_ml_tpu.preprocessing import StandardScaler
+
+        gp = GridSearchCV(
+            Pipeline([("scale", StandardScaler()),
+                      ("clf", LogisticRegression(solver="lbfgs",
+                                                 max_iter=10))]),
+            {"clf__C": [0.1, 1.0]}, cv=2,
+        ).fit(X, y)
+        assert getattr(gp, "_c_grid_vmapped_", None) == 2, \
+            "pipeline C-grid fast path not taken"
         HyperbandSearchCV(
             SkSGD(tol=1e-3), {"alpha": [1e-4, 1e-3, 1e-2]},
             max_iter=4, aggressiveness=2, random_state=0,
