@@ -12,6 +12,10 @@ ONE task graph for the whole search with two key optimizations:
    on (fold, prefix estimator-token chain) caches fitted pipeline
    prefixes AND their transformed output — same de-dup, no task graph
    (SURVEY.md §7: "de-dup via explicit controller memo").
+3. (beyond the reference) Stacked C-grid fast path: a grid varying only
+   the GLM regularization ``C`` — bare, multiclass, or as a Pipeline's
+   last step — solves ALL candidates in ONE compiled joint L-BFGS
+   program per fold (SURVEY.md §3.4 "combos batched when homogeneous").
 
 Execution: candidates run as a host loop over jitted fits. Device
 estimators share XLA compile cache across candidates (same shapes), which
