@@ -8,7 +8,9 @@ Infrastructure layers:
   randomized SVD), pairwise kernels, Pallas fused kernels
 - ``models/``   — estimator implementations + GLM solver library
 - ``io/``       — native (C++) block loaders
-- ``utils/``    — validation, checkpointing, observability, testing
+- ``observability/`` — JSONL metrics, span tracing, runtime counters,
+  run-report CLI (``python -m dask_ml_tpu.observability.report``)
+- ``utils/``    — validation, checkpointing, testing
 
 sklearn/dask-ml-parity namespaces (import as ``dask_ml_tpu.<name>``):
 ``cluster``, ``compose``, ``datasets``, ``decomposition``, ``ensemble``,
@@ -22,6 +24,6 @@ __version__ = "0.1.0"
 __all__ = [
     "cluster", "compose", "config", "datasets", "decomposition",
     "ensemble", "feature_extraction", "impute", "linear_model", "metrics",
-    "model_selection", "naive_bayes", "ops", "parallel", "preprocessing",
-    "utils", "wrappers", "xgboost", "__version__",
+    "model_selection", "naive_bayes", "observability", "ops", "parallel",
+    "preprocessing", "utils", "wrappers", "xgboost", "__version__",
 ]

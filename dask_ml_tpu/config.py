@@ -33,6 +33,14 @@ class Config:
     stream_autotune: bool = False
     # JSONL metrics path ("" = disabled)
     metrics_path: str = ""
+    # span-trace directory: spans append to <trace_dir>/trace.jsonl even
+    # outside a metrics_path fit ("" = spans fall back to metrics_path,
+    # or no-op when both are unset)
+    trace_dir: str = ""
+    # runtime counter registry (recompiles, host<->device bytes, donated
+    # buffer reuse) — cheap host-side adds; disable to make every
+    # counter call site a single config lookup
+    obs_counters: bool = True
     # checkpoint directory for adaptive searches ("" = disabled)
     checkpoint_dir: str = ""
 

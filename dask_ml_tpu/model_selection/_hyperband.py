@@ -135,6 +135,15 @@ class HyperbandSearchCV(BaseIncrementalSearchCV):
                 return s
         return None
 
+    def _trial_tags(self, mid):
+        """Per-trial telemetry tag: which Hyperband bracket this model
+        belongs to (``_bounds`` exists once ``_reset_hook`` ran; the
+        multi-process path runs per-bracket SHAs whose prefix already
+        names the bracket)."""
+        if getattr(self, "_bounds", None):
+            return {"bracket": self._bracket_of(mid)}
+        return {}
+
     def _additional_calls(self, info):
         """One SHA step PER BRACKET over that bracket's live candidates,
         merged into a single round request — the round-robin interleave

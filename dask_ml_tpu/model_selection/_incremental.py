@@ -109,25 +109,29 @@ def disable_process_distribution():
 def fit(model_factory, params_list, train_blocks, X_test, y_test, scorer,
         additional_calls, fit_params=None, patience=False, tol=1e-3,
         max_iter=None, prefix="", verbose=False, checkpoint=None,
-        ckpt_token=None, hook_state=None, scoring_is_default=False):
+        ckpt_token=None, hook_state=None, scoring_is_default=False,
+        trial_tags=None):
     """Core controller entry: opens the per-fit JSONL sink (closed even on
     error) around the actual controller loop in :func:`_fit`."""
-    from ..utils.observability import fit_logger
+    from ..observability import fit_logger, span
 
-    with fit_logger("adaptive_search", prefix=prefix) as logger:
+    with span("fit", component="adaptive_search", prefix=prefix,
+              n_models=len(params_list)), \
+            fit_logger("adaptive_search", prefix=prefix) as logger:
         return _fit(model_factory, params_list, train_blocks, X_test,
                     y_test, scorer, additional_calls, fit_params=fit_params,
                     patience=patience, tol=tol, max_iter=max_iter,
                     prefix=prefix, verbose=verbose, checkpoint=checkpoint,
                     ckpt_token=ckpt_token, hook_state=hook_state,
-                    scoring_is_default=scoring_is_default, logger=logger)
+                    scoring_is_default=scoring_is_default, logger=logger,
+                    trial_tags=trial_tags)
 
 
 def _fit(model_factory, params_list, train_blocks, X_test, y_test, scorer,
          additional_calls, fit_params=None, patience=False, tol=1e-3,
          max_iter=None, prefix="", verbose=False, checkpoint=None,
          ckpt_token=None, hook_state=None, scoring_is_default=False,
-         logger=None):
+         logger=None, trial_tags=None):
     """Core controller (ref: _incremental.py::_fit). Returns
     (info, models, history).
 
@@ -217,12 +221,15 @@ def _fit(model_factory, params_list, train_blocks, X_test, y_test, scorer,
         peers in the allgather."""
         import contextlib
 
+        from ..observability import span
         from ..parallel.mesh import use_mesh
 
         placement = (use_mesh(placement_mesh) if placement_mesh is not None
                      else contextlib.nullcontext())
         try:
-            with placement:
+            with span("search.round", round=round_idx,
+                      n_trials=len(requests),
+                      n_calls=sum(requests.values())), placement:
                 run_requests(requests)
         except Exception as e:
             sync_round(e)
@@ -291,9 +298,12 @@ def _fit(model_factory, params_list, train_blocks, X_test, y_test, scorer,
                 history.append(record)
                 info[mid].append(record)
             if logger is not None:
+                tags = trial_tags(mid) if trial_tags is not None else {}
                 logger.log(step=m["partial_fit_calls"], model_id=mid,
+                           partial_fit_calls=m["partial_fit_calls"],
                            score=float(score), batch_size=len(mids),
-                           partial_fit_time=fit_time, score_time=score_time)
+                           partial_fit_time=fit_time,
+                           score_time=score_time, **tags)
 
     def train_one(mid, n_calls, executor="sequential", blocks=None,
                   test=None):
@@ -634,6 +644,11 @@ class BaseIncrementalSearchCV(BaseEstimator):
         """Schedule position persisted with checkpoints (e.g. SHA rung)."""
         return {}
 
+    def _trial_tags(self, mid):
+        """Extra JSONL fields attached to model ``mid``'s telemetry
+        records (Hyperband tags the bracket)."""
+        return {}
+
     def _set_hook_state(self, state):
         for k, v in state.items():
             setattr(self, k, v)
@@ -745,6 +760,7 @@ class BaseIncrementalSearchCV(BaseEstimator):
             ckpt_token=ckpt_token,
             hook_state=(self._hook_state, self._set_hook_state),
             scoring_is_default=self.scoring is None,
+            trial_tags=self._trial_tags,
         )
 
         self.history_ = history

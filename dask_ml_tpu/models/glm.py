@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..base import BaseEstimator, to_host
+from ..observability import span
 from ..parallel.mesh import resolve_mesh
 from ..parallel.sharded import ShardedArray
 from ..utils.validation import check_X_y, check_array, check_is_fitted
@@ -158,16 +159,19 @@ class _GLMBase(BaseEstimator):
         order. ``solve_fn(lams, pmask) -> (B, info)``;
         ``finish(est, B_i, info)`` publishes one candidate's result."""
         from ..base import clone
-        from ..utils.observability import fit_logger
+        from ..observability import fit_logger
 
         per_c = [clone(self).set_params(C=c)._penalty_setup(d, X.n_rows)
                  for c in Cs]
         pmask = per_c[0][0]
         lams = [lam for _, lam in per_c]
-        with fit_logger(type(self).__name__, solver=self.solver,
-                        n_rows=X.n_rows, lam_grid=len(Cs),
-                        **log_fields) as logger:
+        with span("fit", component=type(self).__name__, solver=self.solver,
+                  n_rows=X.n_rows, lam_grid=len(Cs)) as sp, \
+                fit_logger(type(self).__name__, solver=self.solver,
+                           n_rows=X.n_rows, lam_grid=len(Cs),
+                           **log_fields) as logger:
             B, info = solve_fn(lams, pmask)
+            sp.add(n_iter=info.get("n_iter"))
             if logger is not None:
                 logger.log(step=info.get("n_iter"), summary=True,
                            **{k: v for k, v in info.items()
@@ -247,7 +251,7 @@ class _GLMBase(BaseEstimator):
             raise ValueError(f"Unknown penalty {self.penalty!r}")
         from ..parallel import distributed as dist
         from ..parallel.streaming import BlockStream
-        from ..utils.observability import fit_logger
+        from ..observability import fit_logger
         from .solvers.streamed import solve_streamed
 
         multi_host = dist.process_count() > 1
@@ -268,25 +272,34 @@ class _GLMBase(BaseEstimator):
 
             C = len(classes)
             B0 = self._warm_B0(C, d)
-            with fit_logger(type(self).__name__, solver=self.solver,
-                            streamed=True, n_rows=n,
-                            n_classes=C) as logger:
+            with span("fit", component=type(self).__name__,
+                      solver=self.solver, streamed=True, n_rows=n,
+                      n_classes=C) as sp, \
+                    fit_logger(type(self).__name__, solver=self.solver,
+                               streamed=True, n_rows=n,
+                               n_classes=C) as logger:
                 Beta, info = solve_streamed_multi(
                     self.solver, stream, n, B0, self.family, self.penalty,
                     lam, pmask, l1_ratio=l1_ratio,
                     intercept=self.fit_intercept, max_iter=self.max_iter,
                     tol=self.tol, logger=logger, reduce=reduce, **kwargs,
                 )
+                sp.add(n_iter=info.get("n_iter"),
+                       data_passes=info.get("data_passes"))
             return self._finish_fit_multi(Beta, classes, info, d_feat)
         beta0 = self._warm_beta0(d, np)
-        with fit_logger(type(self).__name__, solver=self.solver,
-                        streamed=True, n_rows=n) as logger:
+        with span("fit", component=type(self).__name__, solver=self.solver,
+                  streamed=True, n_rows=n) as sp, \
+                fit_logger(type(self).__name__, solver=self.solver,
+                           streamed=True, n_rows=n) as logger:
             beta, info = solve_streamed(
                 self.solver, stream, n, beta0, self.family, self.penalty,
                 lam, pmask, l1_ratio=l1_ratio, intercept=self.fit_intercept,
                 max_iter=self.max_iter, tol=self.tol, logger=logger,
                 reduce=reduce, **kwargs,
             )
+            sp.add(n_iter=info.get("n_iter"),
+                   data_passes=info.get("data_passes"))
         return self._finish_fit(beta, classes, info, d_feat)
 
     def _fit_C_grid(self, X, y, Cs):
@@ -391,12 +404,14 @@ class _GLMBase(BaseEstimator):
         beta0 = jnp.asarray(self._warm_beta0(d, np))
         kwargs = dict(self.solver_kwargs or {})
         l1_ratio = kwargs.pop("l1_ratio", 0.5)
-        from ..utils.observability import (
+        from ..observability import (
             active_logger, fit_logger, jit_callbacks_supported,
         )
 
-        with fit_logger(type(self).__name__, solver=self.solver,
-                        n_rows=X.n_rows) as logger, active_logger(logger):
+        with span("fit", component=type(self).__name__, solver=self.solver,
+                  n_rows=X.n_rows) as sp, \
+                fit_logger(type(self).__name__, solver=self.solver,
+                           n_rows=X.n_rows) as logger, active_logger(logger):
             # per-step callbacks need backend support (axon PJRT lacks
             # host callbacks); degrade to one summary record per fit
             log_steps = logger is not None and jit_callbacks_supported()
@@ -409,6 +424,7 @@ class _GLMBase(BaseEstimator):
                 max_iter=self.max_iter, tol=self.tol, mesh=mesh,
                 log=log_steps, **kwargs,
             )
+            sp.add(n_iter=info.get("n_iter"))
             if logger is not None and not log_steps:
                 logger.log(step=info.get("n_iter"), summary=True,
                            **{k: v for k, v in info.items()
@@ -501,7 +517,7 @@ class LogisticRegression(_GLMBase):
                 f"LogisticRegression needs at least 2 classes; got "
                 f"{len(classes)}"
             )
-        from ..utils.observability import fit_logger
+        from ..observability import fit_logger
         from .solvers.solvers import solve_multi
 
         # (C, n) one-vs-rest targets in ONE program; padding rows zeroed
@@ -512,8 +528,10 @@ class LogisticRegression(_GLMBase):
         B0 = jnp.asarray(self._warm_B0(C, d))
         kwargs = dict(self.solver_kwargs or {})
         l1_ratio = kwargs.pop("l1_ratio", 0.5)
-        with fit_logger(type(self).__name__, solver=self.solver,
-                        n_rows=X.n_rows, n_classes=C) as logger:
+        with span("fit", component=type(self).__name__, solver=self.solver,
+                  n_rows=X.n_rows, n_classes=C) as sp, \
+                fit_logger(type(self).__name__, solver=self.solver,
+                           n_rows=X.n_rows, n_classes=C) as logger:
             beta, info = solve_multi(
                 self.solver, X=data, Y=Y, mask=mask, n_rows=X.n_rows,
                 B0=B0, family=self.family, reg=self.penalty,
@@ -521,6 +539,7 @@ class LogisticRegression(_GLMBase):
                 l1_ratio=l1_ratio, max_iter=self.max_iter, tol=self.tol,
                 mesh=X.mesh, **kwargs,
             )
+            sp.add(n_iter=info.get("n_iter"))
             if logger is not None:
                 logger.log(step=info.get("n_iter"), summary=True,
                            **{k: v for k, v in info.items()

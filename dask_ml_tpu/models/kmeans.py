@@ -40,7 +40,7 @@ from ..utils.validation import check_array, check_is_fitted
 
 # -- jitted kernels ---------------------------------------------------------
 
-from ..utils.observability import emit_jit_step
+from ..observability import emit_jit_step, span
 
 
 @partial(jax.jit, static_argnames=("log", "mxu_dtype"))
@@ -617,7 +617,7 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         (SURVEY.md §3.1) without materializing X in HBM. ``labels_`` is a
         host int32 array (X's own size /(4·d) — small next to X)."""
         from ..parallel.streaming import BlockStream
-        from ..utils.observability import fit_logger
+        from ..observability import fit_logger
 
         n_local, d = X.shape
         from ..parallel import distributed as dist
@@ -657,13 +657,19 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             # passes over an out-of-core dataset
             centers0, start_it = resume
         else:
-            centers0, start_it = self._init_centers_streamed(stream, d), 0
-        with fit_logger("KMeans", streamed=True, n_rows=n,
-                        n_clusters=self.n_clusters) as logger:
+            with span("kmeans.init", streamed=True, init=str(self.init)):
+                centers0, start_it = (
+                    self._init_centers_streamed(stream, d), 0
+                )
+        with span("fit", component="KMeans", streamed=True, n_rows=n,
+                  n_clusters=self.n_clusters) as sp, \
+                fit_logger("KMeans", streamed=True, n_rows=n,
+                           n_clusters=self.n_clusters) as logger:
             centers, n_iter = _streamed_lloyd(
                 stream, centers0, self.max_iter, tol2, logger=logger,
                 ckpt=ckpt, start_it=start_it,
             )
+            sp.add(n_iter=int(n_iter))
         labels = np.empty(n_local, np.int32)  # labels stay process-local
         inertia = 0.0
         cursor = 0
@@ -721,12 +727,14 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                 "config.dtype='bfloat16' is ignored on this path",
                 RuntimeWarning,
             )
-        from ..utils.observability import (
+        from ..observability import (
             active_logger, fit_logger, jit_callbacks_supported,
         )
 
-        with fit_logger("KMeans", n_rows=X.n_rows,
-                        n_clusters=self.n_clusters) as logger, \
+        with span("fit", component="KMeans", n_rows=X.n_rows,
+                  n_clusters=self.n_clusters) as sp, \
+                fit_logger("KMeans", n_rows=X.n_rows,
+                           n_clusters=self.n_clusters) as logger, \
                 active_logger(logger):
             # per-step callbacks need backend support (axon PJRT lacks
             # host callbacks); degrade to one summary record per fit
@@ -768,6 +776,7 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                     if int(it_c) < chunk:
                         break  # converged inside the chunk
                 ckpt.clear()
+            sp.add(n_iter=int(n_iter))
             if logger is not None and not log_steps:
                 logger.log(step=int(n_iter), center_shift2=float(shift2),
                            summary=True)

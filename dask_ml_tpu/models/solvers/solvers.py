@@ -31,7 +31,7 @@ import optax
 from jax.sharding import PartitionSpec as P
 
 from ...parallel.mesh import DATA_AXIS
-from ...utils.observability import emit_jit_step
+from ...observability import emit_jit_step
 from ..solvers import regularizers
 from ..solvers.families import get_family
 from ...ops.linalg import shard_map

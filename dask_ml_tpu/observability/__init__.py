@@ -1,0 +1,82 @@
+"""Observability subsystem: JSONL metrics, hierarchical span tracing,
+runtime counters, and the run-report CLI.
+
+The dask-ml reference leaned on dask's diagnostics stack (task-stream
+dashboard, progress bars, profilers — SURVEY.md §5); the TPU rebuild's
+equivalent is this package (grown from the flat per-step logger in
+``utils/observability.py``, which remains as a re-export shim):
+
+- ``_metrics``  — ``MetricsLogger`` (JSONL sink), the ambient
+  ``active_logger`` jit-step sink + ``emit_jit_step`` debug-callback
+  bridge, the host-callback capability probe, profiler wrappers;
+- ``_spans``    — ``span(name, **attrs)``: nested span records (fit →
+  pass → solve) with wall time, device-sync time, parent ids, and
+  counter deltas;
+- ``_counters`` — flat counter/gauge registry: recompiles (via
+  ``jax.monitoring``, with a jit-cache fallback), host↔device transfer
+  bytes, donated-buffer reuse, per-device memory gauges;
+- ``report``    — ``python -m dask_ml_tpu.observability.report
+  metrics.jsonl`` aggregates a recorded run into per-component tables.
+
+Everything is ambient and zero-overhead when disabled: no
+``metrics_path``/``trace_dir`` configured means spans are no-ops and no
+callback is ever traced into jitted code (asserted by
+``tests/test_observability.py``).
+"""
+
+from ._counters import (
+    count_recompiles,
+    counter_add,
+    counters_enabled,
+    counters_reset,
+    counters_snapshot,
+    device_memory_gauges,
+    install_recompile_tracking,
+    log_counters,
+    record_donation,
+    record_transfer,
+)
+from ._metrics import (
+    MetricsLogger,
+    _active_lock,
+    _active_loggers,
+    active_logger,
+    emit_jit_step,
+    fit_logger,
+    jit_callbacks_supported,
+    profile_trace,
+    reset_jit_callbacks_probe,
+    start_profiler_server,
+    timed,
+)
+from ._spans import NOOP_SPAN, current_span_id, span
+
+# recompile telemetry is passive and cheap (a no-op listener call per
+# compile when counters are disabled) — install at import so the counter
+# covers warmup compiles too
+install_recompile_tracking()
+
+__all__ = [
+    "MetricsLogger",
+    "NOOP_SPAN",
+    "active_logger",
+    "count_recompiles",
+    "counter_add",
+    "counters_enabled",
+    "counters_reset",
+    "counters_snapshot",
+    "current_span_id",
+    "device_memory_gauges",
+    "emit_jit_step",
+    "fit_logger",
+    "install_recompile_tracking",
+    "jit_callbacks_supported",
+    "log_counters",
+    "profile_trace",
+    "record_donation",
+    "record_transfer",
+    "reset_jit_callbacks_probe",
+    "span",
+    "start_profiler_server",
+    "timed",
+]

@@ -1,0 +1,155 @@
+"""Runtime counter/gauge registry.
+
+The signals a perf PR must be able to cite (ROADMAP north star:
+hardware-speed hot paths): how many XLA recompiles a run paid, how many
+bytes crossed the host↔device boundary, how much buffer reuse the
+streamer achieved, and where device memory stands. Counters are a flat
+``name -> number`` registry guarded by one lock; spans snapshot it at
+open and emit the deltas at close, so every JSONL span record carries
+the counters *it* caused.
+
+Gating: ``config.obs_counters`` (env ``DASK_ML_TPU_OBS_COUNTERS``)
+switches recording off entirely; the hot-path call sites cost one
+config lookup + dict add, and nothing is ever traced into jitted code.
+
+Recompile counting rides ``jax.monitoring``'s
+``/jax/core/compile/backend_compile_duration`` event where the
+installed jax exposes it; runtimes without ``jax.monitoring`` fall back
+to :func:`count_recompiles`, which wraps a jitted entry point (the
+``ops/`` jit entries use it) and counts compile-cache growth.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+
+import jax
+
+_lock = threading.Lock()
+_counters: dict[str, float] = {}
+
+
+def counters_enabled() -> bool:
+    from ..config import get_config
+
+    return bool(get_config().obs_counters)
+
+
+def counter_add(name: str, value=1) -> None:
+    """Unconditional add — call sites that already paid the enabled()
+    check (or tests building fixtures) use this directly."""
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + value
+
+
+def counters_snapshot() -> dict:
+    with _lock:
+        return dict(_counters)
+
+
+def counters_reset() -> None:
+    with _lock:
+        _counters.clear()
+
+
+def record_transfer(nbytes: int, direction: str = "h2d") -> None:
+    """One host↔device transfer of ``nbytes`` (the block streamer calls
+    this per device_put batch)."""
+    if counters_enabled():
+        counter_add(f"{direction}_bytes", int(nbytes))
+        counter_add(f"{direction}_transfers", 1)
+
+
+def record_donation(nbytes: int) -> None:
+    """A donated buffer was reused in place of a fresh allocation."""
+    if counters_enabled():
+        counter_add("donated_bytes_reused", int(nbytes))
+        counter_add("donated_buffers_reused", 1)
+
+
+# -- recompile tracking ------------------------------------------------------
+
+_recompile_listener_installed = False
+
+
+def _on_compile_duration(name, secs, **kw):
+    # one backend_compile per (function, shape) specialization — exactly
+    # the "how many recompiles did this run pay" signal
+    if name.endswith("backend_compile_duration") and counters_enabled():
+        counter_add("recompiles", 1)
+        counter_add("compile_secs", float(secs))
+
+
+def install_recompile_tracking() -> bool:
+    """Register the jax.monitoring compile listener (idempotent).
+    Returns False on jax builds without the monitoring API — callers
+    then keep :func:`count_recompiles` wrappers live instead."""
+    global _recompile_listener_installed
+    if _recompile_listener_installed:
+        return True
+    try:
+        from jax import monitoring
+
+        monitoring.register_event_duration_secs_listener(
+            _on_compile_duration
+        )
+        _recompile_listener_installed = True
+        return True
+    except Exception:
+        return False
+
+
+def count_recompiles(fn):
+    """Fallback recompile counter for jitted entry points when
+    ``jax.monitoring`` is unavailable: wrap the jitted callable and count
+    compile-cache growth per call. Identity when the listener installed —
+    the wrapper would double-count."""
+    if install_recompile_tracking():
+        return fn
+    if not hasattr(fn, "_cache_size"):  # not a jitted callable
+        return fn
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        before = fn._cache_size()
+        out = fn(*args, **kwargs)
+        grew = fn._cache_size() - before
+        if grew > 0 and counters_enabled():
+            counter_add("recompiles", grew)
+        return out
+
+    wrapped.__wrapped_jit__ = fn
+    return wrapped
+
+
+# -- gauges ------------------------------------------------------------------
+
+def device_memory_gauges() -> dict:
+    """Per-device memory stats as a flat gauge dict (empty on backends
+    that report none — CPU). Polled, not accumulated: emit via
+    :func:`log_counters` or a span ``add`` when a footprint snapshot
+    matters."""
+    out = {}
+    for dev in jax.local_devices():
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if key in stats:
+                out[f"dev{dev.id}_{key}"] = int(stats[key])
+    return out
+
+
+def log_counters(logger, **extra) -> dict:
+    """Emit one JSONL record holding the current counter snapshot plus
+    device memory gauges; returns the snapshot. The report CLI reads the
+    LAST such record as the run's totals."""
+    snap = counters_snapshot()
+    if logger is not None:
+        logger.log(counters=True, **snap, **device_memory_gauges(),
+                   **extra)
+    return snap

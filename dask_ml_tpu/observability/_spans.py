@@ -1,0 +1,198 @@
+"""Hierarchical span tracing.
+
+``span(name, **attrs)`` is the ambient, nesting-aware timer the rest of
+the package wraps its hot paths in (fit → epoch/pass → solve): each
+closed span appends one JSONL record carrying wall time, accumulated
+device-sync time (``Span.sync`` barriers), its id/parent id/depth, the
+caller's attributes, and the counter deltas it caused (``ctr_*`` fields
+from the registry in ``_counters``). The parent chain is per-thread, so
+concurrent fits trace independent trees into the shared sink.
+
+Sink resolution, per span open (cheap: one list peek + one config read):
+
+1. the innermost ``active_logger`` binding OF THIS THREAD — spans
+   inside a fit land in that fit's logger with its ``component``
+   extras (another thread's concurrent binding is never borrowed: its
+   extras would mislabel this thread's records);
+2. ``config.trace_dir`` → a shared append-only ``trace.jsonl`` there;
+3. ``config.metrics_path`` → the same file the step metrics use;
+4. none of those set → the span is the singleton no-op: no record, no
+   id allocation, no counter snapshot. The disabled path is a dict
+   lookup and a None check — nothing is ever traced into jitted code.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+
+from ._counters import counters_enabled, counters_snapshot
+from ._metrics import thread_bound_logger
+
+_ids = itertools.count(1)
+_tls = threading.local()
+
+# "time" origin for fallback-sink span records (relative to process
+# start, matching MetricsLogger's fit-relative convention in spirit)
+_T0 = time.time()
+_trace_lock = threading.Lock()
+
+
+def _stack():
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def current_span_id():
+    """Id of the innermost open span on this thread (None outside any)."""
+    st = getattr(_tls, "stack", None)
+    return st[-1] if st else None
+
+
+class _FileSink:
+    """Open-per-record append sink: no file descriptor outlives the
+    write (a long-lived process tracing many distinct paths must not
+    accumulate open handles), and each record gets a fresh timestamp.
+    Spans are per-fit/pass frequency, so the open cost is noise."""
+
+    __slots__ = ("path",)
+
+    def __init__(self, path):
+        self.path = path
+
+    def log(self, **rec):
+        line = json.dumps(
+            {"time": round(time.time() - _T0, 6), **rec}
+        ) + "\n"
+        with _trace_lock, open(self.path, "a") as fh:
+            fh.write(line)
+
+
+def _trace_sink():
+    lg = thread_bound_logger()
+    if lg is not None:
+        return lg
+    from ..config import get_config
+
+    cfg = get_config()
+    if cfg.trace_dir:
+        try:
+            os.makedirs(cfg.trace_dir, exist_ok=True)
+        except OSError:
+            return None  # unusable sink disables the span, never the fit
+        return _FileSink(os.path.join(cfg.trace_dir, "trace.jsonl"))
+    if cfg.metrics_path:
+        return _FileSink(cfg.metrics_path)
+    return None
+
+
+class _NoopSpan:
+    """Shared zero-cost stand-in when no sink is configured."""
+
+    __slots__ = ()
+
+    def add(self, **attrs):
+        return self
+
+    def sync(self, value):
+        return value
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class span:
+    """Context manager producing one nested JSONL span record.
+
+    ``with span("fit", component="KMeans", n_rows=n) as sp:`` — the
+    yielded object accepts late attributes (``sp.add(n_iter=7)``) and
+    device barriers (``out = sp.sync(out)`` runs ``block_until_ready``
+    and accumulates the stall into the record's ``sync_s``). With no
+    sink configured the context yields the shared no-op span.
+    """
+
+    __slots__ = ("name", "attrs", "span_id", "parent_id", "sync_s",
+                 "_sink", "_t0", "_ctr0")
+
+    def __init__(self, name, **attrs):
+        self.name = name
+        self.attrs = attrs
+        self.sync_s = 0.0
+        self._sink = None
+
+    def add(self, **attrs):
+        self.attrs.update(attrs)
+        return self
+
+    def sync(self, value):
+        """block_until_ready barrier whose wall time is charged to this
+        span's ``sync_s`` — the honest "time the host stalled on the
+        device" number under async dispatch."""
+        import jax
+
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(value)
+        self.sync_s += time.perf_counter() - t0
+        return out
+
+    def __enter__(self):
+        sink = _trace_sink()
+        if sink is None:
+            return NOOP_SPAN
+        self._sink = sink
+        st = _stack()
+        self.parent_id = st[-1] if st else None
+        self.span_id = next(_ids)
+        st.append(self.span_id)
+        self._ctr0 = counters_snapshot() if counters_enabled() else None
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._sink is None:
+            return False
+        wall = time.perf_counter() - self._t0
+        st = _stack()
+        # pop down to (and including) OUR frame: frames above ours are
+        # spans abandoned mid-block (a generator dropped between yields)
+        # — leaving them would corrupt every later span's parent id
+        if self.span_id in st:
+            while st and st[-1] != self.span_id:
+                st.pop()
+            if st:
+                st.pop()
+        rec = {
+            "span": self.name,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "depth": len(st),
+            # absolute close time: the relative "time" field's origin
+            # differs by sink (fit logger's t0 vs process start), so
+            # cross-record correlation uses this one
+            "t_unix": round(time.time(), 6),
+            "wall_s": round(wall, 6),
+            "sync_s": round(self.sync_s, 6),
+        }
+        if exc_type is not None:
+            rec["error"] = exc_type.__name__
+        rec.update(self.attrs)
+        if self._ctr0 is not None:
+            now = counters_snapshot()
+            for k, v in now.items():
+                d = v - self._ctr0.get(k, 0)
+                if d:
+                    rec[f"ctr_{k}"] = round(d, 6) if isinstance(
+                        d, float) else d
+        try:
+            self._sink.log(**rec)
+        except Exception:
+            # telemetry must never kill the fit it observes (a full disk
+            # mid-run would otherwise raise out of this __exit__ —
+            # replacing the in-flight exception when one is unwinding)
+            pass
+        return False

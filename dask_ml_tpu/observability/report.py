@@ -1,0 +1,219 @@
+"""Run-report CLI: aggregate a recorded JSONL metrics/trace file into a
+per-component summary.
+
+Usage::
+
+    python -m dask_ml_tpu.observability.report metrics.jsonl
+
+Reads the records the subsystem emits — span records (``span`` field),
+per-step solver/search records (``component`` field), stream-pass
+overlap records (``stream_pass``), and counter snapshots (``counters``)
+— and prints: time per span (wall + device-sync), samples/s where a
+span recorded its row count, each component's convergence trajectory
+(first→last loss-like metric and step count), streaming overlap totals,
+and the run's counter totals (recompiles, host↔device bytes). The point
+(ISSUE 1): a BENCH round's JSONL answers "where did this fit spend its
+time" without re-running anything.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+# the metric each component's convergence trajectory is read from, in
+# preference order (first key present in its step records wins)
+_LOSS_KEYS = ("loss", "inertia", "center_shift2", "primal_residual",
+              "score", "opt_residual", "grad_norm")
+
+
+def load_records(path):
+    """Parse a JSONL file, skipping blank/corrupt lines (a crashed run
+    may truncate its last line — the report must still read the rest)."""
+    records = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                continue
+    return records
+
+
+def _fmt_seconds(s):
+    return f"{s:.3f}s"
+
+
+def _fmt_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+
+
+def _table(title, headers, rows):
+    if not rows:
+        return []
+    widths = [max(len(str(h)), *(len(str(r[i])) for r in rows))
+              for i, h in enumerate(headers)]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    out = [title, fmt.format(*headers),
+           fmt.format(*("-" * w for w in widths))]
+    out.extend(fmt.format(*(str(c) for c in r)) for r in rows)
+    out.append("")
+    return out
+
+
+def summarize_spans(records):
+    """[(key, count, wall, sync, samples/s or None)] grouped by
+    (span name, component)."""
+    groups = {}
+    for r in records:
+        if "span" not in r:
+            continue
+        key = r["span"]
+        if r.get("component"):
+            key = f"{r['component']}.{key}"
+        g = groups.setdefault(key, {"n": 0, "wall": 0.0, "sync": 0.0,
+                                    "rows": 0.0})
+        g["n"] += 1
+        g["wall"] += float(r.get("wall_s", 0.0))
+        g["sync"] += float(r.get("sync_s", 0.0))
+        g["rows"] += float(r.get("n_rows", 0.0))
+    out = []
+    for key in sorted(groups, key=lambda k: -groups[k]["wall"]):
+        g = groups[key]
+        sps = g["rows"] / g["wall"] if g["rows"] and g["wall"] > 0 else None
+        out.append((key, g["n"], g["wall"], g["sync"], sps))
+    return out
+
+
+def summarize_components(records):
+    """Per-component step telemetry: record count, steps, convergence
+    trajectory (first → last of the component's loss-like metric)."""
+    comps = {}
+    for r in records:
+        if "span" in r or "component" not in r:
+            continue
+        c = comps.setdefault(r["component"], {"n": 0, "steps": set(),
+                                              "key": None, "first": None,
+                                              "last": None})
+        c["n"] += 1
+        if r.get("step") is not None:
+            c["steps"].add(r["step"])
+        if c["key"] is None:
+            for k in _LOSS_KEYS:
+                if k in r:
+                    c["key"] = k
+                    break
+        k = c["key"]
+        if k is not None and k in r:
+            if c["first"] is None:
+                c["first"] = float(r[k])
+            c["last"] = float(r[k])
+    out = []
+    for name in sorted(comps):
+        c = comps[name]
+        traj = "-"
+        if c["key"] is not None and c["first"] is not None:
+            traj = f"{c['key']}: {c['first']:.6g} -> {c['last']:.6g}"
+        out.append((name, c["n"], len(c["steps"]), traj))
+    return out
+
+
+def summarize_stream(records):
+    """Streaming-pass overlap totals (from BlockStream's per-pass
+    records): the double-buffer health check."""
+    passes = [r for r in records if "stream_pass" in r]
+    if not passes:
+        return None
+    tot = {k: sum(float(p.get(k, 0.0)) for p in passes)
+           for k in ("host_s", "put_s", "wait_s", "consume_s", "pass_s")}
+    tot["n_passes"] = len(passes)
+    tot["n_blocks"] = sum(int(p.get("n_blocks", 0)) for p in passes)
+    return tot
+
+
+def final_counters(records):
+    """The run's counter totals: the LAST explicit counters snapshot,
+    else the sum of per-span counter deltas."""
+    snaps = [r for r in records if r.get("counters")]
+    if snaps:
+        return {k: v for k, v in snaps[-1].items()
+                if k not in ("counters", "time", "step", "component")}
+    totals = {}
+    for r in records:
+        # top-level spans only: a parent span's delta already contains
+        # every nested child's (the registry is one global accumulator),
+        # so summing all records would double-count
+        if r.get("parent_id") is not None:
+            continue
+        for k, v in r.items():
+            if k.startswith("ctr_"):
+                totals[k[4:]] = totals.get(k[4:], 0) + v
+    return totals
+
+
+def build_report(records, path="<records>"):
+    """The full report as one string (the CLI prints it; tests assert on
+    it)."""
+    lines = [f"run report: {path}  ({len(records)} records)", ""]
+    span_rows = []
+    for key, n, wall, sync, sps in summarize_spans(records):
+        span_rows.append((
+            key, n, _fmt_seconds(wall), _fmt_seconds(sync),
+            f"{sps:,.0f}" if sps else "-",
+        ))
+    lines += _table("spans (time by component)",
+                    ("span", "count", "wall", "device_sync", "samples/s"),
+                    span_rows)
+    comp_rows = summarize_components(records)
+    lines += _table("per-step telemetry",
+                    ("component", "records", "steps", "convergence"),
+                    comp_rows)
+    st = summarize_stream(records)
+    if st:
+        lines += _table(
+            "streaming overlap",
+            ("passes", "blocks", "host", "put", "wait", "consume"),
+            [(st["n_passes"], st["n_blocks"], _fmt_seconds(st["host_s"]),
+              _fmt_seconds(st["put_s"]), _fmt_seconds(st["wait_s"]),
+              _fmt_seconds(st["consume_s"]))],
+        )
+    ctr = final_counters(records)
+    if ctr:
+        rows = []
+        for k in sorted(ctr):
+            v = ctr[k]
+            shown = _fmt_bytes(v) if k.endswith("bytes") else (
+                _fmt_seconds(v) if k.endswith("secs") else v)
+            rows.append((k, shown))
+        lines += _table("counters", ("counter", "total"), rows)
+    if not span_rows and not comp_rows and not st and not ctr:
+        lines.append("no observability records found "
+                     "(set config.metrics_path or config.trace_dir)")
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__.strip())
+        return 0 if argv else 2
+    rc = 0
+    for path in argv:
+        try:
+            records = load_records(path)
+        except OSError as e:
+            print(f"error: cannot read {path}: {e}", file=sys.stderr)
+            rc = 1
+            continue
+        sys.stdout.write(build_report(records, path=path))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,7 +27,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.mesh import DATA_AXIS
 
-from jax import shard_map as _shard_map
+from .._compat import shard_map as _shard_map
 
 
 def shard_map(f, mesh, in_specs, out_specs):
@@ -114,10 +114,14 @@ def randomized_svd(x, n_components, key, mesh, n_oversamples=10, n_iter=4):
 # op — dozens of launches per SVD — which dominates wall clock on
 # runtimes with high per-launch overhead (tunneled TPU). These compile
 # the whole decomposition into one program; mesh/sizes are static.
-svd_tall_jit = jax.jit(svd_tall, static_argnums=(1,))
-randomized_svd_jit = jax.jit(
+# count_recompiles is identity when jax.monitoring tracks compiles; on
+# runtimes without it, the wrapper counts jit-cache growth instead.
+from ..observability import count_recompiles
+
+svd_tall_jit = count_recompiles(jax.jit(svd_tall, static_argnums=(1,)))
+randomized_svd_jit = count_recompiles(jax.jit(
     randomized_svd, static_argnums=(1, 3, 4, 5)
-)
+))
 
 
 def svd_flip(u, vt):

@@ -348,6 +348,9 @@ class BlockStream:
 
     def _put(self, host_block):
         outs, m, mask = host_block
+        from ..observability import record_transfer
+
+        record_transfer(sum(a.nbytes for a in outs) + mask.nbytes)
         dev = tuple(
             jax.device_put(a, s) for a, s in zip(outs, self._shardings)
         )
@@ -382,15 +385,7 @@ class BlockStream:
         from collections import deque
 
         pending = deque()
-        # the readiness sync serializes the host loop behind each
-        # block's transfer, trading a little overlap for the wait_s
-        # signal — only pay it when someone consumes the signal (a bound
-        # metrics logger, or an autotune pass sizing blocks)
-        from ..utils.observability import _active_loggers
-
-        measure_wait = bool(_active_loggers) or getattr(
-            self, "_autotune_pass", False
-        )
+        from ..observability import NOOP_SPAN, span
 
         def pop():
             blk = pending.popleft()
@@ -407,40 +402,44 @@ class BlockStream:
             yield blk
             stats["consume_s"] += _time.perf_counter() - t_y
 
-        try:
-            for b in order:
-                t0 = _time.perf_counter()
-                hb = self._block_host(b, readers)
-                t1 = _time.perf_counter()
-                stats["host_s"] += t1 - t0
-                pending.append(self._put(hb))
-                stats["put_s"] += _time.perf_counter() - t1
-                if len(pending) > self.prefetch:
+        # one span per pass: nests under the enclosing fit span and
+        # carries the overlap stats + transfer-counter deltas at close
+        with span("stream.pass") as sp:
+            # the readiness sync serializes the host loop behind each
+            # block's transfer, trading a little overlap for the wait_s
+            # signal — only pay it when someone consumes the signal: a
+            # recording sink (the span resolved one — bound fit logger
+            # or configured trace/metrics path, where an unmeasured 0.0
+            # would read as "perfectly overlapped") or an autotune pass
+            measure_wait = sp is not NOOP_SPAN or getattr(
+                self, "_autotune_pass", False
+            )
+            try:
+                for b in order:
+                    t0 = _time.perf_counter()
+                    hb = self._block_host(b, readers)
+                    t1 = _time.perf_counter()
+                    stats["host_s"] += t1 - t0
+                    pending.append(self._put(hb))
+                    stats["put_s"] += _time.perf_counter() - t1
+                    if len(pending) > self.prefetch:
+                        yield from emit(pop())
+                while pending:
                     yield from emit(pop())
-            while pending:
-                yield from emit(pop())
-        finally:
-            stats["pass_s"] = _time.perf_counter() - t_pass
-            self.stats = stats
-            self._passes = getattr(self, "_passes", 0) + 1
-            self._log_pass(stats)
-            if readers:
-                for r in readers:
-                    if r is not None:
-                        r.close()
-
-    def _log_pass(self, stats):
-        """Emit the pass's overlap stats to the ambient fit logger (one
-        JSONL record per pass, nothing when no logger is bound)."""
-        try:
-            from ..utils.observability import _active_loggers
-
-            for lg in list(_active_loggers):
-                lg.log(stream_pass=self._passes,
+            finally:
+                stats["pass_s"] = _time.perf_counter() - t_pass
+                self.stats = stats
+                self._passes = getattr(self, "_passes", 0) + 1
+                # the span record IS the per-pass JSONL record (via the
+                # thread-bound fit logger or the configured trace sink);
+                # `stream_pass` keys it for consumers and the report CLI
+                sp.add(stream_pass=self._passes,
                        **{k: (round(v, 6) if isinstance(v, float) else v)
                           for k, v in stats.items()})
-        except Exception:
-            pass
+                if readers:
+                    for r in readers:
+                        if r is not None:
+                            r.close()
 
     def _maybe_grow_blocks(self):
         """Epoch-boundary block autotune: when a pass spends more HOST

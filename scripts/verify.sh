@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Repo verify: lint + the ROADMAP.md tier-1 test command, verbatim.
+#
+#   scripts/verify.sh          # lint, then the full tier-1 suite
+#   scripts/verify.sh --lint   # lint only (fast pre-commit gate)
+
+cd "$(dirname "$0")/.." || exit 1
+
+# -- lint: shard_map must come from the compat shim --------------------------
+# `from jax import shard_map` only exists on jax >= 0.6; the direct
+# import once took down all 33 tier-1 test collections. Everything goes
+# through dask_ml_tpu/_compat.py.
+bad=$(grep -rn --include='*.py' -E 'from jax import .*shard_map|jax\.shard_map\b|jax\.experimental\.shard_map|from jax\.experimental import .*shard_map' \
+      dask_ml_tpu tests examples bench.py scripts 2>/dev/null \
+      | grep -v 'dask_ml_tpu/_compat.py')
+if [ -n "$bad" ]; then
+    echo "LINT FAIL: import shard_map from dask_ml_tpu._compat, not jax:"
+    echo "$bad"
+    exit 1
+fi
+echo "lint OK: no direct jax shard_map imports outside _compat.py"
+
+if [ "${1:-}" = "--lint" ]; then
+    exit 0
+fi
+
+# -- tier-1 (ROADMAP.md, verbatim) -------------------------------------------
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -1,0 +1,434 @@
+"""Observability subsystem (ISSUE 1): hierarchical span tracing, the
+runtime counter registry, the run-report CLI, back-compat re-exports,
+and the zero-overhead guarantee (no callback traced into jitted code
+when metrics are disabled)."""
+
+import io
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu import config, observability as obs
+
+
+def _read_jsonl(path):
+    return [json.loads(line) for line in open(path)]
+
+
+# -- spans ------------------------------------------------------------------
+
+def test_span_nesting_parent_ids_and_attrs(tmp_path):
+    trace = str(tmp_path / "t")
+    with config.set(trace_dir=trace):
+        with obs.span("outer", component="X", n_rows=100) as sp_o:
+            assert obs.current_span_id() is not None
+            with obs.span("inner") as sp_i:
+                sp_i.add(detail=7)
+            sp_o.add(n_iter=3)
+        assert obs.current_span_id() is None
+    recs = _read_jsonl(os.path.join(trace, "trace.jsonl"))
+    assert [r["span"] for r in recs] == ["inner", "outer"]  # close order
+    inner, outer = recs
+    assert inner["parent_id"] == outer["span_id"]
+    assert inner["depth"] == 1 and outer["depth"] == 0
+    assert outer["parent_id"] is None
+    assert inner["detail"] == 7
+    assert outer["n_iter"] == 3 and outer["n_rows"] == 100
+    assert outer["wall_s"] >= inner["wall_s"] >= 0.0
+    assert "sync_s" in outer
+
+
+def test_span_noop_when_disabled(tmp_path):
+    with config.set(trace_dir="", metrics_path=""):
+        with obs.span("nothing", a=1) as sp:
+            assert sp is obs.NOOP_SPAN
+            assert obs.current_span_id() is None
+            assert sp.sync(5) == 5  # passthrough
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_span_sync_accumulates(tmp_path):
+    import jax.numpy as jnp
+
+    trace = str(tmp_path / "t")
+    with config.set(trace_dir=trace):
+        with obs.span("s") as sp:
+            out = sp.sync(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    assert float(out[0, 0]) == 8.0
+    rec = _read_jsonl(os.path.join(trace, "trace.jsonl"))[-1]
+    assert rec["sync_s"] >= 0.0
+
+
+def test_span_records_error_and_unwinds_stack(tmp_path):
+    trace = str(tmp_path / "t")
+    with config.set(trace_dir=trace):
+        with pytest.raises(ValueError):
+            with obs.span("boom"):
+                raise ValueError("x")
+        assert obs.current_span_id() is None
+    rec = _read_jsonl(os.path.join(trace, "trace.jsonl"))[-1]
+    assert rec["span"] == "boom" and rec["error"] == "ValueError"
+
+
+def test_span_prefers_active_logger_sink(tmp_path):
+    p = str(tmp_path / "m.jsonl")
+    with obs.MetricsLogger(p, extra={"run": "r1"}) as lg, \
+            obs.active_logger(lg):
+        with obs.span("inside"):
+            pass
+    recs = _read_jsonl(p)
+    assert recs and recs[0]["span"] == "inside"
+    assert recs[0]["run"] == "r1"  # went through the bound logger
+
+
+# -- counters ---------------------------------------------------------------
+
+def test_counter_snapshot_and_reset():
+    obs.counters_reset()
+    obs.counter_add("widgets", 2)
+    obs.counter_add("widgets", 3)
+    snap = obs.counters_snapshot()
+    assert snap["widgets"] == 5
+    snap["widgets"] = 99  # snapshot is a copy
+    assert obs.counters_snapshot()["widgets"] == 5
+    obs.counters_reset()
+    assert obs.counters_snapshot() == {}
+
+
+def test_record_transfer_gated_by_config():
+    obs.counters_reset()
+    with config.set(obs_counters=False):
+        obs.record_transfer(1024)
+    assert "h2d_bytes" not in obs.counters_snapshot()
+    with config.set(obs_counters=True):
+        obs.record_transfer(1024)
+        obs.record_donation(512)
+    snap = obs.counters_snapshot()
+    assert snap["h2d_bytes"] == 1024 and snap["h2d_transfers"] == 1
+    assert snap["donated_bytes_reused"] == 512
+
+
+def test_recompile_counter_increments_on_fresh_compile():
+    import jax
+
+    obs.counters_reset()
+    with config.set(obs_counters=True):
+        # a jit of a brand-new Python lambda can't hit any cache
+        jax.jit(lambda x: x * 3 + 1)(np.float32(2.0))
+    snap = obs.counters_snapshot()
+    assert snap.get("recompiles", 0) >= 1
+    assert snap.get("compile_secs", 0) > 0
+
+
+def test_stream_h2d_bytes_counted():
+    from dask_ml_tpu.parallel.streaming import BlockStream
+
+    X = np.random.RandomState(0).rand(512, 4).astype(np.float32)
+    obs.counters_reset()
+    with config.set(obs_counters=True):
+        for blk in BlockStream((X,), block_rows=128):
+            pass
+    snap = obs.counters_snapshot()
+    # every block: X slab + its row mask, all float32
+    assert snap["h2d_bytes"] == X.nbytes + 4 * 512
+    assert snap["h2d_transfers"] == 4
+
+
+def test_span_emits_counter_deltas(tmp_path):
+    trace = str(tmp_path / "t")
+    obs.counters_reset()
+    with config.set(trace_dir=trace, obs_counters=True):
+        obs.counter_add("pre_existing", 100)
+        with obs.span("work"):
+            obs.record_transfer(2048)
+    rec = _read_jsonl(os.path.join(trace, "trace.jsonl"))[-1]
+    assert rec["ctr_h2d_bytes"] == 2048
+    assert "ctr_pre_existing" not in rec  # only deltas, not totals
+
+
+def test_device_memory_gauges_shape():
+    gauges = obs.device_memory_gauges()
+    assert isinstance(gauges, dict)  # empty on CPU; keyed dev<i>_* on TPU
+    for v in gauges.values():
+        assert isinstance(v, int)
+
+
+def test_log_counters_record(tmp_path):
+    p = str(tmp_path / "c.jsonl")
+    obs.counters_reset()
+    obs.counter_add("recompiles", 4)
+    with obs.MetricsLogger(p) as lg:
+        snap = obs.log_counters(lg, phase="end")
+    rec = _read_jsonl(p)[-1]
+    assert rec["counters"] is True and rec["recompiles"] == 4
+    assert rec["phase"] == "end"
+    assert snap["recompiles"] == 4
+
+
+# -- ambient logger under concurrency --------------------------------------
+
+def test_active_logger_non_lifo_and_concurrent(tmp_path):
+    """Two fits binding/unbinding out of LIFO order must each remove
+    exactly their own sink entry; the innermost surviving binding keeps
+    receiving jit-step callbacks."""
+    from dask_ml_tpu.observability._metrics import _active_loggers, _jit_step_cb
+
+    a = obs.MetricsLogger(str(tmp_path / "a.jsonl"), extra={"who": "a"})
+    b = obs.MetricsLogger(str(tmp_path / "b.jsonl"), extra={"who": "b"})
+    cm_a = obs.active_logger(a)
+    cm_b = obs.active_logger(b)
+    cm_a.__enter__()
+    cm_b.__enter__()
+    cm_a.__exit__(None, None, None)  # non-LIFO exit
+    assert _active_loggers == [b]
+    _jit_step_cb(0, ("loss",), 1.5)
+    cm_b.__exit__(None, None, None)
+    assert _active_loggers == []
+    recs = _read_jsonl(str(tmp_path / "b.jsonl"))
+    assert recs and recs[0]["who"] == "b" and recs[0]["loss"] == 1.5
+    assert not os.path.exists(str(tmp_path / "a.jsonl"))
+
+
+def test_concurrent_fits_span_trees_are_threadlocal(tmp_path):
+    """Parallel trial threads trace independent span trees: no thread
+    ever parents its span under another thread's open span."""
+    trace = str(tmp_path / "t")
+    errs = []
+
+    def worker(tag):
+        try:
+            # config.set is thread-local (like dask.config): each trial
+            # thread binds its own override, exactly as the controller's
+            # worker threads would
+            with config.set(trace_dir=trace):
+                with obs.span("outer", tag=tag):
+                    with obs.span("inner", tag=tag):
+                        pass
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    recs = _read_jsonl(os.path.join(trace, "trace.jsonl"))
+    outer = {r["tag"]: r for r in recs if r["span"] == "outer"}
+    inner = {r["tag"]: r for r in recs if r["span"] == "inner"}
+    assert set(outer) == set(inner) == {0, 1, 2, 3}
+    for tag, r in inner.items():
+        assert r["parent_id"] == outer[tag]["span_id"]
+    for r in outer.values():
+        assert r["parent_id"] is None
+
+
+# -- zero overhead ----------------------------------------------------------
+
+def test_no_debug_callback_in_solver_jaxpr_when_disabled():
+    """With metrics disabled the solver trace must contain NO host
+    callback — the acceptance criterion that the silent path stays at
+    hardware speed."""
+    import jax
+    import jax.numpy as jnp
+
+    from dask_ml_tpu.models.solvers.solvers import _gd_run
+
+    X = jnp.ones((16, 3))
+    y = jnp.zeros(16)
+    mask = jnp.ones(16)
+
+    def run(log):
+        return jax.make_jaxpr(
+            lambda X_, y_, m_, b_: _gd_run(
+                X_, y_, m_, 16.0, b_, jnp.float32(0.0), jnp.ones(3), 0.5,
+                jnp.asarray(3), jnp.float32(1e-6), 1.0, "logistic", "none",
+                log=log,
+            )
+        )(X, y, mask, jnp.zeros(3))
+
+    assert "debug_callback" not in str(run(False))
+    assert "debug_callback" in str(run(True))
+
+
+def test_jit_callbacks_probe_resettable(monkeypatch):
+    from dask_ml_tpu.observability import _metrics
+
+    obs.reset_jit_callbacks_probe()
+    assert _metrics._callbacks_supported is None
+    first = obs.jit_callbacks_supported()
+    assert isinstance(first, bool)
+    assert _metrics._callbacks_supported == first
+    # a poisoned cache must be clearable (backend swaps in tests)
+    monkeypatch.setattr(_metrics, "_callbacks_supported", not first)
+    assert obs.jit_callbacks_supported() is (not first)
+    obs.reset_jit_callbacks_probe()
+    assert obs.jit_callbacks_supported() == first
+
+
+# -- back-compat shim -------------------------------------------------------
+
+def test_utils_observability_reexports_same_objects():
+    from dask_ml_tpu.observability import _metrics
+    from dask_ml_tpu.utils import observability as legacy
+
+    assert legacy.MetricsLogger is obs.MetricsLogger
+    assert legacy.active_logger is obs.active_logger
+    assert legacy.emit_jit_step is obs.emit_jit_step
+    assert legacy.fit_logger is obs.fit_logger
+    assert legacy.timed is obs.timed
+    # the mutable sink registry must be the SAME list object — bench.py
+    # and streaming.py bind through different import paths
+    assert legacy._active_loggers is _metrics._active_loggers
+
+
+# -- report CLI -------------------------------------------------------------
+
+@pytest.fixture
+def canned_run(tmp_path):
+    """A canned JSONL run: two fit spans, stream passes, step records,
+    and a final counters snapshot."""
+    p = str(tmp_path / "run.jsonl")
+    recs = [
+        {"time": 0.1, "span": "fit", "span_id": 1, "parent_id": None,
+         "depth": 0, "wall_s": 2.0, "sync_s": 0.5,
+         "component": "KMeans", "n_rows": 10000, "n_iter": 7},
+        {"time": 0.2, "span": "stream.pass", "span_id": 3, "parent_id": 2,
+         "depth": 1, "wall_s": 0.5, "sync_s": 0.0},
+        {"time": 0.3, "span": "fit", "span_id": 2, "parent_id": None,
+         "depth": 0, "wall_s": 1.0, "sync_s": 0.1,
+         "component": "LogisticRegression", "n_rows": 5000},
+        {"time": 0.4, "component": "KMeans", "step": 0,
+         "center_shift2": 9.0},
+        {"time": 0.5, "component": "KMeans", "step": 1,
+         "center_shift2": 0.25},
+        {"time": 0.6, "component": "LogisticRegression", "step": 0,
+         "loss": 0.693, "grad_norm": 1.0},
+        {"time": 0.7, "component": "LogisticRegression", "step": 1,
+         "loss": 0.21, "grad_norm": 0.05},
+        {"time": 0.8, "stream_pass": 1, "host_s": 0.2, "put_s": 0.1,
+         "wait_s": 0.01, "consume_s": 0.4, "pass_s": 0.71, "n_blocks": 8,
+         "block_rows": 1250},
+        {"time": 0.9, "counters": True, "recompiles": 12,
+         "h2d_bytes": 40960000, "h2d_transfers": 8},
+    ]
+    with open(p, "w") as fh:
+        fh.write("\n".join(json.dumps(r) for r in recs) + "\n")
+        fh.write("{corrupt trailing line")  # must be skipped, not fatal
+    return p
+
+
+def test_report_build(canned_run):
+    from dask_ml_tpu.observability.report import build_report, load_records
+
+    records = load_records(canned_run)
+    assert len(records) == 9  # corrupt line skipped
+    out = build_report(records, path=canned_run)
+    assert "KMeans.fit" in out
+    assert "LogisticRegression.fit" in out
+    assert "5,000" in out  # 5000 rows / 1.0s
+    assert "center_shift2: 9 -> 0.25" in out
+    assert "loss: 0.693 -> 0.21" in out
+    assert "recompiles" in out and "12" in out
+    assert "39.1MiB" in out  # h2d_bytes rendered human-readable
+    assert "streaming overlap" in out
+
+
+def test_report_cli_main(canned_run, capsys):
+    from dask_ml_tpu.observability import report
+
+    rc = report.main([canned_run])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "KMeans.fit" in out and "recompiles" in out
+
+
+def test_report_cli_missing_file(tmp_path, capsys):
+    from dask_ml_tpu.observability import report
+
+    rc = report.main([str(tmp_path / "nope.jsonl")])
+    assert rc == 1
+    assert "cannot read" in capsys.readouterr().err
+
+
+def test_report_cli_runs_as_module(canned_run):
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-m", "dask_ml_tpu.observability.report",
+         canned_run],
+        capture_output=True, text=True, cwd=repo,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "KMeans.fit" in proc.stdout
+
+
+# -- end-to-end: spans from a real fit --------------------------------------
+
+def test_fit_emits_span_with_samples_per_sec(tmp_path):
+    from dask_ml_tpu.linear_model import LogisticRegression
+    from dask_ml_tpu.parallel import as_sharded
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(300, 5).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    p = str(tmp_path / "fit.jsonl")
+    with config.set(metrics_path=p):
+        LogisticRegression(solver="lbfgs", max_iter=10).fit(
+            as_sharded(X), as_sharded(y)
+        )
+    spans = [r for r in _read_jsonl(p) if r.get("span") == "fit"]
+    assert len(spans) == 1
+    rec = spans[0]
+    assert rec["component"] == "LogisticRegression"
+    assert rec["n_rows"] == 300 and rec["wall_s"] > 0
+    assert rec["n_iter"] >= 1
+
+
+def test_streamed_fit_nests_pass_spans_under_fit(tmp_path):
+    from dask_ml_tpu.linear_model import LinearRegression
+
+    rng = np.random.RandomState(1)
+    X = rng.randn(600, 4).astype(np.float32)
+    y = (X @ rng.randn(4)).astype(np.float32)
+    p = str(tmp_path / "stream.jsonl")
+    with config.set(metrics_path=p, stream_block_rows=150):
+        LinearRegression(solver="gradient_descent", max_iter=3).fit(X, y)
+    recs = _read_jsonl(p)
+    fits = [r for r in recs if r.get("span") == "fit"]
+    passes = [r for r in recs if r.get("span") == "stream.pass"]
+    assert len(fits) == 1 and fits[0]["streamed"] is True
+    assert passes, "streamed fit must trace stream.pass spans"
+    assert all(r["parent_id"] == fits[0]["span_id"] for r in passes)
+
+
+def test_search_round_spans_and_trial_tags(tmp_path):
+    from dask_ml_tpu.model_selection import HyperbandSearchCV
+    from dask_ml_tpu.models.sgd import SGDClassifier
+
+    rng = np.random.RandomState(3)
+    X = rng.randn(300, 5).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    p = str(tmp_path / "hb.jsonl")
+    with config.set(metrics_path=p):
+        HyperbandSearchCV(
+            SGDClassifier(random_state=0),
+            {"alpha": [1e-4, 1e-3, 1e-2]},
+            max_iter=4, random_state=0,
+        ).fit(X, y, classes=[0.0, 1.0])
+    recs = _read_jsonl(p)
+    rounds = [r for r in recs if r.get("span") == "search.round"]
+    assert rounds and all("n_trials" in r for r in rounds)
+    trials = [r for r in recs
+              if r.get("component") == "adaptive_search"
+              and "model_id" in r]
+    assert trials
+    for r in trials:
+        assert "bracket" in r and "partial_fit_calls" in r and "score" in r

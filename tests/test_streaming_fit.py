@@ -248,8 +248,13 @@ def test_streamed_metrics_logging(tmp_path, clf_data):
     with config.set(metrics_path=path, stream_block_rows=1000):
         LogisticRegression(solver="lbfgs", max_iter=20).fit(X, y)
     records = [json.loads(line) for line in open(path)]
-    assert len(records) >= 2
-    for r in records:
+    # per-step solver records; the fit also traces span records
+    # (fit + one per stream pass) into the same file
+    steps = [r for r in records if "span" not in r]
+    assert len(steps) >= 2
+    for r in steps:
         assert r["component"] == "LogisticRegression"
         assert "loss" in r and "grad_norm" in r and "step" in r
         assert r["streamed"] is True
+    fit_spans = [r for r in records if r.get("span") == "fit"]
+    assert len(fit_spans) == 1 and fit_spans[0]["streamed"] is True
