@@ -10,6 +10,8 @@ Infrastructure layers:
 - ``io/``       — native (C++) block loaders
 - ``observability/`` — JSONL metrics, span tracing, runtime counters,
   run-report CLI (``python -m dask_ml_tpu.observability.report``)
+- ``serving/``  — online inference: ModelServer micro-batching over a
+  shape-bucket ladder with admission control and warmup
 - ``utils/``    — validation, checkpointing, testing
 
 sklearn/dask-ml-parity namespaces (import as ``dask_ml_tpu.<name>``):
@@ -25,5 +27,6 @@ __all__ = [
     "cluster", "compose", "config", "datasets", "decomposition",
     "ensemble", "feature_extraction", "impute", "linear_model", "metrics",
     "model_selection", "naive_bayes", "observability", "ops", "parallel",
-    "preprocessing", "utils", "wrappers", "xgboost", "__version__",
+    "preprocessing", "serving", "utils", "wrappers", "xgboost",
+    "__version__",
 ]

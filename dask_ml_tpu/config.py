@@ -43,6 +43,26 @@ class Config:
     obs_counters: bool = True
     # checkpoint directory for adaptive searches ("" = disabled)
     checkpoint_dir: str = ""
+    # -- serving (dask_ml_tpu/serving/) ----------------------------------
+    # smallest / largest padded batch the micro-batcher emits; the shape
+    # ladder is the geometric sequence between them, so steady-state
+    # serving uses at most ceil(log_growth(max/min)) + 1 compiled
+    # programs per method
+    serving_min_batch: int = 8
+    serving_max_batch: int = 1024
+    # ladder growth factor (must be > 1); 2.0 bounds padding waste at
+    # <50% of any emitted batch
+    serving_bucket_growth: float = 2.0
+    # admission control: max requests waiting in the server queue before
+    # submit() sheds load with ServerOverloaded
+    serving_max_queue: int = 1024
+    # how long the batcher holds an admitted request hoping to coalesce
+    # more (milliseconds); 0 = dispatch immediately
+    serving_batch_window_ms: float = 2.0
+    # per-request deadline (milliseconds) measured from admission; a
+    # request still queued past it is shed with RequestTimeout
+    # (0 = no deadline)
+    serving_timeout_ms: float = 1000.0
 
 
 _ENV_PREFIX = "DASK_ML_TPU_"

@@ -102,7 +102,19 @@ def make_classification(n_samples=100, n_features=20, n_informative=5,
     vertices in the informative subspace) and the feature permutation are
     drawn ONCE from random_state; shards draw only their rows. (The
     reference seeds sklearn's whole generator per block, so each block is
-    a *different* problem — a known quirk we deliberately fix.)"""
+    a *different* problem — a known quirk we deliberately fix.)
+
+    .. note:: seed-stream change — vertex selection now draws the class
+       centers via sklearn's ``sample_without_replacement`` reservoir
+       sampler instead of ``RandomState.choice`` (the old path
+       materialized a ``2**n_informative``-sized permutation: a ~34 GB
+       allocation at 32 informative features). Both are deterministic in
+       ``random_state``, but they consume the seed stream differently,
+       so a given seed selects DIFFERENT centers than it did before the
+       change: snapshot tests pinning exact generated values (or
+       metrics derived from them) will see fixtures move across this
+       version boundary. Re-record such fixtures; distributional
+       properties (separation, class balance) are unchanged."""
     mesh = resolve_mesh(mesh)
     Xs, ys = _classification_parts(
         n_samples, n_features, n_informative, n_classes, class_sep, flip_y,

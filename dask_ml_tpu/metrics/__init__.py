@@ -1,5 +1,6 @@
 """Ref: dask_ml/metrics/__init__.py."""
-from .classification import (accuracy_score, average_precision_score,
+from .classification import (UndefinedMetricWarning, accuracy_score,
+                             average_precision_score,
                              balanced_accuracy_score, confusion_matrix,
                              f1_score, log_loss,
                              precision_recall_curve, precision_score,

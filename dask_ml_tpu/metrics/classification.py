@@ -18,6 +18,23 @@ import numpy as np
 from ..parallel.sharded import ShardedArray, as_sharded
 
 
+from sklearn.exceptions import (  # noqa: E402 - re-export base
+    UndefinedMetricWarning as _SkUndefinedMetricWarning,
+)
+
+
+class UndefinedMetricWarning(_SkUndefinedMetricWarning):
+    """A metric is ill-defined for this input (e.g. a single-class fold)
+    and a degenerate value was returned instead of raising.
+
+    Subclasses ``sklearn.exceptions.UndefinedMetricWarning`` (itself a
+    UserWarning), so code ported from sklearn that filters or catches
+    sklearn's class specifically (CV loops skipping degenerate folds,
+    ``pytest.warns`` assertions) behaves identically against these
+    metrics — an independent same-named class would silently slip those
+    filters."""
+
+
 def _canon(y_true, y_pred, sample_weight=None):
     """Co-shard the pair (and sample_weight, padded alike); returns
     (a, b, weights, n) where weights = row-validity mask * sample_weight."""
@@ -277,6 +294,14 @@ def _binary_targets(t, w, labels, what="roc_auc_score"):
         lab = np.asarray(labels, dtype=np.float64)
         if len(lab) != 2:
             raise ValueError(f"{what} needs exactly 2 labels")
+        if lab[0] == lab[1]:
+            # labels=[1, 1] passes the length check but would map EVERY
+            # row positive below (t == mx_h matches both "classes") —
+            # a silently perfect curve on garbage input
+            raise ValueError(
+                f"{what} labels must be two distinct values, got "
+                f"{list(np.asarray(labels))}"
+            )
         # POSITIONAL: labels=[neg, pos] — the order is honored (not
         # sorted), so a positive class numerically smaller than the
         # negative is expressible, as the ambiguity errors below promise
@@ -394,7 +419,7 @@ def roc_curve(y_true, y_score, sample_weight=None, labels=None):
     if P == 0.0:
         warnings.warn(
             "No positive samples in y_true; true positive rate is "
-            "meaningless", UserWarning,
+            "meaningless", UndefinedMetricWarning,
         )
         tpr = np.full(tp.shape[0] + 1, np.nan)
     else:
@@ -402,7 +427,7 @@ def roc_curve(y_true, y_score, sample_weight=None, labels=None):
     if N == 0.0:
         warnings.warn(
             "No negative samples in y_true; false positive rate is "
-            "meaningless", UserWarning,
+            "meaningless", UndefinedMetricWarning,
         )
         fpr = np.full(fp.shape[0] + 1, np.nan)
     else:
@@ -422,7 +447,7 @@ def precision_recall_curve(y_true, y_score, sample_weight=None,
         # to 1, precision 0) rather than abort a CV fold
         warnings.warn(
             "No positive samples in y_true; recall is meaningless",
-            UserWarning,
+            UndefinedMetricWarning,
         )
         prec = np.zeros_like(tp)
         rec = np.ones_like(tp)
@@ -446,7 +471,7 @@ def average_precision_score(y_true, y_score, sample_weight=None,
         # warning — a raising scorer would abort the whole search
         warnings.warn(
             "No positive samples in y_true; average precision is 0",
-            UserWarning,
+            UndefinedMetricWarning,
         )
         return 0.0
     prec, rec = _pr_points(tp, fp, P)

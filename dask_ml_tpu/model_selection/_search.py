@@ -262,7 +262,19 @@ class _BaseSearchCV(BaseEstimator):
         stacked solve runs on the transformed fold. Scoring uses the
         bare GLM against the transformed folds (equivalent to scoring
         the assembled pipeline on the raw folds, minus k re-transforms
-        of the test fold)."""
+        of the test fold).
+
+        Shared-iteration-budget semantics: the stacked L-BFGS advances
+        all k candidates in lockstep until the SLOWEST one converges
+        (``solvers.solve_lam_grid``) — an early-converged candidate
+        keeps refining inside the joint program, which cannot perturb
+        its optimum (the objective is separable across candidates).
+        Each fitted clone still reports its own per-candidate
+        ``n_iter_`` (the candidate's convergence point within the joint
+        trajectory, recorded by the solver as
+        ``info["n_iter_per_candidate"]``), so convergence diagnostics
+        distinguish fast candidates from the slowest one instead of all
+        clones echoing the joint budget."""
         import jax as _jax
 
         from ..models.glm import _GLMBase

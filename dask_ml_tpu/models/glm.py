@@ -177,10 +177,19 @@ class _GLMBase(BaseEstimator):
                            **{k: v for k, v in info.items()
                               if isinstance(v, (int, float))})
         B = np.asarray(B, np.float64)
+        per_cand = info.get("n_iter_per_candidate")
         fitted = []
         for i, c in enumerate(Cs):
             est = clone(self).set_params(C=c)
-            finish(est, B[i], info)
+            # the stacked solve shares one iteration budget; publish
+            # each clone's OWN convergence point (last iteration its
+            # per-block gradient norm exceeded tol) as its n_iter_ —
+            # the joint budget stays readable as
+            # max(solver_info_["n_iter_per_candidate"])
+            info_i = dict(info)
+            if per_cand is not None:
+                info_i["n_iter"] = int(per_cand[i])
+            finish(est, B[i], info_i)
             fitted.append(est)
         return fitted
 

@@ -245,43 +245,64 @@ def _lbfgs_chunk(X, y, mask, n_rows, carry, lam, pmask, l1_ratio, stop_it,
     return _lbfgs_loop(loss, carry, stop_it, tol, memory, log)
 
 
-def _lbfgs_loop(loss, carry, stop_it, tol, memory, log, gnorm_fn=None):
+def _lbfgs_loop(loss, carry, stop_it, tol, memory, log, n_blocks=None):
     """The optax L-BFGS while_loop, shared by every loss flavor (XLA,
-    Pallas single-target, Pallas multi-target). ``gnorm_fn`` lets the
-    stacked multi-target solves test the MAX per-block gradient norm —
-    "every block converged to tol" — instead of the flat joint norm,
-    matching the single-target criterion exactly."""
+    Pallas single-target, Pallas multi-target).
+
+    ``n_blocks`` switches on the stacked multi-solve semantics: the flat
+    vector is ``n_blocks`` independent row blocks (classes, lam
+    candidates, or both) sharing ONE iteration budget — every iteration
+    advances every block, and the loop stops only when the MAX per-block
+    gradient norm reaches tol ("every block converged"), matching the
+    single-target criterion exactly. The carry then grows a
+    ``(n_blocks,)`` int32 vector recording, per block, the last
+    iteration at which that block's gradient norm still exceeded tol —
+    the block's own convergence point INSIDE the joint trajectory.
+    (Not identical to a standalone solve's ``n_iter_``: the shared
+    L-BFGS curvature state and line search see every block at once, so
+    per-block paths differ even though the separable optimum is the
+    same.) Callers surface it as the per-candidate ``n_iter``.
+    """
     opt = optax.lbfgs(memory_size=memory)
     value_and_grad = optax.value_and_grad_from_state(loss)
-    if gnorm_fn is None:
-        gnorm_fn = jnp.linalg.norm
+    track = n_blocks is not None
 
     def cond(carry):
-        beta, state, gnorm, it = carry
+        gnorm, it = carry[2], carry[3]
         return (it < stop_it) & (gnorm > tol)
 
     def body(carry):
-        beta, state, _, it = carry
+        beta, state, _, it = carry[:4]
         value, grad = value_and_grad(beta, state=state)
         updates, state = opt.update(
             grad, state, beta, value=value, grad=grad, value_fn=loss
         )
         beta = optax.apply_updates(beta, updates)
-        gnorm = gnorm_fn(grad)
+        if track:
+            norms = jnp.linalg.norm(grad.reshape(n_blocks, -1), axis=1)
+            gnorm = jnp.max(norms)
+            conv = jnp.where(norms > tol, it + 1, carry[4])
+        else:
+            gnorm = jnp.linalg.norm(grad)
         if log:  # static: the silent trace has no callback at all
             emit_jit_step(it, loss=value, grad_norm=gnorm)
+        if track:
+            return beta, state, gnorm, it + 1, conv
         return beta, state, gnorm, it + 1
 
+    if track and len(carry) == 4:
+        carry = (*carry, jnp.zeros(n_blocks, jnp.int32))
     return jax.lax.while_loop(cond, body, carry)
 
 
-def _block_max_norm(C):
-    """max over C row-blocks of the flat gradient's per-block l2 norm."""
-
-    def fn(g):
-        return jnp.max(jnp.linalg.norm(g.reshape(C, -1), axis=1))
-
-    return fn
+def _per_block_iters(conv, it_total):
+    """Per-block iteration counts in the single-target ``n_iter``
+    convention: the confirming iteration that first observes a
+    below-tol gradient counts too (+1 over the tracker's last above-tol
+    iteration), clamped to the joint budget for blocks the cap cut
+    off. Guarantees max(per_block) == the joint program's n_iter."""
+    c = np.asarray(conv, np.int64) + 1
+    return np.minimum(c, int(it_total))
 
 
 @partial(jax.jit, static_argnames=("family", "reg", "memory", "log",
@@ -312,7 +333,7 @@ def _lbfgs_multi_pallas_chunk(X, codes, mask, n_rows, carry, lam, pmask_t,
 
     loss = _custom_vjp_loss(data_vg, n_rows, reg, lam, pmask_t, l1_ratio)
     return _lbfgs_loop(loss, carry, stop_it, tol, memory, log,
-                       gnorm_fn=_block_max_norm(n_classes))
+                       n_blocks=n_classes)
 
 
 def lbfgs(X, y, mask, n_rows, beta0, family, reg, lam, pmask, l1_ratio=0.5,
@@ -725,7 +746,18 @@ def solve_multi(solver, X, Y, mask, n_rows, B0, family, reg, lam, pmask,
     per-class matvecs batch into one (n,d)x(d,C) contraction on the MXU,
     the reference's closest analog being C separate dask-glm solves.
     Other solvers fall back to a per-class loop of their single-target
-    programs (correct, C launches)."""
+    programs (correct, C launches).
+
+    Shared-iteration-budget semantics (stacked paths): the C blocks
+    advance in lockstep inside one while_loop — every iteration updates
+    EVERY class, and the loop runs until the slowest block's gradient
+    norm reaches tol (or max_iter). A class that would have converged
+    alone in fewer iterations keeps refining (harmless: its gradient is
+    already below tol; the objective is separable so blocks cannot
+    perturb each other). ``info["n_iter"]`` is therefore the budget the
+    PROGRAM ran (the max), while ``info["n_iter_per_class"]`` records
+    each block's own convergence point within that joint run — the
+    last iteration its gradient norm still exceeded tol."""
     kwargs.pop("log", None)  # per-class step logs would interleave
     use_pallas = kwargs.pop("use_pallas", None)
     pallas_interpret = kwargs.pop("pallas_interpret", False)
@@ -756,7 +788,7 @@ def solve_multi(solver, X, Y, mask, n_rows, B0, family, reg, lam, pmask,
             carry = (b0, opt.init(b0),
                      jnp.asarray(jnp.inf, b0.dtype), 0)
             try:
-                beta, _state, gnorm, it = jax.block_until_ready(
+                beta, _state, gnorm, it, conv = jax.block_until_ready(
                     _lbfgs_multi_pallas_chunk(
                         X, codes, mask, n_rows, carry, lam, pmask_t,
                         l1_ratio, jnp.asarray(max_iter),
@@ -777,6 +809,8 @@ def solve_multi(solver, X, Y, mask, n_rows, B0, family, reg, lam, pmask,
             else:
                 it, gnorm = _host_scalars(it, gnorm)
                 info = {"n_iter": int(it), "grad_norm": float(gnorm),
+                        "n_iter_per_class":
+                            _per_block_iters(conv, it).tolist(),
                         "fused_multi": True}
                 return check_finite_result(
                     np.asarray(beta).reshape(C, d), info, solver
@@ -802,14 +836,15 @@ def solve_multi(solver, X, Y, mask, n_rows, B0, family, reg, lam, pmask,
         opt = optax.lbfgs(memory_size=memory)
         b0 = jnp.asarray(B0, jnp.float32).reshape(-1)
         carry = (b0, opt.init(b0), jnp.asarray(jnp.inf, b0.dtype), 0)
-        beta, _state, gnorm, it = _multi_stacked_chunk(
+        beta, _state, gnorm, it, conv = _multi_stacked_chunk(
             X, Y, mask, n_rows, carry, lam, jnp.asarray(pmask),
             l1_ratio, jnp.asarray(max_iter),
             jnp.asarray(tol, jnp.float32), family, reg, C,
             memory=memory,
         )
         it_h, gnorm_h = _host_scalars(it, gnorm)
-        info = {"n_iter": int(it_h), "grad_norm": float(gnorm_h)}
+        info = {"n_iter": int(it_h), "grad_norm": float(gnorm_h),
+                "n_iter_per_class": _per_block_iters(conv, it_h).tolist()}
         return check_finite_result(
             np.asarray(beta).reshape(C, d), info, solver
         )
@@ -830,7 +865,8 @@ def solve_multi(solver, X, Y, mask, n_rows, B0, family, reg, lam, pmask,
         )
         betas.append(np.asarray(beta_c))
         iters.append(info_c.get("n_iter") or 0)
-    return np.stack(betas), {"n_iter": int(max(iters))}
+    return np.stack(betas), {"n_iter": int(max(iters)),
+                             "n_iter_per_class": [int(i) for i in iters]}
 
 
 @partial(jax.jit, static_argnames=("family", "reg", "C", "memory"))
@@ -858,7 +894,7 @@ def _multi_stacked_chunk(X, Y, mask, n_rows, carry, lam, pmask, l1_ratio,
     # stop when EVERY class block has converged to tol (max per-block
     # norm) — identical criterion to the per-class solves
     return _lbfgs_loop(loss, carry, stop_it, tol, memory, False,
-                       gnorm_fn=_block_max_norm(C))
+                       n_blocks=C)
 
 
 @partial(jax.jit, static_argnames=("family", "reg", "k", "memory"))
@@ -889,7 +925,7 @@ def _lam_grid_chunk(X, y, mask, n_rows, carry, lams, pmask, stop_it, tol,
     # stop when EVERY candidate's block has converged to tol (max
     # per-block norm) — identical criterion to per-candidate solves
     return _lbfgs_loop(loss, carry, stop_it, tol, memory, False,
-                       gnorm_fn=_block_max_norm(k))
+                       n_blocks=k)
 
 
 @partial(jax.jit, static_argnames=("family", "reg", "k", "C", "memory"))
@@ -917,7 +953,7 @@ def _lam_grid_multi_chunk(X, Y, mask, n_rows, carry, lams, pmask, stop_it,
         return base + 0.5 * jnp.sum(lam_rep * jnp.sum(bp * bp, axis=1))
 
     return _lbfgs_loop(loss, carry, stop_it, tol, memory, False,
-                       gnorm_fn=_block_max_norm(k * C))
+                       n_blocks=k * C)
 
 
 def solve_lam_grid_multi(X, Y, mask, n_rows, lams, pmask, family, reg,
@@ -933,14 +969,20 @@ def solve_lam_grid_multi(X, Y, mask, n_rows, lams, pmask, family, reg,
     opt = optax.lbfgs(memory_size=memory)
     b0 = jnp.zeros((k * C * d,), jnp.float32)
     carry = (b0, opt.init(b0), jnp.asarray(jnp.inf, b0.dtype), 0)
-    beta, _state, gnorm, it = _lam_grid_multi_chunk(
+    beta, _state, gnorm, it, conv = _lam_grid_multi_chunk(
         X, Y, mask, n_rows, carry, lams, jnp.asarray(pmask),
         jnp.asarray(max_iter), jnp.asarray(tol, jnp.float32),
         family, reg, k, C, memory=memory,
     )
     it_h, gnorm_h = _host_scalars(it, gnorm)
+    # block j = i*C + c: a candidate's own n_iter is its slowest class
+    # (the iteration count a standalone OvR fit of that candidate would
+    # have reported)
+    conv_kc = _per_block_iters(conv, it_h).reshape(k, C)
     info = {"n_iter": int(it_h), "grad_norm": float(gnorm_h),
-            "lam_grid": k, "n_classes": C}
+            "lam_grid": k, "n_classes": C,
+            "n_iter_per_candidate": conv_kc.max(axis=1).tolist(),
+            "n_iter_per_block": conv_kc.tolist()}
     return check_finite_result(
         np.asarray(beta).reshape(k, C, d), info, "lbfgs"
     )
@@ -954,7 +996,14 @@ def solve_lam_grid(X, y, mask, n_rows, lams, pmask, family, reg,
     batched when homogeneous'; the reference's analog is k separate
     dask-glm solves). Returns ((k, d) betas, info); raises on
     non-finite results (callers fall back to per-candidate fits where
-    error_score= applies individually)."""
+    error_score= applies individually).
+
+    The k candidates share one iteration budget (see
+    :func:`solve_multi`): ``info["n_iter"]`` is the joint program's
+    iteration count (the slowest candidate's), and
+    ``info["n_iter_per_candidate"]`` each candidate's own convergence
+    point within the joint trajectory — the last iteration its
+    per-block gradient norm still exceeded tol."""
     _check_smooth(reg, "lbfgs")
     lams = jnp.asarray(lams, jnp.float32)
     k = int(lams.shape[0])
@@ -962,14 +1011,16 @@ def solve_lam_grid(X, y, mask, n_rows, lams, pmask, family, reg,
     opt = optax.lbfgs(memory_size=memory)
     b0 = jnp.zeros((k * d,), jnp.float32)
     carry = (b0, opt.init(b0), jnp.asarray(jnp.inf, b0.dtype), 0)
-    beta, _state, gnorm, it = _lam_grid_chunk(
+    beta, _state, gnorm, it, conv = _lam_grid_chunk(
         X, y, mask, n_rows, carry, lams, jnp.asarray(pmask),
         jnp.asarray(max_iter), jnp.asarray(tol, jnp.float32),
         family, reg, k, memory=memory,
     )
     it_h, gnorm_h = _host_scalars(it, gnorm)
     info = {"n_iter": int(it_h), "grad_norm": float(gnorm_h),
-            "lam_grid": k}
+            "lam_grid": k,
+            "n_iter_per_candidate":
+                _per_block_iters(conv, it_h).tolist()}
     return check_finite_result(
         np.asarray(beta).reshape(k, d), info, "lbfgs"
     )

@@ -34,6 +34,9 @@ from ._counters import (
     install_recompile_tracking,
     log_counters,
     record_donation,
+    record_serving_batch,
+    record_serving_drop,
+    record_serving_request,
     record_transfer,
 )
 from ._metrics import (
@@ -74,6 +77,9 @@ __all__ = [
     "log_counters",
     "profile_trace",
     "record_donation",
+    "record_serving_batch",
+    "record_serving_drop",
+    "record_serving_request",
     "record_transfer",
     "reset_jit_callbacks_probe",
     "span",

@@ -68,6 +68,42 @@ def record_donation(nbytes: int) -> None:
         counter_add("donated_buffers_reused", 1)
 
 
+# -- serving -----------------------------------------------------------------
+# the online-inference registry slice (dask_ml_tpu/serving): admitted
+# work, batching efficiency, and backpressure outcomes. Kept here so the
+# report CLI and span counter-deltas see serving exactly like the fit
+# counters.
+
+_SERVING_DROP_COUNTERS = {
+    "shed": "serving_shed",          # admission control refused entry
+    "timeout": "serving_timeouts",   # deadline passed while queued
+    "error": "serving_errors",       # batch execution raised
+}
+
+
+def record_serving_request(n_rows: int) -> None:
+    """One admitted serving request of ``n_rows`` rows."""
+    if counters_enabled():
+        counter_add("serving_requests", 1)
+        counter_add("serving_rows", int(n_rows))
+
+
+def record_serving_batch(rows: int, bucket: int) -> None:
+    """One executed micro-batch: ``rows`` real rows padded to the
+    ``bucket`` rung — padding waste accumulates as serving_padded_rows /
+    (serving_rows + serving_padded_rows)."""
+    if counters_enabled():
+        counter_add("serving_batches", 1)
+        counter_add("serving_padded_rows", int(bucket - rows))
+
+
+def record_serving_drop(kind: str) -> None:
+    """A request resolved without a result; ``kind`` in
+    {'shed', 'timeout', 'error'}."""
+    if counters_enabled():
+        counter_add(_SERVING_DROP_COUNTERS[kind], 1)
+
+
 # -- recompile tracking ------------------------------------------------------
 
 _recompile_listener_installed = False

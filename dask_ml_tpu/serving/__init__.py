@@ -1,0 +1,45 @@
+"""Online inference serving for fitted estimators.
+
+The inference side of the ROADMAP north star ("serves heavy traffic from
+millions of users"): where ``wrappers.ParallelPostFit`` parallelizes ONE
+big offline predict over blocks, this package answers MANY small
+concurrent requests without paying a fresh XLA compile per novel shape
+or a host→device parameter transfer per call.
+
+- ``_buckets``  — the geometric shape-bucket ladder bounding the
+  compiled-program set;
+- ``_batching`` — request records, the bounded admission queue,
+  ping-pong staging buffers, pack/demux;
+- ``_server``   — :class:`ModelServer`: micro-batching worker, warmup,
+  backpressure (:class:`ServerOverloaded` / :class:`RequestTimeout`),
+  graceful drain;
+- ``metrics``   — per-batch spans + serving counters through
+  ``dask_ml_tpu/observability``, and the latency-quantile window.
+
+Quick start::
+
+    from dask_ml_tpu.serving import ModelServer
+
+    with ModelServer(fitted_clf,
+                     methods=("predict", "predict_proba")).warmup() as srv:
+        fut = srv.submit(x_small)        # Future
+        proba = srv.predict_proba(x)     # blocking convenience
+"""
+
+from ._buckets import BucketLadder
+from ._server import (
+    ModelServer,
+    RequestTimeout,
+    ServerClosed,
+    ServerOverloaded,
+    ServingError,
+)
+
+__all__ = [
+    "BucketLadder",
+    "ModelServer",
+    "RequestTimeout",
+    "ServerClosed",
+    "ServerOverloaded",
+    "ServingError",
+]

@@ -1,0 +1,258 @@
+"""Micro-batching plumbing: request records, batch packing, ping-pong
+staging buffers, and result demultiplexing.
+
+The hot loop's memory discipline: each (method, bucket) pair owns TWO
+preallocated host staging arrays used alternately (ping-pong), so
+steady-state serving performs zero host allocations for inputs and —
+should a future entry point defer its host pull under async dispatch —
+batch k+1's pack can never overwrite a host buffer batch k's transfer
+is still reading (see PingPongStaging's honesty note: today's entry
+points consume their input synchronously, making the alternation
+conservative insurance). Device input buffers are donated on backends
+that support donation (TPU/GPU).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+__all__ = ["Request", "PingPongStaging", "pack_batch", "demux_outputs"]
+
+
+class Request:
+    """One admitted inference request: a small (n, d) float32 block plus
+    the Future its caller is waiting on."""
+
+    __slots__ = ("X", "n_rows", "method", "future", "t_enqueue",
+                 "deadline", "seq")
+
+    def __init__(self, X, method, timeout_s=0.0, future=None):
+        self.X = X
+        self.n_rows = int(X.shape[0])
+        self.method = method
+        self.future = future if future is not None else Future()
+        self.seq = 0              # stamped by BoundedQueue at admission
+        self.t_enqueue = time.perf_counter()
+        self.deadline = (self.t_enqueue + timeout_s) if timeout_s > 0 \
+            else None
+
+    def expired(self, now=None) -> bool:
+        if self.deadline is None:
+            return False
+        return (now if now is not None else time.perf_counter()) \
+            > self.deadline
+
+
+class PingPongStaging:
+    """Two alternating host staging arrays per (bucket, width) shape.
+
+    ``get(bucket, d)`` returns the next buffer of shape (bucket, d),
+    zero-filled only on first allocation — pack_batch overwrites every
+    real row and padding rows beyond the batch are masked out at demux,
+    so stale padding contents are harmless (they only ever feed rows the
+    caller never sees).
+
+    Honesty note on the alternation: today the compiled entry point
+    materializes its output on host before returning (``_host_out`` →
+    ``np.asarray``), so batch k is fully consumed before batch k+1
+    packs — a single buffer would be correct. The ping-pong is
+    conservative insurance for donation + async dispatch (a future demux
+    that defers the host pull must never overwrite a host source a
+    transfer could still be reading); the cost is one extra small host
+    buffer per shape.
+    """
+
+    __slots__ = ("_bufs", "_flip")
+
+    def __init__(self):
+        self._bufs = {}   # (bucket, d) -> [arr0, arr1]
+        self._flip = {}   # (bucket, d) -> 0|1
+
+    def get(self, bucket: int, d: int) -> np.ndarray:
+        key = (bucket, d)
+        pair = self._bufs.get(key)
+        if pair is None:
+            pair = [np.zeros((bucket, d), np.float32),
+                    np.zeros((bucket, d), np.float32)]
+            self._bufs[key] = pair
+            self._flip[key] = 0
+        i = self._flip[key]
+        self._flip[key] = 1 - i
+        return pair[i]
+
+
+def pack_batch(requests, ladder, staging):
+    """Coalesce ``requests`` (same method, total rows <= ladder top)
+    into one padded staging buffer.
+
+    Returns ``(batch, segments, bucket, rows)`` where ``segments`` is a
+    list of (request, start) row offsets for demux and ``rows`` the real
+    (unpadded) row count.
+    """
+    rows = sum(r.n_rows for r in requests)
+    d = requests[0].X.shape[1]
+    bucket = ladder.bucket_for(rows)
+    buf = staging.get(bucket, d)
+    segments = []
+    at = 0
+    for r in requests:
+        buf[at:at + r.n_rows] = r.X
+        segments.append((r, at))
+        at += r.n_rows
+    if at < bucket:
+        # zero the padding tail: model math on padding rows must stay
+        # finite (garbage from a previous, larger batch could overflow
+        # an exp/sigmoid into NaNs that some backends propagate slowly)
+        buf[at:bucket] = 0.0
+    return buf, segments, bucket, rows
+
+
+def demux_outputs(out, segments):
+    """Slice each caller's rows back out of the batched output and
+    resolve their futures; padding rows (beyond the last segment) are
+    dropped here — this is the mask that keeps them out of every
+    caller-visible result."""
+    for req, start in segments:
+        piece = out[start:start + req.n_rows]
+        # copy: the slice views the ping-pong output only until the next
+        # batch of this bucket lands; the caller's array must be its own
+        if not req.future.set_running_or_notify_cancel():
+            continue  # caller cancelled while we computed
+        req.future.set_result(np.array(piece))
+
+
+def fail_requests(requests, exc):
+    """Resolve every request's future with ``exc`` (batch-level failure
+    or shed); futures already cancelled — or already resolved by a
+    partial demux before the failure — are skipped, never raised on."""
+    for r in requests:
+        try:
+            if r.future.set_running_or_notify_cancel():
+                r.future.set_exception(exc)
+        except Exception:
+            pass  # future already in a terminal state
+
+
+class BoundedQueue:
+    """The admission-controlled request queue: one lock + condition,
+    per-method FIFO lanes, a global request bound, and deadline-aware
+    popping. ``put_many`` never blocks — over the bound it returns
+    "full" and the server sheds with ServerOverloaded (backpressure
+    surfaces to the caller immediately instead of silently growing
+    latency). Admission is ATOMIC with shutdown: ``close()`` flips the
+    closed flag under the same lock, so any successful put
+    happens-before close and is guaranteed to be drained by the
+    worker's tail loop — no request can strand in a closed queue."""
+
+    __slots__ = ("_lock", "_cond", "_lanes", "_seq", "max_requests",
+                 "depth", "peak_depth", "closed")
+
+    def __init__(self, max_requests):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._lanes = {}          # method -> deque[Request]
+        self._seq = 0             # global admission order stamp
+        self.max_requests = int(max_requests)
+        self.depth = 0
+        self.peak_depth = 0
+        self.closed = False
+
+    def put_many(self, reqs) -> str:
+        """Admit ALL of ``reqs`` or none (a chunked oversize request
+        must not half-enter: shedding part way would burn capacity on
+        orphaned chunks). Returns "ok" / "full" / "closed"."""
+        from collections import deque
+
+        with self._lock:
+            if self.closed:
+                return "closed"
+            if self.depth + len(reqs) > self.max_requests:
+                return "full"
+            for req in reqs:
+                req.seq = self._seq
+                self._seq += 1
+                lane = self._lanes.get(req.method)
+                if lane is None:
+                    lane = self._lanes[req.method] = deque()
+                lane.append(req)
+            self.depth += len(reqs)
+            self.peak_depth = max(self.peak_depth, self.depth)
+            self._cond.notify()
+            return "ok"
+
+    def put(self, req) -> bool:
+        return self.put_many([req]) == "ok"
+
+    def close(self) -> None:
+        with self._lock:
+            self.closed = True
+            self._cond.notify_all()
+
+    def _pop_oldest_locked(self):
+        # lanes are FIFO deques; the globally oldest request is one of
+        # the lane HEADS (O(#methods) scan, O(1) popleft — no per-pop
+        # list surgery on the admission-contended hot path)
+        best = None
+        for lane in self._lanes.values():
+            if lane and (best is None or lane[0].seq < best[0].seq):
+                best = lane
+        if best is None:
+            return None
+        self.depth -= 1
+        return best.popleft()
+
+    def pop_first(self, timeout):
+        """Oldest request across lanes, blocking up to ``timeout``
+        seconds; None on timeout."""
+        with self._lock:
+            if self.depth == 0:
+                self._cond.wait(timeout)
+            return self._pop_oldest_locked()
+
+    def drain_method(self, method, max_rows):
+        """Non-blockingly pop same-``method`` requests while their rows
+        fit under ``max_rows``; stops at the first request that would
+        overflow the batch (FIFO order within the lane is preserved) or
+        when the lane empties."""
+        got = []
+        with self._lock:
+            lane = self._lanes.get(method)
+            budget = max_rows
+            while lane:
+                if lane[0].n_rows > budget:
+                    break
+                req = lane.popleft()
+                self.depth -= 1
+                budget -= req.n_rows
+                got.append(req)
+        return got
+
+    def wait_method(self, method, timeout) -> None:
+        """Sleep up to ``timeout`` while THIS method's lane is empty.
+        The wait rides the queue's single shared condition, so a
+        foreign method's admission still wakes the caller early (one
+        cheap spurious wakeup per foreign put) — what this prevents is
+        the depth>0 busy-spin a whole-queue wait would cause when only
+        other methods' requests are pending; callers re-check their
+        lane (via drain_method) after waking."""
+        with self._lock:
+            if not self._lanes.get(method):
+                self._cond.wait(timeout)
+
+    def drain_all(self):
+        with self._lock:
+            out = []
+            while True:
+                r = self._pop_oldest_locked()
+                if r is None:
+                    break
+                out.append(r)
+            return out
+
+    def wake(self):
+        with self._lock:
+            self._cond.notify_all()

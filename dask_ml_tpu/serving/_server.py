@@ -1,0 +1,494 @@
+"""ModelServer: online inference over a fitted estimator.
+
+The serving loop the dask-ml reference never had (its inference story
+stops at offline blockwise ``ParallelPostFit``): many small, concurrently
+arriving requests of ragged sizes are admitted into a bounded queue,
+coalesced by a micro-batcher into padded batches drawn from a geometric
+ladder of shape buckets (``_buckets``), executed through one compiled
+static-shape entry point per method (``wrappers.compiled_batch_fn`` —
+device-resident parameters, donated ping-pong input staging), and
+demultiplexed back to the callers with padding rows masked out.
+
+Around the hot loop:
+
+- admission control / backpressure — ``submit`` never blocks: a full
+  queue sheds immediately with :class:`ServerOverloaded` (the caller's
+  cue to retry elsewhere), and requests whose deadline lapses while
+  queued resolve with :class:`RequestTimeout`;
+- ``warmup()`` — compiles every (method, bucket) program up front, so a
+  warmed server answers steady-state ragged traffic with ZERO new XLA
+  compiles (asserted by the serving tests via the observability
+  recompile counter);
+- graceful drain — ``stop()`` (or leaving the context manager) stops
+  admissions, finishes every queued request, and joins the worker;
+- telemetry — per-batch ``serving.batch`` spans plus queue-depth /
+  occupancy / padding-waste / shed counters through
+  ``dask_ml_tpu/observability`` (``serving/metrics.py``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ..wrappers import compiled_batch_fn
+from . import metrics as smetrics
+from ._batching import (
+    BoundedQueue,
+    PingPongStaging,
+    Request,
+    demux_outputs,
+    fail_requests,
+    pack_batch,
+)
+from ._buckets import BucketLadder
+
+__all__ = ["ModelServer", "ServingError", "ServerOverloaded",
+           "RequestTimeout", "ServerClosed"]
+
+
+class ServingError(RuntimeError):
+    """Base class for typed serving failures."""
+
+
+class ServerOverloaded(ServingError):
+    """Admission control shed this request: the bounded queue is full.
+    Retry with backoff, widen ``max_queue``, or add replicas."""
+
+
+class RequestTimeout(ServingError, TimeoutError):
+    """The request's deadline passed while it waited in the queue."""
+
+
+class ServerClosed(ServingError):
+    """submit() after stop()/drain began."""
+
+
+class ModelServer:
+    """Serve ``estimator``'s post-fit methods over micro-batched
+    concurrent requests.
+
+    Parameters
+    ----------
+    estimator : fitted estimator or pipeline ending in one
+    methods : tuple of method names to serve (compiled entry points are
+        built eagerly — a typo fails at construction, not first request)
+    ladder : BucketLadder, default from config
+        (``serving_min_batch`` / ``serving_max_batch`` /
+        ``serving_bucket_growth``)
+    max_queue : int, queued-request bound for admission control
+    batch_window_ms : float, coalescing wait after the first request
+    timeout_ms : float, per-request queue deadline (0 = none)
+
+    Use as a context manager::
+
+        with ModelServer(clf).warmup() as srv:
+            fut = srv.submit(x)           # -> Future
+            y = srv.predict(x)            # blocking convenience
+    """
+
+    def __init__(self, estimator, methods=("predict",), ladder=None,
+                 max_queue=None, batch_window_ms=None, timeout_ms=None):
+        from ..config import get_config
+
+        cfg = get_config()
+        # config is thread-local; the worker thread re-applies the
+        # config active HERE so trace_dir/metrics/counter gating follow
+        # the server's creator, not the daemon thread's defaults
+        self._cfg = cfg
+        self.estimator = estimator
+        self.ladder = ladder if ladder is not None \
+            else BucketLadder.from_config()
+        self.max_queue = int(cfg.serving_max_queue
+                             if max_queue is None else max_queue)
+        self.batch_window_s = float(
+            cfg.serving_batch_window_ms
+            if batch_window_ms is None else batch_window_ms
+        ) / 1e3
+        self.timeout_s = float(
+            cfg.serving_timeout_ms if timeout_ms is None else timeout_ms
+        ) / 1e3
+        self._fns = {m: compiled_batch_fn(estimator, m) for m in methods}
+        self._queue = BoundedQueue(self.max_queue)
+        self._staging = PingPongStaging()
+        self._latency = smetrics.LatencyWindow()
+        self._lock = threading.Lock()
+        self._thread = None
+        self._stop = threading.Event()
+        self._accepting = False
+        self._paused = threading.Event()
+        self._paused.set()              # set = running, cleared = paused
+        self._parked = threading.Event()  # worker acknowledged a pause
+        self._batches = 0
+        self._warmed = False
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self):
+        with self._lock:
+            if self._thread is not None:
+                return self
+            if self._queue.closed:   # restart after stop(): fresh queue
+                self._queue = BoundedQueue(self.max_queue)
+            self._stop.clear()
+            self._accepting = True
+            self._thread = threading.Thread(
+                target=self._run, name="dask-ml-tpu-serving", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self, drain=True, timeout=None):
+        """Stop admissions; with ``drain`` (default) finish every queued
+        request before joining the worker, else shed them with
+        ServerClosed."""
+        with self._lock:
+            self._accepting = False
+            thread = self._thread
+        # close the queue under ITS lock: every put that succeeded
+        # happens-before this, so the worker's tail drain sees it —
+        # submit() racing with stop() either gets ServerClosed or a
+        # request the drain is guaranteed to serve
+        self._queue.close()
+        if thread is None:
+            # never started: resolve anything queued directly
+            self._shed_queue(drain)
+            return
+        if not drain:
+            fail_requests(self._queue.drain_all(), ServerClosed(
+                "server stopped without drain"
+            ))
+        self._paused.set()              # a paused server must still drain
+        self._stop.set()
+        self._queue.wake()
+        thread.join(timeout)
+        with self._lock:
+            self._thread = None
+
+    def _shed_queue(self, drain):
+        reqs = self._queue.drain_all()
+        if not reqs:
+            return
+        if drain:
+            for r in reqs:
+                self._execute([r])
+        else:
+            fail_requests(reqs, ServerClosed("server stopped"))
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb):
+        self.stop(drain=exc_type is None)
+        return False
+
+    def pause(self):
+        """Hold the worker between batches (requests keep queueing up to
+        the admission bound) — maintenance windows and backpressure
+        tests. Blocks briefly until the worker acknowledges the park, so
+        requests submitted after pause() returns stay queued."""
+        self._parked.clear()
+        self._paused.clear()
+        if self._thread is not None:
+            self._parked.wait(5.0)
+        return self
+
+    def resume(self):
+        self._paused.set()
+        return self
+
+    # -- warmup -----------------------------------------------------------
+    def warmup(self):
+        """Compile every (method, bucket) program now, before traffic:
+        one call per rung per method through the real entry point. After
+        this, a workload whose batches stay on the ladder triggers zero
+        new XLA compiles."""
+        for method, fn in self._fns.items():
+            if not fn.jitted:
+                continue   # host fallback: nothing to compile
+            d = fn.n_features or self._probe_width()
+            if d is None:
+                raise ValueError(
+                    "cannot infer n_features for warmup; estimator "
+                    "exposes neither fitted params nor n_features_in_"
+                )
+            for bucket in self.ladder:
+                fn(np.zeros((bucket, d), np.float32))
+        self._warmed = True
+        return self
+
+    def _probe_width(self):
+        est = self.estimator
+        if hasattr(est, "steps"):
+            est = est.steps[0][1]
+        return getattr(est, "n_features_in_", None)
+
+    # -- request plane ----------------------------------------------------
+    def submit(self, X, method="predict"):
+        """Admit one request; returns a ``concurrent.futures.Future``
+        resolving to the method's output rows for ``X``. Sheds with
+        ServerOverloaded when the queue is at bound, ServerClosed after
+        stop. Requests taller than the top bucket are chunked internally
+        and reassembled — one Future either way."""
+        if method not in self._fns:
+            raise ValueError(
+                f"method {method!r} not served; constructed with "
+                f"methods={tuple(self._fns)}"
+            )
+        if not self._accepting:
+            raise ServerClosed("server is not accepting requests")
+        X = np.asarray(X, np.float32)
+        if X.ndim == 1:
+            X = X[None, :]
+        if X.ndim != 2 or X.shape[0] == 0:
+            raise ValueError(
+                f"expected a non-empty (n, d) request, got {X.shape}"
+            )
+        want = self._fns[method].n_features
+        if want is not None and X.shape[1] != want:
+            raise ValueError(
+                f"request has {X.shape[1]} features; the served model "
+                f"expects {want}"
+            )
+        top = self.ladder.max_rows
+        if X.shape[0] <= top:
+            return self._admit([Request(X, method, self.timeout_s)])
+        # oversize: chunk to top-bucket tiles, admit all-or-nothing
+        # (atomic in the queue — a shed mid-request must not leave
+        # orphaned chunks burning capacity), reassemble via callbacks
+        parts = [X[i:i + top] for i in range(0, X.shape[0], top)]
+        if len(parts) > self.max_queue:
+            # structurally un-admittable even against an idle server:
+            # ServerOverloaded ("retry with backoff") would lie — this
+            # can never succeed, so fail fast and permanently
+            raise ValueError(
+                f"request of {X.shape[0]} rows needs {len(parts)} "
+                f"chunks but max_queue={self.max_queue}; raise "
+                "max_queue or split the request"
+            )
+        reqs = [Request(p, method, self.timeout_s) for p in parts]
+        self._admit(reqs)
+        return _gather_futures([r.future for r in reqs])
+
+    def _admit(self, reqs):
+        verdict = self._queue.put_many(reqs)
+        if verdict == "closed":
+            raise ServerClosed("server is not accepting requests")
+        if verdict != "ok":
+            smetrics.record_drop("shed")
+            raise ServerOverloaded(
+                f"queue at bound ({self.max_queue} requests); request "
+                "shed"
+            )
+        for r in reqs:
+            smetrics.record_request(r.n_rows)
+        return reqs[0].future
+
+    # blocking conveniences ------------------------------------------------
+    def _call(self, X, method):
+        import concurrent.futures as cf
+
+        fut = self.submit(X, method=method)
+        extra = self.timeout_s if self.timeout_s > 0 else None
+        # queue deadline + generous execution allowance; None = wait.
+        # The wait-timeout surfaces as the package's typed error (which
+        # still subclasses TimeoutError), not cf's — callers are told to
+        # catch ServingError subclasses.
+        try:
+            return fut.result(None if extra is None else 30.0 + extra)
+        except cf.TimeoutError:
+            raise RequestTimeout(
+                f"served {method} did not complete within the "
+                f"{self.timeout_s * 1e3:.0f}ms deadline + 30s execution "
+                "allowance"
+            ) from None
+
+    def predict(self, X):
+        return self._call(X, "predict")
+
+    def predict_proba(self, X):
+        return self._call(X, "predict_proba")
+
+    def decision_function(self, X):
+        return self._call(X, "decision_function")
+
+    def transform(self, X):
+        return self._call(X, "transform")
+
+    def score(self, X, y):
+        """Served-path score: predictions via the batcher (so padding
+        masking is exercised), metric via the package's own
+        accuracy/r2 — same dispatch AND same edge-case conventions
+        (e.g. constant-target r2 forced to 0.0) as ParallelPostFit."""
+        from ..metrics import accuracy_score, r2_score
+
+        pred = self.predict(X)
+        y = np.asarray(y)
+        if hasattr(self.estimator, "classes_") or hasattr(
+                self.estimator, "predict_proba"):
+            return float(accuracy_score(y, pred))
+        return float(r2_score(y, pred))
+
+    # -- stats -------------------------------------------------------------
+    def stats(self):
+        """Live snapshot: queue depth/peak, batch count, request count,
+        and latency quantiles over the recent window."""
+        q = self._queue
+        return {
+            "queue_depth": q.depth,
+            "queue_peak_depth": q.peak_depth,
+            "batches": self._batches,
+            "requests": self._latency.count,
+            "warmed": self._warmed,
+            "latency_s": self._latency.percentiles((50, 99)),
+        }
+
+    # -- worker ------------------------------------------------------------
+    def _run(self):
+        import dataclasses
+
+        from .. import config
+
+        # re-apply the creator's (thread-local) config in this thread so
+        # spans/counters gate exactly as they did where the server was
+        # built
+        with config.set(**dataclasses.asdict(self._cfg)):
+            self._run_loop()
+
+    def _run_loop(self):
+        while True:
+            if not self._paused.is_set():
+                if self._stop.is_set():
+                    break
+                self._parked.set()
+                self._paused.wait(0.05)
+                continue
+            self._parked.clear()
+            first = self._queue.pop_first(timeout=0.05)
+            if first is None:
+                if self._stop.is_set() and self._queue.depth == 0:
+                    break
+                continue
+            self._serve_guarded(first)
+        # drain tail: stop() requested with requests still queued
+        while True:
+            req = self._queue.pop_first(timeout=0.0)
+            if req is None:
+                break
+            self._serve_guarded(req)
+
+    def _serve_guarded(self, first):
+        # the worker must be immortal: _execute already fails its own
+        # batch on error, this outer guard covers the assembly path so
+        # no exception can kill the thread and strand the queue
+        try:
+            self._serve_one(first)
+        except Exception as exc:  # pragma: no cover - defensive
+            smetrics.record_drop("error")
+            fail_requests([first], ServingError(
+                f"serving worker error: {type(exc).__name__}: {exc}"
+            ))
+
+    def _serve_one(self, first):
+        if first.expired():
+            smetrics.record_drop("timeout")
+            fail_requests([first], RequestTimeout(
+                f"request waited past its {self.timeout_s * 1e3:.0f}ms "
+                "deadline"
+            ))
+            return
+        batch = [first]
+        rows = first.n_rows
+        top = self.ladder.max_rows
+        # coalescing window: measured from the FIRST dequeue, not per
+        # arrival — a trickle of stragglers cannot hold a batch forever
+        deadline = time.perf_counter() + self.batch_window_s
+        while rows < top and not self._stop.is_set():
+            got = self._queue.drain_method(first.method, top - rows)
+            for r in got:
+                if r.expired():
+                    smetrics.record_drop("timeout")
+                    fail_requests([r], RequestTimeout(
+                        "request waited past its deadline"
+                    ))
+                else:
+                    batch.append(r)
+                    rows += r.n_rows
+            now = time.perf_counter()
+            if now >= deadline or rows >= top:
+                break
+            # sleep on THIS method's lane — depth > 0 from other
+            # methods' requests must not turn the window into a spin
+            self._queue.wait_method(first.method,
+                                    min(deadline - now, 0.01))
+        self._execute(batch)
+
+    def _execute(self, batch):
+        # EVERYTHING from pack to demux sits inside the guard: an
+        # exception anywhere (ragged widths slipping past validation,
+        # a fallback output that isn't row-sliceable) must fail THIS
+        # batch's futures, never kill the worker thread — a dead worker
+        # would strand every later request behind a queue nobody drains
+        try:
+            fn = self._fns[batch[0].method]
+            buf, segments, bucket, rows = pack_batch(
+                batch, self.ladder, self._staging
+            )
+            with smetrics.batch_span(
+                batch[0].method, bucket, rows, len(batch),
+                self._queue.depth,
+            ):
+                out = fn(buf)
+            self._batches += 1
+            smetrics.record_batch(rows, bucket)
+            done = time.perf_counter()
+            for r in batch:
+                self._latency.observe(done - r.t_enqueue)
+            demux_outputs(out, segments)
+        except Exception as exc:
+            for _ in batch:   # per REQUEST, matching the timeout path
+                smetrics.record_drop("error")
+            fail_requests(batch, ServingError(
+                f"batch execution failed: {type(exc).__name__}: {exc}"
+            ))
+
+
+def _gather_futures(futures):
+    """One Future resolving to the row-concatenation of ``futures``'
+    results (oversize-request reassembly); the first failure propagates."""
+    from concurrent.futures import Future
+
+    out = Future()
+    remaining = [len(futures)]
+    lock = threading.Lock()
+
+    def _fail(exc):
+        try:
+            if out.set_running_or_notify_cancel():
+                out.set_exception(exc)
+        except Exception:
+            pass  # already resolved by a racing callback
+
+    def _done(fut):
+        # FIRST failure propagates immediately — a doomed oversize
+        # request must not keep its caller waiting on the slow chunks
+        exc = fut.exception() if not fut.cancelled() else None
+        if exc is not None:
+            _fail(exc)
+            return
+        with lock:
+            remaining[0] -= 1
+            if remaining[0] > 0 or out.done():
+                return
+        try:
+            parts = [f.result() for f in futures]
+        except BaseException as exc:  # noqa: BLE001 - forwarded, not hidden
+            _fail(exc)
+            return
+        if out.set_running_or_notify_cancel():
+            out.set_result(np.concatenate(parts, axis=0))
+
+    for f in futures:
+        f.add_done_callback(_done)
+    return out

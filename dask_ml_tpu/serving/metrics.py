@@ -1,0 +1,87 @@
+"""Serving telemetry: per-batch spans, counters, and latency quantiles.
+
+Everything funnels through ``dask_ml_tpu/observability/`` — the same
+JSONL sinks, span tree, and counter registry the fit paths use, so a
+recorded serving run and a recorded fit aggregate under one report CLI.
+Per batch the server emits one ``serving.batch`` span carrying bucket,
+occupancy, and padding attributes (plus the counter deltas it caused —
+recompiles paid mid-serving show up HERE, on the batch that paid them).
+Counters accumulate the run totals:
+
+- ``serving_requests`` / ``serving_rows``   — admitted work
+- ``serving_batches`` / ``serving_padded_rows`` — batching efficiency
+  (padding waste = padded_rows / (rows + padded_rows))
+- ``serving_shed`` / ``serving_timeouts`` / ``serving_errors`` —
+  backpressure outcomes
+
+Latency quantiles come from a fixed-size ring of recent request
+latencies — O(1) memory for a long-lived server, exact percentiles over
+the retained window.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..observability import span
+from ..observability._counters import (
+    record_serving_batch,
+    record_serving_drop,
+    record_serving_request,
+)
+
+__all__ = ["LatencyWindow", "batch_span", "record_batch",
+           "record_request", "record_drop"]
+
+# counter recording lives in observability/_counters.py (the shared
+# registry the report CLI and span deltas read); these are the serving
+# package's local names for it
+record_request = record_serving_request
+record_batch = record_serving_batch
+record_drop = record_serving_drop
+
+
+def batch_span(method: str, bucket: int, rows: int, n_requests: int,
+               queue_depth: int):
+    """The per-batch span: one JSONL record per executed micro-batch
+    with the occupancy/padding signals a capacity review needs. Cheap
+    no-op when no sink is configured (same contract as every other
+    span)."""
+    return span(
+        "serving.batch", method=method, bucket=bucket, rows=rows,
+        n_requests=n_requests, queue_depth=queue_depth,
+        occupancy=round(rows / bucket, 4),
+    )
+
+
+class LatencyWindow:
+    """Lock-guarded ring buffer of recent per-request latencies
+    (seconds). ``percentiles()`` computes exact quantiles over the
+    retained window — a million-request day keeps memory flat while p50
+    and p99 track the live distribution."""
+
+    __slots__ = ("_lock", "_buf", "_n", "_i", "count")
+
+    def __init__(self, size=4096):
+        self._lock = threading.Lock()
+        self._buf = np.zeros(int(size), np.float64)
+        self._n = 0      # filled entries (<= size)
+        self._i = 0      # next write slot
+        self.count = 0   # total observations ever
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self._buf[self._i] = seconds
+            self._i = (self._i + 1) % len(self._buf)
+            self._n = min(self._n + 1, len(self._buf))
+            self.count += 1
+
+    def percentiles(self, qs=(50, 99)) -> dict:
+        with self._lock:
+            if self._n == 0:
+                return {f"p{q}": float("nan") for q in qs}
+            window = self._buf[: self._n].copy()
+        vals = np.percentile(window, qs)
+        return {f"p{q}": float(v) for q, v in zip(qs, vals)}

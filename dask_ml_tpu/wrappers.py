@@ -25,7 +25,8 @@ from .base import BaseEstimator, clone
 from .metrics import accuracy_score, r2_score
 from .parallel.sharded import ShardedArray, as_sharded
 
-__all__ = ["ParallelPostFit", "Incremental"]
+__all__ = ["ParallelPostFit", "Incremental", "CompiledBatchFn",
+           "compiled_batch_fn"]
 
 
 def _data_shards(mesh):
@@ -212,6 +213,241 @@ class ParallelPostFit(BaseEstimator):
         if hasattr(self._est, "classes_") or hasattr(self._est, "predict_proba"):
             return accuracy_score(y, pred)
         return r2_score(y, pred)
+
+
+# --------------------------------------------------------------------------
+# Compiled static-shape predict entry points (the serving subsystem's
+# hot-loop contract; see dask_ml_tpu/serving/)
+# --------------------------------------------------------------------------
+
+class CompiledBatchFn:
+    """A fitted estimator's ``method`` as ONE static-shape batch
+    function: ``fn(X)`` takes a host float32 (B, d) block and returns a
+    host ndarray with one output row per input row.
+
+    For device estimators the core is a single jitted closure over the
+    fitted parameters (device-resident constants) — XLA specializes it
+    per distinct B, so a caller that draws B from a fixed bucket ladder
+    pays a fixed, pre-warmable set of compiles and nothing after. On
+    backends with real buffer donation (TPU/GPU) the input is donated,
+    letting XLA reuse the batch's device allocation for outputs.
+    ``jitted=False`` marks the host fallback (sklearn-style estimators):
+    still batchable, no compile accounting to speak of.
+    """
+
+    __slots__ = ("method", "jitted", "n_features", "donates", "_fn",
+                 "_post")
+
+    def __init__(self, fn, method, jitted, n_features, donates=False,
+                 post=None):
+        self._fn = fn
+        self._post = post
+        self.method = method
+        self.jitted = jitted
+        self.n_features = n_features
+        self.donates = donates
+
+    def __call__(self, X):
+        out = self._fn(X)
+        if self.donates:
+            from .observability import record_donation
+
+            record_donation(X.nbytes)
+        out = _host_out(out)
+        return self._post(out) if self._post is not None else out
+
+
+def _host_out(out):
+    import scipy.sparse as sp
+
+    if isinstance(out, ShardedArray):
+        return out.to_numpy()
+    if sp.issparse(out):
+        return out.toarray()
+    return np.asarray(out)
+
+
+def _donate_spec():
+    """Donate the batch argument only where the runtime honors it; on
+    CPU jax warns per call that donated buffers were unusable."""
+    import jax
+
+    return (0,) if jax.default_backend() in ("tpu", "gpu") else ()
+
+
+def _linear_wb(est):
+    """(C, d) weight matrix + (C,) bias from a fitted linear model
+    (C=1 encodes the binary/regression row)."""
+    coef = np.asarray(est.coef_, np.float32)
+    if coef.ndim == 1:
+        coef = coef[None, :]
+    b = np.ravel(np.asarray(getattr(est, "intercept_", 0.0),
+                            np.float32))
+    if b.shape[0] != coef.shape[0]:
+        b = np.full(coef.shape[0], b[0] if b.size else 0.0, np.float32)
+    return coef, b
+
+
+def _jit_linear(est, method):
+    """Jitted closures for the linear-model family (GLM + SGD): the
+    whole method is one matmul + pointwise tail on device constants."""
+    import jax
+    import jax.numpy as jnp
+
+    W, b = _linear_wb(est)
+    Wd, bd = jnp.asarray(W), jnp.asarray(b)
+    multi = W.shape[0] > 1
+    classes = getattr(est, "classes_", None)
+    family = getattr(est, "family", None)
+    donate = _donate_spec()
+
+    def eta(X):
+        return X @ Wd.T + bd[None, :]      # (B, C)
+
+    if method == "decision_function":
+        core = (lambda X: eta(X)) if multi else (lambda X: eta(X)[:, 0])
+        post = None
+    elif method == "predict_proba":
+        if classes is None:
+            return None
+        # mirror SGDClassifier's guard: sigmoid(margins) of a non-log
+        # loss is NOT a probability — the direct method raises, so the
+        # compiled path must too (at build time, not first request)
+        loss = getattr(est, "_loss", None)
+        if callable(loss) and loss() != "log_loss":
+            raise AttributeError(
+                "predict_proba requires loss='log_loss'"
+            )
+        if multi:
+            def core(X):
+                p = jax.nn.sigmoid(eta(X))   # OvR sigmoids, normalized
+                return p / jnp.maximum(
+                    jnp.sum(p, axis=1, keepdims=True), 1e-12
+                )
+        else:
+            def core(X):
+                p1 = jax.nn.sigmoid(eta(X)[:, 0])
+                return jnp.stack([1.0 - p1, p1], axis=1)
+        post = None
+    elif method == "predict":
+        if classes is not None:
+            if multi:
+                core = lambda X: jnp.argmax(eta(X), axis=1)  # noqa: E731
+            else:
+                core = lambda X: (eta(X)[:, 0] > 0).astype(jnp.int32)  # noqa: E731
+            cls = np.asarray(classes)
+            post = lambda idx: cls[np.asarray(idx)]  # noqa: E731
+        elif family == "poisson":
+            core = lambda X: jnp.exp(eta(X)[:, 0])  # noqa: E731
+            post = None
+        else:                                   # regression: eta itself
+            core = lambda X: eta(X)[:, 0]  # noqa: E731
+            post = None
+    else:
+        return None
+    return CompiledBatchFn(
+        jax.jit(core, donate_argnums=donate), method, True,
+        W.shape[1], donates=bool(donate), post=post,
+    )
+
+
+def _jit_kmeans(est, method):
+    import jax
+    import jax.numpy as jnp
+
+    centers = jnp.asarray(np.asarray(est.cluster_centers_, np.float32))
+    donate = _donate_spec()
+
+    def dist2(X):
+        # ||x-c||^2 via the expanded form: one (B,d)x(d,k) MXU matmul
+        xx = jnp.sum(X * X, axis=1, keepdims=True)
+        cc = jnp.sum(centers * centers, axis=1)[None, :]
+        return jnp.maximum(xx + cc - 2.0 * (X @ centers.T), 0.0)
+
+    if method == "predict":
+        core = lambda X: jnp.argmin(dist2(X), axis=1).astype(jnp.int32)  # noqa: E731
+    elif method == "transform":
+        core = lambda X: jnp.sqrt(dist2(X))  # noqa: E731
+    else:
+        return None
+    return CompiledBatchFn(
+        jax.jit(core, donate_argnums=donate), method, True,
+        int(centers.shape[1]), donates=bool(donate),
+    )
+
+
+def _jit_pca(est, method):
+    import jax
+    import jax.numpy as jnp
+
+    if method != "transform":
+        return None
+    comp = jnp.asarray(np.asarray(est.components_, np.float32))
+    mean = getattr(est, "mean_", None)
+    mean = (jnp.asarray(np.asarray(mean, np.float32))
+            if mean is not None else None)
+    scale = None
+    if getattr(est, "whiten", False):
+        scale = jnp.sqrt(jnp.asarray(
+            np.asarray(est.explained_variance_, np.float32)
+        ))
+    donate = _donate_spec()
+
+    def core(X):
+        xc = X - mean[None, :] if mean is not None else X
+        sc = xc @ comp.T
+        return sc / scale[None, :] if scale is not None else sc
+
+    return CompiledBatchFn(
+        jax.jit(core, donate_argnums=donate), method, True,
+        int(comp.shape[1]), donates=bool(donate),
+    )
+
+
+def compiled_batch_fn(estimator, method="predict"):
+    """Build the static-shape batch entry point for a fitted estimator
+    (or sklearn-style pipeline ending in one) — the serving subsystem's
+    per-method compile unit.
+
+    Device estimators (GLM, SGD, KMeans, PCA/TruncatedSVD) lower to one
+    jitted closure over their fitted parameters; a pipeline applies its
+    prefix transforms per batch and feeds the final step's compiled fn
+    (prefix outputs are shape-deterministic per batch height, so the
+    compile set stays bounded by the bucket ladder). Anything else gets
+    the host fallback — ``getattr(est, method)`` over the padded batch.
+    """
+    est = estimator
+    if hasattr(est, "steps") and hasattr(est, "named_steps"):
+        prefix = [t for _, t in est.steps[:-1]]
+        inner = compiled_batch_fn(est.steps[-1][1], method)
+
+        def fn(X):
+            for t in prefix:
+                X = _host_out(t.transform(X))
+            return inner(np.asarray(X, np.float32))
+
+        first = est.steps[0][1]
+        return CompiledBatchFn(
+            fn, method, inner.jitted,
+            getattr(first, "n_features_in_", None),
+        )
+    if _is_device_estimator(est):
+        built = None
+        if hasattr(est, "coef_"):
+            built = _jit_linear(est, method)
+        elif hasattr(est, "cluster_centers_"):
+            built = _jit_kmeans(est, method)
+        elif hasattr(est, "components_"):
+            built = _jit_pca(est, method)
+        if built is not None:
+            return built
+    target = getattr(est, method, None)
+    if target is None:
+        raise AttributeError(
+            f"{type(est).__name__} has no method {method!r}"
+        )
+    n_feat = getattr(est, "n_features_in_", None)
+    return CompiledBatchFn(lambda X: target(X), method, False, n_feat)
 
 
 class Incremental(ParallelPostFit):

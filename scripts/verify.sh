@@ -20,8 +20,31 @@ if [ -n "$bad" ]; then
 fi
 echo "lint OK: no direct jax shard_map imports outside _compat.py"
 
+# -- lint: the serving package must never import from tests/ -----------------
+# (a production subsystem reaching into test fixtures would make the
+# test tree a runtime dependency)
+bad=$(grep -rn --include='*.py' -E '^[[:space:]]*(from[[:space:]]+tests|import[[:space:]]+tests)\b' \
+      dask_ml_tpu/serving 2>/dev/null)
+if [ -n "$bad" ]; then
+    echo "LINT FAIL: dask_ml_tpu/serving must not import from tests/:"
+    echo "$bad"
+    exit 1
+fi
+echo "lint OK: serving package imports nothing from tests/"
+
 if [ "${1:-}" = "--lint" ]; then
     exit 0
+fi
+
+# -- serving suite (fast, targeted): the online-inference subsystem gates
+# the same as lint — a broken server should fail verify in ~1min, before
+# the full tier-1 wait. timeout-wrapped like tier-1: a hung serving
+# worker must not block verify forever.
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
+      tests/test_serving.py -q -p no:cacheprovider -p no:xdist \
+      -p no:randomly; then
+    echo "VERIFY FAIL: serving tests"
+    exit 1
 fi
 
 # -- tier-1 (ROADMAP.md, verbatim) -------------------------------------------

@@ -260,3 +260,44 @@ def test_constant_target_force_finite():
     assert r2_score(t, np.arange(6.0)) == \
         skm.r2_score(t, np.arange(6.0)) == 0.0
     assert r2_score(t, t) == skm.r2_score(t, t) == 1.0
+
+
+def test_undefined_metric_warning_class():
+    """The degenerate curve paths warn with an
+    UndefinedMetricWarning-compatible class (ADVICE r5): a UserWarning
+    subclass under sklearn's name, so sklearn-ported filters catch it."""
+    from sklearn.exceptions import (
+        UndefinedMetricWarning as SkUndefinedMetricWarning,
+    )
+
+    from dask_ml_tpu.metrics import UndefinedMetricWarning
+
+    assert issubclass(UndefinedMetricWarning, UserWarning)
+    # sklearn-ported filters target sklearn's class — ours must BE one
+    assert issubclass(UndefinedMetricWarning, SkUndefinedMetricWarning)
+    y = np.zeros(8)
+    s = np.linspace(0, 1, 8)
+    with pytest.warns(UndefinedMetricWarning):
+        dm.roc_curve(y, s)
+    with pytest.warns(UndefinedMetricWarning):
+        dm.precision_recall_curve(y, s)
+    with pytest.warns(UndefinedMetricWarning):
+        assert dm.average_precision_score(y, s) == 0.0
+    # sklearn-style filtering by the SPECIFIC class works
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any warning raises ...
+        warnings.simplefilter("ignore", UndefinedMetricWarning)  # ... but ours
+        dm.roc_curve(y, s)
+
+
+def test_binary_metrics_reject_duplicate_labels():
+    """labels=[v, v] passes the length check but would silently map every
+    row positive (ADVICE r5) — must raise instead."""
+    y = np.array([0.0, 1.0, 1.0, 0.0])
+    s = np.array([0.1, 0.8, 0.7, 0.3])
+    for fn in (dm.roc_auc_score, dm.roc_curve,
+               dm.precision_recall_curve, dm.average_precision_score):
+        with pytest.raises(ValueError, match="distinct"):
+            fn(y, s, labels=[1.0, 1.0])

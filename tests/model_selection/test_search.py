@@ -432,3 +432,64 @@ class TestCGridFastPath:
         fm = GridSearchCV(pipe, {"clf__C": [0.1, 1.0]}, cv=2).fit(Xm, ym)
         assert fm._c_grid_vmapped_ == 2
         assert fm.best_estimator_.named_steps["clf"].coef_.shape == (3, 8)
+
+
+class TestCGridSharedBudgetDiagnostics:
+    """The stacked C-grid solve shares one iteration budget across
+    candidates (ADVICE r5); each fitted clone must still publish its OWN
+    per-candidate convergence point as n_iter_, with the full vector in
+    solver_info_."""
+
+    def test_per_candidate_n_iter(self):
+        from dask_ml_tpu.datasets import make_classification
+        from dask_ml_tpu.linear_model import LogisticRegression
+
+        X, y = make_classification(n_samples=3000, n_features=10,
+                                   n_informative=6, random_state=0)
+        Cs = [0.001, 0.1, 10.0]
+        models = LogisticRegression(
+            solver="lbfgs", max_iter=100, tol=1e-6
+        )._fit_C_grid(X, y, Cs)
+        assert models is not None
+        per_cand = models[0].solver_info_["n_iter_per_candidate"]
+        assert len(per_cand) == len(Cs)
+        for m, expect in zip(models, per_cand):
+            assert m.n_iter_ == expect
+        # the joint budget is the slowest candidate's count: at least
+        # one clone hits it, and none exceeds it
+        budget = max(per_cand)
+        assert all(1 <= it <= budget for it in per_cand)
+
+    def test_multiclass_per_candidate_n_iter(self):
+        from dask_ml_tpu.datasets import make_classification
+        from dask_ml_tpu.linear_model import LogisticRegression
+        from dask_ml_tpu.parallel.sharded import as_sharded  # noqa: F401
+
+        X, y = make_classification(n_samples=3000, n_features=8,
+                                   n_classes=3, n_informative=6,
+                                   random_state=1)
+        Cs = [0.01, 1.0]
+        models = LogisticRegression(
+            solver="lbfgs", max_iter=80
+        )._fit_C_grid(X, y, Cs)
+        assert models is not None
+        info = models[0].solver_info_
+        per_cand = info["n_iter_per_candidate"]
+        blocks = np.asarray(info["n_iter_per_block"])
+        assert blocks.shape == (len(Cs), 3)  # (k candidates, C classes)
+        # a candidate's n_iter is its slowest class's convergence point
+        np.testing.assert_array_equal(blocks.max(axis=1), per_cand)
+        for m, expect in zip(models, per_cand):
+            assert m.n_iter_ == expect
+
+    def test_stacked_multiclass_fit_reports_per_class(self):
+        from dask_ml_tpu.datasets import make_classification
+        from dask_ml_tpu.linear_model import LogisticRegression
+
+        X, y = make_classification(n_samples=3000, n_features=8,
+                                   n_classes=3, n_informative=6,
+                                   random_state=2)
+        clf = LogisticRegression(solver="lbfgs", max_iter=80).fit(X, y)
+        per_class = clf.solver_info_["n_iter_per_class"]
+        assert len(per_class) == 3
+        assert clf.n_iter_ == max(per_class) == clf.solver_info_["n_iter"]

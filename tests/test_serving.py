@@ -1,0 +1,558 @@
+"""Online serving subsystem (dask_ml_tpu/serving): micro-batching
+parity, bucket-ladder compile bounds, backpressure, and drain.
+
+The compile-bound assertions ride the observability recompile counter
+(jax.monitoring backend_compile events): warmup pays at most
+len(ladder) compiles per method, and a warmed server answers randomized
+ragged traffic with ZERO new compiles — the whole point of the shape
+ladder.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu import observability as obs
+from dask_ml_tpu.serving import (
+    BucketLadder,
+    ModelServer,
+    RequestTimeout,
+    ServerClosed,
+    ServerOverloaded,
+)
+from dask_ml_tpu.serving._batching import BoundedQueue, Request
+from dask_ml_tpu.wrappers import compiled_batch_fn
+
+
+@pytest.fixture(scope="module")
+def logreg_fitted():
+    from dask_ml_tpu.datasets import make_classification
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    X, y = make_classification(
+        n_samples=600, n_features=12, n_informative=6, random_state=0
+    )
+    clf = LogisticRegression(solver="lbfgs", max_iter=30).fit(X, y)
+    return clf, X.to_numpy()
+
+
+@pytest.fixture(scope="module")
+def logreg_multi_fitted():
+    from dask_ml_tpu.datasets import make_classification
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    X, y = make_classification(
+        n_samples=600, n_features=10, n_informative=6, n_classes=3,
+        random_state=1,
+    )
+    clf = LogisticRegression(solver="lbfgs", max_iter=30).fit(X, y)
+    return clf, X.to_numpy()
+
+
+def _ladder():
+    return BucketLadder(8, 128, 2.0)
+
+
+# -- bucket ladder -----------------------------------------------------------
+
+def test_ladder_geometry():
+    lad = BucketLadder(8, 128, 2.0)
+    assert lad.buckets == (8, 16, 32, 64, 128)
+    assert lad.bucket_for(1) == 8
+    assert lad.bucket_for(8) == 8
+    assert lad.bucket_for(9) == 16
+    assert lad.bucket_for(128) == 128
+    assert lad.padding_for(100) == 28
+    with pytest.raises(ValueError):
+        lad.bucket_for(129)
+
+
+def test_ladder_validation():
+    with pytest.raises(ValueError):
+        BucketLadder(0, 10)
+    with pytest.raises(ValueError):
+        BucketLadder(16, 8)
+    with pytest.raises(ValueError):
+        BucketLadder(8, 64, growth=1.0)
+
+
+def test_ladder_from_config():
+    from dask_ml_tpu import config
+
+    with config.set(serving_min_batch=4, serving_max_batch=32,
+                    serving_bucket_growth=2.0):
+        lad = BucketLadder.from_config()
+    assert lad.buckets == (4, 8, 16, 32)
+
+
+# -- compiled entry points ---------------------------------------------------
+
+def test_compiled_batch_fn_parity_binary(logreg_fitted):
+    clf, Xh = logreg_fitted
+    for method in ("predict", "predict_proba", "decision_function"):
+        fn = compiled_batch_fn(clf, method)
+        assert fn.jitted
+        got = fn(np.asarray(Xh[:50], np.float32))
+        want = getattr(clf, method)(Xh[:50])
+        np.testing.assert_allclose(got, np.asarray(want), atol=1e-5)
+
+
+def test_compiled_batch_fn_parity_multiclass(logreg_multi_fitted):
+    clf, Xh = logreg_multi_fitted
+    for method in ("predict", "predict_proba", "decision_function"):
+        fn = compiled_batch_fn(clf, method)
+        got = fn(np.asarray(Xh[:40], np.float32))
+        want = getattr(clf, method)(Xh[:40])
+        np.testing.assert_allclose(got, np.asarray(want), atol=1e-5)
+
+
+def test_compiled_batch_fn_kmeans_and_pca():
+    from dask_ml_tpu.cluster import KMeans
+    from dask_ml_tpu.datasets import make_blobs
+    from dask_ml_tpu.decomposition import PCA
+
+    X, _ = make_blobs(n_samples=300, n_features=6, centers=4,
+                      random_state=0)
+    Xh = X.to_numpy()
+    km = KMeans(n_clusters=4, random_state=0).fit(X)
+    fn = compiled_batch_fn(km, "predict")
+    got = fn(np.asarray(Xh[:64], np.float32))
+    want = km.predict(Xh[:64]).to_numpy()
+    np.testing.assert_array_equal(got, want)
+    fnt = compiled_batch_fn(km, "transform")
+    np.testing.assert_allclose(
+        fnt(np.asarray(Xh[:32], np.float32)),
+        km.transform(Xh[:32]).to_numpy(), atol=1e-4,
+    )
+
+    pca = PCA(n_components=3, random_state=0).fit(X)
+    fnp = compiled_batch_fn(pca, "transform")
+    np.testing.assert_allclose(
+        fnp(np.asarray(Xh[:32], np.float32)),
+        pca.transform(Xh[:32]).to_numpy(), atol=1e-4,
+    )
+
+
+def test_compiled_batch_fn_host_fallback(logreg_fitted):
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    _, Xh = logreg_fitted
+    y = (Xh[:, 0] > 0).astype(int)
+    sk = SkLR(max_iter=200).fit(Xh, y)
+    fn = compiled_batch_fn(sk, "predict")
+    assert not fn.jitted
+    np.testing.assert_array_equal(
+        fn(np.asarray(Xh[:30], np.float32)), sk.predict(Xh[:30])
+    )
+
+
+def test_compiled_batch_fn_unknown_method(logreg_fitted):
+    clf, _ = logreg_fitted
+    with pytest.raises(AttributeError):
+        compiled_batch_fn(clf, "no_such_method")
+
+
+# -- served-path parity (padding masked out) ---------------------------------
+
+def test_served_parity_vs_direct(logreg_fitted):
+    clf, Xh = logreg_fitted
+    with ModelServer(clf, methods=("predict", "predict_proba"),
+                     ladder=_ladder(), batch_window_ms=1.0) as srv:
+        rng = np.random.RandomState(3)
+        for _ in range(15):
+            n = rng.randint(1, 60)
+            i = rng.randint(0, Xh.shape[0] - n)
+            req = Xh[i:i + n]
+            np.testing.assert_array_equal(
+                srv.predict(req), np.asarray(clf.predict(req))
+            )
+            np.testing.assert_allclose(
+                srv.predict_proba(req),
+                np.asarray(clf.predict_proba(req)), atol=1e-5,
+            )
+
+
+def test_served_single_row_and_oversize(logreg_fitted):
+    clf, Xh = logreg_fitted
+    with ModelServer(clf, ladder=_ladder(),
+                     batch_window_ms=1.0) as srv:
+        # 1-D single-sample request
+        got = srv.predict(Xh[7])
+        assert got.shape == (1,)
+        assert got[0] == np.asarray(clf.predict(Xh[7:8]))[0]
+        # taller than the top bucket: chunked + reassembled
+        big = Xh[:300]
+        np.testing.assert_array_equal(
+            srv.predict(big), np.asarray(clf.predict(big))
+        )
+
+
+def test_served_transform_parity():
+    from dask_ml_tpu.datasets import make_blobs
+    from dask_ml_tpu.decomposition import PCA
+
+    X, _ = make_blobs(n_samples=300, n_features=6, centers=3,
+                      random_state=2)
+    Xh = X.to_numpy()
+    pca = PCA(n_components=2, random_state=0).fit(X)
+    with ModelServer(pca, methods=("transform",), ladder=_ladder(),
+                     batch_window_ms=1.0) as srv:
+        rng = np.random.RandomState(0)
+        for _ in range(8):
+            n = rng.randint(1, 50)
+            i = rng.randint(0, Xh.shape[0] - n)
+            req = Xh[i:i + n]
+            np.testing.assert_allclose(
+                srv.transform(req), pca.transform(req).to_numpy(),
+                atol=1e-4,
+            )
+
+
+def test_served_score(logreg_fitted):
+    clf, Xh = logreg_fitted
+    y = np.asarray(clf.predict(Xh[:100]))
+    with ModelServer(clf, ladder=_ladder(), batch_window_ms=1.0) as srv:
+        assert srv.score(Xh[:100], y) == 1.0
+
+
+# -- concurrency + compile bounds --------------------------------------------
+
+def test_concurrent_clients_one_server(logreg_fitted):
+    clf, Xh = logreg_fitted
+    expected = {}
+    rngs = {s: np.random.RandomState(100 + s) for s in range(6)}
+    reqs = {}
+    for s, rng in rngs.items():
+        sizes = [int(rng.randint(1, 90)) for _ in range(20)]
+        offs = [int(rng.randint(0, Xh.shape[0] - n)) for n in sizes]
+        reqs[s] = [(Xh[i:i + n]) for n, i in zip(sizes, offs)]
+        expected[s] = [np.asarray(clf.predict(r)) for r in reqs[s]]
+    errs = []
+
+    with ModelServer(clf, ladder=_ladder(), batch_window_ms=2.0,
+                     timeout_ms=0) as srv:
+        def client(s):
+            try:
+                for req, want in zip(reqs[s], expected[s]):
+                    got = srv.predict(req)
+                    if not np.array_equal(got, want):
+                        errs.append(f"client {s}: mismatch")
+            except Exception as exc:  # noqa: BLE001
+                errs.append(f"client {s}: {exc!r}")
+
+        threads = [threading.Thread(target=client, args=(s,))
+                   for s in rngs]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stats = srv.stats()
+    assert not errs, errs[:3]
+    assert stats["requests"] == 120
+    # micro-batching actually coalesced: fewer batches than requests
+    assert stats["batches"] < 120
+
+
+def test_warmup_bounds_compiles_and_workload_is_compile_free(
+    logreg_fitted,
+):
+    clf, Xh = logreg_fitted
+    srv = ModelServer(clf, methods=("predict", "predict_proba"),
+                      ladder=_ladder(), batch_window_ms=2.0,
+                      timeout_ms=0)
+    before_warm = obs.counters_snapshot().get("recompiles", 0)
+    srv.warmup()
+    warm_compiles = obs.counters_snapshot().get("recompiles", 0) \
+        - before_warm
+    # at most one program per (method, rung); the monitoring listener
+    # may be unavailable on exotic jax builds — then deltas read 0 and
+    # the bound still holds
+    assert warm_compiles <= 2 * len(srv.ladder)
+    with srv:
+        before = obs.counters_snapshot().get("recompiles", 0)
+        def client(seed):
+            rng = np.random.RandomState(seed)
+            for _ in range(25):
+                n = rng.randint(1, 100)
+                i = rng.randint(0, Xh.shape[0] - n)
+                srv.predict(Xh[i:i + n])
+                srv.predict_proba(Xh[i:i + n])
+
+        threads = [threading.Thread(target=client, args=(s,))
+                   for s in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        after = obs.counters_snapshot().get("recompiles", 0)
+    assert after - before == 0, (
+        f"warmed server paid {after - before} recompiles on ladder "
+        "traffic"
+    )
+
+
+# -- backpressure / timeout / drain ------------------------------------------
+
+def test_overload_sheds_with_typed_error(logreg_fitted):
+    clf, Xh = logreg_fitted
+    with ModelServer(clf, ladder=_ladder(), max_queue=3,
+                     batch_window_ms=1.0, timeout_ms=0) as srv:
+        srv.pause()
+        futures = [srv.submit(Xh[:4]) for _ in range(3)]
+        with pytest.raises(ServerOverloaded):
+            srv.submit(Xh[:4])
+        snap = obs.counters_snapshot()
+        assert snap.get("serving_shed", 0) >= 1
+        srv.resume()
+        for f in futures:
+            assert f.result(timeout=30).shape == (4,)
+
+
+def test_request_timeout_while_queued(logreg_fitted):
+    clf, Xh = logreg_fitted
+    with ModelServer(clf, ladder=_ladder(), batch_window_ms=1.0,
+                     timeout_ms=50.0) as srv:
+        srv.pause()
+        fut = srv.submit(Xh[:4])
+        time.sleep(0.2)  # let the deadline lapse while queued
+        srv.resume()
+        with pytest.raises(RequestTimeout):
+            fut.result(timeout=30)
+        assert obs.counters_snapshot().get("serving_timeouts", 0) >= 1
+
+
+def test_graceful_drain_completes_queued_requests(logreg_fitted):
+    clf, Xh = logreg_fitted
+    srv = ModelServer(clf, ladder=_ladder(), batch_window_ms=1.0,
+                      timeout_ms=0).start()
+    srv.pause()
+    futures = [srv.submit(Xh[i:i + 5]) for i in range(0, 50, 5)]
+    srv.stop(drain=True)
+    for k, f in enumerate(futures):
+        got = f.result(timeout=30)
+        np.testing.assert_array_equal(
+            got, np.asarray(clf.predict(Xh[5 * k:5 * k + 5]))
+        )
+    with pytest.raises(ServerClosed):
+        srv.submit(Xh[:4])
+
+
+def test_stop_without_drain_sheds(logreg_fitted):
+    clf, Xh = logreg_fitted
+    srv = ModelServer(clf, ladder=_ladder(), batch_window_ms=1.0,
+                      timeout_ms=0).start()
+    srv.pause()
+    fut = srv.submit(Xh[:4])
+    srv.stop(drain=False)
+    with pytest.raises(ServerClosed):
+        fut.result(timeout=30)
+
+
+def test_unserved_method_and_bad_width(logreg_fitted):
+    clf, Xh = logreg_fitted
+    with ModelServer(clf, ladder=_ladder(),
+                     batch_window_ms=1.0) as srv:
+        with pytest.raises(ValueError):
+            srv.submit(Xh[:4], method="transform")
+        with pytest.raises(ValueError):
+            srv.submit(Xh[:4, :5])
+        with pytest.raises(ValueError):
+            srv.submit(np.empty((0, Xh.shape[1])))
+
+
+# -- queue unit behavior -----------------------------------------------------
+
+def test_bounded_queue_fifo_and_bound():
+    q = BoundedQueue(2)
+    r1 = Request(np.zeros((2, 3), np.float32), "predict")
+    r2 = Request(np.zeros((3, 3), np.float32), "predict")
+    r3 = Request(np.zeros((1, 3), np.float32), "predict")
+    assert q.put(r1) and q.put(r2)
+    assert not q.put(r3)          # at bound
+    assert q.pop_first(0.0) is r1
+    got = q.drain_method("predict", max_rows=10)
+    assert got == [r2]
+    assert q.depth == 0
+
+
+def test_bounded_queue_drain_respects_row_budget():
+    q = BoundedQueue(10)
+    rs = [Request(np.zeros((4, 2), np.float32), "predict")
+          for _ in range(4)]
+    for r in rs:
+        q.put(r)
+    first = q.pop_first(0.0)
+    assert first is rs[0]
+    got = q.drain_method("predict", max_rows=9)  # fits 2 of the 3 left
+    assert got == rs[1:3]
+    assert q.depth == 1
+
+
+# -- telemetry ---------------------------------------------------------------
+
+def test_serving_counters_and_spans(logreg_fitted, tmp_path):
+    from dask_ml_tpu import config
+
+    clf, Xh = logreg_fitted
+    obs.counters_reset()
+    trace = tmp_path / "traces"
+    with config.set(trace_dir=str(trace)):
+        with ModelServer(clf, ladder=_ladder(),
+                         batch_window_ms=1.0, timeout_ms=0) as srv:
+            for i in range(6):
+                srv.predict(Xh[i * 10:(i + 1) * 10 + i])
+    snap = obs.counters_snapshot()
+    assert snap.get("serving_requests", 0) == 6
+    assert snap.get("serving_batches", 0) >= 1
+    assert snap.get("serving_rows", 0) > 0
+    assert snap.get("serving_padded_rows", 0) >= 0
+    import json
+
+    recs = [json.loads(line) for line in
+            (trace / "trace.jsonl").read_text().splitlines()]
+    batch_spans = [r for r in recs if r.get("span") == "serving.batch"]
+    assert batch_spans, "no serving.batch spans recorded"
+    for r in batch_spans:
+        assert {"bucket", "rows", "occupancy", "n_requests",
+                "queue_depth"} <= set(r)
+
+
+def test_latency_window_percentiles():
+    from dask_ml_tpu.serving.metrics import LatencyWindow
+
+    win = LatencyWindow(size=64)
+    assert np.isnan(win.percentiles()["p50"])
+    for v in np.linspace(0.001, 0.1, 100):
+        win.observe(float(v))
+    pct = win.percentiles((50, 99))
+    assert 0.0 < pct["p50"] < pct["p99"] <= 0.1
+    assert win.count == 100
+
+
+# -- review regressions ------------------------------------------------------
+
+def test_compiled_predict_proba_respects_sgd_loss_guard():
+    """sigmoid(hinge margins) is not a probability: the compiled path
+    must refuse exactly like the direct method does."""
+    from dask_ml_tpu.datasets import make_classification
+    from dask_ml_tpu.models.sgd import SGDClassifier
+
+    X, y = make_classification(n_samples=300, n_features=6,
+                               n_informative=4, random_state=0)
+    sgd = SGDClassifier(loss="hinge", max_iter=3, random_state=0)
+    sgd.fit(X, y)
+    with pytest.raises(AttributeError, match="log_loss"):
+        compiled_batch_fn(sgd, "predict_proba")
+    with pytest.raises(AttributeError, match="log_loss"):
+        ModelServer(sgd, methods=("predict_proba",))
+    # log_loss SGD serves probabilities fine
+    sgd_log = SGDClassifier(loss="log_loss", max_iter=3, random_state=0)
+    sgd_log.fit(X, y)
+    fn = compiled_batch_fn(sgd_log, "predict_proba")
+    Xh = X.to_numpy()
+    np.testing.assert_allclose(
+        fn(np.asarray(Xh[:20], np.float32)),
+        np.asarray(sgd_log.predict_proba(Xh[:20])), atol=1e-5,
+    )
+
+
+def test_warmup_skips_host_fallback(logreg_fitted):
+    """A host (sklearn) estimator has nothing to compile; warmup must
+    not demand a feature count it cannot infer."""
+    _, Xh = logreg_fitted
+
+    class Opaque:
+        def predict(self, X):
+            return np.asarray(X)[:, 0]
+
+    srv = ModelServer(Opaque(), ladder=_ladder()).warmup()
+    with srv:
+        np.testing.assert_allclose(srv.predict(Xh[:9]), Xh[:9, 0])
+
+
+def test_restart_after_stop(logreg_fitted):
+    clf, Xh = logreg_fitted
+    srv = ModelServer(clf, ladder=_ladder(), batch_window_ms=1.0)
+    with srv:
+        srv.predict(Xh[:5])
+    with pytest.raises(ServerClosed):
+        srv.submit(Xh[:5])
+    with srv:  # restart reopens the queue
+        np.testing.assert_array_equal(
+            srv.predict(Xh[:5]), np.asarray(clf.predict(Xh[:5]))
+        )
+
+
+def test_oversize_admission_is_all_or_nothing(logreg_fitted):
+    """A chunked oversize request sheds atomically: either every chunk
+    is admitted or none (no orphaned chunks burning capacity)."""
+    clf, Xh = logreg_fitted
+    with ModelServer(clf, ladder=_ladder(), max_queue=3,
+                     batch_window_ms=1.0, timeout_ms=0) as srv:
+        srv.pause()
+        held = srv.submit(Xh[:4])      # occupies 1 of 3 slots
+        # 300 rows over a 128-row top bucket = 3 chunks; 1 + 3 > 3 so
+        # the whole request sheds — transiently (room exists when the
+        # queue drains), hence ServerOverloaded, not ValueError
+        with pytest.raises(ServerOverloaded):
+            srv.submit(Xh[:300])
+        assert srv._queue.depth == 1   # nothing half-admitted
+        srv.resume()
+        assert held.result(timeout=30).shape == (4,)
+
+
+def test_batch_failure_does_not_kill_worker():
+    """pack/demux errors must fail the batch's futures and leave the
+    worker serving — a dead worker would strand every later request."""
+    from dask_ml_tpu.serving import ServingError
+
+    class Opaque:  # no n_features_in_: submit() cannot pre-validate
+        def predict(self, X):
+            return np.asarray(X)[:, 0]
+
+    with ModelServer(Opaque(), ladder=_ladder(), batch_window_ms=5.0,
+                     timeout_ms=0) as srv:
+        srv.pause()
+        f_ok = srv.submit(np.ones((4, 3), np.float32))
+        f_bad = srv.submit(np.ones((4, 5), np.float32))  # ragged width
+        srv.resume()
+        # the coalesced batch fails to pack: both resolve with the
+        # typed error instead of hanging
+        with pytest.raises(ServingError):
+            f_ok.result(timeout=30)
+        with pytest.raises(ServingError):
+            f_bad.result(timeout=30)
+        # worker survived: a clean request still serves
+        np.testing.assert_allclose(
+            srv.predict(np.full((3, 3), 2.0, np.float32)), [2.0] * 3
+        )
+
+
+def test_served_regressor_score_constant_target():
+    """srv.score must share the package metrics' conventions (constant
+    target r2 forced to 0.0, not -inf-ish)."""
+    from dask_ml_tpu.datasets import make_regression
+    from dask_ml_tpu.linear_model import LinearRegression
+
+    X, y = make_regression(n_samples=300, n_features=6, random_state=0)
+    reg = LinearRegression().fit(X, y)
+    Xh = X.to_numpy()
+    with ModelServer(reg, ladder=_ladder(), batch_window_ms=1.0) as srv:
+        assert srv.score(Xh[:50], np.ones(50)) == 0.0
+        # and on real targets it matches the estimator's own score
+        yh = y.to_numpy()[:50]
+        direct = reg.score(Xh[:50], yh)
+        assert abs(srv.score(Xh[:50], yh) - direct) < 1e-6
+
+
+def test_oversize_beyond_queue_capacity_fails_fast(logreg_fitted):
+    """A request whose chunk count exceeds max_queue can NEVER be
+    admitted — that is a permanent ValueError, not a retryable
+    ServerOverloaded."""
+    clf, Xh = logreg_fitted
+    with ModelServer(clf, ladder=_ladder(), max_queue=2,
+                     batch_window_ms=1.0) as srv:
+        with pytest.raises(ValueError, match="max_queue"):
+            srv.submit(np.repeat(Xh, 2, axis=0)[:3 * 128 + 1])
