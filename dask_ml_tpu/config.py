@@ -31,6 +31,23 @@ class Config:
     # machine load, breaking random_state reproducibility. Opt in for
     # throughput-bound production streaming.
     stream_autotune: bool = False
+    # -- super-block scan execution (parallel/streaming.py) ---------------
+    # blocks per super-block: streamed hot loops stack K fixed-shape
+    # blocks into one [K, block_rows, d] device buffer and consume it in
+    # ONE jitted lax.scan with a donated carry — one XLA dispatch per K
+    # blocks instead of K. 0 = auto (8, capped by the pass length and a
+    # device byte budget); 1 = per-block dispatch. Changing K never
+    # changes the minibatch partition — only dispatch granularity — so
+    # results are identical at any K.
+    superblock_k: int = 0
+    # opt-out: False forces the per-block dispatch path everywhere even
+    # for consumers that support the fused scan
+    stream_superblock: bool = True
+    # persistent XLA compilation cache directory ("" = off): repeated
+    # runs skip warm-up compiles for programs whose shapes/backends
+    # match a cached entry (applies process-wide on first streamed fit
+    # or serving warmup after the knob is set)
+    compile_cache_dir: str = ""
     # JSONL metrics path ("" = disabled)
     metrics_path: str = ""
     # span-trace directory: spans append to <trace_dir>/trace.jsonl even
@@ -108,6 +125,47 @@ def mxu_dtype():
         f"config.dtype={dt!r} is not supported; use 'float32' or "
         "'bfloat16'"
     )
+
+
+_compile_cache_applied: str | None = None
+
+
+def ensure_compile_cache() -> bool:
+    """Apply ``config.compile_cache_dir`` to jax's persistent
+    compilation cache (idempotent per directory value; process-wide, as
+    the cache itself is). Returns True when a cache directory is
+    active. Called from the streamed-fit entry (BlockStream) and
+    ``serving.warmup()`` — warmup still compiles the full
+    (method, bucket) grid, but a second process/run with the same knob
+    replays those compiles from disk instead of XLA.
+
+    The thresholds are zeroed so even sub-second streamed-block
+    programs are cached: the dispatch-bound hot loops this repo cares
+    about are exactly the ones whose many small compiles add up."""
+    global _compile_cache_applied
+    d = get_config().compile_cache_dir
+    if not d:
+        return False
+    if _compile_cache_applied == d:
+        return True
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        # jax latches the cache backend at the FIRST compile: a process
+        # that already compiled anything before this knob was applied
+        # holds an initialized no-op cache and silently ignores the new
+        # directory — reset so the next compile re-initializes against it
+        from jax._src import compilation_cache as _cc
+
+        if getattr(_cc, "_cache_initialized", False):
+            _cc.reset_cache()
+    except Exception:
+        return False  # jax build without the cache knobs: run uncached
+    _compile_cache_applied = d
+    return True
 
 
 def get_config() -> Config:

@@ -65,23 +65,23 @@ def max_error(y_true, y_pred):
 
 
 def median_absolute_error(y_true, y_pred, sample_weight=None):
-    """Weighted median of |err| with sklearn 1.9's *averaged* weighted
-    percentile: mean of the lower ("first x whose cdf reaches 1/2") and
-    upper (symmetric from the top) percentiles — reduces to np.median's
-    middle-two average for unit weights. One device sort + cumsum."""
+    """Median of |err|, matching sklearn's two conventions exactly: the
+    unweighted path is ``np.median`` (middle-two average over valid
+    rows), the weighted path is ``_weighted_percentile``'s inverted-cdf
+    — the FIRST sorted error whose cumulative weight reaches half the
+    total (so an explicit zero-weight row can never contribute its
+    error value, and an even split takes the LOWER of the two straddling
+    errors, as sklearn does). One device sort + host f64 prefix sums: an
+    f32 cumsum of unit weights saturates at 2**24 rows (the same hazard
+    the curve metrics guard)."""
     t, p, w, n = _canon(y_true, y_pred, sample_weight)
     err = jnp.abs(t - p)
     order = jnp.argsort(err)
-    # device sort, HOST f64 prefix sums: an f32 cumsum of unit weights
-    # saturates at 2**24 rows (the same hazard the curve metrics guard)
     es = np.asarray(jnp.take(err, order), np.float64)
     ws = np.asarray(jnp.take(w, order), np.float64)
+    if sample_weight is None:
+        # w holds only the padding-validity mask here
+        return float(np.median(es[ws > 0]))
     cw = np.cumsum(ws)
     half = 0.5 * cw[-1]
-    lo = es[int(np.argmax(cw >= half))]
-    # upper percentile: LAST valid row whose cumulative weight below it
-    # stays within half; zero-weight rows (padding, user zeros) are
-    # excluded so they can never contribute their error value
-    cand = ((cw - ws) <= half) & (ws > 0)
-    idx_hi = len(es) - 1 - int(np.argmax(cand[::-1]))
-    return float(0.5 * (lo + es[idx_hi]))
+    return float(es[int(np.argmax(cw >= half))])

@@ -549,9 +549,13 @@ def _fit(model_factory, params_list, train_blocks, X_test, y_test, scorer,
         for (key, n_calls, _cursor), mids in sorted(
             groups.items(), key=lambda kv: kv[1][0]
         ):
-            if len(mids) == 1:
+            if len(mids) == 1 and n_calls == 1:
                 train_one(mids[0], n_calls)
             else:
+                # a SINGLE batchable model asked for several calls still
+                # takes the cohort path: its n_calls block steps fuse
+                # into one scan program (super-block execution of the
+                # partial_fit driver) instead of n_calls dispatches
                 train_cohort(mids, n_calls)
 
     # first round: one call each (skipped when resuming a checkpoint)

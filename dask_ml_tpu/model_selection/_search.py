@@ -200,6 +200,23 @@ class _PrefixMemo:
 
 
 class _BaseSearchCV(BaseEstimator):
+    # Deterministic near-tie winner selection: candidates whose mean
+    # selection score is within this ABSOLUTE tolerance of the best are
+    # considered tied, and the earliest candidate in grid order wins.
+    # Rationale: the same grid can execute through different compiled
+    # paths (the stacked C-grid program vs per-candidate fits) whose
+    # iterates agree only to the solver tolerance — a razor-edge test
+    # sample can flip between them, shifting an accuracy-style fold
+    # score by 1/n_test. Exact argmax would then hand different paths
+    # different winners on genuinely tied candidates; the tolerance
+    # absorbs that sub-solver-tol noise so the winner is a function of
+    # the problem, not the execution path. cv_results_ (means, ranks)
+    # are NOT quantized — only best_index_/best_score_/best_params_,
+    # and the selected score is by construction within tie_tol of the
+    # true max. Callers needing sklearn's exact-argmax selection set
+    # ``search.tie_tol = 0.0`` on the instance.
+    tie_tol = 1e-3
+
     def __init__(self, estimator, scoring=None, cv=None, refit=True,
                  error_score="raise", return_train_score=False,
                  cache_cv=True, scheduler=None, n_jobs=-1):
@@ -648,7 +665,15 @@ class _BaseSearchCV(BaseEstimator):
         sel = self.refit if multimetric else "score"
         if sel in means:
             sel_mean = means[sel]
-            self.best_index_ = int(np.argmax(sel_mean))
+            # near-tie deterministic winner (see class ``tie_tol`` note):
+            # earliest candidate within tie_tol of the best — identical
+            # across the stacked C-grid and per-candidate execution paths
+            # when their scores differ only by sub-solver-tol noise
+            best = np.nanmax(sel_mean) if np.isfinite(sel_mean).any() \
+                else np.nan
+            tied = np.flatnonzero(sel_mean >= best - float(self.tie_tol))
+            self.best_index_ = (int(tied[0]) if tied.size
+                                else int(np.argmax(sel_mean)))
             self.best_score_ = float(sel_mean[self.best_index_])
             self.best_params_ = candidates[self.best_index_]
         self.n_splits_ = n_folds

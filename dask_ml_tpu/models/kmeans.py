@@ -196,6 +196,37 @@ def _block_moments(X, mask):
         jnp.tensordot(mask, X * X, axes=(0, 0))
 
 
+@partial(jax.jit, static_argnames=("mxu_dtype",), donate_argnums=(0,))
+def _sb_assign_stats(acc, Xs, counts, centers, mxu_dtype=None):
+    """Super-block Lloyd pass (ISSUE 3): scan the (K, S, d) stack
+    through the per-block assign+update kernel, accumulating
+    (sums, counts, inertia) in a DONATED carry — one dispatch per K
+    blocks; all-padding slots (counts == 0) contribute zero. ``Xs`` may
+    be a K-tuple of blocks (the CPU layout, see
+    ``streaming.superblock_unrolled``): the chain unrolls at trace time
+    into the same single program."""
+    unrolled = isinstance(Xs, (tuple, list))
+    r = jnp.arange(Xs[0].shape[0] if unrolled else Xs.shape[1])
+
+    def step(acc, X, c):
+        mask = (r < c).astype(X.dtype)
+        s, cnt, i = _block_assign_stats.__wrapped__(
+            X, mask, centers, mxu_dtype=mxu_dtype
+        )
+        return (acc[0] + s, acc[1] + cnt, acc[2] + i)
+
+    if unrolled:
+        for j in range(len(Xs)):
+            acc = step(acc, Xs[j], counts[j])
+        return acc
+
+    def scan_step(acc, inp):
+        return step(acc, *inp), jnp.float32(0.0)
+
+    acc, _ = jax.lax.scan(scan_step, acc, (Xs, counts))
+    return acc
+
+
 @partial(jax.jit, static_argnames=("l",))
 def _block_weighted_topl(X, weights, key, l):
     """Per-block Gumbel top-l: (keys, rows). Global weighted sampling
@@ -316,14 +347,31 @@ def _streamed_lloyd(stream, centers0, max_iter, tol2, logger=None,
     multi = dist.process_count() > 1
     centers = jnp.asarray(centers0)
     n_iter = start_it
+    use_sb = hasattr(stream, "use_superblocks") and stream.use_superblocks()
+    from ..observability import record_superblock_donation
+
     for it in range(start_it, int(max_iter)):
-        sums = counts = inertia = None
-        for blk in stream:
-            s, c, i = _block_assign_stats(blk.arrays[0], blk.mask,
-                                          centers, mxu_dtype=mxu)
-            sums = s if sums is None else sums + s
-            counts = c if counts is None else counts + c
-            inertia = i if inertia is None else inertia + i
+        if use_sb:
+            # the streamed hot loop as donated-carry super-block scans:
+            # one dispatch per K blocks instead of K
+            k_clusters, d = centers.shape
+            acc = (jnp.zeros((k_clusters, d), jnp.float32),
+                   jnp.zeros((k_clusters,), jnp.float32),
+                   jnp.zeros((), jnp.float32))
+            acc_bytes = 4 * (k_clusters * d + k_clusters + 1)
+            for sb in stream.superblocks():
+                acc = _sb_assign_stats(acc, sb.arrays[0], sb.counts,
+                                       centers, mxu_dtype=mxu)
+                record_superblock_donation(acc_bytes)
+            sums, counts, inertia = acc
+        else:
+            sums = counts = inertia = None
+            for blk in stream:
+                s, c, i = _block_assign_stats(blk.arrays[0], blk.mask,
+                                              centers, mxu_dtype=mxu)
+                sums = s if sums is None else sums + s
+                counts = c if counts is None else counts + c
+                inertia = i if inertia is None else inertia + i
         if multi:
             # per-process block stats → global (bit-identical on every
             # process, so centers never diverge across hosts)

@@ -108,6 +108,58 @@ def _sgd_step_multi(X, y_codes, mask, n_valid, W, lr, alpha, l2w, l1w,
     return jax.vmap(one)(W, jnp.arange(W.shape[0], dtype=jnp.float32))
 
 
+@partial(jax.jit, static_argnames=("loss", "n_out"), donate_argnums=(0,))
+def _sgd_sb_scan(W, Xs, ys, counts, lrs, alpha, l2w, l1w, iflag, loss,
+                 n_out):
+    """K streamed-block minibatch steps as ONE scan program over a
+    super-block stack (ISSUE 3): ``Xs (K, S, d)`` / ``ys (K, S)`` /
+    ``counts (K,)`` valid-row counts; the weight carry ``W`` is DONATED
+    so XLA advances it in place across the pass's dispatches. ``lrs``
+    carries the host-precomputed lr clock values (identical to the
+    per-block loop's ``_step_args`` sequence). All-padding slots
+    (``counts == 0``, the ragged final super-block) leave W untouched —
+    a masked-empty update would still apply the l2/prox terms.
+
+    ``Xs``/``ys`` may instead be K-tuples of per-block arrays (the CPU
+    layout, ``streaming.superblock_unrolled``): the chain unrolls at
+    trace time into the same single program, minus XLA:CPU's per-step
+    block-sized slice copy of a stacked operand."""
+    unrolled = isinstance(Xs, (tuple, list))
+    S = Xs[0].shape[0] if unrolled else Xs.shape[1]
+    r = jnp.arange(S)
+
+    def step(W, Xb, yb, c, lr):
+        mask = (r < c).astype(jnp.float32)
+        nv = c.astype(jnp.float32)
+        if n_out is not None:
+            def one(w, cc):
+                yy = (yb == cc).astype(jnp.float32)
+                return _sgd_update_one(w, yy, Xb, mask, nv, lr, alpha,
+                                       l2w, l1w, iflag, loss)
+
+            W2, losses = jax.vmap(one)(
+                W, jnp.arange(n_out, dtype=jnp.float32)
+            )
+            loss_v = losses.sum()
+        else:
+            W2, loss_v = _sgd_update_one(W, yb, Xb, mask, nv, lr, alpha,
+                                         l2w, l1w, iflag, loss)
+        return jnp.where(c > 0, W2, W), loss_v
+
+    if unrolled:
+        losses = []
+        for j in range(len(Xs)):
+            W, loss_v = step(W, Xs[j], ys[j], counts[j], lrs[j])
+            losses.append(loss_v)
+        return W, jnp.stack(losses)
+
+    def scan_step(W, inp):
+        Xb, yb, c, lr = inp
+        return step(W, Xb, yb, c, lr)
+
+    return jax.lax.scan(scan_step, W, (Xs, ys, counts, lrs))
+
+
 @partial(jax.jit, static_argnames=("loss", "schedule", "n_out"))
 def _sgd_epoch(Xr, yr, order, W, t0, eta0, power_t, alpha, l2w, l1w,
                iflag, n_rows, loss, schedule, n_out):
@@ -569,6 +621,69 @@ class _SGDBase(BaseEstimator):
         self._w = W[0]
         self._last_loss = losses[0]
 
+    def _sb_step(self, sb):
+        """Advance through one SuperBlock — K minibatch steps, ONE
+        dispatch, donated weight carry. The lr clock advances exactly as
+        K ``_step_args`` calls would (``_lr_schedule`` precomputes the
+        same host values); padding slots get a placeholder lr their
+        pass-through step never reads."""
+        from ..observability import record_superblock_donation
+
+        k = int(sb.counts.shape[0])
+        lrs = np.ones(k, np.float32)
+        lrs[:sb.n_blocks] = self._lr_schedule(sb.n_blocks)
+        l2w, l1w = self._penalty_weights()
+        w_bytes = int(np.prod(self._w.shape)) * 4
+        W, losses = _sgd_sb_scan(
+            self._w, sb.arrays[0], sb.arrays[1], sb.counts,
+            jnp.asarray(lrs), jnp.float32(self.alpha), jnp.float32(l2w),
+            jnp.float32(l1w),
+            jnp.float32(1.0 if self.fit_intercept else 0.0),
+            self._loss(), self._n_out(),
+        )
+        record_superblock_donation(w_bytes)
+        self._w = W
+        self._t += sb.n_blocks
+        self._last_loss = losses[sb.n_blocks - 1]
+
+    def _stream_pass(self, Xh, yh, block_rows, order=None, classes=None,
+                     shuffle=False, seed=None):
+        """One partial_fit pass over host data as super-block scans (the
+        Incremental wrapper's fused driver for host-resident X): block
+        ``order[j]`` is the j-th minibatch, identical updates and lr
+        clock to a per-block ``partial_fit`` loop over the same
+        partition. Returns False when the super-block path is
+        unavailable (opt-out, K == 1, sparse source) — the caller runs
+        its per-block loop instead."""
+        from ..parallel.streaming import BlockStream, _is_sparse_source
+
+        if _is_sparse_source(Xh):
+            return False
+        if classes is not None:
+            self._set_classes(np.asarray(classes))
+        if isinstance(self, ClassifierMixin) and \
+                getattr(self, "classes_", None) is None:
+            raise ValueError(
+                "classes must be passed on the first call to partial_fit."
+            )
+        Xh = np.asarray(Xh)
+        y_enc = np.asarray(self._encode_y(np.asarray(yh)))
+        stream = BlockStream((Xh, y_enc), block_rows=block_rows,
+                             shuffle=shuffle, seed=seed)
+        if stream.block_rows != int(block_rows):
+            # the stream rounds block_rows to a shard multiple; a caller
+            # partition it cannot reproduce must keep its own loop —
+            # training different minibatches would be a silent change
+            return False
+        if not stream.use_superblocks():
+            return False
+        self._ensure_state(Xh.shape[1])
+        for sb in stream.superblocks(order=order):
+            self._sb_step(sb)
+        self._last_stream_stats = getattr(stream, "stats", None)
+        self._publish(Xh.shape[1])
+        return True
+
     def _fit_device(self, X: ShardedArray, y, kwargs):
         """Epoch loop over DEVICE-resident blocks: each block is a sharded
         gather (take_rows) of the input — the (n, d) data never
@@ -652,9 +767,16 @@ class _SGDBase(BaseEstimator):
             shuffle=self.shuffle, seed=self.random_state,
         )
         self._ensure_state(Xh.shape[1])
-        for block in stream.epochs(self.max_iter):
-            Xb, yb = block.arrays
-            self._one_step(Xb, yb, block.mask, block.n_rows)
+        if stream.use_superblocks():
+            # super-block hot loop: one scan dispatch per K blocks with
+            # the weight carry donated (same minibatches, same shuffled
+            # order, same lr clock as the per-block loop below)
+            for sb in stream.superblock_epochs(self.max_iter):
+                self._sb_step(sb)
+        else:
+            for block in stream.epochs(self.max_iter):
+                Xb, yb = block.arrays
+                self._one_step(Xb, yb, block.mask, block.n_rows)
         # last pass's overlap accounting (host/put/wait vs compute) for
         # bench and diagnosis of transfer-bound fits
         self._last_stream_stats = getattr(stream, "stats", None)

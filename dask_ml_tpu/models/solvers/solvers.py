@@ -262,6 +262,16 @@ def _lbfgs_loop(loss, carry, stop_it, tol, memory, log, n_blocks=None):
     L-BFGS curvature state and line search see every block at once, so
     per-block paths differ even though the separable optimum is the
     same.) Callers surface it as the per-candidate ``n_iter``.
+
+    Each block's RETURNED iterate is frozen at its own convergence
+    point — its first iterate whose gradient norm passed tol, exactly
+    where a standalone solve of that block would have stopped. Blocks
+    the budget cut off return the final joint iterate, again matching
+    the standalone cap behavior. Without the freeze an early-converged
+    candidate kept refining inside the joint program; the drift is
+    below tol but was measured flipping razor-edge predictions, so the
+    stacked C-grid's scores disagreed with per-candidate fits on tied
+    candidates (the PR-1 tie-break parity failure).
     """
     opt = optax.lbfgs(memory_size=memory)
     value_and_grad = optax.value_and_grad_from_state(loss)
@@ -274,25 +284,42 @@ def _lbfgs_loop(loss, carry, stop_it, tol, memory, log, n_blocks=None):
     def body(carry):
         beta, state, _, it = carry[:4]
         value, grad = value_and_grad(beta, state=state)
+        if track:
+            conv, frozen, cmask = carry[4:]
+            # the gradient is evaluated at the CURRENT iterate: a block
+            # whose norm just passed tol converged AT this iterate —
+            # record it before the update moves on
+            norms = jnp.linalg.norm(grad.reshape(n_blocks, -1), axis=1)
+            frozen = jnp.where(cmask[:, None], frozen,
+                               beta.reshape(n_blocks, -1))
+            cmask = cmask | (norms <= tol)
         updates, state = opt.update(
             grad, state, beta, value=value, grad=grad, value_fn=loss
         )
         beta = optax.apply_updates(beta, updates)
         if track:
-            norms = jnp.linalg.norm(grad.reshape(n_blocks, -1), axis=1)
             gnorm = jnp.max(norms)
-            conv = jnp.where(norms > tol, it + 1, carry[4])
+            conv = jnp.where(norms > tol, it + 1, conv)
         else:
             gnorm = jnp.linalg.norm(grad)
         if log:  # static: the silent trace has no callback at all
             emit_jit_step(it, loss=value, grad_norm=gnorm)
         if track:
-            return beta, state, gnorm, it + 1, conv
+            return beta, state, gnorm, it + 1, conv, frozen, cmask
         return beta, state, gnorm, it + 1
 
     if track and len(carry) == 4:
-        carry = (*carry, jnp.zeros(n_blocks, jnp.int32))
-    return jax.lax.while_loop(cond, body, carry)
+        b0 = carry[0]
+        carry = (*carry, jnp.zeros(n_blocks, jnp.int32),
+                 b0.reshape(n_blocks, -1),
+                 jnp.zeros(n_blocks, jnp.bool_))
+    out = jax.lax.while_loop(cond, body, carry)
+    if track:
+        beta, state, gnorm, it, conv, frozen, cmask = out
+        merged = jnp.where(cmask[:, None], frozen,
+                           beta.reshape(n_blocks, -1)).reshape(beta.shape)
+        return merged, state, gnorm, it, conv
+    return out
 
 
 def _per_block_iters(conv, it_total):

@@ -230,6 +230,99 @@ def _block_admm_local_multi(X, y, mask, B, U, Z, rho, n_rows, local_iter,
 
 
 # ---------------------------------------------------------------------------
+# super-block scan kernels (ISSUE 3 tentpole): K stacked blocks consumed
+# by ONE jitted lax.scan whose accumulator carry is DONATED — one XLA
+# dispatch per K blocks, the accumulator buffers reused in place across
+# every dispatch of the pass, and no host round-trip inside the scan.
+# Per-step masks derive from the super-block's valid-row counts, so an
+# all-padding slot (the ragged final super-block) contributes exactly
+# zero to every sum — block-order accumulation is identical to the
+# per-block loop's.
+# ---------------------------------------------------------------------------
+
+import functools as _ft
+
+
+@_ft.lru_cache(maxsize=64)
+def _sb_reducer(kind, family, intercept, n_classes):
+    """The donated-carry super-block program for one objective flavor:
+    ``kind`` in {"val", "vg", "vgh"} lifts the matching per-block kernel
+    into a scan over the (K, S, ...) stacks, accumulating its sum tuple.
+    Cached per (kind, family, intercept, n_classes) so every pass reuses
+    ONE jitted callable (a fresh jax.jit per pass would retrace)."""
+    if n_classes:
+        fn = {"val": _block_val_multi, "vg": _block_val_grad_multi,
+              "vgh": _block_val_grad_hess_multi}[kind].__wrapped__
+        extra = (n_classes,)
+    else:
+        fn = {"val": _block_val, "vg": _block_val_grad,
+              "vgh": _block_val_grad_hess}[kind].__wrapped__
+        extra = ()
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def run(acc, beta, Xs, ys, counts):
+        unrolled = isinstance(Xs, (tuple, list))
+        r = jnp.arange(Xs[0].shape[0] if unrolled else Xs.shape[1])
+
+        def step(acc, Xb, yb, c):
+            mask = (r < c).astype(Xb.dtype)
+            out = fn(beta, Xb, yb, mask, family, intercept, *extra)
+            out = out if isinstance(out, tuple) else (out,)
+            return tuple(a + o for a, o in zip(acc, out))
+
+        if unrolled:  # CPU layout: same single program, no slice copies
+            for j in range(len(Xs)):
+                acc = step(acc, Xs[j], ys[j], counts[j])
+            return acc
+
+        def scan_step(acc, inp):
+            return step(acc, *inp), jnp.float32(0.0)
+
+        acc, _ = jax.lax.scan(scan_step, acc, (Xs, ys, counts))
+        return acc
+
+    return run
+
+
+@_ft.lru_cache(maxsize=32)
+def _sb_admm_local(local_iter, family, intercept, n_classes):
+    """Super-block ADMM block-local Newton: the K consensus members of
+    one super-block solve their independent local problems in ONE
+    vmapped dispatch (their (b, u) state slices ride in stacked; the
+    stacked B carry is donated). All-padding slots pass their b through
+    unchanged."""
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def run(Bk, Uk, Xs, ys, counts, z, rho, n_rows):
+        unrolled = isinstance(Xs, (tuple, list))
+        r = jnp.arange(Xs[0].shape[0] if unrolled else Xs.shape[1])
+
+        def one(b, u, X, y, c):
+            mask = (r < c).astype(X.dtype)
+            if n_classes:
+                Y = _codes_onehot(y, mask, n_classes)
+                nb = jax.vmap(
+                    lambda yc, bb, uu, zz: _admm_local_body(
+                        X, yc, mask, bb, uu, zz, rho, n_rows,
+                        local_iter, family, intercept,
+                    )
+                )(Y, b, u, z.reshape(n_classes, -1))
+            else:
+                nb = _admm_local_body(X, y, mask, b, u, z, rho, n_rows,
+                                      local_iter, family, intercept)
+            return jnp.where(c > 0, nb, b)
+
+        if unrolled:  # CPU layout: same single program, no slice copies
+            return jnp.stack([
+                one(Bk[j], Uk[j], Xs[j], ys[j], counts[j])
+                for j in range(len(Xs))
+            ])
+        return jax.vmap(one)(Bk, Uk, Xs, ys, counts)
+
+    return run
+
+
+# ---------------------------------------------------------------------------
 # streamed objective: one call = one pass over the stream
 # ---------------------------------------------------------------------------
 
@@ -282,16 +375,43 @@ class StreamedObjective:
         out = tuple(np.asarray(o, np.float32) for o in out)
         return out if len(out) > 1 else out[0]
 
+    def _sb_pass(self, kind, B, init):
+        """One super-block pass of the ``kind`` objective: the tuple of
+        accumulated sums, or None when the stream doesn't super-block
+        (no support, opt-out, sparse source, or K == 1) — the caller
+        then runs its per-block loop. The accumulator tuple is the
+        scan's DONATED carry: one dispatch per K blocks, its buffers
+        reused in place across the whole pass."""
+        s = self.stream
+        if not (hasattr(s, "use_superblocks") and s.use_superblocks()):
+            return None
+        from ...observability import record_superblock_donation
+
+        run = _sb_reducer(kind, self.family, self.intercept,
+                          self.n_classes or 0)
+        acc = init
+        acc_bytes = sum(4 * int(np.prod(a.shape) or 1) for a in acc)
+        for sb in s.superblocks():
+            acc = run(acc, B, sb.arrays[0], sb.arrays[1], sb.counts)
+            record_superblock_donation(acc_bytes)
+        return acc
+
     def value_and_grad(self, beta):
         self.passes += 1
         beta = jnp.asarray(beta, jnp.float32)
-        vs, gs = None, None
-        for blk in self.stream:
-            Xb, yb = blk.arrays
-            v, g = _block_val_grad(beta, Xb, yb, blk.mask, self.family,
-                                   self.intercept)
-            vs = v if vs is None else vs + v
-            gs = g if gs is None else gs + g
+        out = self._sb_pass("vg", beta, (
+            jnp.zeros((), jnp.float32), jnp.zeros_like(beta),
+        ))
+        if out is not None:
+            vs, gs = out
+        else:
+            vs, gs = None, None
+            for blk in self.stream:
+                Xb, yb = blk.arrays
+                v, g = _block_val_grad(beta, Xb, yb, blk.mask, self.family,
+                                       self.intercept)
+                vs = v if vs is None else vs + v
+                gs = g if gs is None else gs + g
         vs, gs = self._merge(vs, gs)
         val, grad = _finish_vg(vs, gs, beta, self.n_rows, self.lam,
                                self.pmask, self.l1_ratio, self.reg)
@@ -300,12 +420,16 @@ class StreamedObjective:
     def value(self, beta):
         self.passes += 1
         beta = jnp.asarray(beta, jnp.float32)
-        vs = None
-        for blk in self.stream:
-            Xb, yb = blk.arrays
-            v = _block_val(beta, Xb, yb, blk.mask, self.family,
-                           self.intercept)
-            vs = v if vs is None else vs + v
+        out = self._sb_pass("val", beta, (jnp.zeros((), jnp.float32),))
+        if out is not None:
+            vs, = out
+        else:
+            vs = None
+            for blk in self.stream:
+                Xb, yb = blk.arrays
+                v = _block_val(beta, Xb, yb, blk.mask, self.family,
+                               self.intercept)
+                vs = v if vs is None else vs + v
         vs = self._merge(vs)
         pen = regularizers.value(self.reg, beta, self.lam, self.pmask,
                                  self.l1_ratio)
@@ -314,14 +438,22 @@ class StreamedObjective:
     def value_and_grad_and_hess(self, beta):
         self.passes += 1
         beta = jnp.asarray(beta, jnp.float32)
-        vs, gs, hs = None, None, None
-        for blk in self.stream:
-            Xb, yb = blk.arrays
-            v, g, h = _block_val_grad_hess(beta, Xb, yb, blk.mask,
-                                           self.family, self.intercept)
-            vs = v if vs is None else vs + v
-            gs = g if gs is None else gs + g
-            hs = h if hs is None else hs + h
+        p = beta.shape[0]
+        out = self._sb_pass("vgh", beta, (
+            jnp.zeros((), jnp.float32), jnp.zeros_like(beta),
+            jnp.zeros((p, p), jnp.float32),
+        ))
+        if out is not None:
+            vs, gs, hs = out
+        else:
+            vs, gs, hs = None, None, None
+            for blk in self.stream:
+                Xb, yb = blk.arrays
+                v, g, h = _block_val_grad_hess(beta, Xb, yb, blk.mask,
+                                               self.family, self.intercept)
+                vs = v if vs is None else vs + v
+                gs = g if gs is None else gs + g
+                hs = h if hs is None else hs + h
         vs, gs, hs = self._merge(vs, gs, hs)
         val, grad = _finish_vg(vs, gs, beta, self.n_rows, self.lam,
                                self.pmask, self.l1_ratio, self.reg)
@@ -363,13 +495,20 @@ class MulticlassStreamedObjective(StreamedObjective):
     def value_and_grad(self, beta):
         self.passes += 1
         B = self._B(beta)
-        vs, gs = None, None
-        for blk in self.stream:
-            Xb, yb = blk.arrays
-            v, g = _block_val_grad_multi(B, Xb, yb, blk.mask, self.family,
-                                         self.intercept, self.n_classes)
-            vs = v if vs is None else vs + v
-            gs = g if gs is None else gs + g
+        out = self._sb_pass("vg", B, (
+            jnp.zeros((), jnp.float32), jnp.zeros_like(B),
+        ))
+        if out is not None:
+            vs, gs = out
+        else:
+            vs, gs = None, None
+            for blk in self.stream:
+                Xb, yb = blk.arrays
+                v, g = _block_val_grad_multi(B, Xb, yb, blk.mask,
+                                             self.family, self.intercept,
+                                             self.n_classes)
+                vs = v if vs is None else vs + v
+                gs = g if gs is None else gs + g
         vs, gs = self._merge(vs, gs)
         val, grad = _finish_vg(vs, jnp.asarray(gs).ravel(),
                                jnp.asarray(beta, jnp.float32),
@@ -380,12 +519,16 @@ class MulticlassStreamedObjective(StreamedObjective):
     def value(self, beta):
         self.passes += 1
         B = self._B(beta)
-        vs = None
-        for blk in self.stream:
-            Xb, yb = blk.arrays
-            v = _block_val_multi(B, Xb, yb, blk.mask, self.family,
-                                 self.intercept, self.n_classes)
-            vs = v if vs is None else vs + v
+        out = self._sb_pass("val", B, (jnp.zeros((), jnp.float32),))
+        if out is not None:
+            vs, = out
+        else:
+            vs = None
+            for blk in self.stream:
+                Xb, yb = blk.arrays
+                v = _block_val_multi(B, Xb, yb, blk.mask, self.family,
+                                     self.intercept, self.n_classes)
+                vs = v if vs is None else vs + v
         vs = self._merge(vs)
         pen = regularizers.value(self.reg, jnp.asarray(beta, jnp.float32),
                                  self.lam, self.pmask, self.l1_ratio)
@@ -394,16 +537,24 @@ class MulticlassStreamedObjective(StreamedObjective):
     def value_and_grad_and_hess(self, beta):
         self.passes += 1
         B = self._B(beta)
-        vs, gs, hs = None, None, None
-        for blk in self.stream:
-            Xb, yb = blk.arrays
-            v, g, h = _block_val_grad_hess_multi(
-                B, Xb, yb, blk.mask, self.family, self.intercept,
-                self.n_classes,
-            )
-            vs = v if vs is None else vs + v
-            gs = g if gs is None else gs + g
-            hs = h if hs is None else hs + h
+        p = B.shape[1]
+        out = self._sb_pass("vgh", B, (
+            jnp.zeros((), jnp.float32), jnp.zeros_like(B),
+            jnp.zeros((self.n_classes, p, p), jnp.float32),
+        ))
+        if out is not None:
+            vs, gs, hs = out
+        else:
+            vs, gs, hs = None, None, None
+            for blk in self.stream:
+                Xb, yb = blk.arrays
+                v, g, h = _block_val_grad_hess_multi(
+                    B, Xb, yb, blk.mask, self.family, self.intercept,
+                    self.n_classes,
+                )
+                vs = v if vs is None else vs + v
+                gs = g if gs is None else gs + g
+                hs = h if hs is None else hs + h
         vs, gs, hs = self._merge(vs, gs, hs)
         val, grad = _finish_vg(vs, jnp.asarray(gs).ravel(),
                                jnp.asarray(beta, jnp.float32),
@@ -610,26 +761,62 @@ def admm(obj: StreamedObjective, beta0, max_iter=250, tol=1e-4, rho=1.0,
     n_iter = 0
     primal = dual = np.inf
     C = obj.n_classes
+    s = obj.stream
+    use_sb = hasattr(s, "use_superblocks") and s.use_superblocks()
     for it in range(int(max_iter)):
         obj.passes += 1
         bi = 0
-        for blk in obj.stream:
-            Xb, yb = blk.arrays
-            if C:
-                # one block read serves all C consensus problems
-                B[bi] = np.asarray(_block_admm_local_multi(
-                    Xb, yb, blk.mask, jnp.asarray(B[bi]).reshape(C, -1),
-                    jnp.asarray(U[bi]).reshape(C, -1), z.reshape(C, -1),
-                    jnp.float32(rho_f), jnp.float32(obj.n_rows), local_iter,
-                    obj.family, obj.intercept, C,
-                )).ravel()
-            else:
-                B[bi] = np.asarray(_block_admm_local(
-                    Xb, yb, blk.mask, jnp.asarray(B[bi]), jnp.asarray(U[bi]),
-                    z, jnp.float32(rho_f), jnp.float32(obj.n_rows),
-                    local_iter, obj.family, obj.intercept,
-                ))
-            bi += 1
+        if use_sb:
+            # one dispatch advances the K consensus members of each
+            # super-block (GLM local-Newton, vmapped over the stack;
+            # stacked-B carry donated)
+            from ...observability import record_superblock_donation
+
+            runner = _sb_admm_local(int(local_iter), obj.family,
+                                    obj.intercept, C or 0)
+            for sb in s.superblocks():
+                k = int(sb.counts.shape[0])
+                kr = sb.n_blocks
+                Bk = np.zeros((k, d), np.float32)
+                Uk = np.zeros((k, d), np.float32)
+                Bk[:kr] = B[bi:bi + kr]
+                Uk[:kr] = U[bi:bi + kr]
+                if C:
+                    out = runner(
+                        jnp.asarray(Bk).reshape(k, C, -1),
+                        jnp.asarray(Uk).reshape(k, C, -1),
+                        sb.arrays[0], sb.arrays[1], sb.counts, z.ravel(),
+                        jnp.float32(rho_f), jnp.float32(obj.n_rows),
+                    )
+                    B[bi:bi + kr] = np.asarray(out).reshape(k, -1)[:kr]
+                else:
+                    out = runner(
+                        jnp.asarray(Bk), jnp.asarray(Uk), sb.arrays[0],
+                        sb.arrays[1], sb.counts, z,
+                        jnp.float32(rho_f), jnp.float32(obj.n_rows),
+                    )
+                    B[bi:bi + kr] = np.asarray(out)[:kr]
+                record_superblock_donation(Bk.nbytes)
+                bi += kr
+        else:
+            for blk in obj.stream:
+                Xb, yb = blk.arrays
+                if C:
+                    # one block read serves all C consensus problems
+                    B[bi] = np.asarray(_block_admm_local_multi(
+                        Xb, yb, blk.mask, jnp.asarray(B[bi]).reshape(C, -1),
+                        jnp.asarray(U[bi]).reshape(C, -1), z.reshape(C, -1),
+                        jnp.float32(rho_f), jnp.float32(obj.n_rows),
+                        local_iter, obj.family, obj.intercept, C,
+                    )).ravel()
+                else:
+                    B[bi] = np.asarray(_block_admm_local(
+                        Xb, yb, blk.mask, jnp.asarray(B[bi]),
+                        jnp.asarray(U[bi]), z, jnp.float32(rho_f),
+                        jnp.float32(obj.n_rows), local_iter, obj.family,
+                        obj.intercept,
+                    ))
+                bi += 1
         bu_sum, = (reduce(np.asarray((B + U).sum(axis=0), np.float64)),)
         bu_mean = jnp.asarray(np.asarray(bu_sum, np.float32) / glob_blocks)
         z_new = regularizers.prox(reg, bu_mean, lam,

@@ -37,6 +37,8 @@ from ._counters import (
     record_serving_batch,
     record_serving_drop,
     record_serving_request,
+    record_superblock,
+    record_superblock_donation,
     record_transfer,
 )
 from ._metrics import (
@@ -80,6 +82,8 @@ __all__ = [
     "record_serving_batch",
     "record_serving_drop",
     "record_serving_request",
+    "record_superblock",
+    "record_superblock_donation",
     "record_transfer",
     "reset_jit_callbacks_probe",
     "span",

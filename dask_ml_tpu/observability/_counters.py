@@ -68,6 +68,25 @@ def record_donation(nbytes: int) -> None:
         counter_add("donated_buffers_reused", 1)
 
 
+def record_superblock(n_blocks: int) -> None:
+    """One super-block dispatch covering ``n_blocks`` real streamed
+    blocks — superblock_blocks / superblock_dispatches is the measured
+    dispatch amortization (≈K); a pass's dispatches_per_pass lives on
+    its ``streaming.superblock`` span record."""
+    if counters_enabled():
+        counter_add("superblock_dispatches", 1)
+        counter_add("superblock_blocks", int(n_blocks))
+
+
+def record_superblock_donation(nbytes: int) -> None:
+    """A super-block scan's donated carry was handed back to XLA for
+    in-place reuse (the accumulator/weights buffer never reallocates
+    across the pass's dispatches)."""
+    if counters_enabled():
+        counter_add("superblock_donated_bytes", int(nbytes))
+        counter_add("superblock_donations", 1)
+
+
 # -- serving -----------------------------------------------------------------
 # the online-inference registry slice (dask_ml_tpu/serving): admitted
 # work, batching efficiency, and backpressure outcomes. Kept here so the

@@ -126,7 +126,10 @@ def summarize_components(records):
 
 def summarize_stream(records):
     """Streaming-pass overlap totals (from BlockStream's per-pass
-    records): the double-buffer health check."""
+    records): the double-buffer health check, plus the super-block
+    dispatch amortization — a per-block pass costs one dispatch per
+    block, a super-block pass one per K blocks, so dispatches/blocks
+    shows the measured collapse."""
     passes = [r for r in records if "stream_pass" in r]
     if not passes:
         return None
@@ -134,6 +137,13 @@ def summarize_stream(records):
            for k in ("host_s", "put_s", "wait_s", "consume_s", "pass_s")}
     tot["n_passes"] = len(passes)
     tot["n_blocks"] = sum(int(p.get("n_blocks", 0)) for p in passes)
+    # per-block passes dispatch once per block; super-block passes
+    # record their own (smaller) dispatch count
+    tot["dispatches"] = sum(
+        int(p.get("dispatches", p.get("n_blocks", 0))) for p in passes
+    )
+    sb = [int(p["superblock_k"]) for p in passes if p.get("superblock_k")]
+    tot["superblock_k"] = max(sb) if sb else 1
     return tot
 
 
@@ -178,8 +188,10 @@ def build_report(records, path="<records>"):
     if st:
         lines += _table(
             "streaming overlap",
-            ("passes", "blocks", "host", "put", "wait", "consume"),
-            [(st["n_passes"], st["n_blocks"], _fmt_seconds(st["host_s"]),
+            ("passes", "blocks", "dispatches", "sb_k", "host", "put",
+             "wait", "consume"),
+            [(st["n_passes"], st["n_blocks"], st["dispatches"],
+              st["superblock_k"], _fmt_seconds(st["host_s"]),
               _fmt_seconds(st["put_s"]), _fmt_seconds(st["wait_s"]),
               _fmt_seconds(st["consume_s"]))],
         )

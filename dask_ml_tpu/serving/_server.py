@@ -203,7 +203,16 @@ class ModelServer:
         """Compile every (method, bucket) program now, before traffic:
         one call per rung per method through the real entry point. After
         this, a workload whose batches stay on the ladder triggers zero
-        new XLA compiles."""
+        new XLA compiles.
+
+        With ``config.compile_cache_dir`` set, these compiles also land
+        in jax's persistent compilation cache: warmup still walks the
+        full (method, bucket) grid, but a later process serving the same
+        model shapes replays each program from disk instead of paying
+        XLA again — cold-start warmup cost becomes mostly cache reads."""
+        from ..config import ensure_compile_cache
+
+        ensure_compile_cache()
         for method, fn in self._fns.items():
             if not fn.jitted:
                 continue   # host fallback: nothing to compile

@@ -519,9 +519,22 @@ class Incremental(ParallelPostFit):
         Xh = _host_matrix(X)
         yh = y.to_numpy() if isinstance(y, ShardedArray) else np.asarray(y)
         starts = list(range(0, Xh.shape[0], block_size))
+        order = np.arange(len(starts))
         if self.shuffle_blocks:
-            rng.shuffle(starts)
-        for s in starts:
+            rng.shuffle(order)
+        if (_is_device_estimator(est) and hasattr(est, "_stream_pass")
+                and set(fit_kwargs) <= {"classes"}):
+            # super-block fast path for device estimators on host data:
+            # the pass's per-block partial_fit dispatches collapse into
+            # donated-carry scans over K-stacked blocks — identical
+            # minibatches, order, and lr clock. Returns False (sparse
+            # source, K == 1 opt-out, partition mismatch) -> the
+            # per-block loop below.
+            if est._stream_pass(Xh, yh, block_size, order=order,
+                                classes=fit_kwargs.get("classes")):
+                return est
+        for oi in order:
+            s = starts[int(oi)]
             est.partial_fit(Xh[s:s + block_size], yh[s:s + block_size],
                             **fit_kwargs)
         return est

@@ -1,0 +1,88 @@
+"""Perf smoke gate for the super-block streaming hot loop (ISSUE 3).
+
+Runs a scaled-down version of bench.py's streamed-SGD section and fails
+(exit 1) when the dispatch-collapse contract regresses:
+
+- ``dispatches_per_pass`` must not exceed ceil(n_blocks / superblock_k)
+  + 1 — the whole point of super-block execution is one XLA dispatch
+  per K blocks, so a pass that dispatches per block again is a
+  regression even if it still passes the numeric tests;
+- after the first pass has warmed the compile caches, later passes must
+  pay ZERO new XLA compiles — a shape wobble (ragged tail leaking into
+  the compiled signature, ring buffers changing layout) shows up here
+  long before it shows up as a throughput number.
+
+Kept small (~64k rows) so verify.sh stays fast; bench.py carries the
+full-size throughput numbers.
+"""
+
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    from dask_ml_tpu import config
+    from dask_ml_tpu import observability as obs
+    from dask_ml_tpu.models.sgd import SGDClassifier
+    from dask_ml_tpu.parallel.streaming import BlockStream
+
+    n, d = 64_000, 32
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+
+    failures = []
+    with config.set(stream_block_rows=n // 32, stream_autotune=False):
+        stream = BlockStream((X, y), block_rows=n // 32)
+        k = stream.resolve_superblock_k()
+        n_blocks = stream.n_blocks
+        if k <= 1:
+            failures.append(
+                f"super-block execution is off (resolved K={k}); the "
+                "streamed hot loop is dispatching per block"
+            )
+        # pass 1: warmup (compiles the scan at the steady-state shapes)
+        SGDClassifier(max_iter=1, random_state=0, shuffle=False).fit(X, y)
+        obs.counters_reset()
+        clf = SGDClassifier(max_iter=2, random_state=0, shuffle=False)
+        clf.fit(X, y)
+        snap = obs.counters_snapshot()
+        st = dict(getattr(clf, "_last_stream_stats", None) or {})
+
+    budget = math.ceil(n_blocks / max(k, 1)) + 1
+    dpp = st.get("dispatches_per_pass")
+    if dpp is None:
+        failures.append("no dispatches_per_pass in stream stats — the "
+                        "fit did not take the super-block path")
+    elif dpp > budget:
+        failures.append(
+            f"dispatches_per_pass={dpp} exceeds ceil({n_blocks}/{k})+1="
+            f"{budget}"
+        )
+    recompiles = snap.get("recompiles", 0)
+    if recompiles > 0:
+        failures.append(
+            f"{recompiles} new XLA compiles AFTER the first pass — "
+            "steady-state streaming must hit only warm compile caches"
+        )
+    if snap.get("superblock_dispatches", 0) <= 0:
+        failures.append("superblock_dispatches counter never moved")
+
+    print(f"perf smoke: n_blocks={n_blocks} K={k} "
+          f"dispatches_per_pass={dpp} (budget {budget}) "
+          f"recompiles_after_pass1={recompiles}")
+    if failures:
+        for f in failures:
+            print(f"PERF SMOKE FAIL: {f}", file=sys.stderr)
+        return 1
+    print("perf smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

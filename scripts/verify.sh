@@ -36,6 +36,15 @@ if [ "${1:-}" = "--lint" ]; then
     exit 0
 fi
 
+# -- perf smoke: super-block dispatch collapse (ISSUE 3) ---------------------
+# streamed-SGD at smoke scale: fails when dispatches_per_pass exceeds
+# ceil(n_blocks / superblock_k) + 1 or when passes after the first pay
+# any new XLA compiles — the regressions throughput numbers hide.
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/perf_smoke.py; then
+    echo "VERIFY FAIL: super-block perf smoke"
+    exit 1
+fi
+
 # -- serving suite (fast, targeted): the online-inference subsystem gates
 # the same as lint — a broken server should fail verify in ~1min, before
 # the full tier-1 wait. timeout-wrapped like tier-1: a hung serving

@@ -47,8 +47,11 @@ def test_logistic_l1_sparsity(xy_classification):
     clf = LogisticRegression(
         solver="proximal_grad", penalty="l1", C=0.01, max_iter=2000, tol=1e-9
     ).fit(X, y)
+    # penalty="l1" must be explicit: modern sklearn IGNORES l1_ratio
+    # under the default penalty="l2" (with only a warning), silently
+    # turning the oracle into a dense L2 fit
     ref = sklm.LogisticRegression(
-        l1_ratio=1.0, C=0.01, solver="saga", max_iter=5000, tol=1e-10
+        penalty="l1", C=0.01, solver="saga", max_iter=5000, tol=1e-10
     ).fit(X, y)
     np.testing.assert_allclose(ours_zero := (np.abs(clf.coef_) < 1e-6),
                                np.abs(ref.coef_) < 1e-6)
@@ -60,8 +63,9 @@ def test_logistic_admm_l1(xy_classification):
     clf = LogisticRegression(
         solver="admm", penalty="l1", C=0.01, max_iter=400, tol=1e-5
     ).fit(X, y)
+    # explicit penalty="l1" — see test_logistic_l1_sparsity
     ref = sklm.LogisticRegression(
-        l1_ratio=1.0, C=0.01, solver="saga", max_iter=5000, tol=1e-10
+        penalty="l1", C=0.01, solver="saga", max_iter=5000, tol=1e-10
     ).fit(X, y)
     np.testing.assert_allclose(clf.coef_, ref.coef_, atol=0.03)
 

@@ -403,10 +403,15 @@ def test_streamed_fit_nests_pass_spans_under_fit(tmp_path):
         LinearRegression(solver="gradient_descent", max_iter=3).fit(X, y)
     recs = _read_jsonl(p)
     fits = [r for r in recs if r.get("span") == "fit"]
-    passes = [r for r in recs if r.get("span") == "stream.pass"]
+    # per-block passes trace stream.pass; super-block passes (the
+    # default when K > 1) trace streaming.superblock — both are
+    # stream_pass-keyed pass records nested under the fit
+    passes = [r for r in recs
+              if r.get("span") in ("stream.pass", "streaming.superblock")]
     assert len(fits) == 1 and fits[0]["streamed"] is True
-    assert passes, "streamed fit must trace stream.pass spans"
+    assert passes, "streamed fit must trace stream pass spans"
     assert all(r["parent_id"] == fits[0]["span_id"] for r in passes)
+    assert all("stream_pass" in r for r in passes)
 
 
 def test_search_round_spans_and_trial_tags(tmp_path):
