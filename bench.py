@@ -94,59 +94,42 @@ def _init_backend():
     return jax, jax.default_backend()
 
 
-# bf16 datasheet peaks per chip (TFLOP/s) by device_kind substring. The
-# MXU runs f32-input matmuls at bf16-pass rate under default precision,
-# so the bf16 peak is the honest denominator for BOTH dtypes (using it
-# for f32 yields a conservative MFU, never an inflated one).
-_DATASHEET_PEAKS = {
-    "v6": 918e12,       # Trillium / v6e
-    "v5p": 459e12,
-    "v5 lite": 197e12,  # v5e reports device_kind "TPU v5 lite"
-    "v5e": 197e12,
-    "v4": 275e12,
-    "v3": 123e12,
-    "v2": 45e12,
-}
+# the peak-FLOPs table moved to dask_ml_tpu/observability/_peak.py so
+# the report CLI's MEASURED per-span MFU and these analytic MFU numbers
+# divide by the same denominator; bench's hand-written model_flops
+# formulas are now the cross-check against the program registry's
+# XLA-measured cost_analysis FLOPs, not the only source. Imported lazily:
+# dask_ml_tpu imports jax, which must not happen before the CPU-forcing
+# logic in _init_backend.
 
 
-def _resolve_peak(jax, backend):
+def _resolve_peak():
     """Per-chip peak matmul FLOP/s: datasheet when the device_kind is
     known, else MEASURED with a large square matmul (the only honest
     option on CPU fallback — VERDICT r3 #2 wants MFU 'vs CPU peak on
-    fallback')."""
-    kind = getattr(jax.devices()[0], "device_kind", backend) or backend
-    if backend == "tpu":
-        for sub, peak in _DATASHEET_PEAKS.items():
-            if sub in kind.lower():
-                return {"flops": peak, "source": "datasheet",
-                        "device_kind": kind}
-    import jax.numpy as jnp
+    fallback'). Delegates to observability/_peak.py, which derives the
+    backend itself."""
+    from dask_ml_tpu.observability._peak import resolve_peak
 
-    m = 4096 if backend == "tpu" else 1024
-    a = jnp.ones((m, m), jnp.bfloat16 if backend == "tpu" else jnp.float32)
-    f = jax.jit(lambda x: x @ x)
-    jax.block_until_ready(f(a))  # compile
-    reps = 3
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        a = jax.block_until_ready(f(a))
-    dt = time.perf_counter() - t0
-    return {"flops": 2.0 * m ** 3 * reps / dt, "source": "measured",
-            "device_kind": kind}
+    return resolve_peak()
 
 
 def _mfu_fields(model_flops, elapsed, n_chips, peak):
-    """Achieved model FLOP/s and MFU vs per-chip peak (absolute perf
-    measures; model_flops counts the algorithm's useful matmul FLOPs)."""
-    fps = model_flops / elapsed
-    return {
-        "model_flops": round(model_flops),
-        "model_flop_per_s": round(fps, 1),
-        "mfu": round(fps / (peak["flops"] * n_chips), 5),
-        "peak": {"flop_per_s_per_chip": round(peak["flops"], 1),
-                 "source": peak["source"],
-                 "device_kind": peak["device_kind"]},
-    }
+    """Achieved model FLOP/s and MFU vs per-chip peak (analytic
+    model_flops; see observability/_peak.py)."""
+    from dask_ml_tpu.observability._peak import mfu_fields
+
+    return mfu_fields(model_flops, elapsed, n_chips, peak)
+
+
+def _print_stall(rec):
+    """Watchdog stall dump -> stderr (the JSON stdout line must stay
+    clean): the stalled span plus its thread's stack — the diagnostics
+    the wedged-tunnel rounds never had."""
+    lines = [f"bench watchdog: span {rec.get('span')!r} open "
+             f"{rec.get('age_s')}s on thread {rec.get('thread')!r}"]
+    lines.extend(rec.get("stalled_stack", [])[-8:])
+    sys.stderr.write("\n".join(lines) + "\n")
 
 
 def run():
@@ -154,6 +137,19 @@ def run():
     import jax.numpy as jnp
 
     import dask_ml_tpu  # noqa: F401
+
+    # span-level stall watchdog (observability/_watchdog.py): any span
+    # (fit, stream pass, serving batch) open past the deadline dumps
+    # all-thread tracebacks + device memory gauges to stderr while the
+    # bench keeps running — the in-flight diagnostics the deadline
+    # watchdogs above (which only bound TOTAL time) cannot give. Daemon
+    # thread; dies with the child.
+    from dask_ml_tpu.observability import Watchdog
+
+    Watchdog(
+        float(os.environ.get("BENCH_WATCHDOG_TIMEOUT", "120")),
+        on_stall=_print_stall,
+    ).start()
     from dask_ml_tpu.linear_model import LogisticRegression
     from dask_ml_tpu.parallel import as_sharded
 
@@ -203,10 +199,23 @@ def run():
         os.path.dirname(os.path.abspath(__file__)), "BENCH_metrics.jsonl"
     )
     open(metrics_file, "w").close()  # fresh file per bench run
-    with config.set(dtype=dtype, metrics_path=metrics_file):
+    # program tracking ON for the traceability fit only: the recorded
+    # JSONL carries per-program compile/FLOP/HBM attribution (and the
+    # fit span a ctr_program_flops delta -> measured MFU in the report
+    # CLI) as a cross-check of the analytic logreg_flops below. The
+    # TIMED fit above ran without it — the registry's analysis pass
+    # costs one extra AOT compile per program.
+    from dask_ml_tpu.observability import (MetricsLogger, log_programs,
+                                           programs_reset)
+
+    programs_reset()
+    with config.set(dtype=dtype, metrics_path=metrics_file,
+                    obs_programs=True):
         LogisticRegression(solver="lbfgs", max_iter=10, tol=0.0).fit(Xs, ys)
+        with MetricsLogger(metrics_file) as _lg:
+            log_programs(_lg)
     value = n_rows * iters / elapsed / n_chips
-    peak = _resolve_peak(jax, backend)
+    peak = _resolve_peak()
     # lbfgs data pass: eta = X@beta (2nd) + grad = X.T@resid (2nd) per
     # counted iteration; line-search passes uncounted (consistent with
     # the samples metric, so mfu undercounts like it does)

@@ -58,6 +58,19 @@ class Config:
     # buffer reuse) — cheap host-side adds; disable to make every
     # counter call site a single config lookup
     obs_counters: bool = True
+    # compiled-program registry (observability/_programs.py): tracked jit
+    # entry points record compile time + XLA cost/memory analysis per
+    # program and feed the `program_flops` counter spans read for
+    # measured MFU. Opt-in: the analysis pass re-lowers each program once
+    # per fresh compile (an extra, in-memory-cached XLA compile that also
+    # shows up in the recompiles counter), so steady-state zero-recompile
+    # contracts keep it off by default
+    obs_programs: bool = False
+    # slow-span watchdog (observability/_watchdog.py): any span open past
+    # this many seconds dumps all-thread tracebacks + device memory
+    # gauges + the open-span stack to the trace sink, without touching
+    # the fit. 0 = disabled (no thread, nothing armed)
+    watchdog_timeout_s: float = 0.0
     # checkpoint directory for adaptive searches ("" = disabled)
     checkpoint_dir: str = ""
     # -- serving (dask_ml_tpu/serving/) ----------------------------------

@@ -40,9 +40,10 @@ from ..utils.validation import check_array, check_is_fitted
 
 # -- jitted kernels ---------------------------------------------------------
 
-from ..observability import emit_jit_step, span
+from ..observability import emit_jit_step, span, track_program
 
 
+@track_program("kmeans.lloyd")
 @partial(jax.jit, static_argnames=("log", "mxu_dtype"))
 def _lloyd_run(X, mask, centers0, max_iter, tol2, log=False,
                mxu_dtype=None):
@@ -80,6 +81,7 @@ def _lloyd_run(X, mask, centers0, max_iter, tol2, log=False,
     return centers, it, shift2
 
 
+@track_program("kmeans.lloyd_pallas")
 @partial(jax.jit, static_argnames=("mesh", "interpret", "log"))
 def _lloyd_run_pallas(X, mask, centers0, max_iter, tol2, mesh,
                       interpret=False, log=False):
@@ -131,6 +133,7 @@ def _lloyd_run_pallas(X, mask, centers0, max_iter, tol2, mesh,
     return centers, it, shift2
 
 
+@track_program("kmeans.labels_inertia")
 @jax.jit
 def _labels_inertia(X, mask, centers):
     d2 = euclidean_distances_sq(X, centers)
@@ -176,6 +179,7 @@ def _candidate_weights(X, mask, cands, cand_valid):
 # The reference's analog IS its normal mode: per-chunk tasks +
 # tree-reduce (SURVEY.md §3.1). One Lloyd iteration = one pass.
 
+@track_program("kmeans.stream.block_assign")
 @partial(jax.jit, static_argnames=("mxu_dtype",))
 def _block_assign_stats(X, mask, centers, mxu_dtype=None):
     """(Σ_block x per label, count per label, Σ_block min-dist²).
@@ -196,6 +200,7 @@ def _block_moments(X, mask):
         jnp.tensordot(mask, X * X, axes=(0, 0))
 
 
+@track_program("superblock.kmeans_assign")
 @partial(jax.jit, static_argnames=("mxu_dtype",), donate_argnums=(0,))
 def _sb_assign_stats(acc, Xs, counts, centers, mxu_dtype=None):
     """Super-block Lloyd pass (ISSUE 3): scan the (K, S, d) stack

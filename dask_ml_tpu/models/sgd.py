@@ -32,6 +32,7 @@ import numpy as np
 
 from ..base import BaseEstimator, ClassifierMixin, RegressorMixin, to_host
 from ..metrics import accuracy_score, r2_score
+from ..observability import track_program
 from ..parallel.sharded import ShardedArray, as_sharded
 from ..utils.validation import check_is_fitted
 
@@ -74,6 +75,7 @@ def _sgd_update_one(w, y, X, mask, n_valid, lr, alpha, l2w, l1w, iflag,
     return w.at[:-1].set(coef), val
 
 
+@track_program("sgd.step_many")
 @partial(jax.jit, static_argnames=("loss",))
 def _sgd_step_many(X, y, mask, n_valid, W, lrs, alphas, l2_ws, l1_ws,
                    int_flags, loss):
@@ -91,6 +93,7 @@ def _sgd_step_many(X, y, mask, n_valid, W, lrs, alphas, l2_ws, l1_ws,
     )
 
 
+@track_program("sgd.step_multi")
 @partial(jax.jit, static_argnames=("loss",))
 def _sgd_step_multi(X, y_codes, mask, n_valid, W, lr, alpha, l2w, l1w,
                     iflag, loss):
@@ -108,6 +111,7 @@ def _sgd_step_multi(X, y_codes, mask, n_valid, W, lr, alpha, l2w, l1w,
     return jax.vmap(one)(W, jnp.arange(W.shape[0], dtype=jnp.float32))
 
 
+@track_program("superblock.sgd_scan")
 @partial(jax.jit, static_argnames=("loss", "n_out"), donate_argnums=(0,))
 def _sgd_sb_scan(W, Xs, ys, counts, lrs, alpha, l2w, l1w, iflag, loss,
                  n_out):
@@ -160,6 +164,7 @@ def _sgd_sb_scan(W, Xs, ys, counts, lrs, alpha, l2w, l1w, iflag, loss,
     return jax.lax.scan(scan_step, W, (Xs, ys, counts, lrs))
 
 
+@track_program("sgd.fused_epoch")
 @partial(jax.jit, static_argnames=("loss", "schedule", "n_out"))
 def _sgd_epoch(Xr, yr, order, W, t0, eta0, power_t, alpha, l2w, l1w,
                iflag, n_rows, loss, schedule, n_out):
@@ -210,6 +215,7 @@ def _sgd_epoch(Xr, yr, order, W, t0, eta0, power_t, alpha, l2w, l1w,
     return W, t
 
 
+@track_program("sgd.cohort_scan")
 @partial(jax.jit, static_argnames=("loss",))
 def _sgd_cohort_scan(Xr, yr, NV, order, W, LRS, alphas, l2ws, l1ws,
                      iflags, loss):

@@ -31,7 +31,7 @@ import optax
 from jax.sharding import PartitionSpec as P
 
 from ...parallel.mesh import DATA_AXIS
-from ...observability import emit_jit_step
+from ...observability import emit_jit_step, track_program
 from ..solvers import regularizers
 from ..solvers.families import get_family
 from ...ops.linalg import shard_map
@@ -230,6 +230,7 @@ def _check_smooth(reg, solver):
 # L-BFGS (optax, zoom linesearch) — whole optimization in one XLA program
 # --------------------------------------------------------------------------
 
+@track_program("glm.lbfgs")
 @partial(jax.jit, static_argnames=("family", "reg", "memory", "log",
                                    "use_pallas", "mesh", "interpret"))
 def _lbfgs_chunk(X, y, mask, n_rows, carry, lam, pmask, l1_ratio, stop_it,
@@ -332,6 +333,7 @@ def _per_block_iters(conv, it_total):
     return np.minimum(c, int(it_total))
 
 
+@track_program("glm.lbfgs_multi_pallas")
 @partial(jax.jit, static_argnames=("family", "reg", "memory", "log",
                                    "mesh", "interpret", "n_classes"))
 def _lbfgs_multi_pallas_chunk(X, codes, mask, n_rows, carry, lam, pmask_t,
@@ -431,6 +433,7 @@ def lbfgs(X, y, mask, n_rows, beta0, family, reg, lam, pmask, l1_ratio=0.5,
 # Gradient descent with Armijo backtracking (dask_glm::gradient_descent)
 # --------------------------------------------------------------------------
 
+@track_program("glm.gradient_descent")
 @partial(jax.jit, static_argnames=("family", "reg", "log", "use_pallas",
                                    "mesh", "interpret"))
 def _gd_run(X, y, mask, n_rows, beta0, lam, pmask, l1_ratio, max_iter, tol,
@@ -493,6 +496,7 @@ def gradient_descent(X, y, mask, n_rows, beta0, family, reg, lam, pmask,
 # non-smooth penalties via regularizers.prox
 # --------------------------------------------------------------------------
 
+@track_program("glm.proximal_grad")
 @partial(jax.jit, static_argnames=("family", "reg", "log", "use_pallas",
                                    "mesh", "interpret"))
 def _pg_run(X, y, mask, n_rows, beta0, lam, pmask, l1_ratio, max_iter, tol,
@@ -560,6 +564,7 @@ def proximal_grad(X, y, mask, n_rows, beta0, family, reg, lam, pmask,
 # Newton (dask_glm::newton) with step-halving safeguard, fully on device
 # --------------------------------------------------------------------------
 
+@track_program("glm.newton")
 @partial(jax.jit, static_argnames=("family", "reg", "log", "use_pallas",
                                    "mesh", "interpret"))
 def _newton_run(X, y, mask, n_rows, beta0, lam, pmask, l1_ratio, max_iter, tol,
@@ -661,6 +666,7 @@ def newton(X, y, mask, n_rows, beta0, family, reg, lam, pmask, l1_ratio=0.5,
 # reference pays a gather-to-client + broadcast over TCP.
 # --------------------------------------------------------------------------
 
+@track_program("glm.admm")
 @partial(jax.jit, static_argnames=("family", "reg", "local_iter", "mesh",
                                    "log"))
 def _admm_run(X, y, mask, n_rows, B, U, z, lam, pmask, l1_ratio, rho,
@@ -896,6 +902,7 @@ def solve_multi(solver, X, Y, mask, n_rows, B0, family, reg, lam, pmask,
                              "n_iter_per_class": [int(i) for i in iters]}
 
 
+@track_program("glm.lbfgs_multi")
 @partial(jax.jit, static_argnames=("family", "reg", "C", "memory"))
 def _multi_stacked_chunk(X, Y, mask, n_rows, carry, lam, pmask, l1_ratio,
                          stop_it, tol, family, reg, C, memory=10):
@@ -924,6 +931,7 @@ def _multi_stacked_chunk(X, Y, mask, n_rows, carry, lam, pmask, l1_ratio,
                        n_blocks=C)
 
 
+@track_program("glm.lbfgs_lam_grid")
 @partial(jax.jit, static_argnames=("family", "reg", "k", "memory"))
 def _lam_grid_chunk(X, y, mask, n_rows, carry, lams, pmask, stop_it, tol,
                     family, reg, k, memory=10):
@@ -955,6 +963,7 @@ def _lam_grid_chunk(X, y, mask, n_rows, carry, lams, pmask, stop_it, tol,
                        n_blocks=k)
 
 
+@track_program("glm.lbfgs_lam_grid_multi")
 @partial(jax.jit, static_argnames=("family", "reg", "k", "C", "memory"))
 def _lam_grid_multi_chunk(X, Y, mask, n_rows, carry, lams, pmask, stop_it,
                           tol, family, reg, k, C, memory=10):

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...observability import track_program
 from . import regularizers
 from .families import get_family
 
@@ -42,6 +43,7 @@ from .families import get_family
 # ≈ (prefetch + 1) blocks.
 # ---------------------------------------------------------------------------
 
+@track_program("glm.stream.block_vg")
 @partial(jax.jit, static_argnames=("family", "intercept"))
 def _block_val_grad(beta, X, y, mask, family, intercept):
     """(Σ pointwise-NLL, Σ ∂NLL/∂β) over one block's valid rows."""
@@ -54,6 +56,7 @@ def _block_val_grad(beta, X, y, mask, family, intercept):
     return jax.value_and_grad(f)(beta)
 
 
+@track_program("glm.stream.block_val")
 @partial(jax.jit, static_argnames=("family", "intercept"))
 def _block_val(beta, X, y, mask, family, intercept):
     """Forward-only Σ pointwise-NLL — line-search/step-halving trials that
@@ -63,6 +66,7 @@ def _block_val(beta, X, y, mask, family, intercept):
     return jnp.sum(get_family(family).pointwise(eta, y) * mask)
 
 
+@track_program("glm.stream.block_vgh")
 @partial(jax.jit, static_argnames=("family", "intercept"))
 def _block_val_grad_hess(beta, X, y, mask, family, intercept):
     """One fused pass: (Σ NLL, Σ grad, Σ Xᵀ W X) for Newton."""
@@ -117,6 +121,7 @@ def _codes_onehot(y, mask, n_classes):
     return onehot_targets(y, mask, jnp.arange(n_classes, dtype=y.dtype))
 
 
+@track_program("glm.stream.block_vg_multi")
 @partial(jax.jit, static_argnames=("family", "intercept", "n_classes"))
 def _block_val_grad_multi(Beta, X, y, mask, family, intercept, n_classes):
     """(Σ_total NLL over classes+rows, ∂/∂Beta (C, d)) for one block.
@@ -135,6 +140,7 @@ def _block_val_grad_multi(Beta, X, y, mask, family, intercept, n_classes):
     return jax.value_and_grad(f)(Beta)
 
 
+@track_program("glm.stream.block_val_multi")
 @partial(jax.jit, static_argnames=("family", "intercept", "n_classes"))
 def _block_val_multi(Beta, X, y, mask, family, intercept, n_classes):
     Y = _codes_onehot(y, mask, n_classes)
@@ -147,6 +153,7 @@ def _block_val_multi(Beta, X, y, mask, family, intercept, n_classes):
     return jnp.sum(per_class)
 
 
+@track_program("glm.stream.block_vgh_multi")
 @partial(jax.jit, static_argnames=("family", "intercept", "n_classes"))
 def _block_val_grad_hess_multi(Beta, X, y, mask, family, intercept,
                                n_classes):
@@ -210,11 +217,14 @@ def _admm_local_body(X, y, mask, b, u, z, rho, n_rows, local_iter, family,
     return jax.lax.fori_loop(0, local_iter, local_newton, b)
 
 
-_block_admm_local = partial(jax.jit, static_argnames=(
-    "local_iter", "family", "intercept",
-))(_admm_local_body)
+_block_admm_local = track_program("glm.stream.admm_local")(
+    partial(jax.jit, static_argnames=(
+        "local_iter", "family", "intercept",
+    ))(_admm_local_body)
+)
 
 
+@track_program("glm.stream.admm_local_multi")
 @partial(jax.jit, static_argnames=("family", "intercept", "local_iter",
                                    "n_classes"))
 def _block_admm_local_multi(X, y, mask, B, U, Z, rho, n_rows, local_iter,
@@ -281,7 +291,8 @@ def _sb_reducer(kind, family, intercept, n_classes):
         acc, _ = jax.lax.scan(scan_step, acc, (Xs, ys, counts))
         return acc
 
-    return run
+    suffix = "_multi" if n_classes else ""
+    return track_program(f"superblock.glm.{kind}{suffix}")(run)
 
 
 @_ft.lru_cache(maxsize=32)
@@ -319,7 +330,8 @@ def _sb_admm_local(local_iter, family, intercept, n_classes):
             ])
         return jax.vmap(one)(Bk, Uk, Xs, ys, counts)
 
-    return run
+    suffix = "_multi" if n_classes else ""
+    return track_program(f"superblock.glm.admm_local{suffix}")(run)
 
 
 # ---------------------------------------------------------------------------

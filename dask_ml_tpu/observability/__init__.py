@@ -15,8 +15,21 @@ equivalent is this package (grown from the flat per-step logger in
 - ``_counters`` — flat counter/gauge registry: recompiles (via
   ``jax.monitoring``, with a jit-cache fallback), host↔device transfer
   bytes, donated-buffer reuse, per-device memory gauges;
+- ``_programs`` — compiled-program registry: per-program compile time,
+  XLA cost/memory analysis (FLOPs, bytes, HBM peak) and invocation
+  counts for every tracked jit entry point (``config.obs_programs``);
+- ``_watchdog`` — opt-in slow-span watchdog
+  (``config.watchdog_timeout_s``): spans open past their deadline dump
+  all-thread tracebacks + device memory gauges + the open-span stack to
+  the trace sink without touching the fit;
+- ``_peak``     — the peak-FLOPs table (datasheet TPU peaks / measured
+  matmul fallback) the report's measured MFU and bench.py's analytic
+  MFU both divide by;
+- ``export``    — span JSONL -> Chrome-trace/Perfetto JSON
+  (``report ... --perfetto out.json``);
 - ``report``    — ``python -m dask_ml_tpu.observability.report
-  metrics.jsonl`` aggregates a recorded run into per-component tables.
+  metrics.jsonl`` aggregates a recorded run into per-component tables
+  (``--json`` for the machine-readable form).
 
 Everything is ambient and zero-overhead when disabled: no
 ``metrics_path``/``trace_dir`` configured means spans are no-ops and no
@@ -54,7 +67,15 @@ from ._metrics import (
     start_profiler_server,
     timed,
 )
-from ._spans import NOOP_SPAN, current_span_id, span
+from ._programs import (
+    log_programs,
+    programs_enabled,
+    programs_reset,
+    programs_snapshot,
+    track_program,
+)
+from ._spans import NOOP_SPAN, current_span_id, open_spans_snapshot, span
+from ._watchdog import Watchdog, watchdog, watchdog_active
 
 # recompile telemetry is passive and cheap (a no-op listener call per
 # compile when counters are disabled) — install at import so the counter
@@ -64,6 +85,7 @@ install_recompile_tracking()
 __all__ = [
     "MetricsLogger",
     "NOOP_SPAN",
+    "Watchdog",
     "active_logger",
     "count_recompiles",
     "counter_add",
@@ -77,7 +99,12 @@ __all__ = [
     "install_recompile_tracking",
     "jit_callbacks_supported",
     "log_counters",
+    "log_programs",
+    "open_spans_snapshot",
     "profile_trace",
+    "programs_enabled",
+    "programs_reset",
+    "programs_snapshot",
     "record_donation",
     "record_serving_batch",
     "record_serving_drop",
@@ -89,4 +116,7 @@ __all__ = [
     "span",
     "start_profiler_server",
     "timed",
+    "track_program",
+    "watchdog",
+    "watchdog_active",
 ]

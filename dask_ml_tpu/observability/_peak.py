@@ -1,0 +1,80 @@
+"""Peak-FLOPs table: the ONE denominator every MFU number divides by.
+
+Grown out of ``bench.py`` (which now imports it) so the report CLI's
+measured per-span MFU and the benchmark's analytic MFU are computed
+against the same peak: datasheet bf16 matmul peaks for known TPU
+generations, a measured large-matmul peak everywhere else (the only
+honest option on CPU fallback).
+"""
+
+from __future__ import annotations
+
+import time
+
+# bf16 datasheet peaks per chip (TFLOP/s) by device_kind substring. The
+# MXU runs f32-input matmuls at bf16-pass rate under default precision,
+# so the bf16 peak is the honest denominator for BOTH dtypes (using it
+# for f32 yields a conservative MFU, never an inflated one).
+DATASHEET_PEAKS = {
+    "v6": 918e12,       # Trillium / v6e
+    "v5p": 459e12,
+    "v5 lite": 197e12,  # v5e reports device_kind "TPU v5 lite"
+    "v5e": 197e12,
+    "v4": 275e12,
+    "v3": 123e12,
+    "v2": 45e12,
+}
+
+_cached_peak = None
+
+
+def resolve_peak(matmul_dim=None, use_cache=True) -> dict:
+    """Per-chip peak matmul FLOP/s: datasheet when the device_kind is
+    known, else MEASURED with a large square matmul. Returns
+    ``{"flops", "source", "device_kind"}``. The measured path is cached
+    per process (it burns a few GFLOPs); pass ``use_cache=False`` to
+    re-measure."""
+    global _cached_peak
+    if use_cache and matmul_dim is None and _cached_peak is not None:
+        return dict(_cached_peak)
+    import jax
+
+    backend = jax.default_backend()
+    kind = getattr(jax.devices()[0], "device_kind", backend) or backend
+    if backend == "tpu":
+        for sub, peak in DATASHEET_PEAKS.items():
+            if sub in kind.lower():
+                out = {"flops": peak, "source": "datasheet",
+                       "device_kind": kind}
+                _cached_peak = dict(out)
+                return out
+    import jax.numpy as jnp
+
+    m = matmul_dim or (4096 if backend == "tpu" else 1024)
+    a = jnp.ones((m, m), jnp.bfloat16 if backend == "tpu" else jnp.float32)
+    f = jax.jit(lambda x: x @ x)
+    jax.block_until_ready(f(a))  # compile
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        a = jax.block_until_ready(f(a))
+    dt = time.perf_counter() - t0
+    out = {"flops": 2.0 * m ** 3 * reps / dt, "source": "measured",
+           "device_kind": kind}
+    if matmul_dim is None:
+        _cached_peak = dict(out)
+    return out
+
+
+def mfu_fields(model_flops, elapsed, n_chips, peak) -> dict:
+    """Achieved model FLOP/s and MFU vs per-chip peak (absolute perf
+    measures; model_flops counts the algorithm's useful matmul FLOPs)."""
+    fps = model_flops / elapsed
+    return {
+        "model_flops": round(model_flops),
+        "model_flop_per_s": round(fps, 1),
+        "mfu": round(fps / (peak["flops"] * n_chips), 5),
+        "peak": {"flop_per_s_per_chip": round(peak["flops"], 1),
+                 "source": peak["source"],
+                 "device_kind": peak["device_kind"]},
+    }

@@ -32,8 +32,43 @@ import time
 from ._counters import counters_enabled, counters_snapshot
 from ._metrics import thread_bound_logger
 
-_ids = itertools.count(1)
+# span ids carry the pid in their high bits: config.trace_dir is a
+# persistent knob and _FileSink APPENDS, so two processes recording
+# into one trace.jsonl must not collide ids — the report's parent-chain
+# walk (nested-of-group dedup) would silently cross runs. 16M spans per
+# process before ranges could touch.
+_ids = itertools.count(((os.getpid() & 0xFFFFFF) << 24) | 1)
 _tls = threading.local()
+
+# live view of every OPEN span (id -> start time/name/thread): the stall
+# watchdog's working set. Maintained only on the recording path — the
+# disabled (no-sink) path never touches it.
+_open_lock = threading.Lock()
+_open_spans: dict[int, dict] = {}
+
+# live watchdog count (armed by _watchdog.Watchdog.start/stop): while a
+# watchdog is polling, spans register in the open-span registry even
+# when NO sink is configured — otherwise a run without metrics_path/
+# trace_dir (bench's timed fits, the wedged-tunnel scenario) would be
+# invisible to the very thread meant to catch its stalls. Sinkless
+# tracked spans write no record; the disabled path (no sink, no
+# watchdog) stays the zero-cost no-op.
+_armed_watchdogs = 0
+
+
+def _watchdog_arm(delta: int) -> None:
+    global _armed_watchdogs
+    with _open_lock:
+        _armed_watchdogs += delta
+
+
+def open_spans_snapshot():
+    """[{span_id, span, thread, t_open_unix, parent_id, ...}] for every
+    span currently open anywhere in the process, oldest first."""
+    with _open_lock:
+        out = [dict(v) for v in _open_spans.values()]
+    out.sort(key=lambda r: r["t_open_unix"])
+    return out
 
 # "time" origin for fallback-sink span records (relative to process
 # start, matching MetricsLogger's fit-relative convention in spirit)
@@ -96,6 +131,8 @@ class _NoopSpan:
 
     __slots__ = ()
 
+    recording = False
+
     def add(self, **attrs):
         return self
 
@@ -117,13 +154,22 @@ class span:
     """
 
     __slots__ = ("name", "attrs", "span_id", "parent_id", "sync_s",
-                 "_sink", "_t0", "_ctr0")
+                 "_sink", "_t0", "_ctr0", "_tracked")
 
     def __init__(self, name, **attrs):
         self.name = name
         self.attrs = attrs
         self.sync_s = 0.0
         self._sink = None
+        self._tracked = False
+
+    @property
+    def recording(self):
+        """True when this span will emit a record at close — False for
+        spans tracked only for the watchdog (armed timeout, no sink).
+        The public signal call sites gate record-dependent work on
+        (e.g. the stream's wait_s readiness syncs)."""
+        return self._sink is not None
 
     def add(self, **attrs):
         self.attrs.update(attrs)
@@ -142,30 +188,53 @@ class span:
 
     def __enter__(self):
         sink = _trace_sink()
-        if sink is None:
+        if sink is None and not _armed_watchdogs:
             return NOOP_SPAN
+        # sink None but a watchdog armed: track the span (open-span
+        # registry + id stack) without emitting a record at close
         self._sink = sink
+        self._tracked = True
         st = _stack()
         self.parent_id = st[-1] if st else None
         self.span_id = next(_ids)
         st.append(self.span_id)
-        self._ctr0 = counters_snapshot() if counters_enabled() else None
+        with _open_lock:
+            _open_spans[self.span_id] = {
+                "span_id": self.span_id,
+                "span": self.name,
+                "parent_id": self.parent_id,
+                "thread": threading.current_thread().name,
+                # the ident disambiguates same-named threads (every
+                # ModelServer worker is "dask-ml-tpu-serving") so the
+                # watchdog dumps THIS thread's stack, not a namesake's
+                "thread_id": threading.get_ident(),
+                "t_open_unix": time.time(),
+            }
+        self._ctr0 = (counters_snapshot()
+                      if sink is not None and counters_enabled() else None)
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, exc_type, exc, tb):
-        if self._sink is None:
+        if not self._tracked:
             return False
         wall = time.perf_counter() - self._t0
         st = _stack()
         # pop down to (and including) OUR frame: frames above ours are
         # spans abandoned mid-block (a generator dropped between yields)
         # — leaving them would corrupt every later span's parent id
+        abandoned = []
         if self.span_id in st:
             while st and st[-1] != self.span_id:
-                st.pop()
+                abandoned.append(st.pop())
             if st:
                 st.pop()
+        with _open_lock:
+            _open_spans.pop(self.span_id, None)
+            for sid in abandoned:  # their __exit__ will never run
+                _open_spans.pop(sid, None)
+        if self._sink is None:
+            return False  # watchdog-only tracking: no record to emit
         rec = {
             "span": self.name,
             "span_id": self.span_id,
@@ -177,6 +246,9 @@ class span:
             "t_unix": round(time.time(), 6),
             "wall_s": round(wall, 6),
             "sync_s": round(self.sync_s, 6),
+            # which OS thread closed the span — Perfetto export lanes
+            # spans by it, and the watchdog correlates stall dumps to it
+            "thread": threading.current_thread().name,
         }
         if exc_type is not None:
             rec["error"] = exc_type.__name__

@@ -1,0 +1,178 @@
+"""Chrome-trace / Perfetto export of recorded span JSONL.
+
+``python -m dask_ml_tpu.observability.report trace.jsonl --perfetto
+out.json`` converts a recorded run into the Chrome trace-event JSON
+format, viewable in ``ui.perfetto.dev`` (or ``chrome://tracing``):
+
+- span records become complete ("X") track events, laned by the thread
+  that closed them (span trees nest by containment, exactly how the
+  span stack produced them);
+- per-span counter deltas (``ctr_*``) become cumulative counter ("C")
+  tracks — program FLOPs, h2d bytes, recompiles over time;
+- explicit counter snapshots (``log_counters`` records) set the same
+  tracks to their absolute totals;
+- per-step solver records contribute ``<component>.<metric>`` counter
+  tracks (loss / inertia / residual trajectories on the timeline);
+- watchdog stall records become instant ("i") events so a stall dump is
+  visible at the moment it fired.
+
+Timestamps: span records carry absolute ``t_unix``; step records only
+carry the sink-relative ``time``. The exporter estimates each sink's
+origin PER COMPONENT as the median of (t_unix - time) over span records
+carrying both (each fit's MetricsLogger has its own zero-point), with a
+global-median fallback, so mixed records land on one consistent
+timeline (microsecond ts relative to the earliest event).
+"""
+
+from __future__ import annotations
+
+import json
+
+# step-record metrics worth a counter track (same preference list the
+# report's convergence column reads)
+_STEP_KEYS = ("loss", "inertia", "center_shift2", "primal_residual",
+              "score", "opt_residual", "grad_norm")
+
+# span attributes that are structural, not user payload
+_SPAN_META = {"span", "span_id", "parent_id", "depth", "time", "t_unix",
+              "wall_s", "sync_s", "thread"}
+
+
+def _origins(records):
+    """Per-component estimates of each sink's t=0 (median of
+    t_unix - time over span records carrying both), plus a global
+    fallback under the ``None`` key. Per-component because one JSONL
+    file can hold records from SEVERAL sinks with different zero-points
+    (each fit's MetricsLogger stamps ``time`` relative to its own
+    creation) — a single global origin would shift the later fit's
+    step records by the gap between the fits' start times."""
+    by_comp = {}
+    for r in records:
+        if "t_unix" in r and "time" in r:
+            by_comp.setdefault(r.get("component"), []).append(
+                float(r["t_unix"]) - float(r["time"])
+            )
+    out = {}
+    all_deltas = []
+    for comp, deltas in by_comp.items():
+        deltas.sort()
+        out[comp] = deltas[len(deltas) // 2]
+        all_deltas.extend(deltas)
+    all_deltas.sort()
+    out.setdefault(None,
+                   all_deltas[len(all_deltas) // 2] if all_deltas
+                   else 0.0)
+    return out
+
+
+def _abs_time(r, origins):
+    if "t_unix" in r:
+        return float(r["t_unix"])
+    origin = origins.get(r.get("component"), origins[None])
+    return origin + float(r.get("time", 0.0))
+
+
+def to_chrome_trace(records) -> dict:
+    """Records (list of dicts, as ``report.load_records`` returns) ->
+    Chrome trace-event JSON object."""
+    records = [r for r in records if isinstance(r, dict)]
+    origins = _origins(records)
+    if records:
+        # a span's record time is its CLOSE — the earliest event on the
+        # timeline is the earliest span START, so subtract durations
+        # when establishing the zero point (ts must never go negative)
+        base = min(
+            _abs_time(r, origins) - float(r.get("wall_s", 0.0) or 0.0)
+            for r in records
+        )
+    else:
+        base = 0.0
+
+    def ts(r):
+        # clamped at 0: base/abs subtract ~1e9-scale floats whose ulp
+        # (~µs) can push the earliest span start epsilon-negative
+        return max((_abs_time(r, origins) - base) * 1e6, 0.0)  # µs
+
+    events = []
+    tids = {}
+
+    def tid_of(name):
+        if name not in tids:
+            tids[name] = len(tids) + 1
+            events.append({
+                "name": "thread_name", "ph": "M", "pid": 1,
+                "tid": tids[name], "args": {"name": str(name)},
+            })
+        return tids[name]
+
+    counters = {}  # counter name -> cumulative value
+
+    def counter_event(name, value, t):
+        events.append({
+            "name": name, "ph": "C", "pid": 1, "ts": round(t, 3),
+            "args": {name: value},
+        })
+
+    for r in sorted(records, key=lambda r: _abs_time(r, origins)):
+        t = ts(r)
+        if r.get("watchdog"):
+            events.append({
+                "name": f"watchdog: {r.get('span', '?')} stalled",
+                "ph": "i", "s": "g", "pid": 1,
+                "tid": tid_of(r.get("thread", "main")),
+                "ts": round(t, 3),
+                "args": {"age_s": r.get("age_s"),
+                         "timeout_s": r.get("timeout_s")},
+            })
+            continue
+        if "span" in r:
+            dur = float(r.get("wall_s", 0.0)) * 1e6
+            name = r["span"]
+            if r.get("component"):
+                name = f"{r['component']}.{name}"
+            args = {k: v for k, v in r.items()
+                    if k not in _SPAN_META and not k.startswith("ctr_")
+                    and isinstance(v, (int, float, str, bool))}
+            events.append({
+                "name": name, "ph": "X", "pid": 1,
+                "tid": tid_of(r.get("thread", "main")),
+                "ts": round(max(t - dur, 0.0), 3), "dur": round(dur, 3),
+                "args": args,
+            })
+            # counter deltas: TOP-LEVEL spans only — a parent span's
+            # delta already contains every nested child's (one global
+            # accumulator), so summing both would double the track
+            # (same rule as report.final_counters)
+            if r.get("parent_id") is None:
+                for k, v in r.items():
+                    if k.startswith("ctr_") and isinstance(v,
+                                                           (int, float)):
+                        cname = k[4:]
+                        counters[cname] = counters.get(cname, 0) + v
+                        counter_event(cname, counters[cname], t)
+            continue
+        if r.get("counters"):
+            for k, v in r.items():
+                if k in ("counters", "time", "step", "component"):
+                    continue
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    continue
+                counters[k] = v  # absolute snapshot overrides the sum
+                counter_event(k, v, t)
+            continue
+        if r.get("component") is not None and r.get("step") is not None:
+            for k in _STEP_KEYS:
+                if k in r and isinstance(r[k], (int, float)):
+                    counter_event(f"{r['component']}.{k}", float(r[k]), t)
+                    break
+
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(records, path) -> dict:
+    """Serialize :func:`to_chrome_trace` to ``path``; returns the trace
+    object (tests schema-check it)."""
+    trace = to_chrome_trace(records)
+    with open(path, "w") as fh:
+        json.dump(trace, fh)
+    return trace

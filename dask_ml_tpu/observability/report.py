@@ -4,16 +4,23 @@ per-component summary.
 Usage::
 
     python -m dask_ml_tpu.observability.report metrics.jsonl
+    python -m dask_ml_tpu.observability.report metrics.jsonl --json
+    python -m dask_ml_tpu.observability.report trace.jsonl --perfetto out.json
 
 Reads the records the subsystem emits — span records (``span`` field),
 per-step solver/search records (``component`` field), stream-pass
-overlap records (``stream_pass``), and counter snapshots (``counters``)
-— and prints: time per span (wall + device-sync), samples/s where a
-span recorded its row count, each component's convergence trajectory
-(first→last loss-like metric and step count), streaming overlap totals,
-and the run's counter totals (recompiles, host↔device bytes). The point
-(ISSUE 1): a BENCH round's JSONL answers "where did this fit spend its
-time" without re-running anything.
+overlap records (``stream_pass``), counter snapshots (``counters``),
+program-registry snapshots (``programs``, from ``log_programs``), and
+watchdog stall dumps (``watchdog``) — and prints: time per span (wall +
+device-sync + measured MFU where program FLOPs were recorded),
+samples/s where a span recorded its row count, each component's
+convergence trajectory, streaming overlap totals, the compiled-program
+cost table (compiles, compile time, FLOPs, HBM peak), watchdog stalls,
+and the run's counter totals. ``--json`` emits the same content as one
+machine-readable JSON object; ``--perfetto`` converts the span tree to
+Chrome-trace JSON for ``ui.perfetto.dev`` (see ``export.py``). The
+point (ISSUE 1/4): a recorded round's JSONL answers "where did this
+fit spend its time, FLOPs and HBM" without re-running anything.
 """
 
 from __future__ import annotations
@@ -54,6 +61,19 @@ def _fmt_bytes(n):
         n /= 1024.0
 
 
+def _fmt_mfu(v):
+    if v is None:
+        return "-"
+    return f"{v:.4f}" if v >= 1e-4 else f"{v:.1e}"
+
+
+def _fmt_flops(n):
+    for unit in ("", "K", "M", "G", "T", "P"):
+        if abs(n) < 1000 or unit == "P":
+            return f"{n:.3g}{unit}F" if unit else f"{n:.0f}F"
+        n /= 1000.0
+
+
 def _table(title, headers, rows):
     if not rows:
         return []
@@ -68,26 +88,69 @@ def _table(title, headers, rows):
 
 
 def summarize_spans(records):
-    """[(key, count, wall, sync, samples/s or None)] grouped by
-    (span name, component)."""
-    groups = {}
-    for r in records:
-        if "span" not in r:
-            continue
+    """[(key, count, wall, sync, samples/s or None, program_flops)]
+    grouped by (span name, component).
+
+    MFU caveat: ``ctr_program_flops`` deltas come from the ONE
+    process-global counter registry (like every ctr_* field since the
+    observability core) — tracked programs executing on OTHER threads
+    while a span is open attribute their FLOPs to it too. Per-span MFU
+    is exact for single-threaded runs and for spans that own their
+    thread's compute (fits, serving batches); overlapping concurrent
+    tracked work double-attributes across the open spans.
+
+    Wall/sync/rows/flops are aggregated from each group's TOP-LEVEL
+    spans only: a nested span of the same group (a retry inside a pass,
+    a relabeled inner fit) sits INSIDE its ancestor's wall, re-reports
+    rows the ancestor already counted, and its counter deltas are
+    already contained in the ancestor's (one global accumulator) — so
+    summing every record both double-counted rows/flops and inflated
+    the wall denominator. A record whose parent chain reaches another
+    record of the SAME group only contributes to the record count."""
+    def span_key(r):
+        if "span" not in r or r.get("watchdog"):
+            return None
         key = r["span"]
         if r.get("component"):
             key = f"{r['component']}.{key}"
+        return key
+
+    groups = {}
+    key_of = {}
+    parent_of = {}
+    keyed = [(span_key(r), r) for r in records]
+    for key, r in keyed:
+        if key is not None and r.get("span_id") is not None:
+            key_of[r["span_id"]] = key
+            parent_of[r["span_id"]] = r.get("parent_id")
+    for key, r in keyed:
+        if key is None:
+            continue
         g = groups.setdefault(key, {"n": 0, "wall": 0.0, "sync": 0.0,
-                                    "rows": 0.0})
+                                    "rows": 0.0, "flops": 0.0})
         g["n"] += 1
-        g["wall"] += float(r.get("wall_s", 0.0))
-        g["sync"] += float(r.get("sync_s", 0.0))
-        g["rows"] += float(r.get("n_rows", 0.0))
+        # top-level-of-group check: walk the parent chain; any ancestor
+        # in the same group already contains this record's wall, rows
+        # and counter deltas
+        nested = False
+        pid = r.get("parent_id")
+        seen = set()
+        while pid is not None and pid not in seen:
+            seen.add(pid)
+            if key_of.get(pid) == key:
+                nested = True
+                break
+            pid = parent_of.get(pid)
+        if not nested:
+            g["wall"] += float(r.get("wall_s", 0.0))
+            g["sync"] += float(r.get("sync_s", 0.0))
+            g["flops"] += float(r.get("ctr_program_flops", 0.0))
+            g["rows"] += float(r.get("n_rows", 0.0))
     out = []
     for key in sorted(groups, key=lambda k: -groups[k]["wall"]):
         g = groups[key]
         sps = g["rows"] / g["wall"] if g["rows"] and g["wall"] > 0 else None
-        out.append((key, g["n"], g["wall"], g["sync"], sps))
+        out.append((key, g["n"], g["wall"], g["sync"], sps, g["flops"]))
     return out
 
 
@@ -96,7 +159,7 @@ def summarize_components(records):
     trajectory (first → last of the component's loss-like metric)."""
     comps = {}
     for r in records:
-        if "span" in r or "component" not in r:
+        if "span" in r or "component" not in r or r.get("watchdog"):
             continue
         c = comps.setdefault(r["component"], {"n": 0, "steps": set(),
                                               "key": None, "first": None,
@@ -147,13 +210,20 @@ def summarize_stream(records):
     return tot
 
 
+def _numeric(v):
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
 def final_counters(records):
     """The run's counter totals: the LAST explicit counters snapshot,
-    else the sum of per-span counter deltas."""
+    else the sum of per-span counter deltas. Only NUMERIC fields
+    survive — snapshot records can carry stray string/bool fields
+    (extras, phase tags) that must not leak into the counters table."""
     snaps = [r for r in records if r.get("counters")]
     if snaps:
         return {k: v for k, v in snaps[-1].items()
-                if k not in ("counters", "time", "step", "component")}
+                if k not in ("counters", "time", "step", "component")
+                and _numeric(v)}
     totals = {}
     for r in records:
         # top-level spans only: a parent span's delta already contains
@@ -162,29 +232,100 @@ def final_counters(records):
         if r.get("parent_id") is not None:
             continue
         for k, v in r.items():
-            if k.startswith("ctr_"):
+            if k.startswith("ctr_") and _numeric(v):
                 totals[k[4:]] = totals.get(k[4:], 0) + v
     return totals
+
+
+def final_programs(records):
+    """The LAST program-registry snapshot (``log_programs`` record), or
+    []."""
+    for r in reversed(records):
+        if isinstance(r.get("programs"), list):
+            return r["programs"]
+    return []
+
+
+def resolved_peak(records):
+    """The peak-FLOPs fields riding the last programs record (None when
+    the run never recorded them — MFU columns are then skipped)."""
+    for r in reversed(records):
+        if r.get("peak_flop_per_s_per_chip"):
+            return {
+                "flop_per_s_per_chip": float(r["peak_flop_per_s_per_chip"]),
+                "source": r.get("peak_source"),
+                "device_kind": r.get("device_kind"),
+                "n_chips": int(r.get("n_chips", 1)),
+            }
+    return None
+
+
+def watchdog_stalls(records):
+    """[(span, thread, age_s, n_threads_dumped)] per watchdog record."""
+    out = []
+    for r in records:
+        if r.get("watchdog"):
+            out.append((r.get("span"), r.get("thread"),
+                        r.get("age_s"), len(r.get("stacks", {}))))
+    return out
+
+
+def report_data(records):
+    """The full report as one JSON-ready dict (the ``--json`` output;
+    ``build_report`` renders the same content as tables)."""
+    peak = resolved_peak(records)
+    total_peak = (peak["flop_per_s_per_chip"] * peak["n_chips"]
+                  if peak else None)
+    spans = []
+    for key, n, wall, sync, sps, flops in summarize_spans(records):
+        row = {"span": key, "count": n, "wall_s": round(wall, 6),
+               "sync_s": round(sync, 6),
+               "samples_per_sec": round(sps, 1) if sps else None,
+               "program_flops": flops or None}
+        if flops and total_peak and wall > 0:
+            row["mfu"] = round(flops / wall / total_peak, 6)
+        spans.append(row)
+    comps = [{"component": c, "records": n, "steps": s, "convergence": t}
+             for c, n, s, t in summarize_components(records)]
+    return {
+        "records": len(records),
+        "spans": spans,
+        "components": comps,
+        "streaming": summarize_stream(records),
+        "counters": final_counters(records),
+        "programs": final_programs(records),
+        "peak": peak,
+        "watchdog_stalls": [
+            {"span": s, "thread": t, "age_s": a, "threads_dumped": n}
+            for s, t, a, n in watchdog_stalls(records)
+        ],
+    }
 
 
 def build_report(records, path="<records>"):
     """The full report as one string (the CLI prints it; tests assert on
     it)."""
+    data = report_data(records)
     lines = [f"run report: {path}  ({len(records)} records)", ""]
     span_rows = []
-    for key, n, wall, sync, sps in summarize_spans(records):
+    for row in data["spans"]:
         span_rows.append((
-            key, n, _fmt_seconds(wall), _fmt_seconds(sync),
-            f"{sps:,.0f}" if sps else "-",
+            row["span"], row["count"], _fmt_seconds(row["wall_s"]),
+            _fmt_seconds(row["sync_s"]),
+            f"{row['samples_per_sec']:,.0f}"
+            if row["samples_per_sec"] else "-",
+            _fmt_mfu(row.get("mfu")),
         ))
     lines += _table("spans (time by component)",
-                    ("span", "count", "wall", "device_sync", "samples/s"),
+                    ("span", "count", "wall", "device_sync", "samples/s",
+                     "mfu"),
                     span_rows)
-    comp_rows = summarize_components(records)
+    comp_rows = [(c["component"], c["records"], c["steps"],
+                  c["convergence"]) for c in data["components"]]
     lines += _table("per-step telemetry",
                     ("component", "records", "steps", "convergence"),
                     comp_rows)
-    st = summarize_stream(records)
+    st = data["streaming"]
     if st:
         lines += _table(
             "streaming overlap",
@@ -195,7 +336,60 @@ def build_report(records, path="<records>"):
               _fmt_seconds(st["put_s"]), _fmt_seconds(st["wait_s"]),
               _fmt_seconds(st["consume_s"]))],
         )
-    ctr = final_counters(records)
+    progs = data["programs"]
+    if progs:
+        peak = data["peak"]
+        total_peak = (peak["flop_per_s_per_chip"] * peak["n_chips"]
+                      if peak else None)
+        # per-program exec_s is host-side DISPATCH time: honest on the
+        # synchronous CPU backend, but under async dispatch (TPU/GPU)
+        # the call returns at enqueue — an MFU built on it would be
+        # inflated nonsense, so it renders only for cpu runs; the
+        # per-span MFU above (wall + explicit sync barriers) is the
+        # measured number everywhere
+        sync_exec = bool(peak and "cpu" in
+                         str(peak.get("device_kind") or "").lower())
+        rows = []
+        for p in progs:
+            flops = p.get("flops_per_call")
+            hbm = p.get("hbm_peak_bytes")
+            exec_s = p.get("exec_s") or 0.0
+            # warm-call flops only: exec_s excludes compiling calls'
+            # wall, so the matching numerator must too (older records
+            # without the field fall back to the full total)
+            ftot = p.get("flops_exec",
+                         p.get("flops_total") or 0.0) or 0.0
+            mfu = (_fmt_mfu(ftot / exec_s / total_peak)
+                   if sync_exec and total_peak and exec_s > 0 and ftot
+                   else "-")
+            rows.append((
+                p.get("program"), p.get("compiles", 0),
+                _fmt_seconds(p.get("compile_s") or 0.0),
+                p.get("calls", 0),
+                _fmt_flops(flops) if flops else "-",
+                _fmt_bytes(hbm) if hbm else "-",
+                mfu,
+            ))
+        title = "programs (XLA cost/memory per compiled entry point)"
+        if peak:
+            title += (f"  [peak {peak['flop_per_s_per_chip']:.3g} "
+                      f"FLOP/s/chip x{peak['n_chips']}, "
+                      f"{peak['source']}]")
+        lines += _table(
+            title,
+            ("program", "compiles", "compile_s", "calls", "flops/call",
+             "hbm_peak", "mfu"),
+            rows,
+        )
+    stalls = data["watchdog_stalls"]
+    if stalls:
+        lines += _table(
+            "watchdog stalls",
+            ("span", "thread", "age_s", "threads_dumped"),
+            [(s["span"], s["thread"], s["age_s"], s["threads_dumped"])
+             for s in stalls],
+        )
+    ctr = data["counters"]
     if ctr:
         rows = []
         for k in sorted(ctr):
@@ -204,7 +398,8 @@ def build_report(records, path="<records>"):
                 _fmt_seconds(v) if k.endswith("secs") else v)
             rows.append((k, shown))
         lines += _table("counters", ("counter", "total"), rows)
-    if not span_rows and not comp_rows and not st and not ctr:
+    if not span_rows and not comp_rows and not st and not ctr \
+            and not progs and not stalls:
         lines.append("no observability records found "
                      "(set config.metrics_path or config.trace_dir)")
     return "\n".join(lines).rstrip() + "\n"
@@ -215,15 +410,62 @@ def main(argv=None):
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__.strip())
         return 0 if argv else 2
+    as_json = False
+    perfetto_out = None
+    paths = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--json":
+            as_json = True
+        elif a == "--perfetto":
+            if i + 1 >= len(argv):
+                print("error: --perfetto needs an output path",
+                      file=sys.stderr)
+                return 2
+            i += 1
+            perfetto_out = argv[i]
+        else:
+            paths.append(a)
+        i += 1
+    if not paths:
+        print("error: no input JSONL files", file=sys.stderr)
+        return 2
+    if perfetto_out is not None and len(paths) > 1:
+        # one output path per invocation: silently overwriting it per
+        # input would keep only the last file's trace
+        print("error: --perfetto takes exactly one input JSONL "
+              f"(got {len(paths)}); run once per file", file=sys.stderr)
+        return 2
     rc = 0
-    for path in argv:
+    for path in paths:
         try:
             records = load_records(path)
         except OSError as e:
             print(f"error: cannot read {path}: {e}", file=sys.stderr)
             rc = 1
             continue
-        sys.stdout.write(build_report(records, path=path))
+        if perfetto_out is not None:
+            from .export import write_chrome_trace
+
+            try:
+                trace = write_chrome_trace(records, perfetto_out)
+            except OSError as e:
+                print(f"error: cannot write {perfetto_out}: {e}",
+                      file=sys.stderr)
+                rc = 1
+                continue
+            # stderr: --json promises machine-readable stdout, and the
+            # flags combine
+            print(f"wrote {len(trace['traceEvents'])} trace events "
+                  f"-> {perfetto_out}  (open in ui.perfetto.dev)",
+                  file=sys.stderr)
+        if as_json:
+            data = report_data(records)
+            data["path"] = path
+            sys.stdout.write(json.dumps(data) + "\n")
+        elif perfetto_out is None:
+            sys.stdout.write(build_report(records, path=path))
     return rc
 
 

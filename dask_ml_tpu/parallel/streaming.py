@@ -471,7 +471,7 @@ class BlockStream:
         from collections import deque
 
         pending = deque()
-        from ..observability import NOOP_SPAN, span
+        from ..observability import span
 
         def pop():
             blk = pending.popleft()
@@ -497,7 +497,11 @@ class BlockStream:
             # recording sink (the span resolved one — bound fit logger
             # or configured trace/metrics path, where an unmeasured 0.0
             # would read as "perfectly overlapped") or an autotune pass
-            measure_wait = sp is not NOOP_SPAN or getattr(
+            # recording spans only: a span tracked solely for the
+            # watchdog (sinkless, armed timeout) must not switch on the
+            # readiness syncs — that would perturb the very timed runs
+            # the watchdog observes
+            measure_wait = sp.recording or getattr(
                 self, "_autotune_pass", False
             )
             try:
@@ -518,8 +522,13 @@ class BlockStream:
                 self._passes = getattr(self, "_passes", 0) + 1
                 # the span record IS the per-pass JSONL record (via the
                 # thread-bound fit logger or the configured trace sink);
-                # `stream_pass` keys it for consumers and the report CLI
-                sp.add(stream_pass=self._passes,
+                # `stream_pass` keys it for consumers and the report CLI.
+                # n_rows: the pass's valid rows — the report derives
+                # samples/s (and, with program tracking on, measured MFU
+                # from the ctr_program_flops delta this span carries —
+                # the consumer's compute runs while the generator is
+                # suspended INSIDE this span)
+                sp.add(stream_pass=self._passes, n_rows=int(self.n_rows),
                        **{k: (round(v, 6) if isinstance(v, float) else v)
                           for k, v in stats.items()})
                 if readers:
@@ -643,7 +652,7 @@ class BlockStream:
         every dispatch has the identical [K, block_rows, d] shape."""
         import time as _time
 
-        from ..observability import (NOOP_SPAN, record_superblock,
+        from ..observability import (record_superblock,
                                      record_transfer, span)
 
         k = self.resolve_superblock_k()
@@ -791,7 +800,11 @@ class BlockStream:
 
         staging = ThreadPoolExecutor(max_workers=1)
         with span("streaming.superblock") as sp:
-            measure_wait = sp is not NOOP_SPAN or getattr(
+            # recording spans only: a span tracked solely for the
+            # watchdog (sinkless, armed timeout) must not switch on the
+            # readiness syncs — that would perturb the very timed runs
+            # the watchdog observes
+            measure_wait = sp.recording or getattr(
                 self, "_autotune_pass", False
             )
             try:
@@ -806,8 +819,15 @@ class BlockStream:
                 stats["pass_s"] = _time.perf_counter() - t_pass
                 self.stats = stats
                 self._passes = getattr(self, "_passes", 0) + 1
+                # n_rows: valid rows this pass's `order` actually covered
+                # (a partial-order pass must not claim the whole dataset)
+                pass_rows = int(sum(
+                    min((int(b) + 1) * self.block_rows, self.n_rows)
+                    - int(b) * self.block_rows
+                    for b in order
+                ))
                 sp.add(stream_pass=self._passes,
-                       dispatches=int(n_sb),
+                       dispatches=int(n_sb), n_rows=pass_rows,
                        **{key: (round(v, 6) if isinstance(v, float) else v)
                           for key, v in stats.items()})
                 if readers:

@@ -358,12 +358,17 @@ class ModelServer:
         import dataclasses
 
         from .. import config
+        from ..observability import watchdog
 
         # re-apply the creator's (thread-local) config in this thread so
         # spans/counters gate exactly as they did where the server was
-        # built
+        # built; the worker runs under the slow-span watchdog (a no-op
+        # unless config.watchdog_timeout_s is set) so a wedged batch
+        # execution dumps thread stacks + memory gauges instead of
+        # silently freezing the queue
         with config.set(**dataclasses.asdict(self._cfg)):
-            self._run_loop()
+            with watchdog():
+                self._run_loop()
 
     def _run_loop(self):
         while True:

@@ -275,6 +275,19 @@ def _donate_spec():
     return (0,) if jax.default_backend() in ("tpu", "gpu") else ()
 
 
+def _tracked_jit(est, method, core, donate):
+    """Jit a serving core and register it in the compiled-program
+    registry as ``serving.<Estimator>.<method>`` — a recorded serving
+    run attributes per-batch FLOPs/HBM exactly like a fit does."""
+    import jax
+
+    from .observability import track_program
+
+    return track_program(f"serving.{type(est).__name__}.{method}")(
+        jax.jit(core, donate_argnums=donate)
+    )
+
+
 def _linear_wb(est):
     """(C, d) weight matrix + (C,) bias from a fitted linear model
     (C=1 encodes the binary/regression row)."""
@@ -346,7 +359,7 @@ def _jit_linear(est, method):
     else:
         return None
     return CompiledBatchFn(
-        jax.jit(core, donate_argnums=donate), method, True,
+        _tracked_jit(est, method, core, donate), method, True,
         W.shape[1], donates=bool(donate), post=post,
     )
 
@@ -371,7 +384,7 @@ def _jit_kmeans(est, method):
     else:
         return None
     return CompiledBatchFn(
-        jax.jit(core, donate_argnums=donate), method, True,
+        _tracked_jit(est, method, core, donate), method, True,
         int(centers.shape[1]), donates=bool(donate),
     )
 
@@ -399,7 +412,7 @@ def _jit_pca(est, method):
         return sc / scale[None, :] if scale is not None else sc
 
     return CompiledBatchFn(
-        jax.jit(core, donate_argnums=donate), method, True,
+        _tracked_jit(est, method, core, donate), method, True,
         int(comp.shape[1]), donates=bool(donate),
     )
 

@@ -1,14 +1,18 @@
 """Observability: record a KMeans + LogisticRegression run as JSONL
-(span traces, per-step solver metrics, runtime counters), then render
-the run report — the "where did this fit spend its time" answer the
-reference got from dask's dashboard.
+(span traces, per-step solver metrics, runtime counters, and the
+compiled-program registry), then render the run report and a Perfetto
+trace — the "where did this fit spend its time, FLOPs and HBM" answer
+the reference got from dask's dashboard.
 
 Everything is ambient: setting ``config.metrics_path`` wires span
 records (fit -> stream pass, with wall/device-sync time and counter
 deltas) and per-iteration solver telemetry into one append-only file;
-the report CLI (``python -m dask_ml_tpu.observability.report``)
-aggregates it. Unset, the whole subsystem is a no-op — nothing is
-traced into jitted code.
+``config.obs_programs=True`` additionally attributes each compiled
+entry point's XLA-measured FLOPs/compile-time/HBM (the report's
+``programs`` table and per-span measured MFU). The report CLI
+(``python -m dask_ml_tpu.observability.report``) aggregates it;
+``--perfetto`` converts the span tree for ``ui.perfetto.dev``. Unset,
+the whole subsystem is a no-op — nothing is traced into jitted code.
 """
 
 import os
@@ -23,7 +27,8 @@ import numpy as np
 from dask_ml_tpu import config
 from dask_ml_tpu.cluster import KMeans
 from dask_ml_tpu.linear_model import LogisticRegression
-from dask_ml_tpu.observability import MetricsLogger, log_counters
+from dask_ml_tpu.observability import (MetricsLogger, log_counters,
+                                       log_programs, programs_reset)
 from dask_ml_tpu.observability.report import main as report_main
 
 n, d = int(os.environ.get("DASK_ML_TPU_EXAMPLE_N", 50_000)), 16
@@ -34,17 +39,23 @@ X = np.concatenate([
 y = (X[:, 0] > X[:, 1]).astype(np.float32)
 
 path = os.path.join(tempfile.mkdtemp(), "metrics.jsonl")
-with config.set(metrics_path=path):
+programs_reset()
+with config.set(metrics_path=path, obs_programs=True):
     # resident fit: per-iteration Lloyd telemetry out of the jitted loop
     KMeans(n_clusters=4, init="random", random_state=0, max_iter=20).fit(X)
     # streamed fit: stream.pass spans nest under the fit span and carry
-    # host<->device transfer bytes as counter deltas
-    with config.set(metrics_path=path,
+    # host<->device transfer bytes + program-FLOP counter deltas
+    with config.set(metrics_path=path, obs_programs=True,
                     stream_block_rows=max(len(X) // 8, 1)):
         LogisticRegression(solver="lbfgs", max_iter=30).fit(X, y)
     with MetricsLogger(path) as lg:
-        log_counters(lg)  # run totals: recompiles, h2d bytes, memory
+        log_counters(lg)   # run totals: recompiles, h2d bytes, memory
+        log_programs(lg)   # program registry + the resolved peak table
 
 print(f"recorded {sum(1 for _ in open(path))} records -> {path}\n")
 # same as: python -m dask_ml_tpu.observability.report <path>
 report_main([path])
+
+# Perfetto/Chrome trace of the same run (open in ui.perfetto.dev)
+perfetto = path.replace(".jsonl", ".perfetto.json")
+report_main([path, "--perfetto", perfetto])

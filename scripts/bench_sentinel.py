@@ -1,0 +1,196 @@
+"""Bench regression sentinel: gate verify.sh on the recorded BENCH
+history.
+
+Compares the LATEST ``BENCH_r*.json`` round against per-metric budget
+floors seeded from the reference round (``BENCH_r05.json`` by default,
+the earliest available otherwise) and fails (exit 1) on any >20%
+regression — the "throughput quietly rotted" failure mode the numeric
+test suite cannot see.
+
+Rules:
+
+- throughput-like metrics (samples/s, rows/s, iterations/s — anything
+  whose unit is not seconds) must stay >= floor = reference * (1 - tol);
+- latency-like metrics (unit "s": c_grid_search_seconds,
+  randomized_svd_seconds, hyperband_seconds) must stay <= reference *
+  (1 + tol);
+- a metric is only compared when BOTH rounds measured it on the SAME
+  backend with a non-null value — a CPU-fallback round is not a
+  regression of a TPU round, it's a different machine;
+- error/null entries in the latest round for metrics the reference
+  measured (same-backend) are reported but only WARN: a flaky secondary
+  config must not hard-fail verify, the throughput floors do that.
+
+Env knobs: ``BENCH_SENTINEL_TOL`` (default 0.20),
+``BENCH_SENTINEL_REF`` (default r05).
+"""
+
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOL = float(os.environ.get("BENCH_SENTINEL_TOL", "0.20"))
+REF_ROUND = os.environ.get("BENCH_SENTINEL_REF", "r05")
+
+
+def _load(path):
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def _tail_metrics(tail):
+    """Recover metric entries from a TRUNCATED stdout tail: the driver
+    keeps only the last ~2000 chars of bench.py's output, which cuts the
+    headline open-brace but leaves the extra_metrics entries as complete
+    ``{"metric": ...}`` objects — raw_decode each occurrence."""
+    dec = json.JSONDecoder()
+    out = {}
+    for m in re.finditer(r'\{"metric"', tail or ""):
+        try:
+            obj, _ = dec.raw_decode(tail, m.start())
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            out[obj["metric"]] = obj
+    return out
+
+
+def _rounds():
+    """(usable rounds, all round numbers on disk). A round that yields
+    no metrics at all is still REPORTED via the second set — the newest
+    round silently producing nothing is itself the failure mode this
+    gate exists for."""
+    out = {}
+    on_disk = set()
+    for path in glob.glob(os.path.join(REPO, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if m:
+            on_disk.add(int(m.group(1)))
+        data = _load(path)
+        if not (m and isinstance(data, dict)):
+            continue
+        # the driver wraps bench.py's JSON line as {"parsed": {...}}
+        # (null when the line outgrew the driver's tail buffer); a raw
+        # bench doc carries "metric" at top level — accept both, and
+        # fall back to recovering entries from the truncated tail
+        doc = data.get("parsed") if isinstance(data.get("parsed"),
+                                               dict) else (
+            data if "metric" in data else None)
+        if doc is None:
+            recovered = _tail_metrics(data.get("tail"))
+            if recovered:
+                doc = {"metric": None,
+                       "extra_metrics": list(recovered.values())}
+        if isinstance(doc, dict):
+            out[int(m.group(1))] = (path, doc)
+    return out, on_disk
+
+
+def _metrics(doc):
+    """Flatten a bench doc into {metric: {"value", "unit", "backend"}}
+    (headline + extra_metrics; error entries keep value=None)."""
+    out = {}
+    for entry in [doc] + list(doc.get("extra_metrics") or []):
+        if not isinstance(entry, dict) or not entry.get("metric"):
+            continue
+        out[entry["metric"]] = {
+            "value": entry.get("value"),
+            "unit": entry.get("unit", ""),
+            "backend": entry.get("backend"),
+        }
+    return out
+
+
+def main():
+    rounds, on_disk = _rounds()
+    if not on_disk:
+        print("bench sentinel: no BENCH_r*.json recorded yet — skipping")
+        return 0
+    if not rounds or max(on_disk) > max(rounds):
+        # the newest round on disk yielded NO metrics (hung/killed bench
+        # with nothing recoverable) — exactly the silent-rot failure
+        # this gate exists to catch; gating an older round as "latest"
+        # would report OK over it
+        print(
+            f"  SENTINEL FAIL BENCH_r{max(on_disk):02d}.json exists but "
+            "yields no metrics (bench hung or was killed?) — the newest "
+            "round cannot be gated", file=sys.stderr,
+        )
+        return 1
+    ref_num = None
+    m = re.match(r"r(\d+)$", REF_ROUND)
+    if m and int(m.group(1)) in rounds:
+        ref_num = int(m.group(1))
+    else:
+        ref_num = min(rounds)
+    latest_num = max(rounds)
+    ref_path, ref_doc = rounds[ref_num]
+    latest_path, latest_doc = rounds[latest_num]
+    if latest_num == ref_num:
+        print(f"bench sentinel: only the reference round "
+              f"(r{ref_num:02d}) exists — nothing newer to gate")
+        return 0
+    ref = _metrics(ref_doc)
+    latest = _metrics(latest_doc)
+    failures, warnings_, checked = [], [], 0
+    for name, r in sorted(ref.items()):
+        rv = r["value"]
+        if rv is None or not isinstance(rv, (int, float)) or rv <= 0:
+            continue
+        cur = latest.get(name)
+        if cur is None:
+            # absent entirely (crashed bench section, truncated tail) —
+            # the common partial-rot mode; surface it, don't skip it
+            warnings_.append(
+                f"{name}: measured in r{ref_num:02d} but ABSENT from "
+                f"r{latest_num:02d}"
+            )
+            continue
+        if cur["value"] is None:
+            if cur.get("backend") in (None, r["backend"]):
+                warnings_.append(
+                    f"{name}: measured in r{ref_num:02d} but null/error "
+                    f"in r{latest_num:02d}"
+                )
+            continue
+        if cur["backend"] != r["backend"]:
+            continue  # different machine class: not comparable
+        cv = cur["value"]
+        checked += 1
+        lower_is_better = r["unit"] == "s"
+        if lower_is_better:
+            budget = rv * (1.0 + TOL)
+            if cv > budget:
+                failures.append(
+                    f"{name}: {cv:.4g}s vs budget {budget:.4g}s "
+                    f"(reference r{ref_num:02d}={rv:.4g}s, "
+                    f"+{(cv / rv - 1) * 100:.1f}%)"
+                )
+        else:
+            floor = rv * (1.0 - TOL)
+            if cv < floor:
+                failures.append(
+                    f"{name}: {cv:.4g} vs floor {floor:.4g} "
+                    f"(reference r{ref_num:02d}={rv:.4g}, "
+                    f"{(cv / rv - 1) * 100:.1f}%)"
+                )
+    print(f"bench sentinel: r{latest_num:02d} vs r{ref_num:02d} floors, "
+          f"{checked} comparable metrics, tol {TOL:.0%}")
+    for w in warnings_:
+        print(f"  WARN {w}")
+    if failures:
+        for f in failures:
+            print(f"  SENTINEL FAIL {f}", file=sys.stderr)
+        return 1
+    print("bench sentinel OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

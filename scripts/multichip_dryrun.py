@@ -1,0 +1,100 @@
+"""8-device CPU multichip dryrun with a recorded flight-recorder trace.
+
+Extends the MULTICHIP_r*.json dryrun (8 virtual XLA:CPU devices via
+``--xla_force_host_platform_device_count``) beyond "does the sharded
+path run": the run records a span trace + program registry under
+``config.trace_dir`` and ASSERTS the report CLI renders spans AND a
+programs table for the sharded L-BFGS and ADMM fit paths — the
+observability the next wedged-TPU round will need, proven on the same
+virtual mesh the tier-1 suite uses.
+
+Prints one JSON line (MULTICHIP_r*.json shape, plus the trace fields):
+
+    {"n_devices": 8, "ok": true, "rc": 0, "trace_records": ...,
+     "report_spans": [...], "report_programs": [...]}
+
+Run: ``python scripts/multichip_dryrun.py``.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# 8 virtual devices BEFORE jax initializes; never downgrade an explicit
+# operator setting
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_DEVICES = 8
+
+
+def main():
+    out = {"n_devices": None, "rc": 0, "ok": False, "skipped": False,
+           "tail": ""}
+    trace_dir = tempfile.mkdtemp(prefix="multichip_trace_")
+    try:
+        import jax
+        import numpy as np
+
+        out["n_devices"] = len(jax.devices())
+        if out["n_devices"] < N_DEVICES:
+            raise RuntimeError(
+                f"expected {N_DEVICES} virtual devices, got "
+                f"{out['n_devices']} (XLA_FLAGS not honored?)"
+            )
+        from dask_ml_tpu import config
+        from dask_ml_tpu import observability as obs
+        from dask_ml_tpu.linear_model import LogisticRegression
+        from dask_ml_tpu.observability.report import (build_report,
+                                                      load_records,
+                                                      report_data)
+        from dask_ml_tpu.parallel import as_sharded
+
+        rng = np.random.RandomState(0)
+        n, d = 16_384, 32
+        X = rng.randn(n, d).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        Xs, ys = as_sharded(X), as_sharded(y)
+        obs.programs_reset()
+        with config.set(trace_dir=trace_dir, obs_programs=True):
+            # the two sharded solve flavors: one-program L-BFGS
+            # (per-shard matmuls + psum) and shard_map consensus ADMM
+            lb = LogisticRegression(solver="lbfgs", max_iter=20).fit(Xs, ys)
+            ad = LogisticRegression(solver="admm", max_iter=20).fit(Xs, ys)
+            assert lb.score(Xs, ys) > 0.6 and ad.score(Xs, ys) > 0.6
+            trace = os.path.join(trace_dir, "trace.jsonl")
+            with obs.MetricsLogger(trace) as lg:
+                obs.log_counters(lg)
+                obs.log_programs(lg)
+        records = load_records(trace)
+        report = build_report(records, path=trace)
+        data = report_data(records)
+        spans = [r["span"] for r in data["spans"]]
+        programs = [p["program"] for p in data["programs"]]
+        # the report must render the sharded fits' spans AND their
+        # compiled programs — this is the assertion the dryrun exists for
+        assert "LogisticRegression.fit" in spans, spans
+        assert "spans (time by component)" in report
+        assert "programs (XLA cost/memory per compiled entry point)" \
+            in report
+        assert any(p == "glm.lbfgs" for p in programs), programs
+        assert any(p == "glm.admm" for p in programs), programs
+        out.update(
+            ok=True,
+            trace_records=len(records),
+            report_spans=spans,
+            report_programs=programs,
+        )
+    except Exception:
+        out["rc"] = 1
+        out["tail"] = traceback.format_exc()[-2000:]
+    print(json.dumps(out))
+    return out["rc"]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

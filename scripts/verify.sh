@@ -36,12 +36,29 @@ if [ "${1:-}" = "--lint" ]; then
     exit 0
 fi
 
+# -- bench sentinel: recorded-round regression gate (ISSUE 4) ----------------
+# the latest BENCH_r*.json family must hold its per-metric budget floors
+# (seeded from r05): >20% throughput loss / slowdown on a comparable
+# backend fails verify before any throughput number quietly rots.
+if ! python scripts/bench_sentinel.py; then
+    echo "VERIFY FAIL: bench sentinel (recorded-round regression)"
+    exit 1
+fi
+
 # -- perf smoke: super-block dispatch collapse (ISSUE 3) ---------------------
 # streamed-SGD at smoke scale: fails when dispatches_per_pass exceeds
 # ceil(n_blocks / superblock_k) + 1 or when passes after the first pay
 # any new XLA compiles — the regressions throughput numbers hide.
 if ! timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/perf_smoke.py; then
     echo "VERIFY FAIL: super-block perf smoke"
+    exit 1
+fi
+
+# -- multichip dryrun (8 virtual CPU devices): the sharded lbfgs/ADMM
+# paths must run AND record a flight-recorder trace the report CLI can
+# render (spans + programs tables) — asserted inside the script.
+if ! timeout -k 10 300 python scripts/multichip_dryrun.py; then
+    echo "VERIFY FAIL: multichip dryrun (sharded paths + recorded trace)"
     exit 1
 fi
 

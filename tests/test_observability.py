@@ -255,6 +255,49 @@ def test_no_debug_callback_in_solver_jaxpr_when_disabled():
     assert "debug_callback" in str(run(True))
 
 
+def test_program_registry_and_watchdog_add_nothing_when_disabled():
+    """ISSUE 4 extension of the zero-overhead contract: with
+    obs_programs/watchdog_timeout_s at their defaults, the tracked
+    solver entry points trace to the IDENTICAL jaxpr (the tracker lives
+    outside jit and must stay there), the program registry stays empty,
+    and no watchdog thread exists."""
+    import jax
+    import jax.numpy as jnp
+
+    from dask_ml_tpu.models.solvers.solvers import _gd_run
+
+    X = jnp.ones((16, 3))
+    y = jnp.zeros(16)
+    mask = jnp.ones(16)
+
+    def jaxpr():
+        return str(jax.make_jaxpr(
+            lambda X_, y_, m_, b_: _gd_run(
+                X_, y_, m_, 16.0, b_, jnp.float32(0.0), jnp.ones(3), 0.5,
+                jnp.asarray(3), jnp.float32(1e-6), 1.0, "logistic",
+                "none", log=False,
+            )
+        )(X, y, mask, jnp.zeros(3)))
+
+    obs.programs_reset()
+    with config.set(obs_programs=False, watchdog_timeout_s=0.0):
+        baseline = jaxpr()
+        assert "debug_callback" not in baseline
+        assert obs.programs_snapshot() == []   # tracker never recorded
+        assert not obs.watchdog_active()       # no thread armed
+        from dask_ml_tpu.observability import watchdog
+
+        with watchdog() as wd:                 # config-gated: a no-op
+            assert wd is None
+            assert jaxpr() == baseline         # nothing entered the trace
+        assert not obs.watchdog_active()
+    # the tracker is transparent: the jit object stays reachable and the
+    # raw body unwrap (used by super-block reducers) still lands on the
+    # plain function
+    assert hasattr(_gd_run, "__wrapped_jit__")
+    assert not hasattr(_gd_run.__wrapped__, "__wrapped__")
+
+
 def test_jit_callbacks_probe_resettable(monkeypatch):
     from dask_ml_tpu.observability import _metrics
 
