@@ -145,11 +145,17 @@ def run():
     # watchdogs above (which only bound TOTAL time) cannot give. Daemon
     # thread; dies with the child.
     from dask_ml_tpu.observability import Watchdog
+    from dask_ml_tpu.observability.live import ensure_telemetry
 
     Watchdog(
         float(os.environ.get("BENCH_WATCHDOG_TIMEOUT", "120")),
         on_stall=_print_stall,
     ).start()
+    # live exporter (DASK_ML_TPU_OBS_HTTP_PORT): during a wedged round
+    # an operator can curl /status for the open-span stack instead of
+    # waiting on the watchdog's one-shot dump; no-op when the env knob
+    # is unset, so the timed fits below keep their profile
+    ensure_telemetry()
     from dask_ml_tpu.linear_model import LogisticRegression
     from dask_ml_tpu.parallel import as_sharded
 

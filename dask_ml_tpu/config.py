@@ -66,6 +66,13 @@ class Config:
     # shows up in the recompiles counter), so steady-state zero-recompile
     # contracts keep it off by default
     obs_programs: bool = False
+    # live telemetry exporter (observability/live.py): port for the
+    # background HTTP daemon serving Prometheus /metrics, /healthz and
+    # the JSON /status (open-span stack, report tables, serving
+    # windows) WHILE a run is going. 0 = off — the exporter thread is
+    # never created, no span observer registers, and the hot paths keep
+    # today's zero-overhead profile (env DASK_ML_TPU_OBS_HTTP_PORT)
+    obs_http_port: int = 0
     # slow-span watchdog (observability/_watchdog.py): any span open past
     # this many seconds dumps all-thread tracebacks + device memory
     # gauges + the open-span stack to the trace sink, without touching
@@ -93,6 +100,10 @@ class Config:
     # request still queued past it is shed with RequestTimeout
     # (0 = no deadline)
     serving_timeout_ms: float = 1000.0
+    # latency SLO (milliseconds, end-to-end enqueue -> demux) — requests
+    # over it increment the serving_slo_violations counter (visible in
+    # /metrics and the report counters table); 0 = no SLO accounting
+    serving_slo_ms: float = 0.0
 
 
 _ENV_PREFIX = "DASK_ML_TPU_"

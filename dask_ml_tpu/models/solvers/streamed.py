@@ -473,6 +473,14 @@ class StreamedObjective:
                 np.asarray(hs, np.float64) / self.n_rows)
 
     def log(self, it, val, gnorm):
+        from ...observability.live import publish_progress
+
+        # the streamed solvers hold loss/grad_norm on HOST already (the
+        # per-pass reduction fetched them) — publishing live gauges
+        # costs dict writes, never a device sync; no-op without a
+        # telemetry server
+        publish_progress(loss=float(val), grad_norm=float(gnorm),
+                         iteration=int(it), pass_count=self.passes)
         if self.logger is not None:
             self.logger.log(step=it, loss=float(val), grad_norm=float(gnorm),
                             passes=self.passes)

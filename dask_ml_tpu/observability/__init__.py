@@ -29,7 +29,16 @@ equivalent is this package (grown from the flat per-step logger in
   (``report ... --perfetto out.json``);
 - ``report``    — ``python -m dask_ml_tpu.observability.report
   metrics.jsonl`` aggregates a recorded run into per-component tables
-  (``--json`` for the machine-readable form).
+  (``--json`` for the machine-readable form; ``--merge`` folds several
+  processes' trace files into ONE timeline/report);
+- ``_hist``     — thread-safe fixed-boundary log-spaced histograms (the
+  serving latency quantile core and /metrics histogram series);
+- ``live``      — the LIVE telemetry plane (``config.obs_http_port``):
+  a process-wide gauge/histogram registry over the counter registry,
+  fit-progress publication via span-close observers, and a background
+  HTTP exporter serving Prometheus ``/metrics``, ``/healthz`` and a
+  JSON ``/status`` (open-span stack, report tables, serving windows,
+  watchdog stalls) while the run is still going.
 
 Everything is ambient and zero-overhead when disabled: no
 ``metrics_path``/``trace_dir`` configured means spans are no-ops and no
@@ -50,6 +59,7 @@ from ._counters import (
     record_serving_batch,
     record_serving_drop,
     record_serving_request,
+    record_serving_slo_violation,
     record_superblock,
     record_superblock_donation,
     record_transfer,
@@ -74,8 +84,27 @@ from ._programs import (
     programs_snapshot,
     track_program,
 )
-from ._spans import NOOP_SPAN, current_span_id, open_spans_snapshot, span
+from ._hist import Histogram
+from ._spans import (
+    NOOP_SPAN,
+    add_span_observer,
+    current_span_id,
+    open_spans_snapshot,
+    remove_span_observer,
+    span,
+)
 from ._watchdog import Watchdog, watchdog, watchdog_active
+from .live import (
+    TelemetryServer,
+    ensure_telemetry,
+    gauge_set,
+    live_publishing,
+    publish_progress,
+    render_prometheus,
+    status_data,
+    stop_telemetry,
+    telemetry_server,
+)
 
 # recompile telemetry is passive and cheap (a no-op listener call per
 # compile when counters are disabled) — install at import so the counter
@@ -83,10 +112,22 @@ from ._watchdog import Watchdog, watchdog, watchdog_active
 install_recompile_tracking()
 
 __all__ = [
+    "Histogram",
     "MetricsLogger",
     "NOOP_SPAN",
+    "TelemetryServer",
     "Watchdog",
     "active_logger",
+    "add_span_observer",
+    "ensure_telemetry",
+    "gauge_set",
+    "live_publishing",
+    "publish_progress",
+    "remove_span_observer",
+    "render_prometheus",
+    "status_data",
+    "stop_telemetry",
+    "telemetry_server",
     "count_recompiles",
     "counter_add",
     "counters_enabled",
@@ -109,6 +150,7 @@ __all__ = [
     "record_serving_batch",
     "record_serving_drop",
     "record_serving_request",
+    "record_serving_slo_violation",
     "record_superblock",
     "record_superblock_donation",
     "record_transfer",

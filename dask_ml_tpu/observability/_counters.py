@@ -123,6 +123,15 @@ def record_serving_drop(kind: str) -> None:
         counter_add(_SERVING_DROP_COUNTERS[kind], 1)
 
 
+def record_serving_slo_violation() -> None:
+    """A served request's end-to-end latency exceeded the configured
+    ``serving_slo_ms`` — the request still SUCCEEDED (unlike the drop
+    counters above); this is the SLO burn signal a scraper alerts on
+    (live /metrics: ``dask_ml_tpu_serving_slo_violations_total``)."""
+    if counters_enabled():
+        counter_add("serving_slo_violations", 1)
+
+
 # -- recompile tracking ------------------------------------------------------
 
 _recompile_listener_installed = False

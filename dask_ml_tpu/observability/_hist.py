@@ -1,0 +1,131 @@
+"""Fixed-boundary log-spaced histograms: the live plane's distribution
+primitive.
+
+The serving latency story needs quantiles that are (a) thread-safe
+against a reader scraping while the worker records, (b) O(1) per
+``observe`` with zero allocation, and (c) renderable as Prometheus
+histogram series (cumulative ``le`` buckets). A sorted/ring window gives
+exact quantiles but couples readers and writers through one buffer and
+FORGETS everything older than the window; a fixed-boundary histogram
+keeps every observation ever made, costs one bisect + three adds per
+record, and the scrape path reads a consistent snapshot under the same
+small lock.
+
+Boundaries default to a 1-2-5 ladder over 1e-5 .. 100 seconds (seven
+decades: 10µs device dispatches through multi-minute stalled passes).
+Quantiles are estimated by linear interpolation inside the winning
+bucket and clamped to the observed [min, max] — at the default ladder
+the estimate is within a factor of 2.5 of exact everywhere, and much
+tighter in practice because latency mass concentrates in few buckets.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from bisect import bisect_left
+
+# 1-2-5 per decade, 1e-5 s .. 1e2 s. A literal (not a comprehension) so
+# the Prometheus ``le`` labels are stable, exact decimals run to run.
+DEFAULT_BOUNDS = (
+    1e-05, 2e-05, 5e-05,
+    1e-04, 2e-04, 5e-04,
+    0.001, 0.002, 0.005,
+    0.01, 0.02, 0.05,
+    0.1, 0.2, 0.5,
+    1.0, 2.0, 5.0,
+    10.0, 20.0, 50.0,
+    100.0,
+)
+
+
+class Histogram:
+    """Thread-safe fixed-boundary histogram.
+
+    ``observe(v)`` is one bisect over the (immutable) boundary tuple
+    plus three adds under the lock — no allocation, no resize, safe from
+    any thread. ``counts`` has ``len(bounds) + 1`` slots; the last is
+    the +Inf overflow bucket. Bucket semantics match Prometheus:
+    bucket ``i`` counts observations ``v <= bounds[i]``.
+    """
+
+    __slots__ = ("bounds", "_counts", "_sum", "_count", "_min", "_max",
+                 "_lock")
+
+    def __init__(self, bounds=None):
+        self.bounds = tuple(float(b) for b in (bounds or DEFAULT_BOUNDS))
+        if list(self.bounds) != sorted(set(self.bounds)):
+            raise ValueError("histogram bounds must be strictly increasing")
+        self._counts = [0] * (len(self.bounds) + 1)
+        self._sum = 0.0
+        self._count = 0
+        self._min = math.inf
+        self._max = -math.inf
+        self._lock = threading.Lock()
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        i = bisect_left(self.bounds, value)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += value
+            self._count += 1
+            if value < self._min:
+                self._min = value
+            if value > self._max:
+                self._max = value
+
+    def snapshot(self) -> dict:
+        """Consistent copy: {bounds, counts, sum, count, min, max} —
+        ``counts[i]`` is per-bucket (NOT cumulative); the exposition
+        layer accumulates."""
+        with self._lock:
+            return {
+                "bounds": self.bounds,
+                "counts": list(self._counts),
+                "sum": self._sum,
+                "count": self._count,
+                "min": self._min if self._count else None,
+                "max": self._max if self._count else None,
+            }
+
+    def percentiles(self, qs=(50, 99)) -> dict:
+        """{'p50': ..., 'p99': ...} estimated by linear interpolation
+        inside the winning bucket, clamped to observed [min, max]
+        (NaN-valued when empty, matching the old LatencyWindow
+        contract)."""
+        snap = self.snapshot()
+        out = {}
+        n = snap["count"]
+        if n == 0:
+            return {f"p{q}": float("nan") for q in qs}
+        counts, bounds = snap["counts"], snap["bounds"]
+        for q in qs:
+            rank = max(min(math.ceil(q / 100.0 * n), n), 1)
+            cum = 0
+            value = snap["max"]
+            for i, c in enumerate(counts):
+                if c == 0:
+                    continue
+                if cum + c >= rank:
+                    # bucket 0's floor is the observed min (all its
+                    # members are <= bounds[0] and the min is among
+                    # them); the overflow bucket's ceiling is the max
+                    lo = bounds[i - 1] if i > 0 else snap["min"]
+                    hi = bounds[i] if i < len(bounds) else snap["max"]
+                    frac = (rank - cum) / c
+                    value = lo + frac * (hi - lo)
+                    break
+                cum += c
+            out[f"p{q}"] = float(
+                min(max(value, snap["min"]), snap["max"])
+            )
+        return out

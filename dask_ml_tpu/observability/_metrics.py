@@ -38,7 +38,12 @@ class MetricsLogger:
         return self._fh
 
     def log(self, step=None, **metrics):
-        rec = {"time": round(time.time() - self.t0, 6), **self.extra}
+        # t_unix anchors the record on the wall clock so `report --merge`
+        # can place counters/programs-only files (no span records) on the
+        # shared timeline; a record's own t_unix (spans) wins via update()
+        now = time.time()
+        rec = {"time": round(now - self.t0, 6),
+               "t_unix": round(now, 6), **self.extra}
         if step is not None:
             rec["step"] = step
         rec.update(metrics)
@@ -123,6 +128,18 @@ def _jit_step_cb(step, metrics_names, *values):
     if lg is not None:
         lg.log(step=int(step),
                **{n: float(v) for n, v in zip(metrics_names, values)})
+    # resident fits' in-jit step metrics (loss, grad_norm, ...) double
+    # as live progress gauges; publish_progress is a no-op bool check
+    # unless a telemetry server is running, and the values are already
+    # host floats here (the callback runtime synced them) — no new sync
+    try:
+        from .live import publish_progress
+
+        publish_progress(step=int(step),
+                         **{n: float(v)
+                            for n, v in zip(metrics_names, values)})
+    except Exception:
+        pass
 
 
 def emit_jit_step(step, **metrics):
@@ -176,7 +193,12 @@ def fit_logger(component, **extra):
     how estimators/solvers wire per-step JSONL without every call site
     touching config (BASELINE.md measurement protocol)."""
     from ..config import get_config
+    from .live import ensure_telemetry
 
+    # every fit passes through here: the one hook that arms the live
+    # telemetry server for resident fits (config.obs_http_port; a single
+    # config read when the knob is at its 0 default)
+    ensure_telemetry()
     path = get_config().metrics_path
     if not path:
         yield None

@@ -46,20 +46,47 @@ _tls = threading.local()
 _open_lock = threading.Lock()
 _open_spans: dict[int, dict] = {}
 
-# live watchdog count (armed by _watchdog.Watchdog.start/stop): while a
-# watchdog is polling, spans register in the open-span registry even
-# when NO sink is configured — otherwise a run without metrics_path/
+# live tracker count (armed by _watchdog.Watchdog.start/stop and by the
+# telemetry plane's span observers): while a watchdog polls or a live
+# observer listens, spans register in the open-span registry even when
+# NO sink is configured — otherwise a run without metrics_path/
 # trace_dir (bench's timed fits, the wedged-tunnel scenario) would be
-# invisible to the very thread meant to catch its stalls. Sinkless
-# tracked spans write no record; the disabled path (no sink, no
-# watchdog) stays the zero-cost no-op.
-_armed_watchdogs = 0
+# invisible to the very threads meant to watch it. Sinkless tracked
+# spans write no JSONL record; the disabled path (no sink, no tracker)
+# stays the zero-cost no-op.
+_armed_trackers = 0
 
 
-def _watchdog_arm(delta: int) -> None:
-    global _armed_watchdogs
+def _track_arm(delta: int) -> None:
+    global _armed_trackers
     with _open_lock:
-        _armed_watchdogs += delta
+        _armed_trackers += delta
+
+
+# span-close observers (the live telemetry plane subscribes while its
+# HTTP server runs): each gets the SAME record dict the sink receives —
+# for sinkless tracked spans, a record without counter deltas. The list
+# is empty unless something subscribed, so the default path never
+# builds a record it won't use.
+_span_observers: list = []
+
+
+def add_span_observer(fn) -> None:
+    """Subscribe ``fn(record)`` to every span close; arms span tracking
+    (like a watchdog) so observers see spans even with no sink
+    configured."""
+    with _open_lock:
+        _span_observers.append(fn)
+    _track_arm(+1)
+
+
+def remove_span_observer(fn) -> None:
+    with _open_lock:
+        try:
+            _span_observers.remove(fn)
+        except ValueError:
+            return
+    _track_arm(-1)
 
 
 def open_spans_snapshot():
@@ -188,10 +215,11 @@ class span:
 
     def __enter__(self):
         sink = _trace_sink()
-        if sink is None and not _armed_watchdogs:
+        if sink is None and not _armed_trackers:
             return NOOP_SPAN
-        # sink None but a watchdog armed: track the span (open-span
-        # registry + id stack) without emitting a record at close
+        # sink None but a watchdog/observer armed: track the span
+        # (open-span registry + id stack); close emits to observers
+        # only, no JSONL record
         self._sink = sink
         self._tracked = True
         st = _stack()
@@ -233,7 +261,8 @@ class span:
             _open_spans.pop(self.span_id, None)
             for sid in abandoned:  # their __exit__ will never run
                 _open_spans.pop(sid, None)
-        if self._sink is None:
+            observers = list(_span_observers)
+        if self._sink is None and not observers:
             return False  # watchdog-only tracking: no record to emit
         rec = {
             "span": self.name,
@@ -260,11 +289,20 @@ class span:
                 if d:
                     rec[f"ctr_{k}"] = round(d, 6) if isinstance(
                         d, float) else d
-        try:
-            self._sink.log(**rec)
-        except Exception:
-            # telemetry must never kill the fit it observes (a full disk
-            # mid-run would otherwise raise out of this __exit__ —
-            # replacing the in-flight exception when one is unwinding)
-            pass
+        for fn in observers:
+            # the live plane sees every closed span, recorded or not —
+            # a failing observer must never surface into the fit
+            try:
+                fn(rec)
+            except Exception:
+                pass
+        if self._sink is not None:
+            try:
+                self._sink.log(**rec)
+            except Exception:
+                # telemetry must never kill the fit it observes (a full
+                # disk mid-run would otherwise raise out of this
+                # __exit__ — replacing the in-flight exception when one
+                # is unwinding)
+                pass
         return False

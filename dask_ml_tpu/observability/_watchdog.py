@@ -34,7 +34,7 @@ import time
 import traceback
 
 from ._counters import counter_add, counters_enabled, device_memory_gauges
-from ._spans import _trace_sink, _watchdog_arm, open_spans_snapshot
+from ._spans import _trace_sink, _track_arm, open_spans_snapshot
 
 # live watchdog threads (for tests / the zero-overhead assertion)
 _active_lock = threading.Lock()
@@ -100,7 +100,7 @@ class Watchdog:
             _active_watchdogs += 1
         # spans now register in the open-span registry even without a
         # configured sink — a sinkless run's stalls stay catchable
-        _watchdog_arm(+1)
+        _track_arm(+1)
         self._thread.start()
         return self
 
@@ -113,7 +113,7 @@ class Watchdog:
         self._thread = None
         with _active_lock:
             _active_watchdogs -= 1
-        _watchdog_arm(-1)
+        _track_arm(-1)
 
     def __enter__(self):
         return self.start()
@@ -178,6 +178,14 @@ class Watchdog:
             pass
         if counters_enabled():
             counter_add("watchdog_stalls", 1)
+        try:
+            # feed the live plane's /status stall ring (stacks elided
+            # there; the full dump still goes to the trace sink below)
+            from .live import note_stall
+
+            note_stall(rec)
+        except Exception:
+            pass
         sink = None
         try:
             sink = _trace_sink()

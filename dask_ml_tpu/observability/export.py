@@ -6,7 +6,9 @@ format, viewable in ``ui.perfetto.dev`` (or ``chrome://tracing``):
 
 - span records become complete ("X") track events, laned by the thread
   that closed them (span trees nest by containment, exactly how the
-  span stack produced them);
+  span stack produced them); a merged multi-process input (``report
+  --merge``) lanes by (pid, thread) — the pid rides each span id's
+  high bits;
 - per-span counter deltas (``ctr_*``) become cumulative counter ("C")
   tracks — program FLOPs, h2d bytes, recompiles over time;
 - explicit counter snapshots (``log_counters`` records) set the same
@@ -96,6 +98,22 @@ def to_chrome_trace(records) -> dict:
     events = []
     tids = {}
 
+    # span ids carry their process in the high bits (_spans pid-prefixes
+    # the id counter); a MERGED multi-process trace (report --merge)
+    # lanes by (pid, thread) so two processes' "MainThread" spans don't
+    # interleave on one lane — single-process traces keep the plain
+    # thread name
+    span_pids = {r["span_id"] >> 24 for r in records
+                 if isinstance(r.get("span_id"), int)}
+    multi_proc = len(span_pids) > 1
+
+    def lane_of(r):
+        name = r.get("thread", "main")
+        sid = r.get("span_id")
+        if multi_proc and isinstance(sid, int):
+            return f"pid{sid >> 24}.{name}"
+        return name
+
     def tid_of(name):
         if name not in tids:
             tids[name] = len(tids) + 1
@@ -119,7 +137,7 @@ def to_chrome_trace(records) -> dict:
             events.append({
                 "name": f"watchdog: {r.get('span', '?')} stalled",
                 "ph": "i", "s": "g", "pid": 1,
-                "tid": tid_of(r.get("thread", "main")),
+                "tid": tid_of(lane_of(r)),
                 "ts": round(t, 3),
                 "args": {"age_s": r.get("age_s"),
                          "timeout_s": r.get("timeout_s")},
@@ -135,7 +153,7 @@ def to_chrome_trace(records) -> dict:
                     and isinstance(v, (int, float, str, bool))}
             events.append({
                 "name": name, "ph": "X", "pid": 1,
-                "tid": tid_of(r.get("thread", "main")),
+                "tid": tid_of(lane_of(r)),
                 "ts": round(max(t - dur, 0.0), 3), "dur": round(dur, 3),
                 "args": args,
             })
@@ -153,7 +171,8 @@ def to_chrome_trace(records) -> dict:
             continue
         if r.get("counters"):
             for k, v in r.items():
-                if k in ("counters", "time", "step", "component"):
+                if k in ("counters", "time", "t_unix", "step",
+                         "component"):
                     continue
                 if isinstance(v, bool) or not isinstance(v, (int, float)):
                     continue

@@ -6,6 +6,7 @@ Usage::
     python -m dask_ml_tpu.observability.report metrics.jsonl
     python -m dask_ml_tpu.observability.report metrics.jsonl --json
     python -m dask_ml_tpu.observability.report trace.jsonl --perfetto out.json
+    python -m dask_ml_tpu.observability.report --merge a.jsonl b.jsonl ...
 
 Reads the records the subsystem emits — span records (``span`` field),
 per-step solver/search records (``component`` field), stream-pass
@@ -48,6 +49,58 @@ def load_records(path):
             except ValueError:
                 continue
     return records
+
+
+def merge_records(record_lists):
+    """Fold several processes' record lists into ONE timeline.
+
+    The flight recorder already pid-prefixes span ids, so records from
+    a bench child, a serving worker, and a multichip dryrun can share
+    one report without id collisions — what they do NOT share is a time
+    origin: span records carry absolute ``t_unix``, but step/stream
+    records only carry the sink-relative ``time`` whose zero-point is
+    per-process (per-logger, even). Per input list this estimates the
+    origin as the median of (t_unix - time) over records carrying both
+    (the same estimator ``export.py`` uses per component), assigns each
+    record an absolute timestamp — records with neither field inherit
+    their in-file predecessor's, preserving local order — and merge-
+    sorts everything by it. ``final_counters``/``final_programs``'s
+    "last snapshot wins" then means last *in wall-clock time*, not last
+    file on the command line.
+    """
+    keyed = []
+    seq = 0
+    # fallback anchor for a legacy clock-less file (no t_unix anywhere,
+    # pre-stamping writers): place it after every clocked record rather
+    # than at -inf, where it would steal "first" and its counters
+    # snapshot would LOSE "last in wall-clock time" to any mid-run one
+    t_max = max(
+        (float(r["t_unix"]) for records in record_lists
+         for r in records if isinstance(r, dict) and "t_unix" in r),
+        default=0.0,
+    )
+    for records in record_lists:
+        deltas = sorted(
+            float(r["t_unix"]) - float(r["time"])
+            for r in records
+            if isinstance(r, dict) and "t_unix" in r and "time" in r
+        )
+        origin = deltas[len(deltas) // 2] if deltas else None
+        last = float("-inf") if origin is not None else t_max
+        for r in records:
+            if not isinstance(r, dict):
+                continue
+            if "t_unix" in r:
+                t = float(r["t_unix"])
+            elif origin is not None and "time" in r:
+                t = origin + float(r["time"])
+            else:
+                t = last  # no clock: ride the neighbor, keep file order
+            last = t
+            keyed.append((t, seq, r))
+            seq += 1
+    keyed.sort(key=lambda kv: (kv[0], kv[1]))
+    return [r for _, _, r in keyed]
 
 
 def _fmt_seconds(s):
@@ -222,7 +275,8 @@ def final_counters(records):
     snaps = [r for r in records if r.get("counters")]
     if snaps:
         return {k: v for k, v in snaps[-1].items()
-                if k not in ("counters", "time", "step", "component")
+                if k not in ("counters", "time", "t_unix", "step",
+                             "component")
                 and _numeric(v)}
     totals = {}
     for r in records:
@@ -411,6 +465,7 @@ def main(argv=None):
         print(__doc__.strip())
         return 0 if argv else 2
     as_json = False
+    merge = False
     perfetto_out = None
     paths = []
     i = 0
@@ -418,6 +473,8 @@ def main(argv=None):
         a = argv[i]
         if a == "--json":
             as_json = True
+        elif a == "--merge":
+            merge = True
         elif a == "--perfetto":
             if i + 1 >= len(argv):
                 print("error: --perfetto needs an output path",
@@ -431,13 +488,49 @@ def main(argv=None):
     if not paths:
         print("error: no input JSONL files", file=sys.stderr)
         return 2
-    if perfetto_out is not None and len(paths) > 1:
+    if perfetto_out is not None and len(paths) > 1 and not merge:
         # one output path per invocation: silently overwriting it per
-        # input would keep only the last file's trace
+        # input would keep only the last file's trace (--merge folds
+        # the inputs into ONE trace, which is the multi-file story)
         print("error: --perfetto takes exactly one input JSONL "
-              f"(got {len(paths)}); run once per file", file=sys.stderr)
+              f"(got {len(paths)}); run once per file or pass --merge",
+              file=sys.stderr)
         return 2
     rc = 0
+    if merge:
+        # one merged timeline: every input contributes to a single
+        # report/trace instead of one report per file
+        lists = []
+        for path in paths:
+            try:
+                lists.append(load_records(path))
+            except OSError as e:
+                print(f"error: cannot read {path}: {e}", file=sys.stderr)
+                rc = 1
+        if not lists:
+            return rc or 1
+        merged = merge_records(lists)
+        label = " + ".join(paths)
+        if perfetto_out is not None:
+            from .export import write_chrome_trace
+
+            try:
+                trace = write_chrome_trace(merged, perfetto_out)
+            except OSError as e:
+                print(f"error: cannot write {perfetto_out}: {e}",
+                      file=sys.stderr)
+                return 1
+            print(f"wrote {len(trace['traceEvents'])} trace events "
+                  f"-> {perfetto_out}  (open in ui.perfetto.dev)",
+                  file=sys.stderr)
+        if as_json:
+            data = report_data(merged)
+            data["path"] = label
+            data["merged_files"] = len(lists)
+            sys.stdout.write(json.dumps(data) + "\n")
+        elif perfetto_out is None:
+            sys.stdout.write(build_report(merged, path=label))
+        return rc
     for path in paths:
         try:
             records = load_records(path)

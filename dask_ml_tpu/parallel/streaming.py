@@ -356,11 +356,15 @@ class BlockStream:
         self._counts_sharding = NamedSharding(self.mesh, P())
         self._superblock_k_override = None  # set by the K autotuner
         from ..config import ensure_compile_cache
+        from ..observability.live import ensure_telemetry
 
         # streamed fits are the repeated-warmup-compile hot spot the
         # persistent compile cache exists for; apply the knob (no-op
         # when config.compile_cache_dir is unset)
         ensure_compile_cache()
+        # ... and the long-running workload the live exporter exists
+        # for: arm /metrics//status (no-op when obs_http_port is 0)
+        ensure_telemetry()
 
     def _verify_native(self):
         """Which arrays the C++ readahead reader can serve, verified by
@@ -528,6 +532,11 @@ class BlockStream:
                 # from the ctr_program_flops delta this span carries —
                 # the consumer's compute runs while the generator is
                 # suspended INSIDE this span)
+                # passes_total (known inside epochs()) lets the live
+                # plane derive an ETA from the pass clock — host ints
+                tot = getattr(self, "_epochs_total", None)
+                if tot:
+                    sp.add(passes_total=int(tot))
                 sp.add(stream_pass=self._passes, n_rows=int(self.n_rows),
                        **{k: (round(v, 6) if isinstance(v, float) else v)
                           for k, v in stats.items()})
@@ -574,6 +583,7 @@ class BlockStream:
 
             autotune = get_config().stream_autotune
         self._autotune_pass = bool(autotune)  # enables wait_s measuring
+        self._epochs_total = int(n_epochs)    # pass spans carry it (ETA)
         try:
             for e in range(n_epochs):
                 yield from self
@@ -581,6 +591,7 @@ class BlockStream:
                     self._maybe_grow_blocks()
         finally:
             self._autotune_pass = False
+            self._epochs_total = None
 
     # -- super-block execution (ISSUE 3 tentpole) -------------------------
     # K fixed-shape blocks stack into one [K, block_rows, d] device
@@ -826,6 +837,9 @@ class BlockStream:
                     - int(b) * self.block_rows
                     for b in order
                 ))
+                tot = getattr(self, "_epochs_total", None)
+                if tot:
+                    sp.add(passes_total=int(tot))
                 sp.add(stream_pass=self._passes,
                        dispatches=int(n_sb), n_rows=pass_rows,
                        **{key: (round(v, 6) if isinstance(v, float) else v)
@@ -845,6 +859,7 @@ class BlockStream:
 
             autotune = get_config().stream_autotune
         self._autotune_pass = bool(autotune)
+        self._epochs_total = int(n_epochs)
         try:
             for e in range(n_epochs):
                 yield from self.superblocks()
@@ -853,6 +868,7 @@ class BlockStream:
                     self._maybe_grow_superblock()
         finally:
             self._autotune_pass = False
+            self._epochs_total = None
 
     def _pass_data_bound(self, st):
         """Was the last pass limited by data movement? Per-block passes

@@ -126,6 +126,13 @@ class ModelServer:
 
     # -- lifecycle --------------------------------------------------------
     def start(self):
+        from ..observability.live import ensure_telemetry, register_server
+
+        # a serving process is exactly what the live exporter exists
+        # for: arm it (no-op unless config.obs_http_port is set) and
+        # list this server's stats() window on /status
+        ensure_telemetry()
+        register_server(self)
         with self._lock:
             if self._thread is not None:
                 return self
@@ -143,6 +150,9 @@ class ModelServer:
         """Stop admissions; with ``drain`` (default) finish every queued
         request before joining the worker, else shed them with
         ServerClosed."""
+        from ..observability.live import unregister_server
+
+        unregister_server(self)
         with self._lock:
             self._accepting = False
             thread = self._thread
@@ -342,7 +352,12 @@ class ModelServer:
     # -- stats -------------------------------------------------------------
     def stats(self):
         """Live snapshot: queue depth/peak, batch count, request count,
-        and latency quantiles over the recent window."""
+        and latency quantiles over the SERVER'S LIFETIME — the
+        histogram-backed LatencyWindow keeps the whole run, so p50/p99
+        answer "how has this server behaved", not "how is it behaving
+        right now" (a long fast history dilutes a fresh degradation;
+        watch the per-(method, bucket) /metrics histograms over scrape
+        intervals for rate-of-change)."""
         q = self._queue
         return {
             "queue_depth": q.depth,
@@ -445,12 +460,14 @@ class ModelServer:
         # batch's futures, never kill the worker thread — a dead worker
         # would strand every later request behind a queue nobody drains
         try:
-            fn = self._fns[batch[0].method]
+            method = batch[0].method
+            fn = self._fns[method]
             buf, segments, bucket, rows = pack_batch(
                 batch, self.ladder, self._staging
             )
+            smetrics.set_queue_gauges(self._queue.depth, rows)
             with smetrics.batch_span(
-                batch[0].method, bucket, rows, len(batch),
+                method, bucket, rows, len(batch),
                 self._queue.depth,
             ):
                 out = fn(buf)
@@ -458,7 +475,12 @@ class ModelServer:
             smetrics.record_batch(rows, bucket)
             done = time.perf_counter()
             for r in batch:
-                self._latency.observe(done - r.t_enqueue)
+                lat = done - r.t_enqueue
+                self._latency.observe(lat)
+                # the /metrics histogram series: per (method, bucket)
+                # so a capacity review sees which rung is slow, and the
+                # SLO counter when config.serving_slo_ms is set
+                smetrics.observe_request_latency(method, bucket, lat)
             demux_outputs(out, segments)
         except Exception as exc:
             for _ in batch:   # per REQUEST, matching the timeout path
@@ -466,6 +488,10 @@ class ModelServer:
             fail_requests(batch, ServingError(
                 f"batch execution failed: {type(exc).__name__}: {exc}"
             ))
+        finally:
+            # inflight back to 0 on the failure path too — a failed
+            # batch must not leave /metrics showing phantom inflight rows
+            smetrics.set_queue_gauges(self._queue.depth, 0)
 
 
 def _gather_futures(futures):

@@ -1,39 +1,50 @@
-"""Serving telemetry: per-batch spans, counters, and latency quantiles.
+"""Serving telemetry: per-batch spans, counters, latency histograms and
+SLO accounting.
 
 Everything funnels through ``dask_ml_tpu/observability/`` — the same
-JSONL sinks, span tree, and counter registry the fit paths use, so a
-recorded serving run and a recorded fit aggregate under one report CLI.
-Per batch the server emits one ``serving.batch`` span carrying bucket,
-occupancy, and padding attributes (plus the counter deltas it caused —
-recompiles paid mid-serving show up HERE, on the batch that paid them).
-Counters accumulate the run totals:
+JSONL sinks, span tree, counter registry and live-telemetry registry
+the fit paths use, so a recorded serving run and a recorded fit
+aggregate under one report CLI and one ``/metrics`` page. Per batch the
+server emits one ``serving.batch`` span carrying bucket, occupancy, and
+padding attributes (plus the counter deltas it caused — recompiles paid
+mid-serving show up HERE, on the batch that paid them). Counters
+accumulate the run totals:
 
 - ``serving_requests`` / ``serving_rows``   — admitted work
 - ``serving_batches`` / ``serving_padded_rows`` — batching efficiency
   (padding waste = padded_rows / (rows + padded_rows))
 - ``serving_shed`` / ``serving_timeouts`` / ``serving_errors`` —
   backpressure outcomes
+- ``serving_slo_violations`` — requests whose end-to-end latency
+  exceeded ``config.serving_slo_ms`` (0 = no SLO)
 
-Latency quantiles come from a fixed-size ring of recent request
-latencies — O(1) memory for a long-lived server, exact percentiles over
-the retained window.
+Latency quantiles come from fixed-boundary log-spaced histograms
+(``observability._hist``): O(1) thread-safe ``observe`` from the worker
+while any number of scrape/stats readers take consistent snapshots, and
+— unlike the retired ring window — nothing is ever forgotten, so a p99
+over a million-request day really covers the day. :class:`LatencyWindow`
+keeps its name and API (``observe`` / ``percentiles`` / ``count``) as
+the server-local view; :func:`observe_request_latency` additionally
+feeds the process-wide per-(method, bucket) histogram series the
+``/metrics`` exporter renders
+(``dask_ml_tpu_serving_latency_seconds_bucket{method=...,bucket=...}``).
 """
 
 from __future__ import annotations
-
-import threading
-
-import numpy as np
 
 from ..observability import span
 from ..observability._counters import (
     record_serving_batch,
     record_serving_drop,
     record_serving_request,
+    record_serving_slo_violation,
 )
+from ..observability._hist import Histogram
+from ..observability.live import gauge_set, histogram, live_publishing
 
 __all__ = ["LatencyWindow", "batch_span", "record_batch",
-           "record_request", "record_drop"]
+           "record_request", "record_drop", "observe_request_latency",
+           "set_queue_gauges"]
 
 # counter recording lives in observability/_counters.py (the shared
 # registry the report CLI and span deltas read); these are the serving
@@ -56,32 +67,63 @@ def batch_span(method: str, bucket: int, rows: int, n_requests: int,
     )
 
 
+def observe_request_latency(method: str, bucket: int,
+                            seconds: float) -> None:
+    """One request's end-to-end latency (enqueue -> demux) into the
+    process-wide per-(method, bucket) histogram series, plus the SLO
+    violation counter when ``config.serving_slo_ms`` is set. Called by
+    the worker per request per batch — one bisect + dict adds, no
+    device interaction. The histogram series is gated like the queue
+    gauges (same no-exporter-nobody-pays rule): ``LatencyWindow``
+    already keeps the run's latency summary, so without a live server
+    the registry write is pure dead work; the SLO counter stays
+    unconditional — it feeds the report counters table, server or not."""
+    if live_publishing():
+        histogram(
+            "serving_latency_seconds",
+            labels=(("method", str(method)),
+                    ("bucket", str(int(bucket)))),
+        ).observe(seconds)
+    from ..config import get_config
+
+    slo_ms = get_config().serving_slo_ms
+    if slo_ms and seconds * 1e3 > slo_ms:
+        record_serving_slo_violation()
+
+
+def set_queue_gauges(depth: int, inflight_rows: int) -> None:
+    """Live queue-depth / inflight gauges (scraped via /metrics). Only
+    written while a telemetry server is up — the steady-state serving
+    loop must not pay dict writes for an exporter nobody runs."""
+    if not live_publishing():
+        return
+    gauge_set("serving_queue_depth", depth)
+    gauge_set("serving_inflight_rows", inflight_rows)
+
+
 class LatencyWindow:
-    """Lock-guarded ring buffer of recent per-request latencies
-    (seconds). ``percentiles()`` computes exact quantiles over the
-    retained window — a million-request day keeps memory flat while p50
-    and p99 track the live distribution."""
+    """Histogram-backed latency summary (seconds): thread-safe O(1)
+    ``observe`` from the serving worker, quantile reads from any thread
+    without touching the writer's path. The name is historical — the
+    retired implementation was a ring window whose quantile read raced
+    a concurrent ``observe`` on the shared buffer AND silently forgot
+    everything older than its 4096 slots; the histogram keeps the whole
+    run. ``size`` is accepted for API compatibility and ignored."""
 
-    __slots__ = ("_lock", "_buf", "_n", "_i", "count")
+    __slots__ = ("_hist",)
 
-    def __init__(self, size=4096):
-        self._lock = threading.Lock()
-        self._buf = np.zeros(int(size), np.float64)
-        self._n = 0      # filled entries (<= size)
-        self._i = 0      # next write slot
-        self.count = 0   # total observations ever
+    def __init__(self, size=None, bounds=None):
+        self._hist = Histogram(bounds)
+
+    @property
+    def count(self) -> int:
+        return self._hist.count
 
     def observe(self, seconds: float) -> None:
-        with self._lock:
-            self._buf[self._i] = seconds
-            self._i = (self._i + 1) % len(self._buf)
-            self._n = min(self._n + 1, len(self._buf))
-            self.count += 1
+        self._hist.observe(seconds)
 
     def percentiles(self, qs=(50, 99)) -> dict:
-        with self._lock:
-            if self._n == 0:
-                return {f"p{q}": float("nan") for q in qs}
-            window = self._buf[: self._n].copy()
-        vals = np.percentile(window, qs)
-        return {f"p{q}": float(v) for q, v in zip(qs, vals)}
+        return self._hist.percentiles(qs)
+
+    def snapshot(self) -> dict:
+        return self._hist.snapshot()

@@ -515,7 +515,9 @@ class Incremental(ParallelPostFit):
                     classes=fit_kwargs.get("classes"),
                 )
                 return est
-            for b in order:
+            from .observability.live import publish_progress
+
+            for done, b in enumerate(order):
                 idx = np.arange(b * S, min((b + 1) * S, X.n_rows))
                 Xb = take_rows(X, idx)
                 if ys is None:
@@ -524,6 +526,9 @@ class Incremental(ParallelPostFit):
                     yb = take_rows(ys, idx) if isinstance(ys, ShardedArray) \
                         else ys[idx]
                     est.partial_fit(Xb, yb, **fit_kwargs)
+                # live pass progress (host ints; no-op without the
+                # telemetry server)
+                publish_progress(block=done + 1, blocks_total=B)
             return est
         # sparse X blocks stay CSR host-side: a device estimator's
         # partial_fit densifies ONE block at placement (as_sharded), a
@@ -546,10 +551,13 @@ class Incremental(ParallelPostFit):
             if est._stream_pass(Xh, yh, block_size, order=order,
                                 classes=fit_kwargs.get("classes")):
                 return est
-        for oi in order:
+        from .observability.live import publish_progress
+
+        for done, oi in enumerate(order):
             s = starts[int(oi)]
             est.partial_fit(Xh[s:s + block_size], yh[s:s + block_size],
                             **fit_kwargs)
+            publish_progress(block=done + 1, blocks_total=len(starts))
         return est
 
     def fit(self, X, y=None, **fit_kwargs):

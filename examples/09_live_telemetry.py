@@ -1,0 +1,86 @@
+"""Live telemetry: watch a fit WHILE it runs.
+
+Everything earlier in the observability stack (spans, counters, the
+report CLI) is post-hoc — you read the JSONL after the run. The live
+plane is the dask-dashboard analog: set ``config.obs_http_port`` (or
+``DASK_ML_TPU_OBS_HTTP_PORT``) and a daemon thread serves
+
+- ``/metrics``  — Prometheus text exposition (counters, fit progress
+  gauges, latency histograms) for a scraper,
+- ``/status``   — JSON: the open-span stack (what the process is doing
+  RIGHT NOW), recent-span report tables, serving windows,
+- ``/healthz``  — liveness.
+
+This example runs a streamed SGD fit on one thread and scrapes its own
+endpoints from another — the same curl an operator would run against a
+wedged production fit::
+
+    curl localhost:<port>/status | python -m json.tool
+    curl localhost:<port>/metrics | grep fit_
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import json
+import re
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+from dask_ml_tpu import config
+from dask_ml_tpu import observability as obs
+from dask_ml_tpu.models.sgd import SGDClassifier
+
+n = int(os.environ.get("DASK_ML_TPU_EXAMPLE_N", 200_000))
+rng = np.random.RandomState(0)
+X = rng.randn(n, 16).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.float32)
+
+# port=0 binds an ephemeral port; production would set
+# config.obs_http_port so every fit/serving entry arms it automatically
+server = obs.TelemetryServer(port=0).start()
+print(f"telemetry at {server.url}  (endpoints: /metrics /status /healthz)")
+
+
+def fit():
+    with config.set(stream_block_rows=8192):
+        SGDClassifier(max_iter=10, random_state=0).fit(X, y)
+
+
+t = threading.Thread(target=fit)
+t.start()
+
+while t.is_alive():
+    time.sleep(0.2)
+    with urllib.request.urlopen(server.url + "/status", timeout=5) as r:
+        status = json.loads(r.read())
+    with urllib.request.urlopen(server.url + "/metrics", timeout=5) as r:
+        metrics = r.read().decode()
+    gauges = dict(re.findall(r"^dask_ml_tpu_(fit_\w+) ([\d.e+-]+)$",
+                             metrics, re.MULTILINE))
+    open_spans = " > ".join(s["span"] for s in status["open_spans"])
+    print(f"open: [{open_spans or 'idle'}]  "
+          f"pass {gauges.get('fit_pass', '?')}/"
+          f"{gauges.get('fit_passes_total', '?')}  "
+          f"rows/s {float(gauges.get('fit_rows_per_sec', 0)):,.0f}  "
+          f"eta {float(gauges.get('fit_eta_seconds', 0)):.2f}s")
+t.join()
+
+with urllib.request.urlopen(server.url + "/metrics", timeout=5) as r:
+    metrics = r.read().decode()
+print("\nfinal /metrics (fit + histogram lines):")
+for line in metrics.splitlines():
+    if "fit_" in line and not line.startswith("#"):
+        print(" ", line)
+
+with urllib.request.urlopen(server.url + "/status", timeout=5) as r:
+    status = json.loads(r.read())
+spans = [s["span"] for s in status["report"]["spans"]]
+print(f"\n/status report covers spans: {spans}")
+server.stop()
+print("done.")

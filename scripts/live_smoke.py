@@ -1,0 +1,141 @@
+"""Live-scrape verify gate (ISSUE 5): a SUBPROCESS streamed fit with
+``obs_http_port`` set must be scrapable while it runs.
+
+The parent picks a free port, launches a child that runs a streamed SGD
+fit with ``DASK_ML_TPU_OBS_HTTP_PORT`` pointing at it (then lingers
+briefly so a slow scraper still sees the final state), and asserts:
+
+- ``/healthz`` answers 200;
+- ``/metrics`` parses as Prometheus text and contains >= 1 histogram
+  series and >= 1 fit progress gauge (``fit_pass``);
+- ``/status`` is valid JSON naming this child's pid.
+
+Prints one JSON line: {"ok": true, "fit_pass": ..., "histograms": ...}.
+Run: ``python scripts/live_smoke.py`` (exit 0 = gate holds).
+"""
+
+import json
+import os
+import re
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CHILD = r"""
+import os, time
+import numpy as np
+from dask_ml_tpu import config
+from dask_ml_tpu.models.sgd import SGDClassifier
+
+rng = np.random.RandomState(0)
+X = rng.randn(120_000, 16).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.float32)
+with config.set(stream_block_rows=4096):
+    SGDClassifier(max_iter=8, random_state=0).fit(X, y)
+print("FIT_DONE", flush=True)
+# keep the exporter up so the parent's final scrape can't race the exit
+time.sleep(float(os.environ.get("LIVE_SMOKE_LINGER", "20")))
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get(url, timeout=2.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode()
+
+
+def main():
+    out = {"ok": False}
+    port = _free_port()
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "DASK_ML_TPU_OBS_HTTP_PORT": str(port)}
+    child = subprocess.Popen(
+        [sys.executable, "-c", CHILD], env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+    )
+    base = f"http://127.0.0.1:{port}"
+    deadline = time.time() + 120
+    try:
+        # 1) liveness comes up with the fit
+        while True:
+            try:
+                status, body = _get(base + "/healthz")
+                assert status == 200 and body == "ok\n"
+                break
+            except AssertionError:
+                raise
+            except Exception:
+                if child.poll() is not None or time.time() > deadline:
+                    # stderr.read() on a LIVE child blocks until EOF —
+                    # kill it first so the diagnostic actually prints
+                    if child.poll() is None:
+                        child.kill()
+                        child.wait(10)
+                    raise RuntimeError(
+                        "child exited or deadline passed before "
+                        "/healthz answered: "
+                        + child.stderr.read().decode()[-2000:]
+                    )
+                time.sleep(0.05)
+        # 2) scrape until the progress gauge and a histogram series show
+        #    (the fit may still be mid-flight — that is the point)
+        fit_pass = None
+        n_hist = 0
+        while time.time() < deadline:
+            _, text = _get(base + "/metrics")
+            m = re.search(r"^dask_ml_tpu_fit_pass (\d+)", text,
+                          re.MULTILINE)
+            hists = set(re.findall(
+                r"^# TYPE (dask_ml_tpu_\w+) histogram$", text,
+                re.MULTILINE,
+            ))
+            if m and hists:
+                fit_pass, n_hist = int(m.group(1)), len(hists)
+                break
+            if child.poll() is not None:
+                raise RuntimeError(
+                    "child exited before /metrics showed a progress "
+                    "gauge + histogram"
+                )
+            time.sleep(0.05)
+        if fit_pass is None:
+            raise RuntimeError("deadline: no progress gauge/histogram")
+        # every sample line must be grammar-clean
+        for line in text.rstrip("\n").split("\n"):
+            assert line.startswith("#") or re.match(
+                r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? "
+                r"(-?\d+(\.\d+)?([eE][+-]?\d+)?|[+-]Inf|NaN)$", line
+            ), f"bad exposition line: {line!r}"
+        # 3) /status belongs to the child
+        _, body = _get(base + "/status")
+        status_doc = json.loads(body)
+        assert status_doc["pid"] == child.pid, (status_doc["pid"],
+                                                child.pid)
+        out.update(ok=True, fit_pass=fit_pass, histograms=n_hist,
+                   port=port)
+    except Exception as exc:
+        out["error"] = f"{type(exc).__name__}: {exc}"
+    finally:
+        child.terminate()
+        try:
+            child.wait(10)
+        except Exception:
+            child.kill()
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

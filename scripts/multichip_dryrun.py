@@ -3,8 +3,9 @@
 Extends the MULTICHIP_r*.json dryrun (8 virtual XLA:CPU devices via
 ``--xla_force_host_platform_device_count``) beyond "does the sharded
 path run": the run records a span trace + program registry under
-``config.trace_dir`` and ASSERTS the report CLI renders spans AND a
-programs table for the sharded L-BFGS and ADMM fit paths — the
+``config.trace_dir`` (spans) plus a separate counters/programs file and
+ASSERTS ``report --merge`` folds both into ONE timeline rendering spans
+AND a programs table for the sharded L-BFGS and ADMM fit paths — the
 observability the next wedged-TPU round will need, proven on the same
 virtual mesh the tier-1 suite uses.
 
@@ -67,25 +68,52 @@ def main():
             ad = LogisticRegression(solver="admm", max_iter=20).fit(Xs, ys)
             assert lb.score(Xs, ys) > 0.6 and ad.score(Xs, ys) > 0.6
             trace = os.path.join(trace_dir, "trace.jsonl")
-            with obs.MetricsLogger(trace) as lg:
+            # counters/programs land in a SEPARATE file, the shape a
+            # multi-process run produces (bench child + serving worker
+            # each append their own sink) — report --merge below must
+            # fold both into one timeline
+            aux = os.path.join(trace_dir, "aux.jsonl")
+            with obs.MetricsLogger(aux) as lg:
                 obs.log_counters(lg)
                 obs.log_programs(lg)
-        records = load_records(trace)
-        report = build_report(records, path=trace)
+        from dask_ml_tpu.observability.report import merge_records
+
+        # `report --merge`: the span trace and the aux counters/programs
+        # file fold into ONE timeline — the 8-device run renders as a
+        # single report exactly like a multi-file multi-process round
+        records = merge_records([load_records(trace), load_records(aux)])
+        report = build_report(records, path=f"{trace} + {aux}")
         data = report_data(records)
         spans = [r["span"] for r in data["spans"]]
         programs = [p["program"] for p in data["programs"]]
-        # the report must render the sharded fits' spans AND their
-        # compiled programs — this is the assertion the dryrun exists for
+        # the merged report must render the sharded fits' spans AND
+        # their compiled programs — the assertion the dryrun exists for
         assert "LogisticRegression.fit" in spans, spans
         assert "spans (time by component)" in report
         assert "programs (XLA cost/memory per compiled entry point)" \
             in report
         assert any(p == "glm.lbfgs" for p in programs), programs
         assert any(p == "glm.admm" for p in programs), programs
+        # counters came from the aux file: the merge really folded both
+        assert data["counters"].get("recompiles", 0) > 0, data["counters"]
+        # the CLI flag itself renders the same merged timeline
+        import contextlib
+        import io
+
+        from dask_ml_tpu.observability import report as report_cli
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = report_cli.main(["--merge", "--json", trace, aux])
+        assert rc == 0, rc
+        cli_data = json.loads(buf.getvalue())
+        assert cli_data["merged_files"] == 2
+        assert any(r["span"] == "LogisticRegression.fit"
+                   for r in cli_data["spans"])
         out.update(
             ok=True,
             trace_records=len(records),
+            merged_files=2,
             report_spans=spans,
             report_programs=programs,
         )

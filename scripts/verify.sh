@@ -54,6 +54,14 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/perf_smoke.py; then
     exit 1
 fi
 
+# -- live-scrape gate (ISSUE 5): a subprocess streamed fit with
+# obs_http_port set must answer /healthz 200 and expose >=1 histogram
+# series + >=1 fit progress gauge on /metrics WHILE it runs.
+if ! timeout -k 10 300 python scripts/live_smoke.py; then
+    echo "VERIFY FAIL: live telemetry scrape gate"
+    exit 1
+fi
+
 # -- multichip dryrun (8 virtual CPU devices): the sharded lbfgs/ADMM
 # paths must run AND record a flight-recorder trace the report CLI can
 # render (spans + programs tables) — asserted inside the script.

@@ -1,0 +1,574 @@
+"""Live telemetry plane (ISSUE 5): the histogram registry, Prometheus
+/metrics exposition, /status, fit progress gauges published during live
+streamed fits, the LatencyWindow rebuild, and ``report --merge``.
+
+The load-bearing assertions: scraping causes ZERO new XLA compiles
+(recompile counter before/after), progress gauges actually move while a
+streamed fit runs, and every exposition line parses against the
+text-format v0.0.4 grammar.
+"""
+
+import json
+import os
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu import config, observability as obs
+from dask_ml_tpu.observability import live
+from dask_ml_tpu.observability._hist import DEFAULT_BOUNDS, Histogram
+from dask_ml_tpu.observability._spans import _span_observers
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    """Every test starts from (and leaves behind) a pristine plane: no
+    singleton server, no registered observers, empty gauge/histogram
+    registry — earlier test FILES may have fed the always-on serving
+    histograms, so the pre-test reset matters as much as the post."""
+    live.stop_telemetry()
+    live.metrics_reset()
+    yield
+    live.stop_telemetry()
+    live.metrics_reset()
+    assert _span_observers == []
+
+
+def _get(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode()
+
+
+# -- histogram core ----------------------------------------------------------
+
+def test_histogram_counts_sum_and_percentiles():
+    h = Histogram()
+    assert np.isnan(h.percentiles()["p50"])
+    for v in np.linspace(0.001, 0.1, 100):
+        h.observe(float(v))
+    assert h.count == 100
+    assert h.sum == pytest.approx(float(np.sum(np.linspace(0.001, 0.1,
+                                                           100))))
+    pct = h.percentiles((50, 99))
+    # linear interpolation inside the 1-2-5 buckets: estimates land
+    # within the winning bucket, clamped to observed range
+    assert 0.02 <= pct["p50"] <= 0.06
+    assert 0.09 <= pct["p99"] <= 0.1
+    snap = h.snapshot()
+    assert sum(snap["counts"]) == 100
+    assert snap["min"] == pytest.approx(0.001)
+    assert snap["max"] == pytest.approx(0.1)
+
+
+def test_histogram_overflow_bucket_and_bounds_validation():
+    h = Histogram(bounds=(0.1, 1.0))
+    for v in (0.05, 0.5, 50.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["counts"] == [1, 1, 1]  # <=0.1, <=1.0, +Inf overflow
+    assert h.percentiles((99,))["p99"] == pytest.approx(50.0)
+    with pytest.raises(ValueError):
+        Histogram(bounds=(1.0, 0.5))
+    with pytest.raises(ValueError):
+        Histogram(bounds=(1.0, 1.0))
+
+
+def test_histogram_concurrent_observe_loses_nothing():
+    h = Histogram()
+    n_threads, per = 8, 5000
+    errs = []
+
+    def worker(seed):
+        try:
+            rng = np.random.RandomState(seed)
+            for _ in range(per):
+                h.observe(float(rng.uniform(1e-4, 1.0)))
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    # hammer quantile reads WHILE writers run
+    for _ in range(200):
+        p = h.percentiles((50, 99))
+        if h.count:
+            assert p["p50"] <= p["p99"] or np.isnan(p["p50"])
+    for t in threads:
+        t.join()
+    assert not errs
+    assert h.count == n_threads * per
+    assert sum(h.snapshot()["counts"]) == n_threads * per
+
+
+# -- LatencyWindow rebuild (satellite: the hammer test) ----------------------
+
+def test_latency_window_hammer_retains_all_observations():
+    """The retired ring-window implementation (a) shared one numpy
+    buffer between concurrent ``observe`` writers and the quantile
+    reader's slice-copy and (b) FORGOT everything older than its 4096
+    slots — after 4096 late slow requests its p50 claimed the whole day
+    was slow. This hammer fails on that implementation: four threads
+    record 4096 fast (1 ms) observations each while a reader thread
+    hammers quantiles, then one burst of 4096 slow (100 ms) ones lands;
+    a windowed p50 is ~0.1 (only the burst survives), the histogram's
+    stays ~0.001 because the 16384 fast observations still exist."""
+    from dask_ml_tpu.serving.metrics import LatencyWindow
+
+    win = LatencyWindow(size=4096)
+    stop = threading.Event()
+    errs = []
+
+    def reader():
+        try:
+            while not stop.is_set():
+                p = win.percentiles((50, 99))
+                # NaN = the snapshot was taken before the first observe
+                # landed; any later snapshot must be ordered
+                if not np.isnan(p["p50"]):
+                    assert p["p50"] <= p["p99"] * 1.0000001
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    rt = threading.Thread(target=reader)
+    rt.start()
+
+    def fast_writer():
+        for _ in range(4096):
+            win.observe(0.001)
+
+    writers = [threading.Thread(target=fast_writer) for _ in range(4)]
+    for t in writers:
+        t.start()
+    for t in writers:
+        t.join()
+    for _ in range(4096):           # the late slow burst
+        win.observe(0.1)
+    stop.set()
+    rt.join()
+    assert not errs
+    assert win.count == 5 * 4096    # nothing lost to racing writers
+    # the fast majority still dominates the median: a 4096-slot ring
+    # would report p50 == 0.1 here
+    assert win.percentiles((50,))["p50"] < 0.01
+
+
+def test_latency_window_keeps_old_api():
+    from dask_ml_tpu.serving.metrics import LatencyWindow
+
+    win = LatencyWindow(size=64)
+    assert np.isnan(win.percentiles()["p50"])
+    for v in np.linspace(0.001, 0.1, 100):
+        win.observe(float(v))
+    pct = win.percentiles((50, 99))
+    assert 0.0 < pct["p50"] < pct["p99"] <= 0.1
+    assert win.count == 100
+
+
+# -- Prometheus exposition ---------------------------------------------------
+
+_COMMENT_RE = re.compile(
+    r"^# (HELP [a-zA-Z_:][a-zA-Z0-9_:]* .+"
+    r"|TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (counter|gauge|histogram|summary|"
+    r"untyped))$"
+)
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"\\\n]*\""
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"\\\n]*\")*\})?"
+    r" (-?\d+(\.\d+)?([eE][+-]?\d+)?|[+-]Inf|NaN)$"
+)
+
+
+def _check_exposition_grammar(text):
+    """Every line must be a valid v0.0.4 comment or sample; histogram
+    series must be cumulative-monotonic and end at the +Inf bucket ==
+    _count."""
+    assert text.endswith("\n")
+    for line in text.rstrip("\n").split("\n"):
+        assert _COMMENT_RE.match(line) or _SAMPLE_RE.match(line), line
+    # one TYPE line per metric family (a duplicate — e.g. a gauge named
+    # after a histogram — makes real scrapers reject the whole page)
+    families = re.findall(r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) ", text,
+                          flags=re.M)
+    dupes = {f for f in families if families.count(f) > 1}
+    assert not dupes, f"duplicate TYPE declarations: {sorted(dupes)}"
+    # per-series histogram invariants
+    buckets = {}
+    counts = {}
+    for line in text.split("\n"):
+        m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)_bucket"
+                     r"\{(.*)le=\"([^\"]+)\"\} (\d+)$", line)
+        if m:
+            key = (m.group(1), m.group(2))
+            buckets.setdefault(key, []).append(
+                (float("inf") if m.group(3) == "+Inf"
+                 else float(m.group(3)), int(m.group(4)))
+            )
+        m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)_count"
+                     r"(\{.*\})? (\d+)$", line)
+        if m:
+            counts[(m.group(1), (m.group(2) or "{}")[1:-1])] = \
+                int(m.group(3))
+    for key, series in buckets.items():
+        les = [le for le, _ in series]
+        cums = [c for _, c in series]
+        assert les == sorted(les), key
+        assert les[-1] == float("inf"), key
+        assert cums == sorted(cums), key
+        name, labels = key
+        labels = labels.rstrip(",")
+        assert counts[(name, labels)] == cums[-1], key
+    return buckets
+
+
+def test_render_prometheus_grammar_and_kinds():
+    obs.counters_reset()
+    obs.counter_add("recompiles", 3)
+    obs.counter_add("h2d_bytes", 1 << 20)
+    live.gauge_set("fit_pass", 4)
+    live.gauge_set("serving_queue_depth", 2,
+                   labels=(("method", "predict"),))
+    h = live.histogram("serving_latency_seconds",
+                       labels=(("method", "predict"), ("bucket", "64")))
+    for v in (0.001, 0.004, 0.2):
+        h.observe(v)
+    text = live.render_prometheus()
+    buckets = _check_exposition_grammar(text)
+    assert "# TYPE dask_ml_tpu_recompiles_total counter" in text
+    assert "dask_ml_tpu_recompiles_total 3" in text
+    assert "# TYPE dask_ml_tpu_fit_pass gauge" in text
+    assert "dask_ml_tpu_fit_pass 4" in text
+    assert "# TYPE dask_ml_tpu_serving_latency_seconds histogram" in text
+    assert any(k[0] == "dask_ml_tpu_serving_latency_seconds"
+               for k in buckets)
+    assert 'method="predict"' in text
+    obs.counters_reset()
+
+
+# -- the live server ---------------------------------------------------------
+
+def test_healthz_and_404():
+    with obs.TelemetryServer(port=0) as srv:
+        status, body = _get(srv.url + "/healthz")
+        assert status == 200 and body == "ok\n"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.url + "/nope")
+        assert ei.value.code == 404
+
+
+def test_scrape_during_live_streamed_fit_gauges_move_zero_compiles():
+    """The acceptance fixture: scrape /metrics from the main thread
+    while a streamed SGD fit runs in another. Every scrape parses,
+    the fit progress gauges move, a histogram series exists, and the
+    scrapes themselves cause zero XLA compiles."""
+    from dask_ml_tpu.models.sgd import SGDClassifier
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(40_000, 16).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    errs = []
+    with obs.TelemetryServer(port=0) as srv:
+        def fit():
+            try:
+                with config.set(stream_block_rows=2048):
+                    SGDClassifier(max_iter=6, random_state=0).fit(X, y)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        t = threading.Thread(target=fit)
+        t.start()
+        seen_pass = []
+        while t.is_alive():
+            status, text = _get(srv.url + "/metrics")
+            assert status == 200
+            m = re.search(r"^dask_ml_tpu_fit_pass (\d+)", text,
+                          re.MULTILINE)
+            if m:
+                seen_pass.append(int(m.group(1)))
+            time.sleep(0.01)
+        t.join()
+        assert not errs
+        status, text = _get(srv.url + "/metrics")
+        _check_exposition_grammar(text)
+        seen_pass.append(int(re.search(
+            r"^dask_ml_tpu_fit_pass (\d+)", text, re.MULTILINE
+        ).group(1)))
+        # the gauge moved: the fit ran 6 passes and the final scrape
+        # sees the last one; mid-run scrapes only ever saw fewer
+        assert seen_pass[-1] == 6
+        assert seen_pass == sorted(seen_pass)
+        assert re.search(r"^dask_ml_tpu_fit_rows_per_sec \d", text,
+                         re.MULTILINE)
+        assert re.search(r"^dask_ml_tpu_fit_eta_seconds ", text,
+                         re.MULTILINE)
+        # >=1 histogram series (pass-seconds) with every pass counted
+        m = re.search(r"^dask_ml_tpu_fit_pass_seconds_count (\d+)",
+                      text, re.MULTILINE)
+        assert m and int(m.group(1)) == 6
+        # scraping is pure host-dict reads: no XLA compile, ever
+        before = obs.counters_snapshot().get("recompiles", 0)
+        for _ in range(5):
+            _get(srv.url + "/metrics")
+            _get(srv.url + "/status")
+            _get(srv.url + "/healthz")
+        after = obs.counters_snapshot().get("recompiles", 0)
+        assert after == before
+
+
+def test_status_shows_open_span_stack_and_report_tables():
+    with obs.TelemetryServer(port=0) as srv:
+        with obs.span("outer", component="Demo"):
+            with obs.span("inner"):
+                status, body = _get(srv.url + "/status")
+        data = json.loads(body)
+        names = [s["span"] for s in data["open_spans"]]
+        assert names == ["outer", "inner"]   # oldest first
+        assert all("age_s" in s and "thread" in s
+                   for s in data["open_spans"])
+        assert data["pid"] == os.getpid()
+        # the closed spans land in the recent ring -> report tables
+        status, body = _get(srv.url + "/status")
+        data = json.loads(body)
+        spans = [r["span"] for r in data["report"]["spans"]]
+        assert "Demo.outer" in spans
+        assert "counters" in data["report"]
+
+
+def test_status_serving_window_and_latency_histograms(logreg_fitted):
+    from dask_ml_tpu.serving import BucketLadder, ModelServer
+
+    clf, X = logreg_fitted
+    with obs.TelemetryServer(port=0) as srv:
+        with ModelServer(clf, ladder=BucketLadder(8, 64, 2.0)) as ms:
+            for i in range(12):
+                ms.predict(X[i * 3:(i + 1) * 3])
+            status, body = _get(srv.url + "/status")
+            data = json.loads(body)
+            assert data["serving"], "live server missing from /status"
+            stats = data["serving"][0]
+            assert stats["requests"] == 12
+            assert "latency_s" in stats
+        # per-(method,bucket) histogram series exist and count requests
+        status, text = _get(srv.url + "/metrics")
+        _check_exposition_grammar(text)
+        m = re.findall(
+            r'^dask_ml_tpu_serving_latency_seconds_count'
+            r'\{method="predict",bucket="(\d+)"\} (\d+)$',
+            text, re.MULTILINE,
+        )
+        assert m and sum(int(c) for _, c in m) == 12
+        # queue gauges were published by the worker
+        assert re.search(r"^dask_ml_tpu_serving_queue_depth ", text,
+                         re.MULTILINE)
+        assert re.search(r"^dask_ml_tpu_serving_inflight_rows ", text,
+                         re.MULTILINE)
+
+
+def test_serving_slo_violation_counter(logreg_fitted):
+    from dask_ml_tpu.serving import BucketLadder, ModelServer
+
+    clf, X = logreg_fitted
+    obs.counters_reset()
+    # an SLO of ~0ms: every served request violates it
+    with config.set(serving_slo_ms=1e-6):
+        with ModelServer(clf, ladder=BucketLadder(8, 64, 2.0)) as ms:
+            for i in range(5):
+                ms.predict(X[i * 2:(i + 1) * 2])
+    assert obs.counters_snapshot().get("serving_slo_violations", 0) == 5
+    obs.counters_reset()
+
+
+def test_watchdog_stall_counter_reaches_metrics_and_report(tmp_path):
+    """Satellite: a stall is a COUNTER (live /metrics + report counters
+    table), not just a trace record."""
+    obs.counters_reset()
+    trace = str(tmp_path / "t")
+    with config.set(trace_dir=trace, watchdog_timeout_s=0.15):
+        with obs.watchdog(poll_s=0.03):
+            with obs.span("wedged"):
+                time.sleep(0.5)
+    snap = obs.counters_snapshot()
+    assert snap.get("watchdog_stalls", 0) >= 1
+    text = live.render_prometheus()
+    assert re.search(r"^dask_ml_tpu_watchdog_stalls_total [1-9]", text,
+                     re.MULTILINE)
+    # ... and the post-hoc counters table agrees
+    from dask_ml_tpu.observability.report import build_report
+
+    out = build_report([{"counters": True, **snap}])
+    assert "watchdog_stalls" in out
+    # the live /status ring kept the dump (sans stacks)
+    with obs.TelemetryServer(port=0) as srv:
+        data = json.loads(_get(srv.url + "/status")[1])
+        assert any(r.get("span") == "wedged"
+                   for r in data["watchdog_stalls"])
+        assert all("stacks" not in r for r in data["watchdog_stalls"])
+    obs.counters_reset()
+
+
+def test_ensure_telemetry_config_gated_and_idempotent():
+    # port 0 (default): nothing starts
+    assert live.ensure_telemetry() is None
+    assert live.telemetry_server() is None
+    # pick a free port, then let the BlockStream entry arm the server
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    from dask_ml_tpu.parallel.streaming import BlockStream
+
+    X = np.zeros((64, 4), np.float32)
+    with config.set(obs_http_port=port):
+        for _ in BlockStream((X,), block_rows=32):
+            pass
+        srv = live.telemetry_server()
+        assert srv is not None and srv.port == port
+        assert live.ensure_telemetry() is srv   # idempotent
+        assert _get(srv.url + "/healthz")[0] == 200
+    live.stop_telemetry()
+    assert live.telemetry_server() is None
+
+
+# -- report --merge ----------------------------------------------------------
+
+def _write_jsonl(path, recs):
+    with open(path, "w") as fh:
+        for r in recs:
+            fh.write(json.dumps(r) + "\n")
+
+
+def test_merge_records_interleaves_by_wall_clock(tmp_path):
+    from dask_ml_tpu.observability.report import (load_records,
+                                                  merge_records)
+
+    # two processes with different sink origins; ids pid-prefixed like
+    # the span layer produces
+    base = 1700000000.0
+    a = [
+        {"time": 0.1, "span": "fit", "span_id": (7 << 24) | 1,
+         "parent_id": None, "wall_s": 0.05, "sync_s": 0.0,
+         "t_unix": base + 0.1, "component": "A"},
+        {"time": 0.2, "component": "A", "step": 0, "loss": 1.0},
+        {"time": 0.9, "counters": True, "recompiles": 5,
+         "t_unix": base + 0.9},
+    ]
+    b = [
+        {"time": 0.05, "span": "fit", "span_id": (9 << 24) | 1,
+         "parent_id": None, "wall_s": 0.01, "sync_s": 0.0,
+         "t_unix": base + 0.55, "component": "B"},
+        {"time": 0.6, "counters": True, "recompiles": 11,
+         "t_unix": base + 1.1},
+    ]
+    pa, pb = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    _write_jsonl(pa, a)
+    _write_jsonl(pb, b)
+    merged = merge_records([load_records(pa), load_records(pb)])
+    assert len(merged) == 5
+    # wall-clock order: A.fit, A.step (~base+0.2), B.fit (~base+0.55),
+    # A counters, B counters
+    kinds = [(r.get("component"), bool(r.get("counters")))
+             for r in merged]
+    assert kinds == [("A", False), ("A", False), ("B", False),
+                     (None, True), (None, True)]
+    # LAST counters snapshot by wall clock wins (B's, despite file order)
+    from dask_ml_tpu.observability.report import final_counters
+
+    assert final_counters(merged)["recompiles"] == 11
+
+
+def test_merge_clockless_file_lands_after_clocked_records(tmp_path):
+    """A legacy aux file with NO t_unix anywhere (counters-only, written
+    by a pre-stamping MetricsLogger) must not fall to -inf and sort
+    first — its end-of-run counters snapshot would lose "last snapshot
+    wins" to any mid-run snapshot in the clocked file."""
+    from dask_ml_tpu.observability.report import (final_counters,
+                                                  merge_records)
+
+    base = 1700000200.0
+    clocked = [
+        {"time": 0.1, "span": "fit", "span_id": 1, "parent_id": None,
+         "wall_s": 1.0, "sync_s": 0.0, "t_unix": base + 0.1},
+        # mid-run snapshot — must NOT become the run's totals
+        {"time": 0.5, "counters": True, "recompiles": 2,
+         "t_unix": base + 0.5},
+    ]
+    clockless_aux = [{"time": 0.2, "counters": True, "recompiles": 9}]
+    merged = merge_records([clocked, clockless_aux])
+    assert merged[-1]["recompiles"] == 9
+    assert final_counters(merged)["recompiles"] == 9
+
+
+def test_report_cli_merge_json_and_perfetto(tmp_path, capsys):
+    from dask_ml_tpu.observability import report
+
+    base = 1700000100.0
+    pa, pb = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    _write_jsonl(pa, [
+        {"time": 0.1, "span": "fit", "span_id": (3 << 24) | 1,
+         "parent_id": None, "depth": 0, "wall_s": 0.2, "sync_s": 0.0,
+         "t_unix": base, "component": "A", "n_rows": 100,
+         "thread": "MainThread"},
+    ])
+    _write_jsonl(pb, [
+        {"time": 0.1, "span": "fit", "span_id": (4 << 24) | 1,
+         "parent_id": None, "depth": 0, "wall_s": 0.1, "sync_s": 0.0,
+         "t_unix": base + 1.0, "component": "B", "n_rows": 50,
+         "thread": "MainThread"},
+    ])
+    rc = report.main(["--merge", "--json", pa, pb])
+    assert rc == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["merged_files"] == 2
+    spans = {r["span"] for r in data["spans"]}
+    assert spans == {"A.fit", "B.fit"}
+    # --perfetto accepts multiple inputs ONLY under --merge, and lanes
+    # the two processes separately (pid rides the span-id high bits)
+    out = str(tmp_path / "trace.json")
+    assert report.main([pa, pb, "--perfetto", out]) == 2
+    assert report.main(["--merge", pa, pb, "--perfetto", out]) == 0
+    trace = json.load(open(out))
+    lanes = {e["args"]["name"] for e in trace["traceEvents"]
+             if e.get("ph") == "M"}
+    assert lanes == {"pid3.MainThread", "pid4.MainThread"}
+    xs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    assert len(xs) == 2
+    # one timeline: B.fit starts ~1s after A.fit on the merged clock
+    ts = sorted(e["ts"] for e in xs)
+    assert 0.8e6 < ts[1] - ts[0] < 1.4e6
+
+
+def test_merge_single_file_is_identity(tmp_path, capsys):
+    from dask_ml_tpu.observability import report
+
+    p = str(tmp_path / "one.jsonl")
+    _write_jsonl(p, [
+        {"time": 0.1, "span": "fit", "span_id": 1, "parent_id": None,
+         "depth": 0, "wall_s": 1.0, "sync_s": 0.0, "t_unix": 1.7e9,
+         "component": "K", "n_rows": 10},
+    ])
+    assert report.main(["--merge", p]) == 0
+    out = capsys.readouterr().out
+    assert "K.fit" in out
+
+
+# -- fixtures ----------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def logreg_fitted():
+    from dask_ml_tpu.datasets import make_classification
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    X, y = make_classification(
+        n_samples=400, n_features=10, n_informative=5, random_state=0
+    )
+    clf = LogisticRegression(solver="lbfgs", max_iter=20).fit(X, y)
+    return clf, X.to_numpy()

@@ -298,6 +298,55 @@ def test_program_registry_and_watchdog_add_nothing_when_disabled():
     assert not hasattr(_gd_run.__wrapped__, "__wrapped__")
 
 
+def test_live_plane_adds_nothing_when_port_unset():
+    """ISSUE 5 extension of the zero-overhead contract: with
+    obs_http_port at its 0 default the live telemetry plane is inert —
+    no exporter thread, no span observer, every publish call a bool
+    check, the gauge/histogram registry untouched by a streamed SGD
+    pass, and the streamed scan kernel's jaxpr byte-identical whether
+    or not a server ever existed in the process."""
+    import jax
+    import jax.numpy as jnp
+
+    from dask_ml_tpu.models.sgd import SGDClassifier, _sgd_sb_scan
+    from dask_ml_tpu.observability import live
+    from dask_ml_tpu.observability._programs import unwrap
+    from dask_ml_tpu.observability._spans import _span_observers
+
+    def scan_jaxpr():
+        body = unwrap(_sgd_sb_scan)
+        K, S, d = 2, 8, 3
+        return str(jax.make_jaxpr(
+            lambda W, Xs, ys, c, lrs: body(
+                W, Xs, ys, c, lrs, 1e-4, 1.0, 0.0, 1.0, "hinge", None
+            )
+        )(jnp.zeros(d + 1), jnp.zeros((K, S, d)), jnp.zeros((K, S)),
+          jnp.zeros(K, jnp.int32), jnp.zeros(K)))
+
+    assert live.telemetry_server() is None
+    assert not live.live_publishing()
+    baseline = scan_jaxpr()
+    live.metrics_reset()
+    rng = np.random.RandomState(0)
+    X = rng.randn(4096, 6).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    with config.set(stream_block_rows=512):
+        SGDClassifier(max_iter=2, random_state=0).fit(X, y)
+    # the fit registered nothing with the live plane...
+    from dask_ml_tpu.observability import _spans
+
+    assert live.gauges_snapshot() == {}
+    assert live.histograms_snapshot() == {}
+    assert _span_observers == [] and _spans._armed_trackers == 0
+    assert live.telemetry_server() is None
+    # ...and a server's life cycle leaves the traced program unchanged
+    # (the plane lives entirely outside jit)
+    with obs.TelemetryServer(port=0):
+        assert scan_jaxpr() == baseline
+    assert scan_jaxpr() == baseline
+    live.metrics_reset()
+
+
 def test_jit_callbacks_probe_resettable(monkeypatch):
     from dask_ml_tpu.observability import _metrics
 
