@@ -273,7 +273,9 @@ def run():
 
     def _try(fn, *args):
         try:
-            extras.append(fn(*args))
+            out = fn(*args)
+            # a section may return several metric entries (fleet does)
+            extras.extend(out if isinstance(out, list) else [out])
         except Exception as exc:  # record and continue; Ctrl-C still exits
             extras.append({"metric": fn.__name__, "value": None,
                            "error": f"{type(exc).__name__}: {exc}"})
@@ -291,6 +293,7 @@ def run():
     _try(_bench_hyperband, jax, on_tpu, n_chips)
     _try(_bench_c_grid_search, jax, on_tpu, n_chips)
     _try(_bench_serving, jax, on_tpu, n_chips)
+    _try(_bench_fleet, jax, on_tpu, n_chips)
     result["extra_metrics"] = extras
     return result
 
@@ -890,6 +893,171 @@ def _bench_serving(jax, on_tpu, n_chips):
         },
         "served_seconds": round(served_s, 3),
     }
+
+
+def _bench_fleet(jax, on_tpu, n_chips):
+    """Fleet section (ISSUE 6): 2-replica FleetServer vs a single
+    ModelServer over the SAME ragged closed-loop mix, plus
+    hot-swap-under-load — client-side p99 while 3 zero-recompile swaps
+    land vs a swap-free steady-state pass on the same fleet.
+
+    Replica throughput scaling is a DEVICE-parallelism story: with >1
+    real device each replica's params and programs are committed to its
+    own chip and XLA runs them concurrently (the >= 1.6x regime). On a
+    shared-silicon CPU host both servers ride the same cores, so the
+    honest ratio is ~1x — recorded as measured, per backend, exactly
+    like the sentinel's backend-matched floors expect. The swap claim
+    is backend-independent: p99 must NOT collapse while versions flip,
+    because the swap mints zero compiles."""
+    import threading as _threading
+    import time
+
+    from dask_ml_tpu import observability as obs
+    from dask_ml_tpu.datasets import make_classification
+    from dask_ml_tpu.linear_model import LogisticRegression
+    from dask_ml_tpu.serving import BucketLadder, FleetServer, ModelServer
+
+    n = 100_000 if on_tpu else 20_000
+    d = 128 if on_tpu else 32
+    X, y = make_classification(n_samples=n, n_features=d,
+                               n_informative=max(d // 4, 2),
+                               random_state=0)
+    X2, y2 = make_classification(n_samples=n, n_features=d,
+                                 n_informative=max(d // 4, 2),
+                                 random_state=7)
+    a = LogisticRegression(solver="lbfgs", max_iter=20).fit(X, y)
+    b = LogisticRegression(solver="lbfgs", max_iter=20).fit(X2, y2)
+    Xh = X.to_numpy().astype(np.float32)
+
+    rng = np.random.RandomState(11)
+    n_requests = 400
+    sizes = np.maximum(np.exp(
+        rng.uniform(0, np.log(256), size=n_requests)
+    ).astype(int), 1)
+    offs = [int(rng.randint(0, n - s)) for s in sizes]
+    requests = [Xh[i:i + int(s)] for s, i in zip(sizes, offs)]
+    total_rows = int(sizes.sum())
+    n_clients = 8
+    shares = [list(range(c, n_requests, n_clients))
+              for c in range(n_clients)]
+    ladder = BucketLadder(8, 512, 2.0)
+
+    def drive(server):
+        """One closed-loop pass; returns (seconds, per-request secs)."""
+        lats = np.zeros(n_requests)
+
+        def client(c):
+            for i in shares[c]:
+                t1 = time.perf_counter()
+                server.predict(requests[i])
+                lats[i] = time.perf_counter() - t1
+
+        threads = [_threading.Thread(target=client, args=(c,))
+                   for c in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0, lats
+
+    srv = ModelServer(a, methods=("predict",), ladder=ladder,
+                      batch_window_ms=1.0, timeout_ms=0).warmup()
+    with srv:
+        drive(srv)                       # warm pass
+        single_s, _ = drive(srv)
+
+    fleet = FleetServer(a, name="bench", replicas=2, ladder=ladder,
+                        batch_window_ms=1.0, timeout_ms=0).warmup()
+    with fleet:
+        drive(fleet)                     # warm pass
+        fleet_s, steady_lats = drive(fleet)
+        # hot-swap pass: same traffic while 3 publishes roll through
+        before = obs.counters_snapshot().get("recompiles", 0)
+        stop_swaps = _threading.Event()
+        swaps = []
+
+        def swapper():
+            for est in (b, a, b):
+                if stop_swaps.wait(0.05):
+                    return
+                swaps.append(fleet.publish(est))
+
+        sw = _threading.Thread(target=swapper)
+        sw.start()
+        swap_s, swap_lats = drive(fleet)
+        stop_swaps.set()
+        sw.join()
+        recompiles = obs.counters_snapshot().get("recompiles", 0) - before
+        stats = fleet.stats()
+
+    steady_p99 = float(np.percentile(steady_lats, 99))
+    swap_p99 = float(np.percentile(swap_lats, 99))
+    entries = _fleet_entries(jax, n_chips, n_requests, total_rows,
+                             n_clients, single_s, fleet_s, swap_s,
+                             steady_p99, swap_p99, swaps, recompiles,
+                             stats)
+    # the fleet numbers join the per-run record the headline fit opened
+    from dask_ml_tpu.observability import MetricsLogger
+
+    metrics_file = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_metrics.jsonl"
+    )
+    with MetricsLogger(metrics_file) as _lg:
+        for e in entries:
+            _lg.log(kind="bench_fleet", **e)
+    return entries
+
+
+def _fleet_entries(jax, n_chips, n_requests, total_rows, n_clients,
+                   single_s, fleet_s, swap_s, steady_p99, swap_p99,
+                   swaps, recompiles, stats):
+    common = {
+        "unit": "",
+        "backend": jax.default_backend(),
+        "dtype": "float32",
+        "n_chips": n_chips,
+        "replicas": 2,
+        "n_requests": n_requests,
+        "total_rows": total_rows,
+        "n_clients": n_clients,
+    }
+    return [
+        {
+            **common,
+            "metric": "fleet_2replica_throughput_rows_per_sec",
+            "value": round(total_rows / fleet_s, 1),
+            "unit": "rows/s",
+            # replicas-vs-single on the same mix: ~1x on shared-silicon
+            # CPU (see docstring), the >= 1.6x claim is per-device
+            "vs_baseline": round(single_s / fleet_s, 3),
+            "baseline": {
+                "what": "single warmed ModelServer, same ragged mix",
+                "seconds": round(single_s, 3),
+                "rows_per_sec": round(total_rows / single_s, 1),
+            },
+            "fleet_seconds": round(fleet_s, 3),
+        },
+        {
+            **common,
+            "metric": "fleet_hot_swap_p99_seconds",
+            "value": round(swap_p99, 4),
+            "unit": "s",
+            # the product claim: p99 under 3 rolling hot-swaps vs the
+            # swap-free pass on the same fleet — flat, because the swap
+            # compiles nothing
+            "vs_baseline": round(swap_p99 / max(steady_p99, 1e-9), 3),
+            "baseline": {
+                "what": "steady-state p99 on the same 2-replica fleet, "
+                        "no swaps",
+                "p99_s": round(steady_p99, 4),
+            },
+            "swaps": len(swaps),
+            "recompiles_during_swaps": int(recompiles),
+            "swap_pass_seconds": round(swap_s, 3),
+            "final_version": stats["version"],
+        },
+    ]
 
 
 _emit_lock = threading.Lock()

@@ -102,8 +102,29 @@ class Config:
     serving_timeout_ms: float = 1000.0
     # latency SLO (milliseconds, end-to-end enqueue -> demux) — requests
     # over it increment the serving_slo_violations counter (visible in
-    # /metrics and the report counters table); 0 = no SLO accounting
+    # /metrics and the report counters table). With an SLO set the
+    # micro-batcher also switches from the fixed coalescing window to
+    # DEADLINE-AWARE release: a partial batch dispatches as soon as the
+    # oldest request's SLO budget minus the predicted execution time
+    # (windowed per-(method, bucket) histogram quantile) says waiting
+    # longer would miss, and may coalesce LONGER than the fixed window
+    # when the budget is ample. 0 = no SLO accounting, fixed window
     serving_slo_ms: float = 0.0
+    # -- serving fleet (dask_ml_tpu/serving/fleet.py) ---------------------
+    # replica count for FleetServer; 0 = auto (one replica per local
+    # device when several exist, else 1). More replicas than devices
+    # share devices round-robin as thread replicas
+    serving_replicas: int = 0
+    # SLO-aware admission at the fleet door: when an SLO is configured
+    # and every replica's predicted completion (queued rows / predicted
+    # batch execution from the live latency histograms) would miss it,
+    # shed IMMEDIATELY with SloShed instead of queueing a request that
+    # is already doomed — backpressure before the latency collapse, not
+    # after
+    serving_slo_shed: bool = True
+    # versions a ModelRegistry keeps per model name for rollback (the
+    # current version is never evicted)
+    serving_registry_keep: int = 8
 
 
 _ENV_PREFIX = "DASK_ML_TPU_"

@@ -203,9 +203,9 @@ class HyperbandSearchCV(BaseIncrementalSearchCV):
         # one object-allgather — BASELINE configs[4] 'trials parallel
         # across TPU hosts' (SURVEY.md §3.5). Single-process: one
         # interleaved controller fit over all brackets.
-        import jax as _jax
+        from ..parallel import distributed as _dist
 
-        n_proc = _jax.process_count()
+        n_proc = _dist.process_count()
         if n_proc == 1:
             return self._fit_interleaved(X, y, **fit_params)
         from ..parallel.sharded import ShardedArray
@@ -220,12 +220,12 @@ class HyperbandSearchCV(BaseIncrementalSearchCV):
         from ..parallel.mesh import use_mesh
 
         placement_mesh = local_mesh()
-        self._dist_stats = (_jax.process_index(), n_proc)
+        self._dist_stats = (_dist.process_index(), n_proc)
 
         payloads = {}
         local_exc = None
         for bi, (s, n, r) in enumerate(brackets):
-            if bi % n_proc != _jax.process_index():
+            if bi % n_proc != _dist.process_index():
                 continue
             sha = SuccessiveHalvingSearchCV(
                 clone(self.estimator), self.parameters,

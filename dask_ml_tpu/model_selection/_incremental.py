@@ -89,21 +89,27 @@ def host_view_estimator(est):
 # Hyperband distributes whole brackets across processes; the SHA fits it
 # runs per bracket must NOT additionally distribute their candidates (the
 # peers are busy with other brackets — a nested allgather would deadlock).
-_dist_disabled = False
-
-
+# Thread-local, not a module global: virtual process ranks are threads of
+# ONE process, and rank A leaving its bracket must not re-enable
+# distribution under rank B's still-running SHA.
 import contextlib
+import threading
+
+_dist_state = threading.local()
+
+
+def _dist_is_disabled():
+    return getattr(_dist_state, "disabled", False)
 
 
 @contextlib.contextmanager
 def disable_process_distribution():
-    global _dist_disabled
-    prev = _dist_disabled
-    _dist_disabled = True
+    prev = getattr(_dist_state, "disabled", False)
+    _dist_state.disabled = True
     try:
         yield
     finally:
-        _dist_disabled = prev
+        _dist_state.disabled = prev
 
 
 def fit(model_factory, params_list, train_blocks, X_test, y_test, scorer,
@@ -158,10 +164,10 @@ def _fit(model_factory, params_list, train_blocks, X_test, y_test, scorer,
     # object-allgather merges the round's records so the adaptive
     # decisions (additional_calls, patience, budget caps) are computed
     # identically everywhere from identical info.
-    import jax as _jax
+    from ..parallel import distributed as _dist
 
-    n_proc = 1 if _dist_disabled else _jax.process_count()
-    pid = _jax.process_index() if n_proc > 1 else 0
+    n_proc = 1 if _dist_is_disabled() else _dist.process_count()
+    pid = _dist.process_index() if n_proc > 1 else 0
     placement_mesh = None
     if n_proc > 1:
         # per-process partial model state is not round-resumable
@@ -663,9 +669,9 @@ class BaseIncrementalSearchCV(BaseEstimator):
         ))
 
     def fit(self, X, y=None, **fit_params):
-        import jax as _jax
+        from ..parallel import distributed as _dist
 
-        if _jax.process_count() > 1 and not _dist_disabled:
+        if _dist.process_count() > 1 and not _dist_is_disabled():
             if isinstance(X, ShardedArray) or isinstance(y, ShardedArray):
                 raise ValueError(
                     "multi-process adaptive search requires host-resident "
@@ -678,7 +684,7 @@ class BaseIncrementalSearchCV(BaseEstimator):
                     "random_state: every process must derive the "
                     "IDENTICAL train/test split and candidate sample"
                 )
-            self._dist_stats = (_jax.process_index(), _jax.process_count())
+            self._dist_stats = (_dist.process_index(), _dist.process_count())
         test_size = self.test_size
         if test_size is None:
             test_size = 0.15

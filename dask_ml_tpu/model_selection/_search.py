@@ -292,7 +292,7 @@ class _BaseSearchCV(BaseEstimator):
         ``info["n_iter_per_candidate"]``), so convergence diagnostics
         distinguish fast candidates from the slowest one instead of all
         clones echoing the joint budget."""
-        import jax as _jax
+        from ..parallel import distributed as _dist
 
         from ..models.glm import _GLMBase
 
@@ -318,7 +318,7 @@ class _BaseSearchCV(BaseEstimator):
             glm = est
         else:
             return False
-        if (fit_params or _jax.process_count() > 1 or len(candidates) < 2
+        if (fit_params or _dist.process_count() > 1 or len(candidates) < 2
                 or any(set(p) != {c_key} for p in candidates)):
             return False
         Cs = [p[c_key] for p in candidates]
@@ -433,9 +433,9 @@ class _BaseSearchCV(BaseEstimator):
         # concurrently. Scores merge through one allgather at the end; the
         # reference's scheduler→worker task placement + result gathering
         # over TCP becomes placement-by-index + a device-fabric collective.
-        import jax as _jax
+        from ..parallel import distributed as _dist
 
-        n_proc = _jax.process_count()
+        n_proc = _dist.process_count()
         my_tasks = tasks
         dist_mesh = None
         if n_proc > 1:
@@ -446,12 +446,12 @@ class _BaseSearchCV(BaseEstimator):
                     "subset); a ShardedArray on the global mesh cannot be "
                     "split into per-process trials"
                 )
-            my_tasks = tasks[_jax.process_index()::n_proc]
+            my_tasks = tasks[_dist.process_index()::n_proc]
             from ..parallel.distributed import local_mesh
 
             dist_mesh = local_mesh()
             self._dist_stats = (
-                len(my_tasks), len(tasks), _jax.process_index(), n_proc
+                len(my_tasks), len(tasks), _dist.process_index(), n_proc
             )
 
         def _placement():

@@ -56,10 +56,13 @@ from ._counters import (
     install_recompile_tracking,
     log_counters,
     record_donation,
+    record_registry_publish,
     record_serving_batch,
     record_serving_drop,
     record_serving_request,
+    record_serving_reroute,
     record_serving_slo_violation,
+    record_serving_swap,
     record_superblock,
     record_superblock_donation,
     record_transfer,
@@ -147,10 +150,13 @@ __all__ = [
     "programs_reset",
     "programs_snapshot",
     "record_donation",
+    "record_registry_publish",
     "record_serving_batch",
     "record_serving_drop",
     "record_serving_request",
+    "record_serving_reroute",
     "record_serving_slo_violation",
+    "record_serving_swap",
     "record_superblock",
     "record_superblock_donation",
     "record_transfer",

@@ -97,6 +97,7 @@ _SERVING_DROP_COUNTERS = {
     "shed": "serving_shed",          # admission control refused entry
     "timeout": "serving_timeouts",   # deadline passed while queued
     "error": "serving_errors",       # batch execution raised
+    "slo_shed": "serving_slo_shed",  # SLO admission predicted a miss
 }
 
 
@@ -121,6 +122,33 @@ def record_serving_drop(kind: str) -> None:
     {'shed', 'timeout', 'error'}."""
     if counters_enabled():
         counter_add(_SERVING_DROP_COUNTERS[kind], 1)
+
+
+def record_serving_swap(rebuilt: bool = False) -> None:
+    """One model hot-swap applied to a serving entry-point set.
+    ``rebuilt=True`` marks the slow path — the new version's shapes did
+    not match, so the entry points were recompiled instead of swapped
+    (the zero-recompile contract intentionally does not cover it)."""
+    if counters_enabled():
+        counter_add("serving_swaps", 1)
+        if rebuilt:
+            counter_add("serving_swap_rebuilds", 1)
+
+
+def record_serving_reroute() -> None:
+    """A fleet request was rerouted off a failed/closed replica onto a
+    surviving one."""
+    if counters_enabled():
+        counter_add("serving_reroutes", 1)
+
+
+def record_registry_publish(rollback: bool = False) -> None:
+    """One model version published to (or rolled back in) a
+    ModelRegistry."""
+    if counters_enabled():
+        counter_add("registry_publishes", 1)
+        if rollback:
+            counter_add("registry_rollbacks", 1)
 
 
 def record_serving_slo_violation() -> None:

@@ -102,30 +102,70 @@ class Histogram:
         inside the winning bucket, clamped to observed [min, max]
         (NaN-valued when empty, matching the old LatencyWindow
         contract)."""
-        snap = self.snapshot()
-        out = {}
-        n = snap["count"]
-        if n == 0:
-            return {f"p{q}": float("nan") for q in qs}
-        counts, bounds = snap["counts"], snap["bounds"]
-        for q in qs:
-            rank = max(min(math.ceil(q / 100.0 * n), n), 1)
-            cum = 0
-            value = snap["max"]
-            for i, c in enumerate(counts):
-                if c == 0:
-                    continue
-                if cum + c >= rank:
-                    # bucket 0's floor is the observed min (all its
-                    # members are <= bounds[0] and the min is among
-                    # them); the overflow bucket's ceiling is the max
-                    lo = bounds[i - 1] if i > 0 else snap["min"]
-                    hi = bounds[i] if i < len(bounds) else snap["max"]
-                    frac = (rank - cum) / c
-                    value = lo + frac * (hi - lo)
-                    break
-                cum += c
-            out[f"p{q}"] = float(
-                min(max(value, snap["min"]), snap["max"])
-            )
-        return out
+        return percentiles_from(self.snapshot(), qs)
+
+
+def percentiles_from(snap: dict, qs=(50, 99)) -> dict:
+    """Quantiles from any snapshot-shaped dict (a :meth:`snapshot` or a
+    :func:`snapshot_delta` window): linear interpolation inside the
+    winning bucket, clamped to the snapshot's [min, max]; NaN when the
+    snapshot is empty."""
+    out = {}
+    n = snap["count"]
+    if n <= 0:
+        return {f"p{q}": float("nan") for q in qs}
+    counts, bounds = snap["counts"], snap["bounds"]
+    for q in qs:
+        rank = max(min(math.ceil(q / 100.0 * n), n), 1)
+        cum = 0
+        value = snap["max"]
+        for i, c in enumerate(counts):
+            if c <= 0:
+                continue
+            if cum + c >= rank:
+                # bucket 0's floor is the observed min (all its
+                # members are <= bounds[0] and the min is among
+                # them); the overflow bucket's ceiling is the max
+                lo = bounds[i - 1] if i > 0 else snap["min"]
+                hi = bounds[i] if i < len(bounds) else snap["max"]
+                frac = (rank - cum) / c
+                value = lo + frac * (hi - lo)
+                break
+            cum += c
+        out[f"p{q}"] = float(
+            min(max(value, snap["min"]), snap["max"])
+        )
+    return out
+
+
+def snapshot_delta(cur: dict, prev: dict | None) -> dict:
+    """The WINDOW between two snapshots of one histogram as another
+    snapshot-shaped dict — the delta-quantile primitive behind
+    ``ModelServer.stats()``'s windowed latency and the fleet's routing/
+    admission predictions (an all-time p99 over a long fast history
+    dilutes a fresh degradation; a window sees it immediately).
+
+    ``prev=None`` (or a fresh cursor) returns ``cur`` itself. The
+    window's true min/max were not tracked, so they are estimated from
+    the populated delta buckets' edges (lifetime min/max bound the
+    open-ended first/overflow buckets) — quantile error stays within
+    one bucket, same contract as the lifetime estimate."""
+    if prev is None or prev.get("count", 0) == 0:
+        return cur
+    bounds = cur["bounds"]
+    counts = [c - p for c, p in zip(cur["counts"], prev["counts"])]
+    n = cur["count"] - prev["count"]
+    lo = hi = None
+    for i, c in enumerate(counts):
+        if c > 0:
+            if lo is None:
+                lo = cur["min"] if i == 0 else bounds[i - 1]
+            hi = cur["max"] if i >= len(bounds) else bounds[i]
+    return {
+        "bounds": bounds,
+        "counts": counts,
+        "sum": cur["sum"] - prev["sum"],
+        "count": n,
+        "min": lo,
+        "max": hi,
+    }

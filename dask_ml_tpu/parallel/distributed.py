@@ -14,9 +14,29 @@ protocol.
 Single-host sessions exercise the same code paths (process_count == 1),
 which is how the test suite covers it; a pod run only changes the
 environment variables.
+
+VIRTUAL PROCESSES: the real 2-process bring-up needs a backend that
+implements cross-process collectives — some CPU jax builds refuse with
+"Multiprocess computations aren't implemented on the CPU backend",
+which used to leave the distribution LOGIC (task partitioning, round
+merges, failure propagation, local-mesh placement) untestable under
+tier-1. :func:`run_virtual_processes` runs N ranks as threads of ONE
+process: ``process_count``/``process_index`` answer per-thread, the
+host collectives (``allgather_object`` and everything built on it)
+rendezvous in-process with the same ordering/bit-exactness guarantees,
+``local_mesh`` splits the local devices into per-rank submeshes, and a
+rank that dies mid-round fails its peers' collectives fast (the
+worker-death detection analog). Device-fabric SPMD (a GSPMD program
+psumming across processes) is exactly what this cannot emulate — those
+paths keep their real-multiprocess tests, capability-probed.
 """
 
 from __future__ import annotations
+
+import contextlib
+import pickle
+import threading
+import time as _time
 
 import numpy as np
 
@@ -26,6 +46,133 @@ import jax.numpy as jnp
 from .mesh import device_mesh
 
 _initialized = False
+
+# -- virtual process plane ---------------------------------------------------
+
+_vlocal = threading.local()     # .ctx = (rank, world, _VirtualExchange)
+
+
+def _virtual():
+    return getattr(_vlocal, "ctx", None)
+
+
+class _VirtualExchange:
+    """In-process rendezvous allgather shared by one virtual world's
+    rank threads. Rounds are generation-counted so back-to-back
+    collectives never mix; a failed rank poisons the exchange so peers
+    raise instead of waiting out the timeout."""
+
+    def __init__(self, world, timeout=120.0):
+        self.world = int(world)
+        self.timeout = float(timeout)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._slots = {}
+        self._result = None
+        self._gen = 0
+        self._failed = None     # (rank, repr(exc))
+
+    def fail(self, rank, exc):
+        with self._cond:
+            if self._failed is None:
+                self._failed = (rank, repr(exc))
+            self._cond.notify_all()
+
+    def allgather(self, rank, obj):
+        with self._cond:
+            if self._failed is not None:
+                raise RuntimeError(
+                    f"virtual peer {self._failed[0]} failed: "
+                    f"{self._failed[1]}"
+                )
+            gen = self._gen
+            self._slots[rank] = obj
+            if len(self._slots) == self.world:
+                self._result = [self._slots[r]
+                                for r in range(self.world)]
+                self._slots = {}
+                self._gen += 1
+                self._cond.notify_all()
+                return list(self._result)
+            deadline = _time.monotonic() + self.timeout
+            while self._gen == gen:
+                if self._failed is not None:
+                    raise RuntimeError(
+                        f"virtual peer {self._failed[0]} failed: "
+                        f"{self._failed[1]}"
+                    )
+                left = deadline - _time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(
+                        f"virtual allgather timed out after "
+                        f"{self.timeout}s (rank {rank} waiting)"
+                    )
+                self._cond.wait(min(left, 0.1))
+            return list(self._result)
+
+
+@contextlib.contextmanager
+def virtual_process(rank, world, exchange):
+    """Make THIS thread virtual rank ``rank`` of ``world`` — every
+    process-topology query and host collective in this module answers
+    for the virtual rank while the context is open."""
+    prev = _virtual()
+    _vlocal.ctx = (int(rank), int(world), exchange)
+    try:
+        yield
+    finally:
+        if prev is None:
+            del _vlocal.ctx
+        else:
+            _vlocal.ctx = prev
+
+
+def run_virtual_processes(fn, world=2, timeout=120.0):
+    """Run ``fn(rank)`` on ``world`` rank threads of this process with
+    the virtual collective plane wired up; returns ``[fn(0), ...,
+    fn(world-1)]``. The single-process stand-in for a real
+    ``jax.distributed`` bring-up: same partitioning/merge/failure logic,
+    no cross-process runtime required. A rank that raises fails the
+    others' pending collectives immediately; the first raised exception
+    propagates to the caller."""
+    exchange = _VirtualExchange(world, timeout=timeout)
+    results = [None] * world
+    errors = [None] * world
+
+    def body(rank):
+        try:
+            with virtual_process(rank, world, exchange):
+                results[rank] = fn(rank)
+        except BaseException as exc:  # noqa: BLE001 - reraised below
+            errors[rank] = exc
+            exchange.fail(rank, exc)
+
+    threads = [threading.Thread(target=body, args=(r,),
+                                name=f"virtual-rank-{r}")
+               for r in range(world)]
+    for t in threads:
+        t.start()
+    # one shared deadline (not `timeout` per join — sequential joins
+    # would wait up to world x timeout), and an explicit liveness check:
+    # a rank hung OUTSIDE a collective never trips exchange.fail, and
+    # silently returning its None result would surface as a confusing
+    # TypeError in the caller instead of a timeout naming the rank
+    deadline = _time.monotonic() + timeout
+    for t in threads:
+        t.join(max(0.0, deadline - _time.monotonic()))
+    for exc in errors:
+        if exc is not None and not isinstance(exc, RuntimeError):
+            raise exc
+    for exc in errors:
+        if exc is not None:
+            raise exc
+    hung = [t.name for t in threads if t.is_alive()]
+    if hung:
+        raise RuntimeError(
+            f"virtual rank(s) still running after {timeout}s: "
+            + ", ".join(hung)
+        )
+    return results
 
 
 def initialize(coordinator_address=None, num_processes=None,
@@ -52,17 +199,30 @@ def initialize(coordinator_address=None, num_processes=None,
 
 
 def process_index() -> int:
-    return jax.process_index()
+    v = _virtual()
+    return v[0] if v is not None else jax.process_index()
 
 
 def process_count() -> int:
-    return jax.process_count()
+    v = _virtual()
+    return v[1] if v is not None else jax.process_count()
+
+
+def in_virtual_world() -> bool:
+    """True on a thread running as a virtual rank of a >1 world.
+    Topology queries answer for the virtual rank, but every device
+    still reports the REAL process — so callers detecting
+    cross-process work from device attributes must ask this instead
+    (a virtual world is always "cross-process": its twins exist to
+    replicate multi-process semantics in one process)."""
+    v = _virtual()
+    return v is not None and v[1] > 1
 
 
 def is_coordinator() -> bool:
     """The host that runs search controllers (SURVEY.md §3.5: 'asyncio
     controller on host 0')."""
-    return jax.process_index() == 0
+    return process_index() == 0
 
 
 def global_mesh(axis_names=("data",), shape=None):
@@ -78,9 +238,20 @@ def local_mesh(axis_names=("data",), shape=None):
     emit cross-host collectives, so different processes can run different
     programs concurrently — the placement unit for distributed
     hyperparameter search (SURVEY.md §3.5: 'trials pinned to
-    hosts/mesh-subsets')."""
+    hosts/mesh-subsets'). Under a virtual world the local devices are
+    SPLIT into contiguous per-rank groups, so virtual ranks place their
+    trials on disjoint devices exactly like real processes do."""
+    devices = jax.local_devices()
+    v = _virtual()
+    if v is not None:
+        rank, world, _ = v
+        if len(devices) >= world:
+            per = len(devices) // world
+            devices = devices[rank * per:(rank + 1) * per]
+        else:  # fewer devices than ranks: everyone shares device 0
+            devices = devices[:1]
     return device_mesh(shape=shape, axis_names=axis_names,
-                       devices=jax.local_devices(), topology_order=True)
+                       devices=devices, topology_order=True)
 
 
 def allgather_object(obj):
@@ -90,10 +261,15 @@ def allgather_object(obj):
     padding to the max length (sizes exchanged first) — the control-plane
     result channel for distributed searches, replacing the reference's
     msgpack/pickle frames over TCP (SURVEY.md §5 comm row)."""
-    import pickle
-
     if process_count() == 1:
         return [obj]
+    v = _virtual()
+    if v is not None:
+        rank, _, exchange = v
+        # pickle round-trip per rank: same isolation (and same
+        # picklability requirement) as the real wire path
+        return [pickle.loads(p) for p in
+                exchange.allgather(rank, pickle.dumps(obj))]
     buf = np.frombuffer(pickle.dumps(obj), np.uint8)
     sizes = allgather_host(np.array([buf.size], np.int32))[:, 0]
     padded = np.zeros(int(sizes.max()), np.uint8)
@@ -143,6 +319,17 @@ def allgather_host(value: np.ndarray) -> np.ndarray:
     value = np.ascontiguousarray(value)
     if process_count() == 1:
         return value[None]
+    v = _virtual()
+    if v is not None:
+        rank, _, exchange = v
+        parts = exchange.allgather(rank, value.copy())
+        if any(p.shape != value.shape or p.dtype != value.dtype
+               for p in parts):
+            raise ValueError(
+                "allgather_host requires identical shape/dtype on "
+                f"every rank; got {[(p.shape, str(p.dtype)) for p in parts]}"
+            )
+        return np.stack(parts)
     from jax.experimental import multihost_utils
 
     buf = np.frombuffer(value.tobytes(), np.uint8)
@@ -178,7 +365,7 @@ def array_from_process_local(local, mesh=None, dtype=np.float32):
     local = np.ascontiguousarray(np.asarray(local, dtype))
     if mesh is None:
         mesh = global_mesh()
-    me = jax.process_index()
+    me = process_index()
     shapes = allgather_object(
         (tuple(local.shape[1:]), str(local.dtype))
     )
@@ -227,6 +414,18 @@ def array_from_process_local(local, mesh=None, dtype=np.float32):
                     buf[l2 - a:h2 - a] = arr[l2 - g0:h2 - g0]
         mine[(a, b)] = buf
 
+    if _virtual() is not None:
+        # virtual ranks share one process whose devices ALL report
+        # process_index 0, so the shard buffers (own rows + shipped
+        # parcels) land wherever the real attribute says — but every
+        # rank must build the (fully addressable) global array. One
+        # more gather merges the assembled shard buffers everywhere;
+        # the parcel-routing logic above still ran for real.
+        merged = {}
+        for part in allgather_object(mine):
+            merged.update(part)
+        mine = merged
+
     def cb(idx):
         sl = idx[0]
         a = sl.start or 0
@@ -237,7 +436,13 @@ def array_from_process_local(local, mesh=None, dtype=np.float32):
 
 
 def barrier(name="barrier"):
-    """Cross-host sync point: a tiny psum over every device."""
+    """Cross-host sync point: a tiny psum over every device (virtual
+    ranks rendezvous in-process and report the same device-count sum)."""
+    v = _virtual()
+    if v is not None:
+        rank, _, exchange = v
+        exchange.allgather(rank, name)
+        return float(len(jax.devices()))
     x = jnp.ones((jax.device_count(),))
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -256,6 +461,11 @@ def broadcast_host(value: np.ndarray, root: int = 0) -> np.ndarray:
     the device fabric (device_put + replication), not a socket."""
     if process_count() == 1:
         return np.asarray(value)
+    v = _virtual()
+    if v is not None:
+        rank, _, exchange = v
+        parts = exchange.allgather(rank, np.asarray(value).copy())
+        return parts[root]
     from jax.experimental import multihost_utils
 
     return np.asarray(

@@ -157,8 +157,17 @@ class PartitionedFrame:
         mesh = resolve_mesh(mesh)  # ambient/default meshes can ALSO span
         # processes — detection must see the resolved mesh, or a
         # multi-process to_sharded() with no mesh arg would take the
-        # SPMD path with per-process-different arrays
-        cross_process = any(
+        # SPMD path with per-process-different arrays. Virtual ranks
+        # (distributed.run_virtual_processes) share one real process
+        # whose devices all report process 0, so THEY need the explicit
+        # virtual-world probe; a real multi-process session keeps the
+        # device-attribute check — its process_count() is >1 for every
+        # call, including to_sharded onto a purely process-LOCAL mesh,
+        # which must stay on the local path (no peer reaches the
+        # collective).
+        from . import distributed as dist
+
+        cross_process = dist.in_virtual_world() or any(
             d.process_index != jax.process_index()
             for d in mesh.devices.flat
         )

@@ -9,21 +9,34 @@ or a host→device parameter transfer per call.
 - ``_buckets``  — the geometric shape-bucket ladder bounding the
   compiled-program set;
 - ``_batching`` — request records, the bounded admission queue,
-  ping-pong staging buffers, pack/demux;
+  ping-pong staging buffers, pack/demux, the deadline-aware batch
+  release rule;
 - ``_server``   — :class:`ModelServer`: micro-batching worker, warmup,
   backpressure (:class:`ServerOverloaded` / :class:`RequestTimeout`),
-  graceful drain;
+  zero-recompile hot-swap (:meth:`ModelServer.swap_model`), graceful
+  drain;
+- ``registry``  — :class:`ModelRegistry`: named, versioned fitted-model
+  snapshots with publish/rollback notification;
+- ``policy``    — windowed execution-latency prediction + SLO admission
+  verdicts;
+- ``fleet``     — :class:`FleetServer`: N replica workers (per-device
+  placement), least-loaded routing, SLO-aware admission, rolling
+  hot-swap, failover, and :func:`serve_while_training`;
 - ``metrics``   — per-batch spans + serving counters through
   ``dask_ml_tpu/observability``, and the latency-quantile window.
 
 Quick start::
 
-    from dask_ml_tpu.serving import ModelServer
+    from dask_ml_tpu.serving import FleetServer, ModelServer
 
     with ModelServer(fitted_clf,
                      methods=("predict", "predict_proba")).warmup() as srv:
         fut = srv.submit(x_small)        # Future
         proba = srv.predict_proba(x)     # blocking convenience
+
+    with FleetServer(fitted_clf, replicas=2).warmup() as fleet:
+        y = fleet.predict(x)
+        fleet.publish(retrained_clf)     # zero-recompile rolling swap
 """
 
 from ._buckets import BucketLadder
@@ -33,13 +46,29 @@ from ._server import (
     ServerClosed,
     ServerOverloaded,
     ServingError,
+    SloShed,
+)
+from .fleet import FleetServer, NoHealthyReplicas, serve_while_training
+from .registry import (
+    ModelRegistry,
+    ModelVersion,
+    RegistryError,
+    UnknownModelError,
 )
 
 __all__ = [
     "BucketLadder",
+    "FleetServer",
+    "ModelRegistry",
     "ModelServer",
+    "ModelVersion",
+    "NoHealthyReplicas",
+    "RegistryError",
     "RequestTimeout",
     "ServerClosed",
     "ServerOverloaded",
     "ServingError",
+    "SloShed",
+    "UnknownModelError",
+    "serve_while_training",
 ]

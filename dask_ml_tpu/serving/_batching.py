@@ -20,7 +20,38 @@ from concurrent.futures import Future
 
 import numpy as np
 
-__all__ = ["Request", "PingPongStaging", "pack_batch", "demux_outputs"]
+__all__ = ["Request", "PingPongStaging", "pack_batch", "demux_outputs",
+           "release_deadline"]
+
+
+def release_deadline(oldest_enqueue_t, dequeue_t, window_s, slo_s,
+                     predicted_exec_s, margin_frac=0.15):
+    """When must the coalescing loop stop waiting and dispatch?
+
+    The fixed rule (no SLO configured, or no latency history yet to
+    predict from): ``dequeue_t + window_s`` — the classic min/max batch
+    window, measured from the first dequeue so a trickle of stragglers
+    cannot hold a batch forever.
+
+    The DEADLINE-AWARE rule (``config.serving_slo_ms`` set and an
+    execution-time prediction available): the batch must leave early
+    enough that the OLDEST request still makes its SLO —
+    ``oldest_enqueue + slo - predicted_exec - margin``. That replaces
+    the fixed window in both directions: a slow bucket releases a
+    partial batch EARLY (waiting would already miss), while an ample
+    budget lets the batcher coalesce LONGER than the fixed window for
+    better occupancy. The margin (default 15% of the SLO) absorbs
+    prediction error and the demux/host tail the execution histogram
+    does not see. Never returns earlier than ``dequeue_t`` — an
+    already-doomed oldest request dispatches immediately rather than
+    waiting at all."""
+    if slo_s <= 0 or predicted_exec_s is None:
+        return dequeue_t + window_s
+    return max(
+        oldest_enqueue_t + slo_s - predicted_exec_s
+        - slo_s * margin_frac,
+        dequeue_t,
+    )
 
 
 class Request:
@@ -149,7 +180,7 @@ class BoundedQueue:
     worker's tail loop — no request can strand in a closed queue."""
 
     __slots__ = ("_lock", "_cond", "_lanes", "_seq", "max_requests",
-                 "depth", "peak_depth", "closed")
+                 "depth", "rows", "peak_depth", "closed")
 
     def __init__(self, max_requests):
         self._lock = threading.Lock()
@@ -158,6 +189,8 @@ class BoundedQueue:
         self._seq = 0             # global admission order stamp
         self.max_requests = int(max_requests)
         self.depth = 0
+        self.rows = 0             # queued ROWS (the admission/routing
+        #                           load signal — depth counts requests)
         self.peak_depth = 0
         self.closed = False
 
@@ -180,6 +213,7 @@ class BoundedQueue:
                     lane = self._lanes[req.method] = deque()
                 lane.append(req)
             self.depth += len(reqs)
+            self.rows += sum(r.n_rows for r in reqs)
             self.peak_depth = max(self.peak_depth, self.depth)
             self._cond.notify()
             return "ok"
@@ -203,7 +237,9 @@ class BoundedQueue:
         if best is None:
             return None
         self.depth -= 1
-        return best.popleft()
+        req = best.popleft()
+        self.rows -= req.n_rows
+        return req
 
     def pop_first(self, timeout):
         """Oldest request across lanes, blocking up to ``timeout``
@@ -227,6 +263,7 @@ class BoundedQueue:
                     break
                 req = lane.popleft()
                 self.depth -= 1
+                self.rows -= req.n_rows
                 budget -= req.n_rows
                 got.append(req)
         return got

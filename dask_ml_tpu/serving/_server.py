@@ -33,7 +33,7 @@ import time
 
 import numpy as np
 
-from ..wrappers import compiled_batch_fn
+from ..wrappers import ParamSwapError, compiled_batch_fn
 from . import metrics as smetrics
 from ._batching import (
     BoundedQueue,
@@ -42,11 +42,13 @@ from ._batching import (
     demux_outputs,
     fail_requests,
     pack_batch,
+    release_deadline,
 )
 from ._buckets import BucketLadder
+from .policy import ExecStats
 
 __all__ = ["ModelServer", "ServingError", "ServerOverloaded",
-           "RequestTimeout", "ServerClosed"]
+           "RequestTimeout", "ServerClosed", "SloShed"]
 
 
 class ServingError(RuntimeError):
@@ -56,6 +58,13 @@ class ServingError(RuntimeError):
 class ServerOverloaded(ServingError):
     """Admission control shed this request: the bounded queue is full.
     Retry with backoff, widen ``max_queue``, or add replicas."""
+
+
+class SloShed(ServerOverloaded):
+    """SLO-aware admission shed this request: every candidate replica's
+    predicted completion (queued work x predicted execution time) would
+    miss ``config.serving_slo_ms``. Queueing it anyway would only add a
+    guaranteed violation — retry with backoff or add capacity."""
 
 
 class RequestTimeout(ServingError, TimeoutError):
@@ -90,7 +99,8 @@ class ModelServer:
     """
 
     def __init__(self, estimator, methods=("predict",), ladder=None,
-                 max_queue=None, batch_window_ms=None, timeout_ms=None):
+                 max_queue=None, batch_window_ms=None, timeout_ms=None,
+                 device=None, replica_id=None):
         from ..config import get_config
 
         cfg = get_config()
@@ -110,10 +120,21 @@ class ModelServer:
         self.timeout_s = float(
             cfg.serving_timeout_ms if timeout_ms is None else timeout_ms
         ) / 1e3
-        self._fns = {m: compiled_batch_fn(estimator, m) for m in methods}
+        # deadline-aware batch release (see _batching.release_deadline):
+        # armed by an SLO in the creator's config
+        self._slo_s = float(cfg.serving_slo_ms) / 1e3
+        # per-replica placement: the fleet commits each replica's param
+        # pytrees to its own device; None = default device
+        self.device = device
+        self.replica_id = replica_id
+        self.model_version = 0          # stamped by swap/rebuild/fleet
+        self._fns = {m: compiled_batch_fn(estimator, m, device=device)
+                     for m in methods}
         self._queue = BoundedQueue(self.max_queue)
         self._staging = PingPongStaging()
         self._latency = smetrics.LatencyWindow()
+        self._stats_cursor = None       # windowed-quantile cursor
+        self._exec = ExecStats()        # per-(method,bucket) exec times
         self._lock = threading.Lock()
         self._thread = None
         self._stop = threading.Event()
@@ -208,6 +229,72 @@ class ModelServer:
         self._paused.set()
         return self
 
+    @property
+    def healthy(self) -> bool:
+        """Accepting requests with a live (or not-yet-started) worker —
+        the fleet's routing predicate."""
+        if not self._accepting:
+            return False
+        thread = self._thread
+        return thread is None or thread.is_alive()
+
+    # -- hot-swap ----------------------------------------------------------
+    def swap_model(self, estimator, version=None):
+        """Zero-recompile hot-swap: replace the served parameters with
+        ``estimator``'s under the SAME compiled entry points
+        (``CompiledBatchFn.swap_params`` — programs close over shapes,
+        not values, so a same-shape swap mints no XLA compile; asserted
+        via the recompile counters in tests and fleet_smoke). Raises
+        :class:`~dask_ml_tpu.wrappers.ParamSwapError` when the new
+        version is structurally incompatible — use :meth:`rebuild_model`
+        then. In-flight batches finish on the old version; batches
+        packed after return serve the new one. Safe under live traffic.
+        """
+        # validate EVERY method against the new estimator before
+        # mutating ANY entry point: a multi-method server must never be
+        # left half-swapped (predict on v2, predict_proba on v1).
+        # prepare_swap covers every entry-point flavor — compiled,
+        # pipeline, host fallback — and touches no live state.
+        tokens = {}
+        for m, fn in self._fns.items():
+            try:
+                tokens[m] = fn.prepare_swap(estimator)
+            except ParamSwapError as exc:
+                raise ParamSwapError(f"method {m!r}: {exc}") from exc
+        for m, fn in self._fns.items():
+            fn.commit_swap(tokens[m])
+        self.estimator = estimator
+        if version is not None:
+            self.model_version = int(version)
+        else:
+            self.model_version += 1
+        smetrics.record_swap()
+        if self.replica_id is not None:
+            smetrics.set_replica_gauges(self.replica_id,
+                                        version=self.model_version)
+        return self
+
+    def rebuild_model(self, estimator, version=None, warm=None):
+        """The slow path a shape-incompatible publish needs: build fresh
+        compiled entry points for ``estimator`` (paying compiles), warm
+        them off the serving path, then install atomically. ``warm``
+        defaults to whether this server was warmed."""
+        fns = {m: compiled_batch_fn(estimator, m, device=self.device)
+               for m in self._fns}
+        if warm or (warm is None and self._warmed):
+            self._warm_fns(fns)
+        self._fns = fns
+        self.estimator = estimator
+        if version is not None:
+            self.model_version = int(version)
+        else:
+            self.model_version += 1
+        smetrics.record_swap(rebuilt=True)
+        if self.replica_id is not None:
+            smetrics.set_replica_gauges(self.replica_id,
+                                        version=self.model_version)
+        return self
+
     # -- warmup -----------------------------------------------------------
     def warmup(self):
         """Compile every (method, bucket) program now, before traffic:
@@ -223,7 +310,12 @@ class ModelServer:
         from ..config import ensure_compile_cache
 
         ensure_compile_cache()
-        for method, fn in self._fns.items():
+        self._warm_fns(self._fns)
+        self._warmed = True
+        return self
+
+    def _warm_fns(self, fns):
+        for method, fn in fns.items():
             if not fn.jitted:
                 continue   # host fallback: nothing to compile
             d = fn.n_features or self._probe_width()
@@ -234,8 +326,6 @@ class ModelServer:
                 )
             for bucket in self.ladder:
                 fn(np.zeros((bucket, d), np.float32))
-        self._warmed = True
-        return self
 
     def _probe_width(self):
         est = self.estimator
@@ -350,23 +440,56 @@ class ModelServer:
         return float(r2_score(y, pred))
 
     # -- stats -------------------------------------------------------------
+    @property
+    def queue_rows(self) -> int:
+        """Rows currently queued — the fleet's least-loaded routing
+        signal (requests vary 1..top-bucket rows, so row depth ranks
+        load better than request depth)."""
+        return self._queue.rows
+
+    def predict_exec_s(self, method: str, n_rows: int):
+        """Predicted execution seconds for an ``n_rows`` batch of
+        ``method`` (windowed per-(method, bucket) quantile; None before
+        any history) — the fleet admission's per-replica input."""
+        try:
+            bucket = self.ladder.bucket_for(min(n_rows,
+                                                self.ladder.max_rows))
+        except ValueError:
+            bucket = self.ladder.max_rows
+        return self._exec.predict_s(method, bucket)
+
     def stats(self):
-        """Live snapshot: queue depth/peak, batch count, request count,
-        and latency quantiles over the SERVER'S LIFETIME — the
-        histogram-backed LatencyWindow keeps the whole run, so p50/p99
-        answer "how has this server behaved", not "how is it behaving
-        right now" (a long fast history dilutes a fresh degradation;
-        watch the per-(method, bucket) /metrics histograms over scrape
-        intervals for rate-of-change)."""
+        """Live snapshot: queue depth/rows/peak, batch count, request
+        count, and latency quantiles — BOTH lifetime (``latency_s``:
+        "how has this server behaved", the histogram keeps the whole
+        run) and windowed (``latency_window_s``: quantiles over the
+        requests since the PREVIOUS stats() call — the view routing
+        and dashboards should ride, since a long fast history dilutes a
+        fresh degradation). ``exec_s`` carries the per-(method, bucket)
+        execution-time summary feeding deadline release and SLO
+        admission."""
         q = self._queue
-        return {
+        cursor = self._stats_cursor
+        cur = self._latency.snapshot()
+        self._stats_cursor = cur
+        out = {
             "queue_depth": q.depth,
+            "queue_rows": q.rows,
             "queue_peak_depth": q.peak_depth,
             "batches": self._batches,
             "requests": self._latency.count,
             "warmed": self._warmed,
+            "healthy": self.healthy,
+            "version": self.model_version,
             "latency_s": self._latency.percentiles((50, 99)),
+            "latency_window_s": self._latency.percentiles_between(
+                cursor, (50, 99), cur=cur
+            ),
+            "exec_s": self._exec.snapshot(),
         }
+        if self.replica_id is not None:
+            out["replica"] = self.replica_id
+        return out
 
     # -- worker ------------------------------------------------------------
     def _run(self):
@@ -430,9 +553,20 @@ class ModelServer:
         batch = [first]
         rows = first.n_rows
         top = self.ladder.max_rows
-        # coalescing window: measured from the FIRST dequeue, not per
-        # arrival — a trickle of stragglers cannot hold a batch forever
-        deadline = time.perf_counter() + self.batch_window_s
+        # coalescing deadline, measured from the FIRST dequeue (a
+        # trickle of stragglers cannot hold a batch forever). With an
+        # SLO configured and execution history to predict from, the
+        # fixed window is REPLACED by the deadline-aware rule: release
+        # when waiting longer would make the oldest request miss its
+        # SLO (predicted exec for the CURRENT candidate bucket), and
+        # keep coalescing past the fixed window while the budget is
+        # ample (_batching.release_deadline)
+        dequeue_t = time.perf_counter()
+        # exec predictions change once per ExecStats WINDOW (seconds),
+        # not per coalescing wake (<=10ms) — cache per candidate bucket
+        # for this assembly so the loop doesn't pay a locked histogram
+        # snapshot + percentile scan on every iteration
+        pred_cache = {}
         while rows < top and not self._stop.is_set():
             got = self._queue.drain_method(first.method, top - rows)
             for r in got:
@@ -445,6 +579,19 @@ class ModelServer:
                     batch.append(r)
                     rows += r.n_rows
             now = time.perf_counter()
+            if self._slo_s > 0:
+                bucket = self.ladder.bucket_for(rows)
+                if bucket not in pred_cache:
+                    pred_cache[bucket] = self._exec.predict_s(
+                        first.method, bucket
+                    )
+                predicted = pred_cache[bucket]
+            else:
+                predicted = None
+            deadline = release_deadline(
+                first.t_enqueue, dequeue_t, self.batch_window_s,
+                self._slo_s, predicted,
+            )
             if now >= deadline or rows >= top:
                 break
             # sleep on THIS method's lane — depth > 0 from other
@@ -465,7 +612,9 @@ class ModelServer:
             buf, segments, bucket, rows = pack_batch(
                 batch, self.ladder, self._staging
             )
-            smetrics.set_queue_gauges(self._queue.depth, rows)
+            smetrics.set_queue_gauges(self._queue.depth, rows,
+                                      replica=self.replica_id)
+            t_exec = time.perf_counter()
             with smetrics.batch_span(
                 method, bucket, rows, len(batch),
                 self._queue.depth,
@@ -474,6 +623,10 @@ class ModelServer:
             self._batches += 1
             smetrics.record_batch(rows, bucket)
             done = time.perf_counter()
+            # the deadline-release / SLO-admission predictor's feed:
+            # execution wall of THIS (method, bucket), queue wait
+            # excluded
+            self._exec.observe(method, bucket, done - t_exec)
             for r in batch:
                 lat = done - r.t_enqueue
                 self._latency.observe(lat)
@@ -491,7 +644,8 @@ class ModelServer:
         finally:
             # inflight back to 0 on the failure path too — a failed
             # batch must not leave /metrics showing phantom inflight rows
-            smetrics.set_queue_gauges(self._queue.depth, 0)
+            smetrics.set_queue_gauges(self._queue.depth, 0,
+                                      replica=self.replica_id)
 
 
 def _gather_futures(futures):

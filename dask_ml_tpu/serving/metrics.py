@@ -34,17 +34,25 @@ from __future__ import annotations
 
 from ..observability import span
 from ..observability._counters import (
+    record_registry_publish,
     record_serving_batch,
     record_serving_drop,
     record_serving_request,
+    record_serving_reroute,
     record_serving_slo_violation,
+    record_serving_swap,
 )
-from ..observability._hist import Histogram
+from ..observability._hist import (
+    Histogram,
+    percentiles_from,
+    snapshot_delta,
+)
 from ..observability.live import gauge_set, histogram, live_publishing
 
 __all__ = ["LatencyWindow", "batch_span", "record_batch",
            "record_request", "record_drop", "observe_request_latency",
-           "set_queue_gauges"]
+           "set_queue_gauges", "set_replica_gauges", "record_swap",
+           "record_reroute", "record_publish"]
 
 # counter recording lives in observability/_counters.py (the shared
 # registry the report CLI and span deltas read); these are the serving
@@ -52,6 +60,9 @@ __all__ = ["LatencyWindow", "batch_span", "record_batch",
 record_request = record_serving_request
 record_batch = record_serving_batch
 record_drop = record_serving_drop
+record_swap = record_serving_swap
+record_reroute = record_serving_reroute
+record_publish = record_registry_publish
 
 
 def batch_span(method: str, bucket: int, rows: int, n_requests: int,
@@ -91,14 +102,33 @@ def observe_request_latency(method: str, bucket: int,
         record_serving_slo_violation()
 
 
-def set_queue_gauges(depth: int, inflight_rows: int) -> None:
+def set_queue_gauges(depth: int, inflight_rows: int,
+                     replica=None) -> None:
     """Live queue-depth / inflight gauges (scraped via /metrics). Only
     written while a telemetry server is up — the steady-state serving
-    loop must not pay dict writes for an exporter nobody runs."""
+    loop must not pay dict writes for an exporter nobody runs. A fleet
+    replica labels its series (``replica="0"``...) so per-replica load
+    imbalance is visible on one scrape; a standalone server keeps the
+    unlabeled family."""
     if not live_publishing():
         return
-    gauge_set("serving_queue_depth", depth)
-    gauge_set("serving_inflight_rows", inflight_rows)
+    labels = () if replica is None else (("replica", str(replica)),)
+    gauge_set("serving_queue_depth", depth, labels)
+    gauge_set("serving_inflight_rows", inflight_rows, labels)
+
+
+def set_replica_gauges(replica, version=None, healthy=None) -> None:
+    """Per-replica served-model-version + health gauges — the /metrics
+    view of a rolling hot-swap (each replica's version gauge flips as
+    the swap reaches it) and of failover (healthy drops to 0)."""
+    if not live_publishing():
+        return
+    labels = (("replica", str(replica)),)
+    if version is not None:
+        gauge_set("serving_replica_version", int(version), labels)
+    if healthy is not None:
+        gauge_set("serving_replica_healthy", 1 if healthy else 0,
+                  labels)
 
 
 class LatencyWindow:
@@ -127,3 +157,20 @@ class LatencyWindow:
 
     def snapshot(self) -> dict:
         return self._hist.snapshot()
+
+    def percentiles_between(self, prev_snapshot, qs=(50, 99),
+                            cur=None) -> dict:
+        """Quantiles over the WINDOW since ``prev_snapshot`` (a
+        ``snapshot()`` the caller took earlier; None = lifetime). The
+        windowed view the fleet's routing/admission and
+        ``ModelServer.stats()`` ride — a recent degradation shows up
+        immediately instead of being diluted by a long fast history.
+
+        Pass ``cur`` when the caller already snapshotted (and is, say,
+        advancing a cursor to that same snapshot): computing the delta
+        from a SECOND fresh snapshot would double-count observations
+        landing between the two in this window and the next."""
+        return percentiles_from(
+            snapshot_delta(self.snapshot() if cur is None else cur,
+                           prev_snapshot), qs
+        )

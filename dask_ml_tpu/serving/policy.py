@@ -1,0 +1,153 @@
+"""SLO policy plane: execution-latency prediction, deadline-aware batch
+release inputs, and fleet admission decisions.
+
+Two consumers ride the same windowed per-(method, bucket) histograms:
+
+- the micro-batcher's **deadline-aware release** (``_batching.
+  release_deadline``): how long may this partial batch keep coalescing
+  before the oldest request's SLO budget minus the predicted execution
+  time says "dispatch now";
+- the fleet's **SLO-aware admission** (:func:`predict_completion_s` /
+  :func:`admission_verdict`): given each replica's queued rows and its
+  predicted per-batch execution time, would this request complete
+  inside ``config.serving_slo_ms``? If no replica can, shed at the door
+  (typed ``SloShed``) — backpressure lands BEFORE the queue builds the
+  latency collapse, not after requests have already burned their budget
+  waiting.
+
+Predictions are WINDOWED quantiles (``observability._hist``
+delta-snapshots, rotated every :data:`WINDOW_S` seconds), not lifetime
+averages: a model swap or a noisy neighbor changes execution time NOW,
+and routing/admission must see the change within a window, undiluted by
+hours of healthy history.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+
+from ..observability._hist import (
+    Histogram,
+    percentiles_from,
+    snapshot_delta,
+)
+
+__all__ = ["ExecStats", "predict_completion_s", "admission_verdict",
+           "WINDOW_S"]
+
+# windowed-quantile rotation period: predictions read the delta since a
+# snapshot at most 2 windows old
+WINDOW_S = 10.0
+# a window needs this many observations before its quantile outranks
+# the lifetime one (tiny windows estimate wildly)
+_MIN_WINDOW_N = 8
+
+
+class ExecStats:
+    """Per-(method, bucket) batch EXECUTION seconds (pack -> demux of
+    one dispatched micro-batch — not queue wait) with windowed quantile
+    prediction.
+
+    ``observe`` is the serving worker's per-batch write: one histogram
+    observe. ``predict_s`` answers "how long will the next batch of
+    this shape take" from the freshest window with enough mass, falling
+    back to the lifetime histogram, then to any sibling bucket's
+    estimate (a bucket never executed yet borrows its nearest measured
+    neighbor — still better than no admission control at all), then to
+    ``None`` (caller keeps the fixed-window rule).
+    """
+
+    __slots__ = ("_hists", "_cursors", "_lock")
+
+    def __init__(self):
+        self._hists: dict[tuple, Histogram] = {}
+        # key -> (snapshot, t_taken): the rotation cursor windows read
+        self._cursors: dict[tuple, tuple] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, method: str, bucket: int, seconds: float) -> None:
+        key = (method, int(bucket))
+        h = self._hists.get(key)
+        if h is None:
+            with self._lock:
+                h = self._hists.setdefault(key, Histogram())
+        h.observe(seconds)
+
+    def _window(self, key):
+        """Delta snapshot since the rotation cursor (rotating it when
+        stale); None when the key was never observed."""
+        h = self._hists.get(key)
+        if h is None:
+            return None
+        cur = h.snapshot()
+        now = time.perf_counter()
+        with self._lock:
+            prev = self._cursors.get(key)
+            if prev is None or now - prev[1] > WINDOW_S:
+                self._cursors[key] = (cur, now)
+            prev_snap = prev[0] if prev is not None else None
+        delta = snapshot_delta(cur, prev_snap)
+        return delta if delta["count"] >= _MIN_WINDOW_N else cur
+
+    def predict_s(self, method: str, bucket: int, q: float = 90):
+        """Predicted execution seconds for a (method, bucket) batch, or
+        None when nothing was ever measured for the method."""
+        key = (method, int(bucket))
+        snap = self._window(key)
+        if snap is not None and snap["count"] > 0:
+            return next(iter(percentiles_from(snap, (q,)).values()))
+        # nearest measured sibling bucket of the same method
+        best, best_dist = None, math.inf
+        for (m, b), h in list(self._hists.items()):
+            if m != method or h.count == 0:
+                continue
+            dist = abs(math.log(max(b, 1)) - math.log(max(bucket, 1)))
+            if dist < best_dist:
+                best, best_dist = (m, b), dist
+        if best is None:
+            return None
+        snap = self._window(best)
+        if snap is None or snap["count"] == 0:
+            return None
+        return next(iter(percentiles_from(snap, (q,)).values()))
+
+    def snapshot(self) -> dict:
+        """{"method:bucket": {count, p50, p90}} — the stats()/status
+        rendering of the prediction state."""
+        out = {}
+        for (m, b), h in sorted(self._hists.items()):
+            if h.count == 0:
+                continue
+            pct = h.percentiles((50, 90))
+            out[f"{m}:{b}"] = {
+                "count": h.count,
+                "p50_s": round(pct["p50"], 6),
+                "p90_s": round(pct["p90"], 6),
+            }
+        return out
+
+
+def predict_completion_s(queue_rows: int, n_rows: int, top_bucket: int,
+                         exec_s) -> float | None:
+    """Predicted end-to-end seconds for a request of ``n_rows`` joining
+    a replica with ``queue_rows`` already queued: the queued work packs
+    into ``ceil(rows / top_bucket)`` full batches ahead of (or around)
+    this request, each costing one predicted execution. None when no
+    execution estimate exists yet (admission then stays open — never
+    shed on ignorance)."""
+    if exec_s is None:
+        return None
+    batches = max(math.ceil((queue_rows + n_rows) / max(top_bucket, 1)),
+                  1)
+    return batches * exec_s
+
+
+def admission_verdict(predicted_s, slo_s: float) -> bool:
+    """True = admit. Shed only on a CONFIDENT predicted miss: an SLO is
+    configured, a prediction exists, and the predicted completion
+    exceeds the full budget."""
+    if slo_s <= 0 or predicted_s is None:
+        return True
+    return predicted_s <= slo_s

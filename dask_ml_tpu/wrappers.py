@@ -19,6 +19,8 @@ worker-to-worker; blocks stream to it).
 
 from __future__ import annotations
 
+import copy as _copy
+
 import numpy as np
 
 from .base import BaseEstimator, clone
@@ -26,7 +28,7 @@ from .metrics import accuracy_score, r2_score
 from .parallel.sharded import ShardedArray, as_sharded
 
 __all__ = ["ParallelPostFit", "Incremental", "CompiledBatchFn",
-           "compiled_batch_fn"]
+           "compiled_batch_fn", "ParamSwapError"]
 
 
 def _data_shards(mesh):
@@ -220,41 +222,200 @@ class ParallelPostFit(BaseEstimator):
 # hot-loop contract; see dask_ml_tpu/serving/)
 # --------------------------------------------------------------------------
 
+class ParamSwapError(ValueError):
+    """A hot-swap was structurally impossible: the new estimator's
+    fitted parameters do not match the compiled entry point's shapes /
+    family / method semantics. The caller must rebuild the entry point
+    (paying fresh compiles) instead of swapping."""
+
+
 class CompiledBatchFn:
     """A fitted estimator's ``method`` as ONE static-shape batch
     function: ``fn(X)`` takes a host float32 (B, d) block and returns a
     host ndarray with one output row per input row.
 
-    For device estimators the core is a single jitted closure over the
-    fitted parameters (device-resident constants) — XLA specializes it
-    per distinct B, so a caller that draws B from a fixed bucket ladder
-    pays a fixed, pre-warmable set of compiles and nothing after. On
-    backends with real buffer donation (TPU/GPU) the input is donated,
-    letting XLA reuse the batch's device allocation for outputs.
-    ``jitted=False`` marks the host fallback (sklearn-style estimators):
-    still batchable, no compile accounting to speak of.
+    For device estimators the core is a single jitted function of
+    ``(params, X)`` — the fitted parameters are a pytree ARGUMENT, not a
+    baked-in constant, so the compiled program closes over their SHAPES
+    only. That is the hot-swap contract the serving fleet rides:
+    :meth:`swap_params` replaces the param pytree under the same
+    executable, and because XLA specializes per (param shapes, B), a
+    swap to same-shape parameters hits the existing compile cache — ZERO
+    new XLA compiles (asserted via the recompile counters in
+    tests/test_fleet.py). Callers drawing B from a fixed bucket ladder
+    pay a fixed, pre-warmable set of compiles and nothing after, across
+    any number of swaps. On backends with real buffer donation
+    (TPU/GPU) the input batch is donated (the params never are — they
+    are reused every call). ``jitted=False`` marks the host fallback
+    (sklearn-style estimators): still batchable and still swappable, no
+    compile accounting to speak of.
     """
 
-    __slots__ = ("method", "jitted", "n_features", "donates", "_fn",
-                 "_post")
+    __slots__ = ("method", "jitted", "n_features", "donates", "version",
+                 "_fn", "_state", "_extract", "_sig", "_device",
+                 "_prefix", "_inner")
 
     def __init__(self, fn, method, jitted, n_features, donates=False,
-                 post=None):
+                 params=None, post=None, extract=None, sig=None,
+                 device=None, prefix=None, inner=None):
         self._fn = fn
-        self._post = post
+        # pipeline flavor: _state holds the LIVE (prefix, inner) pair —
+        # one attribute so a swap publishes both in one assignment.
+        # leaf flavor: _state holds (params, post), same single-read
+        # contract. _prefix/_inner stay as the flavor flag + debug view.
+        self._state = (tuple(prefix), inner) if inner is not None \
+            else (params, post)
+        self._extract = extract
+        self._sig = sig
+        self._device = device
+        self._prefix = prefix
+        self._inner = inner
         self.method = method
         self.jitted = jitted
         self.n_features = n_features
         self.donates = donates
+        self.version = 0
 
     def __call__(self, X):
-        out = self._fn(X)
+        if self._inner is not None:
+            # pipeline: host prefix transforms feed the final step's
+            # compiled fn. ONE read of the live (prefix, inner) pair: a
+            # concurrent swap publishes a fresh pair in a single
+            # assignment, so a request never runs old transforms into
+            # new weights (or vice versa)
+            prefix, inner = self._state
+            for t in prefix:
+                X = _host_out(t.transform(X))
+            return inner(np.asarray(X, np.float32))
+        # ONE attribute read: a concurrent swap_params either lands
+        # before (new params+post) or after (old pair) — never a torn
+        # mix of new weights with old classes
+        params, post = self._state
+        out = self._fn(X) if params is None else self._fn(params, X)
         if self.donates:
             from .observability import record_donation
 
             record_donation(X.nbytes)
         out = _host_out(out)
-        return self._post(out) if self._post is not None else out
+        return post(out) if post is not None else out
+
+    def swap_params(self, estimator):
+        """Atomically replace the fitted parameters under the compiled
+        entry point with ``estimator``'s — the zero-recompile hot-swap.
+
+        The new estimator must map onto the SAME compiled structure:
+        same family, same method semantics, same parameter shapes (all
+        captured in the build-time signature). Anything else raises
+        :class:`ParamSwapError` — the cue to rebuild entry points (and
+        pay compiles) rather than swap. In-flight batches finish on the
+        old parameters; batches packed after the swap see the new ones.
+
+        ``swap_params`` is prepare+commit in one call; callers swapping
+        SEVERAL entry points against one estimator (ModelServer.
+        swap_model) run :meth:`prepare_swap` on all of them first so a
+        late refusal cannot leave the set half-swapped.
+        """
+        return self.commit_swap(self.prepare_swap(estimator))
+
+    def prepare_swap(self, estimator):
+        """Validate ``estimator`` against this entry point WITHOUT
+        touching any live state; returns an opaque token for
+        :meth:`commit_swap`. Raises :class:`ParamSwapError` on any
+        structural mismatch, leaving the entry point exactly as it was.
+        """
+        if self._inner is not None:
+            if not (hasattr(estimator, "steps")
+                    and hasattr(estimator, "named_steps")):
+                raise ParamSwapError(
+                    "entry point serves a pipeline; the swapped-in "
+                    f"estimator {type(estimator).__name__} is not one"
+                )
+            prefix, inner = self._state
+            if len(estimator.steps) != len(prefix) + 1:
+                raise ParamSwapError(
+                    f"pipeline step count changed: "
+                    f"{len(prefix) + 1} -> {len(estimator.steps)}"
+                )
+            # the inner leaf's signature only sees the PREFIX's output
+            # width — the pipeline's own input width must match too, or
+            # a swap to a pipeline trained on different-width rows would
+            # commit fine and then fail inside the prefix transform on
+            # every request instead of refusing typed at publish time
+            want = getattr(estimator, "n_features_in_", None)
+            if want is None:
+                want = getattr(estimator.steps[0][1],
+                               "n_features_in_", None)
+            if (self.n_features is not None and want is not None
+                    and int(want) != self.n_features):
+                raise ParamSwapError(
+                    f"n_features changed: {self.n_features} -> {want}"
+                )
+            inner_tok = inner.prepare_swap(estimator.steps[-1][1])
+            return ("pipe",
+                    tuple(t for _, t in estimator.steps[:-1]),
+                    inner_tok)
+        if self._extract is None:
+            # host fallback: rebind the bound method — no compiled
+            # structure to protect, but keep the width contract
+            target = getattr(estimator, self.method, None)
+            if target is None:
+                raise ParamSwapError(
+                    f"{type(estimator).__name__} has no method "
+                    f"{self.method!r}"
+                )
+            want = getattr(estimator, "n_features_in_", None)
+            if (self.n_features is not None and want is not None
+                    and want != self.n_features):
+                raise ParamSwapError(
+                    f"n_features changed: {self.n_features} -> {want}"
+                )
+            return ("host", target)
+        try:
+            built = self._extract(estimator)
+        except AttributeError as exc:
+            # build-time guards (e.g. predict_proba on a hinge loss)
+            # surface as the swap's typed refusal, not a raw attribute
+            # error mid-request
+            raise ParamSwapError(str(exc)) from exc
+        if built is None:
+            raise ParamSwapError(
+                f"{type(estimator).__name__} does not support "
+                f"{self.method!r} on the compiled path"
+            )
+        params, post, sig = built
+        if sig != self._sig:
+            raise ParamSwapError(
+                "compiled structure mismatch (shapes/family/method "
+                f"semantics): built with {self._sig}, swap offers {sig}"
+            )
+        return ("leaf", params, post)
+
+    def commit_swap(self, token):
+        """Apply a :meth:`prepare_swap` token. The request-visible flip
+        is ONE attribute assignment per entry point — concurrent calls
+        see either the complete old state or the complete new one, never
+        a torn mix (for a pipeline, old transforms never feed new
+        weights: the (prefix, inner) pair is republished together, with
+        the new params living in a CLONE of the inner leaf that shares
+        the same jitted executable — same compile cache, no compile)."""
+        kind = token[0]
+        if kind == "pipe":
+            _, prefix, inner_tok = token
+            _, inner = self._state
+            new_inner = _copy.copy(inner).commit_swap(inner_tok)
+            self._state = (prefix, new_inner)
+            self._prefix, self._inner = prefix, new_inner
+        elif kind == "host":
+            target = token[1]
+            self._fn = lambda X: target(X)
+        else:
+            _, params, post = token
+            # place the new pytree exactly like the old one (same
+            # device / same committedness) so the jit cache key is
+            # identical and the swap never mints a compile
+            self._state = (_put_params(params, self._device), post)
+        self.version += 1
+        return self
 
 
 def _host_out(out):
@@ -269,10 +430,12 @@ def _host_out(out):
 
 def _donate_spec():
     """Donate the batch argument only where the runtime honors it; on
-    CPU jax warns per call that donated buffers were unusable."""
+    CPU jax warns per call that donated buffers were unusable. Cores are
+    ``(params, X)`` — argnum 1 is the batch; the params pytree is never
+    donated (it is reused on every call until a swap replaces it)."""
     import jax
 
-    return (0,) if jax.default_backend() in ("tpu", "gpu") else ()
+    return (1,) if jax.default_backend() in ("tpu", "gpu") else ()
 
 
 def _tracked_jit(est, method, core, donate):
@@ -288,6 +451,24 @@ def _tracked_jit(est, method, core, donate):
     )
 
 
+def _put_params(params, device):
+    """Host param pytree -> device-resident arrays, committed to
+    ``device`` when given (per-replica placement), else the default
+    device. Build and every swap go through HERE so the jit cache key
+    (shapes + placement) is identical across swaps."""
+    import jax
+
+    if device is None:
+        return jax.device_put(params)
+    return jax.device_put(params, device)
+
+
+def _shapes(params):
+    return tuple(sorted(
+        (k, tuple(v.shape), str(v.dtype)) for k, v in params.items()
+    ))
+
+
 def _linear_wb(est):
     """(C, d) weight matrix + (C,) bias from a fitted linear model
     (C=1 encodes the binary/regression row)."""
@@ -301,157 +482,213 @@ def _linear_wb(est):
     return coef, b
 
 
-def _jit_linear(est, method):
-    """Jitted closures for the linear-model family (GLM + SGD): the
-    whole method is one matmul + pointwise tail on device constants."""
-    import jax
-    import jax.numpy as jnp
-
+def _linear_extract(est, method):
+    """(host params, post, signature) for a linear-family estimator —
+    the swap contract's one source of truth: everything the compiled
+    program's STRUCTURE depends on (method semantics, multiclass-ness,
+    link family, parameter shapes) lands in the signature; everything
+    that may change per version (weights, bias, class labels) lands in
+    params/post."""
     W, b = _linear_wb(est)
-    Wd, bd = jnp.asarray(W), jnp.asarray(b)
     multi = W.shape[0] > 1
     classes = getattr(est, "classes_", None)
     family = getattr(est, "family", None)
-    donate = _donate_spec()
-
-    def eta(X):
-        return X @ Wd.T + bd[None, :]      # (B, C)
-
     if method == "decision_function":
-        core = (lambda X: eta(X)) if multi else (lambda X: eta(X)[:, 0])
-        post = None
+        kind = "margin"
     elif method == "predict_proba":
         if classes is None:
             return None
         # mirror SGDClassifier's guard: sigmoid(margins) of a non-log
         # loss is NOT a probability — the direct method raises, so the
-        # compiled path must too (at build time, not first request)
+        # compiled path (and any swap onto it) must too
         loss = getattr(est, "_loss", None)
         if callable(loss) and loss() != "log_loss":
             raise AttributeError(
                 "predict_proba requires loss='log_loss'"
             )
-        if multi:
-            def core(X):
-                p = jax.nn.sigmoid(eta(X))   # OvR sigmoids, normalized
-                return p / jnp.maximum(
-                    jnp.sum(p, axis=1, keepdims=True), 1e-12
-                )
-        else:
-            def core(X):
-                p1 = jax.nn.sigmoid(eta(X)[:, 0])
-                return jnp.stack([1.0 - p1, p1], axis=1)
-        post = None
+        kind = "proba"
     elif method == "predict":
         if classes is not None:
-            if multi:
-                core = lambda X: jnp.argmax(eta(X), axis=1)  # noqa: E731
-            else:
-                core = lambda X: (eta(X)[:, 0] > 0).astype(jnp.int32)  # noqa: E731
-            cls = np.asarray(classes)
-            post = lambda idx: cls[np.asarray(idx)]  # noqa: E731
+            kind = "classify"
         elif family == "poisson":
-            core = lambda X: jnp.exp(eta(X)[:, 0])  # noqa: E731
-            post = None
-        else:                                   # regression: eta itself
-            core = lambda X: eta(X)[:, 0]  # noqa: E731
-            post = None
+            kind = "poisson"
+        else:
+            kind = "regress"
     else:
         return None
-    return CompiledBatchFn(
-        _tracked_jit(est, method, core, donate), method, True,
-        W.shape[1], donates=bool(donate), post=post,
-    )
+    post = None
+    if kind == "classify":
+        cls = np.asarray(classes)
+        post = lambda idx: cls[np.asarray(idx)]  # noqa: E731
+    params = {"W": W, "b": b}
+    sig = ("linear", kind, multi, _shapes(params))
+    return params, post, sig
 
 
-def _jit_kmeans(est, method):
+def _linear_core(kind, multi):
     import jax
     import jax.numpy as jnp
 
-    centers = jnp.asarray(np.asarray(est.cluster_centers_, np.float32))
-    donate = _donate_spec()
+    def eta(p, X):
+        return X @ p["W"].T + p["b"][None, :]      # (B, C)
 
-    def dist2(X):
+    if kind == "margin":
+        return (lambda p, X: eta(p, X)) if multi \
+            else (lambda p, X: eta(p, X)[:, 0])
+    if kind == "proba":
+        if multi:
+            def core(p, X):
+                pr = jax.nn.sigmoid(eta(p, X))  # OvR sigmoids, normed
+                return pr / jnp.maximum(
+                    jnp.sum(pr, axis=1, keepdims=True), 1e-12
+                )
+        else:
+            def core(p, X):
+                p1 = jax.nn.sigmoid(eta(p, X)[:, 0])
+                return jnp.stack([1.0 - p1, p1], axis=1)
+        return core
+    if kind == "classify":
+        if multi:
+            return lambda p, X: jnp.argmax(eta(p, X), axis=1)
+        return lambda p, X: (eta(p, X)[:, 0] > 0).astype(jnp.int32)
+    if kind == "poisson":
+        return lambda p, X: jnp.exp(eta(p, X)[:, 0])
+    return lambda p, X: eta(p, X)[:, 0]            # regression
+
+
+def _jit_linear(est, method, device=None):
+    """Jitted ``(params, X)`` programs for the linear-model family
+    (GLM + SGD): the whole method is one matmul + pointwise tail over
+    the swappable param pytree."""
+    built = _linear_extract(est, method)
+    if built is None:
+        return None
+    params, post, sig = built
+    donate = _donate_spec()
+    core = _linear_core(sig[1], sig[2])
+    return CompiledBatchFn(
+        _tracked_jit(est, method, core, donate), method, True,
+        params["W"].shape[1], donates=bool(donate),
+        params=_put_params(params, device), post=post,
+        extract=lambda e: _linear_extract(e, method), sig=sig,
+        device=device,
+    )
+
+
+def _kmeans_extract(est, method):
+    if method not in ("predict", "transform"):
+        return None
+    centers = np.asarray(est.cluster_centers_, np.float32)
+    params = {"centers": centers}
+    return params, None, ("kmeans", method, _shapes(params))
+
+
+def _kmeans_core(method):
+    import jax.numpy as jnp
+
+    def dist2(p, X):
         # ||x-c||^2 via the expanded form: one (B,d)x(d,k) MXU matmul
+        c = p["centers"]
         xx = jnp.sum(X * X, axis=1, keepdims=True)
-        cc = jnp.sum(centers * centers, axis=1)[None, :]
-        return jnp.maximum(xx + cc - 2.0 * (X @ centers.T), 0.0)
+        cc = jnp.sum(c * c, axis=1)[None, :]
+        return jnp.maximum(xx + cc - 2.0 * (X @ c.T), 0.0)
 
     if method == "predict":
-        core = lambda X: jnp.argmin(dist2(X), axis=1).astype(jnp.int32)  # noqa: E731
-    elif method == "transform":
-        core = lambda X: jnp.sqrt(dist2(X))  # noqa: E731
-    else:
+        return lambda p, X: jnp.argmin(dist2(p, X), axis=1).astype(
+            jnp.int32
+        )
+    return lambda p, X: jnp.sqrt(dist2(p, X))
+
+
+def _jit_kmeans(est, method, device=None):
+    built = _kmeans_extract(est, method)
+    if built is None:
         return None
+    params, post, sig = built
+    donate = _donate_spec()
     return CompiledBatchFn(
-        _tracked_jit(est, method, core, donate), method, True,
-        int(centers.shape[1]), donates=bool(donate),
+        _tracked_jit(est, method, _kmeans_core(method), donate), method,
+        True, int(params["centers"].shape[1]), donates=bool(donate),
+        params=_put_params(params, device), post=post,
+        extract=lambda e: _kmeans_extract(e, method), sig=sig,
+        device=device,
     )
 
 
-def _jit_pca(est, method):
-    import jax
-    import jax.numpy as jnp
-
+def _pca_extract(est, method):
     if method != "transform":
         return None
-    comp = jnp.asarray(np.asarray(est.components_, np.float32))
+    params = {"components": np.asarray(est.components_, np.float32)}
     mean = getattr(est, "mean_", None)
-    mean = (jnp.asarray(np.asarray(mean, np.float32))
-            if mean is not None else None)
-    scale = None
+    if mean is not None:
+        params["mean"] = np.asarray(mean, np.float32)
     if getattr(est, "whiten", False):
-        scale = jnp.sqrt(jnp.asarray(
-            np.asarray(est.explained_variance_, np.float32)
+        params["scale"] = np.sqrt(np.asarray(
+            est.explained_variance_, np.float32
         ))
+    # which optional terms exist is structural (the traced graph
+    # branches on their presence), so it rides the signature via shapes
+    return params, None, ("pca", _shapes(params))
+
+
+def _pca_core(has_mean, has_scale):
+    def core(p, X):
+        xc = X - p["mean"][None, :] if has_mean else X
+        sc = xc @ p["components"].T
+        return sc / p["scale"][None, :] if has_scale else sc
+
+    return core
+
+
+def _jit_pca(est, method, device=None):
+    built = _pca_extract(est, method)
+    if built is None:
+        return None
+    params, post, sig = built
     donate = _donate_spec()
-
-    def core(X):
-        xc = X - mean[None, :] if mean is not None else X
-        sc = xc @ comp.T
-        return sc / scale[None, :] if scale is not None else sc
-
+    core = _pca_core("mean" in params, "scale" in params)
     return CompiledBatchFn(
         _tracked_jit(est, method, core, donate), method, True,
-        int(comp.shape[1]), donates=bool(donate),
+        int(params["components"].shape[1]), donates=bool(donate),
+        params=_put_params(params, device), post=post,
+        extract=lambda e: _pca_extract(e, method), sig=sig,
+        device=device,
     )
 
 
-def compiled_batch_fn(estimator, method="predict"):
+def compiled_batch_fn(estimator, method="predict", device=None):
     """Build the static-shape batch entry point for a fitted estimator
     (or sklearn-style pipeline ending in one) — the serving subsystem's
     per-method compile unit.
 
     Device estimators (GLM, SGD, KMeans, PCA/TruncatedSVD) lower to one
-    jitted closure over their fitted parameters; a pipeline applies its
-    prefix transforms per batch and feeds the final step's compiled fn
-    (prefix outputs are shape-deterministic per batch height, so the
-    compile set stays bounded by the bucket ladder). Anything else gets
-    the host fallback — ``getattr(est, method)`` over the padded batch.
+    jitted ``(params, X)`` program whose fitted parameters are a
+    swappable pytree argument (see :meth:`CompiledBatchFn.swap_params`);
+    ``device=`` commits the params to a specific device — the fleet's
+    per-replica placement knob. A pipeline applies its prefix transforms
+    per batch and feeds the final step's compiled fn (prefix outputs are
+    shape-deterministic per batch height, so the compile set stays
+    bounded by the bucket ladder). Anything else gets the host
+    fallback — ``getattr(est, method)`` over the padded batch.
     """
     est = estimator
     if hasattr(est, "steps") and hasattr(est, "named_steps"):
-        prefix = [t for _, t in est.steps[:-1]]
-        inner = compiled_batch_fn(est.steps[-1][1], method)
-
-        def fn(X):
-            for t in prefix:
-                X = _host_out(t.transform(X))
-            return inner(np.asarray(X, np.float32))
-
+        inner = compiled_batch_fn(est.steps[-1][1], method,
+                                  device=device)
         first = est.steps[0][1]
         return CompiledBatchFn(
-            fn, method, inner.jitted,
+            None, method, inner.jitted,
             getattr(first, "n_features_in_", None),
+            prefix=tuple(t for _, t in est.steps[:-1]), inner=inner,
         )
     if _is_device_estimator(est):
         built = None
         if hasattr(est, "coef_"):
-            built = _jit_linear(est, method)
+            built = _jit_linear(est, method, device=device)
         elif hasattr(est, "cluster_centers_"):
-            built = _jit_kmeans(est, method)
+            built = _jit_kmeans(est, method, device=device)
         elif hasattr(est, "components_"):
-            built = _jit_pca(est, method)
+            built = _jit_pca(est, method, device=device)
         if built is not None:
             return built
     target = getattr(est, method, None)

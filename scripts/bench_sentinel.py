@@ -138,6 +138,22 @@ def main():
         return 0
     ref = _metrics(ref_doc)
     latest = _metrics(latest_doc)
+    # metrics the reference round predates (e.g. the fleet section) seed
+    # their floor from the EARLIEST round that measured them — a new
+    # metric becomes gated the round after it first records, instead of
+    # staying floorless until someone rewrites the reference
+    seeded = {}
+    for num in sorted(rounds):
+        if num == latest_num:
+            break
+        for name, entry in _metrics(rounds[num][1]).items():
+            if name not in ref and name not in seeded \
+                    and entry["value"] is not None:
+                seeded[name] = (entry, num)
+    for name, (entry, num) in seeded.items():
+        ref[name] = entry
+        print(f"bench sentinel: {name} floor seeded from r{num:02d} "
+              "(absent from the reference round)")
     failures, warnings_, checked = [], [], 0
     for name, r in sorted(ref.items()):
         rv = r["value"]

@@ -1,0 +1,213 @@
+"""Fleet verify gate (ISSUE 6): a SUBPROCESS 2-replica FleetServer under
+ragged traffic with one hot-swap mid-run must
+
+- pay ZERO XLA compiles after warmup (the swap rides
+  ``CompiledBatchFn.swap_params`` — programs close over shapes, not
+  values; the recompile counter is the witness);
+- lose NO request across the swap (every submitted request resolves,
+  and every answer matches one of the two published versions exactly);
+- expose per-replica stats on ``/status`` (the fleet aggregate carries a
+  ``replicas`` list; each replica labels its queue gauges).
+
+The parent picks a free port, launches the child with
+``DASK_ML_TPU_OBS_HTTP_PORT`` pointing at it, scrapes ``/status`` while
+the fleet is up, and checks the child's own verdict line.
+
+Prints one JSON line: {"ok": true, "requests": ..., "recompiles": 0,
+"swapped_to": 2, ...}. Run: ``python scripts/fleet_smoke.py``
+(exit 0 = gate holds).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CHILD = r"""
+import json, os, threading, time
+import numpy as np
+
+from dask_ml_tpu import observability as obs
+from dask_ml_tpu.datasets import make_classification
+from dask_ml_tpu.linear_model import LogisticRegression
+from dask_ml_tpu.serving import BucketLadder, FleetServer, ServingError
+
+X, y = make_classification(n_samples=600, n_features=12,
+                           n_informative=6, random_state=0)
+X2, y2 = make_classification(n_samples=600, n_features=12,
+                             n_informative=6, random_state=7)
+a = LogisticRegression(solver="lbfgs", max_iter=30).fit(X, y)
+b = LogisticRegression(solver="lbfgs", max_iter=30).fit(X2, y2)
+Xh = X.to_numpy().astype(np.float32)
+preds = {1: np.asarray(a.predict(Xh)), 2: np.asarray(b.predict(Xh))}
+
+fleet = FleetServer(a, name="clf", replicas=2,
+                    ladder=BucketLadder(8, 128, 2.0),
+                    batch_window_ms=1.0, timeout_ms=0).warmup()
+verdict = {"ok": False}
+errs = []
+N_CLIENTS = 3
+# per-thread slots, summed after join: `sent[0] += 1` from several
+# threads is a read-modify-write that can lose increments and flake
+# the done == sent no-lost-request assertion
+sent = [0] * N_CLIENTS
+done = [0] * N_CLIENTS
+stop = threading.Event()
+
+def client(seed):
+    rng = np.random.RandomState(seed)
+    while not stop.is_set():
+        n = rng.randint(1, 100)
+        i = rng.randint(0, Xh.shape[0] - n)
+        sent[seed] += 1
+        try:
+            got = fleet.predict(Xh[i:i + n])
+        except ServingError as exc:        # a shed/timeout IS a lost
+            errs.append(repr(exc))         # request for this gate
+            continue
+        if not any(np.array_equal(got, preds[v][i:i + n])
+                   for v in (1, 2)):
+            errs.append(f"mismatch at n={n} i={i}")
+            continue
+        done[seed] += 1
+
+with fleet:
+    before = obs.counters_snapshot().get("recompiles", 0)
+    threads = [threading.Thread(target=client, args=(s,))
+               for s in range(N_CLIENTS)]
+    for t in threads:
+        t.start()
+    time.sleep(1.0)
+    swapped_to = fleet.publish(b)          # ONE hot-swap mid-run
+    time.sleep(1.0)
+    stop.set()
+    for t in threads:
+        t.join()
+    recompiles = obs.counters_snapshot().get("recompiles", 0) - before
+    stats = fleet.stats()
+    try:
+        assert not errs, errs[:3]
+        n_sent, n_done = sum(sent), sum(done)
+        assert n_done == n_sent, (n_done, n_sent)
+        assert n_sent >= 50, f"only {n_sent} requests — no real load"
+        assert recompiles == 0, f"{recompiles} post-warmup compiles"
+        assert swapped_to == 2 and stats["version"] == 2
+        assert stats["swaps"] >= 1
+        assert [p["version"] for p in stats["replicas"]] == [2, 2]
+        verdict.update(ok=True, requests=n_done,
+                       recompiles=recompiles, swapped_to=swapped_to,
+                       batches=stats["batches"])
+    except AssertionError as exc:
+        verdict["error"] = str(exc)
+    print("FLEET_DONE " + json.dumps(verdict), flush=True)
+    # hold the fleet (and its /status registration) up so the parent's
+    # scrape cannot race the exit
+    time.sleep(float(os.environ.get("FLEET_SMOKE_LINGER", "20")))
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get(url, timeout=2.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode()
+
+
+def main():
+    out = {"ok": False}
+    port = _free_port()
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "DASK_ML_TPU_OBS_HTTP_PORT": str(port)}
+    child = subprocess.Popen(
+        [sys.executable, "-c", CHILD], env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    base = f"http://127.0.0.1:{port}"
+    deadline = time.time() + 180
+    try:
+        # 1) the exporter comes up with the fleet
+        while True:
+            try:
+                status, body = _get(base + "/healthz")
+                assert status == 200 and body == "ok\n"
+                break
+            except AssertionError:
+                raise
+            except Exception:
+                if child.poll() is not None or time.time() > deadline:
+                    if child.poll() is None:
+                        child.kill()
+                        child.wait(10)
+                    raise RuntimeError(
+                        "child exited or deadline passed before "
+                        "/healthz answered: "
+                        + child.stderr.read()[-2000:]
+                    )
+                time.sleep(0.05)
+        # 2) /status must show the fleet aggregate WITH its per-replica
+        #    breakdown while the fleet serves
+        fleet_entry = None
+        while time.time() < deadline:
+            _, body = _get(base + "/status")
+            doc = json.loads(body)
+            fleets = [s for s in doc.get("serving", [])
+                      if isinstance(s, dict) and "replicas" in s]
+            if fleets and len(fleets[0]["replicas"]) == 2:
+                fleet_entry = fleets[0]
+                break
+            if child.poll() is not None:
+                raise RuntimeError(
+                    "child exited before /status showed fleet stats"
+                )
+            time.sleep(0.05)
+        if fleet_entry is None:
+            raise RuntimeError("deadline: /status never showed a fleet "
+                               "with 2 replicas")
+        for p in fleet_entry["replicas"]:
+            assert "replica" in p and "version" in p \
+                and "queue_depth" in p, p
+        # 3) the child's own verdict: zero compiles, zero lost requests
+        verdict = None
+        while time.time() < deadline:
+            line = child.stdout.readline()
+            if not line:
+                break
+            if line.startswith("FLEET_DONE "):
+                verdict = json.loads(line[len("FLEET_DONE "):])
+                break
+        if verdict is None:
+            raise RuntimeError("child ended without a FLEET_DONE line: "
+                               + child.stderr.read()[-2000:])
+        if not verdict.get("ok"):
+            raise RuntimeError(f"fleet gate failed in child: {verdict}")
+        out.update(verdict)
+        out.update(port=port,
+                   fleet_version=fleet_entry["version"],
+                   healthy_replicas=fleet_entry["healthy_replicas"])
+    except Exception as exc:
+        out["ok"] = False
+        out["error"] = f"{type(exc).__name__}: {exc}"
+    finally:
+        child.terminate()
+        try:
+            child.wait(10)
+        except Exception:
+            child.kill()
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -70,13 +70,21 @@ if ! timeout -k 10 300 python scripts/multichip_dryrun.py; then
     exit 1
 fi
 
+# -- fleet gate (ISSUE 6): a subprocess 2-replica fleet under ragged
+# traffic with one hot-swap mid-run must pay zero post-warmup compiles,
+# lose no request across the swap, and show per-replica stats on /status.
+if ! timeout -k 10 300 python scripts/fleet_smoke.py; then
+    echo "VERIFY FAIL: serving fleet gate (hot-swap / replicas / status)"
+    exit 1
+fi
+
 # -- serving suite (fast, targeted): the online-inference subsystem gates
 # the same as lint — a broken server should fail verify in ~1min, before
 # the full tier-1 wait. timeout-wrapped like tier-1: a hung serving
 # worker must not block verify forever.
-if ! timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
-      tests/test_serving.py -q -p no:cacheprovider -p no:xdist \
-      -p no:randomly; then
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+      tests/test_serving.py tests/test_fleet.py -q -p no:cacheprovider \
+      -p no:xdist -p no:randomly; then
     echo "VERIFY FAIL: serving tests"
     exit 1
 fi

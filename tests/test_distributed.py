@@ -8,13 +8,17 @@ processes. Fault injection: one worker is killed and the survivor's
 checkpoint-restart path is exercised (SURVEY.md §5 failure row)."""
 
 import os
-import socket
 import subprocess
 import sys
 import textwrap
 
 import numpy as np
 import pytest
+
+from tests._mp_capability import (
+    free_port as _free_port,
+    require_multiprocess_backend,
+)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -29,14 +33,6 @@ def test_single_process_runtime():
     assert dist.barrier() == float(len(__import__("jax").devices()))
     out = dist.broadcast_host(np.arange(3.0))
     np.testing.assert_array_equal(out, np.arange(3.0))
-
-
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 _WORKER = textwrap.dedent("""
@@ -73,6 +69,7 @@ def test_two_process_collectives(tmp_path):
     """Real 2-process jax.distributed bring-up: global mesh, psum barrier,
     coordinator broadcast. One retry: the free-port probe can race with
     another process binding it between probe and bring-up."""
+    require_multiprocess_backend()
     last = None
     for _attempt in range(2):
         port = str(_free_port())
@@ -196,6 +193,7 @@ def test_two_process_distributed_search(tmp_path):
     subset on its local-device mesh; the allgather merge reassembles
     cv_results_ identical to the sequential single-process run
     (SURVEY.md §3.5 'trials pinned to hosts', VERDICT r2 #2)."""
+    require_multiprocess_backend()
     import numpy as np
     from sklearn.datasets import make_classification
 
@@ -308,6 +306,7 @@ def test_two_process_hyperband_brackets(tmp_path):
     """Hyperband brackets distributed over 2 real processes reassemble
     history_/cv_results_/best identical to the single-process run
     (BASELINE configs[4]; VERDICT r2 #2)."""
+    require_multiprocess_backend()
     exp = str(tmp_path / "expected.npz")
     solo = subprocess.run(
         [sys.executable, "-c", _HB_SOLO.format(repo=REPO), exp],
@@ -414,6 +413,7 @@ def test_two_process_adaptive_search(tmp_path):
     """IncrementalSearchCV candidates distributed over 2 real processes:
     per-round record allgather keeps the adaptive decisions identical, and
     cv_results_/history_ match the single-process run exactly."""
+    require_multiprocess_backend()
     exp = str(tmp_path / "expected.npz")
     solo = subprocess.run(
         [sys.executable, "-c", _ADAPT_SOLO.format(repo=REPO), exp],
@@ -495,6 +495,7 @@ def test_two_process_global_mesh_fit(tmp_path):
     SPMD analog of the reference's multi-machine training
     (SURVEY.md §2b comm row, §5 'DCN'; completes VERDICT r2 #2's data
     plane half)."""
+    require_multiprocess_backend()
     import numpy as np
 
     from dask_ml_tpu.linear_model import LogisticRegression
@@ -585,6 +586,7 @@ def test_two_process_frame_ingest(tmp_path):
     """Cross-process frame ingest (VERDICT r3 missing #3): each process
     contributes ITS local PartitionedFrame partitions to one global-mesh
     ShardedArray via array_from_process_local, then fits on it."""
+    require_multiprocess_backend()
     last = None
     for _attempt in range(2):
         port = str(_free_port())
@@ -613,3 +615,252 @@ def test_two_process_frame_ingest(tmp_path):
                 if p.poll() is None:
                     p.kill()
     raise AssertionError(f"both attempts failed:\n{last}")
+
+# -- single-process virtual-rank twins ---------------------------------------
+# Each real 2-process test above has a twin that runs the SAME
+# partitioning/merge/failure logic as 2 rank THREADS of this process
+# (``distributed.run_virtual_processes``): topology queries answer
+# per-rank, host collectives rendezvous in-process, local_mesh splits
+# the devices. The capability-gated subprocess tests keep covering the
+# real collective fabric; these keep the logic under tier-1 everywhere.
+
+
+def test_virtual_collectives():
+    import jax
+
+    from dask_ml_tpu.parallel import distributed as dist
+
+    def body(rank):
+        assert dist.process_count() == 2
+        assert dist.process_index() == rank
+        assert dist.is_coordinator() == (rank == 0)
+        # object gather comes back in rank order on every rank
+        got = dist.allgather_object({"rank": rank, "x": rank * 10})
+        assert [g["rank"] for g in got] == [0, 1]
+        assert [g["x"] for g in got] == [0, 10]
+        # additive merge plane (the streamed-fit channel)
+        s = dist.psum_host(np.full(3, float(rank + 1)))
+        np.testing.assert_allclose(s, np.full(3, 3.0))
+        # stacked host gather
+        stack = dist.allgather_host(np.arange(4.0) + rank)
+        assert stack.shape == (2, 4)
+        np.testing.assert_allclose(stack[1] - stack[0], np.ones(4))
+        # coordinator broadcast
+        val = np.array([42.0, 7.0]) if rank == 0 else np.zeros(2)
+        np.testing.assert_allclose(dist.broadcast_host(val), [42.0, 7.0])
+        # barrier reports the same device-count sum as the real psum
+        assert dist.barrier() == float(len(jax.devices()))
+        # per-rank placement: disjoint submeshes of the local devices
+        return [d.id for d in dist.local_mesh().devices.ravel()]
+
+    ids = dist.run_virtual_processes(body, world=2)
+    assert len(ids[0]) == len(ids[1]) == len(jax.devices()) // 2
+    assert not (set(ids[0]) & set(ids[1])), ids
+
+
+def test_virtual_worker_death():
+    """Twin of test_worker_death_detected: a rank dying mid-round fails
+    its peers' pending collectives FAST (poisoned exchange), and the
+    injected exception — not the peers' collateral — reaches the
+    caller."""
+    from dask_ml_tpu.parallel import distributed as dist
+
+    witnessed = {}
+
+    def body(rank):
+        if rank == 1:
+            raise ValueError("injected death")
+        try:
+            dist.allgather_object("round-1")
+        except RuntimeError as exc:
+            witnessed["err"] = str(exc)
+            raise
+        raise AssertionError("survivor's collective must fail fast")
+
+    with pytest.raises(ValueError, match="injected death"):
+        dist.run_virtual_processes(body, world=2)
+    assert "virtual peer 1 failed" in witnessed["err"]
+
+
+def test_virtual_distributed_search():
+    """Twin of test_two_process_distributed_search: strided
+    (candidate, fold) shares on disjoint local meshes, one allgather
+    merge, results identical to the sequential run."""
+    from sklearn.datasets import make_classification
+
+    from dask_ml_tpu.linear_model import LogisticRegression
+    from dask_ml_tpu.model_selection import GridSearchCV
+    from dask_ml_tpu.parallel import distributed as dist
+
+    X, y = make_classification(n_samples=400, n_features=8,
+                               n_informative=4, random_state=0)
+    X = X.astype(np.float32)
+    y = y.astype(np.float32)
+    seq = GridSearchCV(
+        LogisticRegression(solver="lbfgs", max_iter=25),
+        {"C": [0.01, 0.1, 1.0, 10.0]}, cv=2,
+        scheduler="synchronous", refit=False,
+    ).fit(X, y)
+    expected = np.asarray(seq.cv_results_["mean_test_score"])
+
+    def body(rank):
+        search = GridSearchCV(
+            LogisticRegression(solver="lbfgs", max_iter=25),
+            {"C": [0.01, 0.1, 1.0, 10.0]}, cv=2,
+            scheduler="synchronous", refit=True,
+        ).fit(X, y)
+        n_local, n_total, proc, n_proc = search._dist_stats
+        assert n_proc == 2 and proc == rank
+        assert n_local < n_total, (n_local, n_total)
+        assert n_local == len(range(rank, n_total, 2))
+        scores = np.asarray(search.cv_results_["mean_test_score"])
+        assert not np.isnan(scores).any(), scores  # merge filled every cell
+        assert search.best_estimator_.score(X, y) > 0.7
+        return scores
+
+    for scores in dist.run_virtual_processes(body, world=2, timeout=600):
+        np.testing.assert_allclose(scores, expected, atol=1e-4)
+
+
+def test_virtual_hyperband_brackets():
+    """Twin of test_two_process_hyperband_brackets: brackets strided
+    over 2 virtual ranks, payload allgather merge, results identical to
+    the single-process interleaved fit."""
+    from dask_ml_tpu.model_selection import HyperbandSearchCV
+    from dask_ml_tpu.models.sgd import SGDClassifier
+    from dask_ml_tpu.parallel import distributed as dist
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(600, 6).astype(np.float32)
+    w = rng.randn(6)
+    y = (X @ w > 0).astype(np.float32)
+    params = {"alpha": [1e-5, 1e-4, 1e-3, 1e-2], "eta0": [0.05, 0.5]}
+
+    def run():
+        search = HyperbandSearchCV(
+            SGDClassifier(tol=1e-3, random_state=0), params,
+            max_iter=9, aggressiveness=3, random_state=0,
+        )
+        search.fit(X, y, classes=[0.0, 1.0])
+        return search
+
+    # the virtual ranks fit on half-meshes (local_mesh splits the
+    # devices 2 ways) and SGD block math depends on shard count, so the
+    # solo reference must run on a same-size mesh — exactly like the
+    # real test, where solo and each worker process both saw 2 devices
+    import jax
+
+    from dask_ml_tpu.parallel.mesh import device_mesh, use_mesh
+
+    half = device_mesh(devices=jax.devices()[:len(jax.devices()) // 2])
+    with use_mesh(half):
+        solo = run()
+    exp = np.asarray(solo.cv_results_["test_score"], np.float64)
+
+    def body(rank):
+        search = run()
+        assert search._dist_stats == (rank, 2)
+        assert {r["bracket"] for r in search.history_} == {0, 1, 2}
+        # the gathered best model is usable on every rank
+        assert 0.0 <= search.best_estimator_.score(X, y) <= 1.0
+        return (np.asarray(search.cv_results_["test_score"], np.float64),
+                search.best_score_, len(search.history_))
+
+    for got, best, n_hist in dist.run_virtual_processes(
+            body, world=2, timeout=600):
+        assert got.shape == exp.shape, (got.shape, exp.shape)
+        np.testing.assert_allclose(got, exp, atol=1e-5)
+        assert abs(best - solo.best_score_) < 1e-5
+        assert n_hist == len(solo.history_)
+
+
+def test_virtual_adaptive_search():
+    """Twin of test_two_process_adaptive_search: mid%2 ownership,
+    per-round record allgather, identical adaptive decisions."""
+    from sklearn.linear_model import SGDClassifier as SkSGD
+
+    from dask_ml_tpu.model_selection import IncrementalSearchCV
+    from dask_ml_tpu.parallel import distributed as dist
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(500, 6).astype(np.float32)
+    w = rng.randn(6)
+    y = (X @ w > 0).astype(np.float32)
+
+    def make():
+        return IncrementalSearchCV(
+            SkSGD(tol=None, random_state=7),
+            {"alpha": [1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0]},
+            n_initial_parameters="grid", decay_rate=1.0, max_iter=6,
+            random_state=0,
+        )
+
+    solo = make()
+    solo.fit(X, y, classes=[0.0, 1.0])
+    exp_scores = np.asarray(solo.cv_results_["test_score"], np.float64)
+    exp_calls = np.asarray(solo.cv_results_["partial_fit_calls"])
+
+    def body(rank):
+        search = make()
+        search.fit(X, y, classes=[0.0, 1.0])
+        assert search._dist_stats == (rank, 2)
+        # ownership evidence: this rank trained ONLY mid % 2 == rank,
+        # and the merged history covers both owners
+        owners = {r["model_id"] % 2 for r in search.history_
+                  if r["owner"] == rank}
+        assert owners == {rank}, owners
+        assert {r["owner"] for r in search.history_} == {0, 1}
+        assert 0.0 <= search.best_estimator_.score(X, y) <= 1.0
+        return (np.asarray(search.cv_results_["test_score"], np.float64),
+                np.asarray(search.cv_results_["partial_fit_calls"]),
+                search.best_score_, len(search.history_))
+
+    for scores, calls, best, n_hist in dist.run_virtual_processes(
+            body, world=2, timeout=600):
+        np.testing.assert_allclose(scores, exp_scores, atol=1e-6)
+        np.testing.assert_array_equal(calls, exp_calls)
+        assert abs(best - solo.best_score_) < 1e-6
+        assert n_hist == len(solo.history_)
+
+
+def test_virtual_frame_ingest():
+    """Twin of test_two_process_frame_ingest: per-rank PartitionedFrames
+    with UNEVEN row counts merge through array_from_process_local
+    (parcel routing runs for real; the final assembly gather stands in
+    for foreign-shard placement), then feed a fit."""
+    import pandas as pd
+
+    from dask_ml_tpu.linear_model import LinearRegression
+    from dask_ml_tpu.parallel import distributed as dist
+    from dask_ml_tpu.parallel.frames import from_pandas
+    from dask_ml_tpu.parallel.sharded import ShardedArray
+
+    def body(rank):
+        rows = [37, 23][rank]
+        rng = np.random.RandomState(rank)
+        df = pd.DataFrame({
+            "a": np.arange(rows, dtype=np.float32) + 100.0 * rank,
+            "b": rng.randn(rows).astype(np.float32),
+            "s": ["x"] * rows,                     # non-numeric: dropped
+        })
+        pf = from_pandas(df, npartitions=3)
+        mesh = dist.global_mesh()
+        sa = pf.to_sharded(mesh=mesh)
+        assert sa.n_rows == 60, sa.n_rows
+        assert sa.shape == (60, 2), sa.shape
+        host = sa.to_numpy()
+        # global order = rank order, content exact ("a" encodes
+        # rank + row index)
+        expect_a = np.concatenate([np.arange(37.0),
+                                   np.arange(23.0) + 100.0])
+        np.testing.assert_allclose(host[:, 0], expect_a)
+        # the ingested array feeds a real fit on the same mesh
+        yh = host[:, 0] * 0.5 + 1.0
+        ys = ShardedArray.from_array(yh, mesh=mesh)
+        est = LinearRegression(solver="lbfgs", max_iter=50).fit(sa, ys)
+        pred = est.predict(host[:5])
+        assert np.allclose(pred, yh[:5], atol=1e-2), pred
+        return host
+
+    h0, h1 = dist.run_virtual_processes(body, world=2, timeout=600)
+    np.testing.assert_allclose(h0, h1)

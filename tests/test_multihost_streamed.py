@@ -8,7 +8,6 @@ Ref: SURVEY.md §1 L2 (the reference's dd-from-files ingest with
 per-worker partitions feeding one global fit) and §3.2."""
 
 import os
-import socket
 import subprocess
 import sys
 import textwrap
@@ -16,15 +15,12 @@ import textwrap
 import numpy as np
 import pytest
 
+from tests._mp_capability import (
+    free_port as _free_port,
+    require_multiprocess_backend,
+)
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 _WORKER = textwrap.dedent("""
@@ -81,6 +77,7 @@ _WORKER = textwrap.dedent("""
 
 @pytest.mark.slow
 def test_two_process_streamed_fits_match_single(tmp_path):
+    require_multiprocess_backend()
     nproc = 2
     last = None
     for _attempt in range(2):
@@ -158,4 +155,75 @@ def test_two_process_streamed_fits_match_single(tmp_path):
         np.testing.assert_allclose(p0[0], ref_p.mean_, atol=1e-4)
         np.testing.assert_allclose(
             np.abs(p0[1:] @ ref_p.components_.T), np.eye(3), atol=1e-3
+        )
+
+
+def test_virtual_streamed_fits_match_single():
+    """Single-process twin: 2 virtual rank THREADS each stream HALF the
+    rows (256-row blocks); the per-pass block sums merge through the
+    in-process psum_host rendezvous; both ranks converge to the
+    identical global fit, matching the single-process fit over the
+    concatenated data — the same partition/merge logic as the real
+    2-process run, minus the cross-process fabric."""
+    from dask_ml_tpu._platform import force_cpu_platform  # noqa: F401
+    import dask_ml_tpu.config as config
+    from dask_ml_tpu.cluster import KMeans
+    from dask_ml_tpu.decomposition import PCA
+    from dask_ml_tpu.linear_model import LogisticRegression
+    from dask_ml_tpu.parallel import distributed as dist
+
+    rng = np.random.RandomState(0)
+    n_glob, d = 4096, 6
+    Xg = rng.randn(n_glob, d).astype(np.float32)
+    w = rng.randn(d).astype(np.float32)
+    yg = (Xg @ w + 0.3 * rng.randn(n_glob) > 0).astype(np.float32)
+    Xg[yg > 0, :2] += 1.5   # separable-ish + cluster structure
+
+    def body(rank):
+        n_loc = n_glob // 2
+        lo, hi = rank * n_loc, (rank + 1) * n_loc
+        X, y = Xg[lo:hi], yg[lo:hi]
+        out = {}
+        # config is thread-local: each rank arms its own streaming plan
+        with config.set(stream_block_rows=256):
+            for solver in ("lbfgs", "admm"):
+                clf = LogisticRegression(solver=solver, max_iter=60).fit(
+                    X, y
+                )
+                out[solver] = np.r_[clf.coef_.ravel(), clf.intercept_]
+            km = KMeans(n_clusters=2, random_state=0, max_iter=20).fit(X)
+            out["centers"] = np.asarray(km.cluster_centers_)
+            out["inertia"] = float(km.inertia_)
+            p = PCA(n_components=3).fit(X)
+            out["pca"] = np.r_[p.mean_[None], p.components_]
+        return out
+
+    r0, r1 = dist.run_virtual_processes(body, world=2, timeout=600)
+
+    with config.set(stream_block_rows=256):
+        for solver, tol in (("lbfgs", 2e-3), ("admm", 2e-2)):
+            ref = LogisticRegression(solver=solver, max_iter=60).fit(
+                Xg, yg
+            )
+            ref_vec = np.r_[ref.coef_.ravel(), ref.intercept_]
+            for got in (r0[solver], r1[solver]):
+                np.testing.assert_allclose(got, ref_vec, rtol=tol,
+                                           atol=tol, err_msg=solver)
+        ref_km = KMeans(n_clusters=2, random_state=0, max_iter=20).fit(Xg)
+        # both ranks computed identical global centers
+        np.testing.assert_allclose(r0["centers"], r1["centers"], atol=1e-6)
+        ref_sorted = ref_km.cluster_centers_[
+            np.argsort(ref_km.cluster_centers_[:, 0])
+        ]
+        got_sorted = r0["centers"][np.argsort(r0["centers"][:, 0])]
+        np.testing.assert_allclose(got_sorted, ref_sorted, rtol=2e-2,
+                                   atol=2e-2)
+        assert abs(r0["inertia"] - ref_km.inertia_) / ref_km.inertia_ < 2e-2
+        # PCA: identical across ranks AND matches single-process
+        ref_p = PCA(n_components=3).fit(Xg)
+        np.testing.assert_allclose(r0["pca"], r1["pca"], atol=1e-7)
+        np.testing.assert_allclose(r0["pca"][0], ref_p.mean_, atol=1e-4)
+        np.testing.assert_allclose(
+            np.abs(r0["pca"][1:] @ ref_p.components_.T), np.eye(3),
+            atol=1e-3
         )
