@@ -294,6 +294,7 @@ def run():
     _try(_bench_c_grid_search, jax, on_tpu, n_chips)
     _try(_bench_serving, jax, on_tpu, n_chips)
     _try(_bench_fleet, jax, on_tpu, n_chips)
+    _try(_bench_drift, jax, on_tpu, n_chips)
     result["extra_metrics"] = extras
     return result
 
@@ -893,6 +894,133 @@ def _bench_serving(jax, on_tpu, n_chips):
         },
         "served_seconds": round(served_s, 3),
     }
+
+
+def _bench_drift(jax, on_tpu, n_chips):
+    """Drift-overhead section (ISSUE 7): the quality plane must be
+    near-free. Two numbers:
+
+    - sketch fold throughput — rows/s through ``FeatureSketch.fold``
+      at serving width (the per-batch host cost the serving worker
+      pays);
+    - serving overhead — the SAME warmed closed-loop ragged mix served
+      with ``obs_drift`` on vs off; criterion: the ratio stays >= 0.97
+      (<= 3% throughput regression with sketches + shadow sampling on).
+    """
+    import threading as _threading
+    import time
+
+    from dask_ml_tpu.observability import FeatureSketch, drift
+    from dask_ml_tpu.datasets import make_classification
+    from dask_ml_tpu.linear_model import LogisticRegression
+    from dask_ml_tpu.serving import BucketLadder, ModelServer
+
+    d = 32
+    n = 20_000
+    X, y = make_classification(n_samples=n, n_features=d,
+                               n_informative=d // 4, random_state=0)
+    clf = LogisticRegression(solver="lbfgs", max_iter=20).fit(X, y)
+    Xh = X.to_numpy().astype(np.float32)
+
+    # -- sketch fold cost per 10k rows ------------------------------------
+    sk = FeatureSketch(d)
+    block = Xh[:10_000]
+    sk.fold(block)                        # warm allocation
+    reps = 20
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        sk.fold(block)
+    fold_s = (time.perf_counter() - t0) / reps
+    fold_rows_per_sec = block.shape[0] / fold_s
+
+    # -- serving throughput: sketches on vs off ---------------------------
+    rng = np.random.RandomState(11)
+    n_requests = 400
+    sizes = np.maximum(np.exp(
+        rng.uniform(0, np.log(256), size=n_requests)
+    ).astype(int), 1)
+    offs = [int(rng.randint(0, n - s)) for s in sizes]
+    requests = [Xh[i:i + int(s)] for s, i in zip(sizes, offs)]
+    total_rows = int(sizes.sum())
+    n_clients = 8
+    shares = [requests[c::n_clients] for c in range(n_clients)]
+
+    def drive(srv):
+        def client(c):
+            for r in shares[c]:
+                srv.predict(r)
+
+        threads = [_threading.Thread(target=client, args=(c,))
+                   for c in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0
+
+    def build(obs_drift_on):
+        from dask_ml_tpu import config
+
+        # monitor cadence off: the overhead under test is the fold on
+        # the serving path, not a background compute tick landing
+        # mid-pass and adding variance
+        with config.set(obs_drift=obs_drift_on,
+                        obs_drift_interval_s=0.0):
+            return ModelServer(
+                clf, methods=("predict",),
+                ladder=BucketLadder(8, 512, 2.0),
+                batch_window_ms=1.0, timeout_ms=0,
+            ).warmup()
+
+    # INTERLEAVED passes over two live servers: shared-box load drifts
+    # on the same timescale as a pass, so back-to-back blocks of
+    # off-then-on confound the machine with the knob — alternating
+    # passes and taking each mode's best cancels it
+    srv_off, srv_on = build(False), build(True)
+    t_offs, t_ons = [], []
+    with srv_off, srv_on:
+        drive(srv_off)                     # warm passes
+        drive(srv_on)
+        for _ in range(4):
+            t_offs.append(drive(srv_off))
+            t_ons.append(drive(srv_on))
+    off_s, on_s = min(t_offs), min(t_ons)
+    drift.reset()                          # bench must not leak sketches
+    ratio = off_s / on_s                   # >= 1.0 means no overhead
+    entries = [
+        {
+            "metric": "drift_sketch_fold_rows_per_sec",
+            "value": round(fold_rows_per_sec, 1),
+            "unit": "rows/s",
+            "backend": jax.default_backend(),
+            "dtype": "float32",
+            "n_features": d,
+            "fold_seconds_per_10k_rows": round(fold_s, 6),
+        },
+        {
+            "metric": "drift_serving_overhead_ratio",
+            "value": round(ratio, 4),
+            "unit": "ratio",
+            "backend": jax.default_backend(),
+            "dtype": "float32",
+            "criterion": ">= 0.97 (sketches cost <= 3% throughput)",
+            "criterion_met": bool(ratio >= 0.97),
+            "n_requests": n_requests,
+            "total_rows": total_rows,
+            "rows_per_sec_off": round(total_rows / off_s, 1),
+            "rows_per_sec_on": round(total_rows / on_s, 1),
+        },
+    ]
+    from dask_ml_tpu.observability import MetricsLogger
+
+    metrics_file = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_metrics.jsonl"
+    )
+    with MetricsLogger(metrics_file) as _lg:
+        for e in entries:
+            _lg.log(kind="bench_drift", **e)
+    return entries
 
 
 def _bench_fleet(jax, on_tpu, n_chips):

@@ -73,6 +73,30 @@ class Config:
     # never created, no span observer registers, and the hot paths keep
     # today's zero-overhead profile (env DASK_ML_TPU_OBS_HTTP_PORT)
     obs_http_port: int = 0
+    # data/model-quality observability (observability/sketch.py +
+    # drift.py): streamed fits fold per-feature training profiles on the
+    # host staging path, serving folds request/prediction sketches, and
+    # hot swaps score a shadow canary — all pure host numpy (never in a
+    # jaxpr, never a device sync). Off = no sketch is ever allocated
+    obs_drift: bool = True
+    # background drift-score cadence (seconds) while a server runs:
+    # every tick recomputes PSI/KS over the registered sketch pairs and
+    # publishes drift_score gauges / drift_alerts. 0 = no monitor thread
+    # (scores still compute on demand via drift.compute())
+    obs_drift_interval_s: float = 5.0
+    # PSI above this alerts (drift_alerts_total; 0.2 is the classic
+    # "significant shift" line); canary disagreement/quantile-shift
+    # share it
+    obs_drift_threshold: float = 0.2
+    # fraction of served rows stashed into the per-method shadow
+    # reservoir a hot-swap canary scores against both versions
+    # (0 = no shadow sampling, swaps record no canary)
+    obs_shadow_fraction: float = 0.05
+    # max LABELED series per metric family in the live registry:
+    # per-feature drift gauges can mint unbounded label sets; past the
+    # cap new series are dropped and counted
+    # (telemetry_series_dropped_total)
+    obs_max_series: int = 512
     # slow-span watchdog (observability/_watchdog.py): any span open past
     # this many seconds dumps all-thread tracebacks + device memory
     # gauges + the open-span stack to the trace sink, without touching

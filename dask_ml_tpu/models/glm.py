@@ -295,6 +295,7 @@ class _GLMBase(BaseEstimator):
                 )
                 sp.add(n_iter=info.get("n_iter"),
                        data_passes=info.get("data_passes"))
+            self.training_profile_ = stream.profile_snapshot()
             return self._finish_fit_multi(Beta, classes, info, d_feat)
         beta0 = self._warm_beta0(d, np)
         with span("fit", component=type(self).__name__, solver=self.solver,
@@ -309,6 +310,8 @@ class _GLMBase(BaseEstimator):
             )
             sp.add(n_iter=info.get("n_iter"),
                    data_passes=info.get("data_passes"))
+        # per-feature training profile for train-vs-serve drift scoring
+        self.training_profile_ = stream.profile_snapshot()
         return self._finish_fit(beta, classes, info, d_feat)
 
     def _fit_C_grid(self, X, y, Cs):

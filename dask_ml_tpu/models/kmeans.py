@@ -745,6 +745,8 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         self.inertia_ = inertia
         self.n_iter_ = int(n_iter)
         self.n_features_in_ = d
+        # per-feature training profile for train-vs-serve drift scoring
+        self.training_profile_ = stream.profile_snapshot()
         return self
 
     def fit(self, X, y=None):

@@ -187,6 +187,8 @@ class PCA(TransformerMixin, BaseEstimator):
             self.noise_variance_ = 0.0
         self.n_features_in_ = d
         self.n_samples_ = n
+        # per-feature training profile for train-vs-serve drift scoring
+        self.training_profile_ = stream.profile_snapshot()
         return self
 
     def _fit(self, X):

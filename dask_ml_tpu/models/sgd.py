@@ -687,6 +687,15 @@ class _SGDBase(BaseEstimator):
         for sb in stream.superblocks(order=order):
             self._sb_step(sb)
         self._last_stream_stats = getattr(stream, "stats", None)
+        prof = stream.profile_snapshot()
+        if prof is not None:
+            # accumulate across partial_fit calls: one training profile
+            # covers every pass this model ever trained on
+            from ..observability.sketch import merge_profiles
+
+            self.training_profile_ = merge_profiles(
+                getattr(self, "training_profile_", None), prof
+            )
         self._publish(Xh.shape[1])
         return True
 
@@ -786,6 +795,9 @@ class _SGDBase(BaseEstimator):
         # last pass's overlap accounting (host/put/wait vs compute) for
         # bench and diagnosis of transfer-bound fits
         self._last_stream_stats = getattr(stream, "stats", None)
+        # per-feature training profile (drift.py scores serving traffic
+        # against it); a fresh fit replaces any previous profile
+        self.training_profile_ = stream.profile_snapshot()
         self._publish(Xh.shape[1])
         self.n_iter_ = self.max_iter
         return self

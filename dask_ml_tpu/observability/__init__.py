@@ -33,6 +33,13 @@ equivalent is this package (grown from the flat per-step logger in
   processes' trace files into ONE timeline/report);
 - ``_hist``     — thread-safe fixed-boundary log-spaced histograms (the
   serving latency quantile core and /metrics histogram series);
+- ``sketch``    — streaming data sketches (per-feature moments +
+  fixed-boundary histograms, top-k categoricals): host-only, mergeable,
+  JSON-safe — the training profiles streamed fits attach and the
+  serving sketches the quality plane scores;
+- ``drift``     — train-serve/window/version drift scoring (PSI/KS),
+  hot-swap shadow canaries, the drift-alert counter, the background
+  drift monitor (``config.obs_drift``);
 - ``live``      — the LIVE telemetry plane (``config.obs_http_port``):
   a process-wide gauge/histogram registry over the counter registry,
   fit-progress publication via span-close observers, and a background
@@ -88,6 +95,7 @@ from ._programs import (
     track_program,
 )
 from ._hist import Histogram
+from .sketch import CategoricalSketch, FeatureSketch, merge_profiles
 from ._spans import (
     NOOP_SPAN,
     add_span_observer,
@@ -115,8 +123,11 @@ from .live import (
 install_recompile_tracking()
 
 __all__ = [
+    "CategoricalSketch",
+    "FeatureSketch",
     "Histogram",
     "MetricsLogger",
+    "merge_profiles",
     "NOOP_SPAN",
     "TelemetryServer",
     "Watchdog",

@@ -151,6 +151,23 @@ def record_registry_publish(rollback: bool = False) -> None:
             counter_add("registry_rollbacks", 1)
 
 
+def record_drift_alert() -> None:
+    """A drift score (train-vs-serve / window PSI, or a canary delta)
+    crossed ``config.obs_drift_threshold`` — latched once per
+    below→above crossing by the drift engine. The quality-plane burn
+    signal a scraper alerts on (``dask_ml_tpu_drift_alerts_total``)."""
+    if counters_enabled():
+        counter_add("drift_alerts", 1)
+
+
+def record_telemetry_series_dropped() -> None:
+    """The live metric registry refused a NEW labeled series past
+    ``config.obs_max_series`` (cardinality guard) — visible as
+    ``telemetry_series_dropped_total``."""
+    if counters_enabled():
+        counter_add("telemetry_series_dropped", 1)
+
+
 def record_serving_slo_violation() -> None:
     """A served request's end-to-end latency exceeded the configured
     ``serving_slo_ms`` — the request still SUCCEEDED (unlike the drop

@@ -133,6 +133,32 @@ def to_chrome_trace(records) -> dict:
 
     for r in sorted(records, key=lambda r: _abs_time(r, origins)):
         t = ts(r)
+        if r.get("drift"):
+            # drift-alert instants: the moment a feature crossed the
+            # PSI threshold (or a canary flagged a version delta) lands
+            # on the timeline next to the spans that served it; quiet
+            # drift records stay out of the trace (they would swamp it)
+            if r.get("alert"):
+                if r.get("pair") == "canary":
+                    name = (f"canary alert: {r.get('model')} "
+                            f"v{r.get('version_from')}->"
+                            f"v{r.get('version_to')}")
+                    args = {
+                        "disagreement": r.get("disagreement"),
+                        "max_quantile_shift":
+                            r.get("max_quantile_shift"),
+                    }
+                else:
+                    name = (f"drift alert: {r.get('model')} "
+                            f"{r.get('feature')} ({r.get('pair')})")
+                    args = {"psi": r.get("psi"), "ks": r.get("ks"),
+                            "version": r.get("version")}
+                events.append({
+                    "name": name, "ph": "i", "s": "g", "pid": 1,
+                    "tid": tid_of(lane_of(r)), "ts": round(t, 3),
+                    "args": args,
+                })
+            continue
         if r.get("watchdog"):
             events.append({
                 "name": f"watchdog: {r.get('span', '?')} stalled",

@@ -63,9 +63,10 @@ from ._spans import add_span_observer, open_spans_snapshot, \
 __all__ = [
     "TelemetryServer", "ensure_telemetry", "stop_telemetry",
     "telemetry_server", "live_publishing", "gauge_set", "gauges_snapshot",
-    "histogram", "histograms_snapshot", "render_prometheus",
+    "histogram", "histograms_snapshot", "drop_labeled_series",
+    "render_prometheus",
     "status_data", "publish_progress", "note_stall", "register_server",
-    "unregister_server",
+    "unregister_server", "register_registry",
 ]
 
 _PREFIX = "dask_ml_tpu_"
@@ -79,6 +80,21 @@ _T0 = time.time()
 _lock = threading.Lock()
 _gauges: dict[tuple, float] = {}          # (name, labels) -> value
 _hists: dict[tuple, Histogram] = {}       # (name, labels) -> Histogram
+# labeled-series count per family name: the cardinality guard's ledger.
+# Per-feature drift gauges (and any future labeled family) could mint
+# unbounded series from unbounded label values; past
+# config.obs_max_series new labeled children of a family are DROPPED
+# and counted (telemetry_series_dropped_total) instead of growing the
+# registry without bound. Unlabeled series are never capped.
+_family_series: dict[str, int] = {}
+# shared sink for rejected histogram series: callers still get a
+# working Histogram, its observations just never render
+_overflow_hist: Histogram | None = None
+# series keys already rejected by the cap: the drop counter counts
+# DROPPED SERIES, not rejected writes — a publisher re-setting the same
+# over-cap gauge every monitor tick must not inflate it forever (and
+# the known-rejected path must stay one set lookup, no config read)
+_dropped_series: set = set()
 
 # recent closed-span records (the observer feeds it while a server is
 # live): /status renders them through report.report_data so the live
@@ -109,6 +125,29 @@ def register_server(srv) -> None:
         pass
 
 
+# live ModelRegistry instances (weakly referenced): /status renders
+# their per-name current/archived versions in the ``registry`` block
+_registries = None
+
+
+def _registry_set():
+    global _registries
+    if _registries is None:
+        import weakref
+
+        _registries = weakref.WeakSet()
+    return _registries
+
+
+def register_registry(reg) -> None:
+    """A ModelRegistry announces itself for the /status registry block
+    (what is serving, archived versions, last publish, publisher)."""
+    try:
+        _registry_set().add(reg)
+    except Exception:
+        pass
+
+
 def unregister_server(srv) -> None:
     try:
         _server_set().discard(srv)
@@ -116,13 +155,60 @@ def unregister_server(srv) -> None:
         pass
 
 
+def _admit_series_locked(name: str, labels: tuple) -> bool:
+    """Cardinality guard (caller holds ``_lock``): may a NEW labeled
+    series join ``name``'s family? Past ``config.obs_max_series`` the
+    series is dropped and the drop counted — /metrics stays bounded and
+    parseable no matter what label values a caller mints."""
+    if not labels:
+        return True
+    if (name, labels) in _dropped_series:
+        return False
+    from ..config import get_config
+
+    cap = int(get_config().obs_max_series)
+    if cap > 0 and _family_series.get(name, 0) >= cap:
+        from ._counters import record_telemetry_series_dropped
+
+        _dropped_series.add((name, labels))
+        record_telemetry_series_dropped()
+        return False
+    _family_series[name] = _family_series.get(name, 0) + 1
+    return True
+
+
 def gauge_set(name: str, value, labels: tuple = ()) -> None:
     try:
         value = float(value)
     except (TypeError, ValueError):
         return
+    key = (name, labels)
     with _lock:
-        _gauges[(name, labels)] = value
+        if key not in _gauges and not _admit_series_locked(name, labels):
+            return
+        _gauges[key] = value
+
+
+def drop_labeled_series(name_prefix: str, label_kvs: tuple) -> int:
+    """Remove every labeled gauge series whose family name starts with
+    ``name_prefix`` and whose label set contains all of ``label_kvs``,
+    releasing their slots in the cardinality ledger. Drift's version
+    eviction rides this: an evicted model version must not leave its
+    ``drift_score{version=...}`` series latched at a stale value on
+    /metrics — or pinning cap room the live versions need."""
+    kvs = set(label_kvs)
+    with _lock:
+        doomed = [k for k in _gauges
+                  if k[0].startswith(name_prefix) and kvs <= set(k[1])]
+        for k in doomed:
+            del _gauges[k]
+            left = _family_series.get(k[0], 0) - 1
+            if left > 0:
+                _family_series[k[0]] = left
+            else:
+                _family_series.pop(k[0], None)
+            _dropped_series.discard(k)
+        return len(doomed)
 
 
 def gauges_snapshot() -> dict:
@@ -134,10 +220,18 @@ def histogram(name: str, labels: tuple = (), bounds=None) -> Histogram:
     """Create-or-get the histogram keyed (name, labels). ``labels`` is
     a tuple of (key, value) string pairs; label sets under one name
     must share boundaries (the first creation wins)."""
+    global _overflow_hist
     key = (name, labels)
     with _lock:
         h = _hists.get(key)
         if h is None:
+            if not _admit_series_locked(name, labels):
+                # callers observe into a shared sink that never renders
+                # — the write contract survives the cap, the page stays
+                # bounded
+                if _overflow_hist is None:
+                    _overflow_hist = Histogram(bounds)
+                return _overflow_hist
             h = _hists[key] = Histogram(bounds)
         return h
 
@@ -153,6 +247,8 @@ def metrics_reset() -> None:
     with _lock:
         _gauges.clear()
         _hists.clear()
+        _family_series.clear()
+        _dropped_series.clear()
         _recent_spans.clear()
         _recent_stalls.clear()
 
@@ -362,6 +458,23 @@ def status_data() -> dict:
             serving.append(srv.stats())
         except Exception:
             continue
+    # the registry block: every live ModelRegistry's per-name view
+    # (current version, archived versions, last publish, publisher) —
+    # fleet operators see what is serving without instrumenting code
+    registry = {}
+    for reg in list(_registry_set()):
+        try:
+            registry.update(reg.status_snapshot())
+        except Exception:
+            continue
+    # the drift block: last computed train-vs-serve / window scores,
+    # recent hot-swap canaries, and the tracked sketch keys
+    try:
+        from . import drift as _drift
+
+        drift_block = _drift.status_block()
+    except Exception:
+        drift_block = {}
     out = {
         "pid": os.getpid(),
         "t_unix": round(now, 3),
@@ -372,6 +485,8 @@ def status_data() -> dict:
                    for (n, ls), v in gauges_snapshot().items()},
         "histograms": hists,
         "serving": serving,
+        "registry": registry,
+        "drift": drift_block,
         "watchdog_stalls": stalls,
         "report": report_data(records),
     }

@@ -263,6 +263,63 @@ def summarize_stream(records):
     return tot
 
 
+def summarize_drift(records):
+    """The drift records (``drift.py`` emits one per scored feature /
+    canary) as two table-ready lists:
+
+    - ``scores``: train-vs-serve and window-vs-window PSI/KS grouped by
+      (pair, model, version, method) — feature count, worst feature,
+      max psi/ks, alert count;
+    - ``canaries``: version-vs-version hot-swap deltas, one row per
+      recorded canary (disagreement + max quantile shift).
+    """
+    groups = {}
+    canaries = []
+    for r in records:
+        if not r.get("drift"):
+            continue
+        if r.get("pair") == "canary":
+            canaries.append({
+                "model": r.get("model"),
+                "versions": f"{r.get('version_from')}"
+                            f"->{r.get('version_to')}",
+                "method": r.get("method"),
+                "n_rows": r.get("n_rows"),
+                "disagreement": r.get("disagreement"),
+                "max_quantile_shift": r.get("max_quantile_shift"),
+                "alert": bool(r.get("alert")),
+            })
+            continue
+        key = (r.get("pair"), r.get("model"), r.get("version"),
+               r.get("method"))
+        g = groups.setdefault(key, {"features": set(), "max_psi": 0.0,
+                                    "max_ks": 0.0, "worst": None,
+                                    "alerts": 0})
+        g["features"].add(r.get("feature"))
+        psi = r.get("psi")
+        if isinstance(psi, (int, float)) and psi >= g["max_psi"]:
+            g["max_psi"] = float(psi)
+            g["worst"] = r.get("feature")
+        ks = r.get("ks")
+        if isinstance(ks, (int, float)):
+            g["max_ks"] = max(g["max_ks"], float(ks))
+        if r.get("alert"):
+            g["alerts"] += 1
+    scores = []
+    for (pair, model, version, method) in sorted(
+            groups, key=lambda k: (str(k[0]), str(k[1]), str(k[2]))):
+        g = groups[(pair, model, version, method)]
+        scores.append({
+            "pair": pair, "model": model, "version": version,
+            "method": method, "features": len(g["features"]),
+            "worst_feature": g["worst"],
+            "max_psi": round(g["max_psi"], 6),
+            "max_ks": round(g["max_ks"], 6),
+            "alerts": g["alerts"],
+        })
+    return {"scores": scores, "canaries": canaries}
+
+
 def _numeric(v):
     return isinstance(v, (int, float)) and not isinstance(v, bool)
 
@@ -346,6 +403,7 @@ def report_data(records):
         "spans": spans,
         "components": comps,
         "streaming": summarize_stream(records),
+        "drift": summarize_drift(records),
         "counters": final_counters(records),
         "programs": final_programs(records),
         "peak": peak,
@@ -389,6 +447,26 @@ def build_report(records, path="<records>"):
               st["superblock_k"], _fmt_seconds(st["host_s"]),
               _fmt_seconds(st["put_s"]), _fmt_seconds(st["wait_s"]),
               _fmt_seconds(st["consume_s"]))],
+        )
+    dr = data["drift"]
+    if dr["scores"]:
+        lines += _table(
+            "drift (train vs serve / window vs window)",
+            ("pair", "model", "version", "method", "features",
+             "worst", "max_psi", "max_ks", "alerts"),
+            [(s["pair"], s["model"], s["version"], s["method"],
+              s["features"], s["worst_feature"], s["max_psi"],
+              s["max_ks"], s["alerts"]) for s in dr["scores"]],
+        )
+    if dr["canaries"]:
+        lines += _table(
+            "canary (version vs version prediction deltas)",
+            ("model", "versions", "method", "rows", "disagreement",
+             "max_q_shift", "alert"),
+            [(c["model"], c["versions"], c["method"], c["n_rows"],
+              c["disagreement"], c["max_quantile_shift"],
+              "ALERT" if c["alert"] else "-")
+             for c in dr["canaries"]],
         )
     progs = data["programs"]
     if progs:
@@ -453,7 +531,8 @@ def build_report(records, path="<records>"):
             rows.append((k, shown))
         lines += _table("counters", ("counter", "total"), rows)
     if not span_rows and not comp_rows and not st and not ctr \
-            and not progs and not stalls:
+            and not progs and not stalls and not dr["scores"] \
+            and not dr["canaries"]:
         lines.append("no observability records found "
                      "(set config.metrics_path or config.trace_dir)")
     return "\n".join(lines).rstrip() + "\n"

@@ -195,6 +195,13 @@ _AUTO_BLOCK_BYTES = 256 << 20
 # super-blocks while a pass is in flight)
 _SUPERBLOCK_BYTES = 512 << 20
 
+# widest feature count the training profile sketches: past this the
+# per-feature histogram matrix (d x ~80 int64 buckets) and the fold's
+# O(block x d) temporaries stop being "free on the staging path" —
+# wide/hashed feature spaces are served by the serving-side sketches'
+# own cap instead
+_PROFILE_MAX_FEATURES = 1024
+
 # auto K: dispatch amortization saturates quickly — 8 blocks per
 # dispatch removes ~7/8 of the per-block launch+sync overhead; beyond
 # that the stacked buffer's footprint grows for single-digit-% returns
@@ -295,7 +302,8 @@ class BlockStream:
     """
 
     def __init__(self, arrays, block_rows=None, mesh=None, shuffle=False,
-                 seed=None, dtype=np.float32, prefetch=None):
+                 seed=None, dtype=np.float32, prefetch=None,
+                 profile=True):
         if mesh is None:
             from . import distributed as dist
 
@@ -355,8 +363,32 @@ class BlockStream:
         )
         self._counts_sharding = NamedSharding(self.mesh, P())
         self._superblock_k_override = None  # set by the K autotuner
-        from ..config import ensure_compile_cache
+        from ..config import ensure_compile_cache, get_config
         from ..observability.live import ensure_telemetry
+
+        # per-feature training profile (observability/sketch.py): the
+        # staging path folds a strided row sample of the FIRST pass's
+        # host slabs — pure numpy on buffers already in hand, so it can
+        # never add a device sync or touch a jaxpr. Consumers attach the
+        # snapshot to the fitted estimator (training_profile_); serving
+        # scores live traffic against it (drift.py). `profile=False`
+        # opts inference streams (streamed_map) out — a predict stream's
+        # distribution is not a training profile.
+        self.profile = None
+        # sparse sources opt out: a hashed-text corpus is 2**16+ wide,
+        # and a per-feature sketch there is O(d * buckets) memory (tens
+        # of MB) on a path whose whole point is O(block) footprint;
+        # _PROFILE_MAX_FEATURES guards the dense equivalent
+        self._profile_enabled = bool(
+            profile and get_config().obs_drift
+            and not any(_is_sparse_source(a) for a in self.arrays)
+        )
+        # row budget for the profile sample: bounds the fold cost per
+        # fit to ~64k rows regardless of dataset size (the profile is a
+        # uniform strided sample either way)
+        self._profile_stride = max(
+            int(np.ceil(self.n_rows / 65536)), 1
+        )
 
         # streamed fits are the repeated-warmup-compile hot spot the
         # persistent compile cache exists for; apply the knob (no-op
@@ -415,6 +447,37 @@ class BlockStream:
             for ok, a in zip(self._native_ok, self.arrays)
         ]
 
+    def _profile_fold(self, blk) -> None:
+        """Fold one host X slab (valid rows only, pre-padding) into the
+        training profile — first pass only (later passes re-stream the
+        same rows), strided to the row budget, never raising into the
+        stream. Called from the per-block path and the super-block
+        staging worker alike (the sketch is thread-safe)."""
+        if not self._profile_enabled or getattr(self, "_passes", 0):
+            return
+        try:
+            if blk.ndim != 2 or blk.shape[0] == 0 \
+                    or blk.shape[1] > _PROFILE_MAX_FEATURES:
+                self._profile_enabled = (
+                    blk.ndim == 2 and blk.shape[1] <= _PROFILE_MAX_FEATURES
+                )
+                return
+            prof = self.profile
+            if prof is None:
+                from ..observability.sketch import FeatureSketch
+
+                prof = self.profile = FeatureSketch(blk.shape[1])
+            prof.fold(blk[:: self._profile_stride])
+        except Exception:
+            self._profile_enabled = False  # diagnostics never kill a fit
+
+    def profile_snapshot(self):
+        """The training profile as a JSON-safe dict (None when profiling
+        is off / nothing folded) — what fits attach as
+        ``estimator.training_profile_``."""
+        prof = self.profile
+        return prof.to_dict() if prof is not None and prof.rows else None
+
     def _block_host(self, b, readers=None):
         lo = b * self.block_rows
         hi = min(lo + self.block_rows, self.n_rows)
@@ -428,6 +491,8 @@ class BlockStream:
                 blk = raw.astype(self.dtype, copy=True)
             else:
                 blk = _slice_dense(a, lo, hi, self.dtype)
+            if i == 0:
+                self._profile_fold(blk[:m])
             if m < self.block_rows:  # fixed shape: pad the tail block
                 pad = [(0, self.block_rows - m)] + [(0, 0)] * (blk.ndim - 1)
                 blk = np.pad(blk, pad)
@@ -727,12 +792,16 @@ class BlockStream:
                                    and readers[i] is not None)
                     if (unroll and not from_reader
                             and m == self.block_rows and view_ok(a)):
+                        if i == 0:
+                            self._profile_fold(a[lo:hi])
                         parts[i].append(a[lo:hi])
                         continue
                     if from_reader:
                         buf[j, :m] = readers[i].next()
                     else:
                         buf[j, :m] = _slice_dense(a, lo, hi, self.dtype)
+                    if i == 0:
+                        self._profile_fold(buf[j, :m])
                     if m < self.block_rows:
                         buf[j, m:] = 0
                     if unroll:
@@ -914,6 +983,6 @@ def streamed_map(X, block_rows, fn):
     distances, PCA scores). ``fn`` receives the padded device block; its
     output is sliced to the block's logical rows here."""
     outs = []
-    for blk in BlockStream((X,), block_rows=block_rows):
+    for blk in BlockStream((X,), block_rows=block_rows, profile=False):
         outs.append(np.asarray(fn(blk))[: blk.n_rows])
     return np.concatenate(outs, axis=0)

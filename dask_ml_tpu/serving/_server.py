@@ -100,7 +100,7 @@ class ModelServer:
 
     def __init__(self, estimator, methods=("predict",), ladder=None,
                  max_queue=None, batch_window_ms=None, timeout_ms=None,
-                 device=None, replica_id=None):
+                 device=None, replica_id=None, name=None):
         from ..config import get_config
 
         cfg = get_config()
@@ -128,6 +128,18 @@ class ModelServer:
         self.device = device
         self.replica_id = replica_id
         self.model_version = 0          # stamped by swap/rebuild/fleet
+        # quality observability (observability/drift.py): serving-side
+        # sketches + the hot-swap shadow canary, keyed by this model
+        # name (a fleet stamps its registry name onto every replica).
+        # The gate is captured ONCE — the worker must not pay a config
+        # read per batch
+        self.model_name = str(name) if name else type(estimator).__name__
+        self._drift_on = bool(cfg.obs_drift)
+        self._shadow_frac = float(cfg.obs_shadow_fraction)
+        self._shadow = {}               # method -> drift.ShadowBuffer
+        self._pend = {}                 # method -> pending fold sample
+        self._pend_lock = threading.Lock()
+        self._next_fold_t = 0.0         # backpressure gate (see _execute)
         self._fns = {m: compiled_batch_fn(estimator, m, device=device)
                      for m in methods}
         self._queue = BoundedQueue(self.max_queue)
@@ -154,6 +166,17 @@ class ModelServer:
         # list this server's stats() window on /status
         ensure_telemetry()
         register_server(self)
+        if self._drift_on:
+            # register the served version's training profile (when the
+            # fit recorded one) and arm the background drift monitor —
+            # both host-only, neither touches the request path
+            from ..observability import drift
+
+            drift.note_training_profile(
+                self.model_name, self.model_version,
+                getattr(self.estimator, "training_profile_", None),
+            )
+            drift.ensure_monitor(self._cfg)
         with self._lock:
             if self._thread is not None:
                 return self
@@ -185,6 +208,8 @@ class ModelServer:
         if thread is None:
             # never started: resolve anything queued directly
             self._shed_queue(drain)
+            if self._drift_on:
+                self._flush_quality()
             return
         if not drain:
             fail_requests(self._queue.drain_all(), ServerClosed(
@@ -196,6 +221,10 @@ class ModelServer:
         thread.join(timeout)
         with self._lock:
             self._thread = None
+        if self._drift_on:
+            # the drained tail's pending sample folds before callers
+            # read scores (tests stop the server, then compute)
+            self._flush_quality()
 
     def _shed_queue(self, drain):
         reqs = self._queue.drain_all()
@@ -261,6 +290,18 @@ class ModelServer:
                 tokens[m] = fn.prepare_swap(estimator)
             except ParamSwapError as exc:
                 raise ParamSwapError(f"method {m!r}: {exc}") from exc
+        # canary phase 1 (obs_drift + a warmed server only): score the
+        # shadow sample of recent traffic against the OUTGOING params
+        # through the already-compiled entry points — the batch rides a
+        # warmed ladder bucket, so both canary passes mint ZERO XLA
+        # compiles (the zero-recompile swap contract holds with the
+        # canary on)
+        v_old = self.model_version
+        if self._drift_on:
+            # the outgoing version's pending sample must fold under ITS
+            # version key before the flip
+            self._flush_quality()
+        old_outs = self._canary_pass() if self._drift_on else {}
         for m, fn in self._fns.items():
             fn.commit_swap(tokens[m])
         self.estimator = estimator
@@ -268,11 +309,63 @@ class ModelServer:
             self.model_version = int(version)
         else:
             self.model_version += 1
+        if old_outs:
+            # canary phase 2: the SAME shadow rows through the
+            # just-committed parameters; the per-method prediction
+            # deltas (disagreement + max quantile shift) publish as
+            # per-version series on /metrics and a JSONL drift record
+            from ..observability import drift
+
+            for m, (sample_n, old) in old_outs.items():
+                try:
+                    new = self._canary_run(m, sample_n[0], sample_n[1])
+                    drift.record_canary(self.model_name, v_old,
+                                        self.model_version, m, old, new)
+                except Exception:
+                    pass  # diagnostics never fail a swap
+        if self._drift_on:
+            from ..observability import drift
+
+            drift.note_training_profile(
+                self.model_name, self.model_version,
+                getattr(estimator, "training_profile_", None),
+            )
         smetrics.record_swap()
         if self.replica_id is not None:
             smetrics.set_replica_gauges(self.replica_id,
                                         version=self.model_version)
         return self
+
+    def _canary_pass(self):
+        """Run every shadow-sampled method's reservoir through the LIVE
+        entry points (pre-commit = outgoing version). Returns
+        {method: ((padded_batch, n_rows), outputs)} — phase 2 reruns the
+        identical padded batch post-commit. Only a warmed server
+        canaries (every ladder bucket is compiled, so the pass cannot
+        mint a compile); failures return {} and never block the swap."""
+        if not self._warmed:
+            return {}
+        outs = {}
+        for m, buf in list(self._shadow.items()):
+            fn = self._fns.get(m)
+            if fn is None or not fn.jitted:
+                continue
+            try:
+                sample = buf.sample()
+                if sample is None:
+                    continue
+                sample = sample[: self.ladder.max_rows]
+                bucket = self.ladder.bucket_for(len(sample))
+                padded = np.zeros((bucket, sample.shape[1]), np.float32)
+                padded[: len(sample)] = sample
+                outs[m] = ((padded, len(sample)),
+                           self._canary_run(m, padded, len(sample)))
+            except Exception:
+                continue
+        return outs
+
+    def _canary_run(self, method, padded, n_rows):
+        return np.asarray(self._fns[method](padded))[:n_rows]
 
     def rebuild_model(self, estimator, version=None, warm=None):
         """The slow path a shape-incompatible publish needs: build fresh
@@ -289,6 +382,17 @@ class ModelServer:
             self.model_version = int(version)
         else:
             self.model_version += 1
+        if self._drift_on:
+            # a rebuild changes shapes — the old shadow rows no longer
+            # fit the new entry points, so no canary; the new version's
+            # training profile still registers for train-vs-serve
+            from ..observability import drift
+
+            self._shadow.clear()
+            drift.note_training_profile(
+                self.model_name, self.model_version,
+                getattr(estimator, "training_profile_", None),
+            )
         smetrics.record_swap(rebuilt=True)
         if self.replica_id is not None:
             smetrics.set_replica_gauges(self.replica_id,
@@ -600,6 +704,97 @@ class ModelServer:
                                     min(deadline - now, 0.01))
         self._execute(batch)
 
+    # pending-fold batching: the sketch fold's ~30 small numpy calls
+    # cost ~0.2-1 ms of fixed overhead per invocation — paid per BATCH
+    # on the worker thread, that taxes serving throughput by tens of
+    # percent. The worker therefore only memcpy's a strided row sample
+    # (a few µs) into a pending list and folds it in one amortized
+    # chunk every _FOLD_PENDING_ROWS rows / _FOLD_PENDING_S seconds.
+    _FOLD_PENDING_ROWS = 1024
+    _FOLD_PENDING_S = 0.5
+    _FOLD_ROWS_PER_BATCH = 128
+
+    def _fold_quality(self, method, rows_view, out):
+        """Serving-side sketch fold + shadow sampling (obs_drift only).
+        Pure host numpy on buffers the batch already produced; any
+        failure disables quality capture for this server rather than
+        ever surfacing into the worker."""
+        try:
+            from ..observability import drift
+
+            if rows_view.shape[1] > drift._MAX_SKETCH_FEATURES:
+                self._drift_on = False   # ultra-wide model: skip capture
+                return
+            out_rows = None
+            try:
+                if hasattr(out, "__len__") and len(out) >= len(rows_view):
+                    out_rows = np.asarray(out)[: len(rows_view)]
+            except Exception:
+                out_rows = None
+            stride = max(
+                -(-len(rows_view) // self._FOLD_ROWS_PER_BATCH), 1
+            )
+            sample_X = np.array(rows_view[::stride])
+            sample_out = np.array(out_rows[::stride]) \
+                if out_rows is not None else None
+            now = time.monotonic()
+            ready = []
+            with self._pend_lock:
+                pend = self._pend.get(method)
+                if pend is not None \
+                        and pend["version"] != self.model_version:
+                    ready.append(self._pend.pop(method))  # old tail
+                    pend = None
+                if pend is None:
+                    pend = self._pend[method] = {
+                        "version": self.model_version, "X": [],
+                        "out": [], "rows": 0, "t": now,
+                    }
+                pend["X"].append(sample_X)
+                pend["out"].append(sample_out)
+                pend["rows"] += sample_X.shape[0]
+                if pend["rows"] >= self._FOLD_PENDING_ROWS \
+                        or now - pend["t"] > self._FOLD_PENDING_S:
+                    ready.append(self._pend.pop(method))
+            for p in ready:
+                self._fold_pending(method, p)
+            if self._shadow_frac > 0:
+                buf = self._shadow.get(method)
+                if buf is None:
+                    buf = self._shadow[method] = drift.ShadowBuffer()
+                buf.offer(rows_view, self._shadow_frac)
+        except Exception:  # pragma: no cover - defensive
+            self._drift_on = False
+
+    def _flush_quality(self):
+        """Fold every method's pending row sample now — the swap path
+        (sketches must be current per version before the version flips)
+        and ``stop()`` (tests compute scores right after) call this
+        from their own threads; the pop is under ``_pend_lock``."""
+        with self._pend_lock:
+            ready = dict(self._pend)
+            self._pend.clear()
+        for m, pend in ready.items():
+            self._fold_pending(m, pend)
+
+    def _fold_pending(self, method, pend):
+        """One amortized sketch fold of a popped pending sample."""
+        from ..observability import drift
+
+        if not pend or not pend["rows"]:
+            return
+        X = np.concatenate(pend["X"], axis=0)
+        outs = None
+        if pend["out"] and all(o is not None for o in pend["out"]):
+            try:
+                outs = np.concatenate(
+                    [np.atleast_1d(o) for o in pend["out"]], axis=0
+                )
+            except Exception:
+                outs = None
+        drift.fold_serving(self.model_name, pend["version"], method, X,
+                           outs, max_rows=X.shape[0])
+
     def _execute(self, batch):
         # EVERYTHING from pack to demux sits inside the guard: an
         # exception anywhere (ragged widths slipping past validation,
@@ -635,6 +830,26 @@ class ModelServer:
                 # SLO counter when config.serving_slo_ms is set
                 smetrics.observe_request_latency(method, bucket, lat)
             demux_outputs(out, segments)
+            if self._drift_on:
+                # quality sketches AFTER demux (callers already have
+                # their results — the fold never adds request latency):
+                # admitted rows + emitted predictions into the
+                # per-(model, version, method) serving sketches, plus
+                # the shadow reservoir the next hot-swap canary scores.
+                # buf/out stay untouched until the next batch packs
+                # (single worker thread), so the views are stable here.
+                # RATE GATE: sample at most ~20 batches/s into the
+                # sketches. A per-batch fold costs far more wall than
+                # CPU under concurrent load — every extra preemption
+                # point in the worker hands the GIL to a hammering
+                # client for a whole switch interval — so the gate must
+                # be ONE clock read + compare on the skipped path (a
+                # queue-emptiness test flickers with coalescing and
+                # makes the overhead nondeterministic)
+                now2 = time.monotonic()
+                if now2 >= self._next_fold_t:
+                    self._next_fold_t = now2 + 0.05
+                    self._fold_quality(method, buf[:rows], out)
         except Exception as exc:
             for _ in batch:   # per REQUEST, matching the timeout path
                 smetrics.record_drop("error")

@@ -129,7 +129,7 @@ class FleetServer:
                 timeout_ms=timeout_ms,
                 device=devices[i % len(devices)]
                 if len(devices) > 1 else None,
-                replica_id=i,
+                replica_id=i, name=self.name,
             )
             for i in range(n)
         )
@@ -330,6 +330,13 @@ class FleetServer:
 
     def transform(self, X):
         return self._call(X, "transform")
+
+    def _flush_quality(self):
+        """Flush every replica's pending drift-fold sample (the fleet
+        entry stands in for its unlisted replicas on the live plane —
+        ``drift.compute`` reaches them through this)."""
+        for r in self.replicas:
+            r._flush_quality()
 
     # -- stats -------------------------------------------------------------
     def stats(self):

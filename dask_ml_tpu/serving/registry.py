@@ -45,16 +45,26 @@ class UnknownModelError(RegistryError):
 
 
 class ModelVersion:
-    """One immutable published snapshot: ``estimator`` plus identity."""
+    """One immutable published snapshot: ``estimator`` plus identity,
+    the publisher (thread name unless given — the /status registry
+    block's audit field), and the estimator's per-feature training
+    profile (``training_profile_``, see observability/sketch.py) so a
+    version's drift baseline is archived WITH the version — rollback
+    restores the matching baseline, not the current one's."""
 
-    __slots__ = ("name", "version", "estimator", "t_publish", "tag")
+    __slots__ = ("name", "version", "estimator", "t_publish", "tag",
+                 "publisher", "profile")
 
-    def __init__(self, name, version, estimator, tag=None):
+    def __init__(self, name, version, estimator, tag=None,
+                 publisher=None):
         self.name = name
         self.version = int(version)
         self.estimator = estimator
         self.t_publish = time.time()
         self.tag = tag
+        self.publisher = str(publisher) if publisher is not None \
+            else threading.current_thread().name
+        self.profile = getattr(estimator, "training_profile_", None)
 
     def __repr__(self):
         tag = f", tag={self.tag!r}" if self.tag else ""
@@ -83,11 +93,19 @@ class ModelRegistry:
         self._current: dict[str, int] = {}
         self._next: dict[str, int] = {}
         self._subs: dict[str, list] = {}
+        # list this registry on /status (weakly referenced — a dropped
+        # registry disappears from the page with no unregister call)
+        from ..observability.live import register_registry
+
+        register_registry(self)
 
     # -- write plane -------------------------------------------------------
-    def publish(self, name, estimator, tag=None, snapshot=True) -> int:
+    def publish(self, name, estimator, tag=None, snapshot=True,
+                publisher=None) -> int:
         """Store ``estimator`` as the next version of ``name``, make it
         current, notify subscribers. Returns the new version id.
+        ``publisher`` labels the version on /status (defaults to the
+        publishing thread's name).
 
         ``snapshot=True`` (default) deep-copies the estimator so later
         in-place training (``partial_fit``) cannot mutate the archive;
@@ -97,7 +115,8 @@ class ModelRegistry:
         with self._lock:
             version = self._next.get(name, 1)
             self._next[name] = version + 1
-            mv = ModelVersion(name, version, est, tag=tag)
+            mv = ModelVersion(name, version, est, tag=tag,
+                              publisher=publisher)
             versions = self._models.setdefault(name, {})
             versions[version] = mv
             self._current[name] = version
@@ -199,6 +218,25 @@ class ModelRegistry:
     def names(self) -> tuple:
         with self._lock:
             return tuple(sorted(self._models))
+
+    def status_snapshot(self) -> dict:
+        """{name: {current, versions, t_publish, publisher, tag}} — the
+        /status ``registry`` block: what is serving, what is archived,
+        who pushed it and when, without instrumenting application
+        code."""
+        out = {}
+        with self._lock:
+            for name, versions in self._models.items():
+                cur = self._current.get(name)
+                mv = versions.get(cur)
+                out[name] = {
+                    "current": cur,
+                    "versions": sorted(versions),
+                    "t_publish": round(mv.t_publish, 3) if mv else None,
+                    "publisher": mv.publisher if mv else None,
+                    "tag": mv.tag if mv else None,
+                }
+        return out
 
     # -- subscription ------------------------------------------------------
     def subscribe(self, name, callback):

@@ -134,6 +134,18 @@ class ParallelPostFit(BaseEstimator):
     def classes_(self):
         return self._est.classes_
 
+    @property
+    def training_profile_(self):
+        """The wrapped estimator's per-feature training profile (see
+        observability/sketch.py) — so a served `Incremental`/
+        `ParallelPostFit` carries its drift baseline exactly like the
+        bare estimator. AttributeError when the inner fit recorded
+        none (sklearn hasattr semantics)."""
+        prof = getattr(self._est, "training_profile_", None)
+        if prof is None:
+            raise AttributeError("training_profile_")
+        return prof
+
     # -- parallel post-fit ops --------------------------------------------
     def _pin_meta(self, out, method):
         """Pin the output dtype when a *_meta hint was given (the

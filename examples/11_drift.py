@@ -1,0 +1,93 @@
+"""Quality observability: training profiles, drift scores, a hot-swap
+canary — watching the DATA, not just the system.
+
+The live plane (`examples/09`) shows where time goes; this example
+shows what the quality plane sees when production data misbehaves:
+
+1. a streamed fit attaches a per-feature **training profile**
+   (``training_profile_``: moments + fixed-boundary histograms, folded
+   on the host staging path — zero device syncs);
+2. a served model folds admitted rows into **serving sketches**, and
+   the drift engine scores serve-vs-train PSI/KS per feature —
+   in-distribution traffic scores near zero;
+3. a **hot swap** scores a shadow sample of recent traffic against
+   both versions through the warmed entry points (zero new compiles):
+   the canary's disagreement rate says how differently the new version
+   answers the SAME requests;
+4. a **+3σ covariate shift** in the request stream pushes the drift
+   score over ``config.obs_drift_threshold`` and latches
+   ``drift_alerts_total`` — the page an operator gets BEFORE accuracy
+   quietly collapses.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from dask_ml_tpu import config, observability as obs
+from dask_ml_tpu.models.sgd import SGDClassifier
+from dask_ml_tpu.observability import drift
+from dask_ml_tpu.serving import BucketLadder, FleetServer
+
+n = int(os.environ.get("DASK_ML_TPU_EXAMPLE_N", 40_000))
+rng = np.random.RandomState(0)
+X = rng.randn(n, 8).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.float32)
+y2 = (X[:, 1] > 0).astype(np.float32)   # v2 learns a DIFFERENT concept
+
+# 1) streamed fits attach training profiles (obs_drift defaults on)
+with config.set(stream_block_rows=max(n // 8, 512)):
+    v1 = SGDClassifier(max_iter=2, random_state=0).fit(X, y)
+    v2 = SGDClassifier(max_iter=2, random_state=7).fit(X, y2)
+from dask_ml_tpu.observability.sketch import profile_from_dict
+
+prof = v1.training_profile_
+stats = profile_from_dict(prof).stats()
+print(f"training profile: {prof['rows']} rows x "
+      f"{prof['n_features']} features; "
+      f"feature means {np.round(stats['mean'], 3)}")
+
+drift.reset()
+threshold = config.get_config().obs_drift_threshold
+
+with config.set(obs_shadow_fraction=1.0, obs_drift_interval_s=0.0):
+    fleet = FleetServer(v1, name="demo", replicas=1,
+                        ladder=BucketLadder(8, 128, 2.0),
+                        batch_window_ms=0.5, timeout_ms=0).warmup()
+    with fleet:
+        # 2) in-distribution traffic: drift stays quiet
+        for i in range(150):
+            lo = (i * 60) % (n - 60)
+            fleet.predict(X[lo:lo + 50])
+        quiet = [r for r in drift.compute()
+                 if r["pair"] == "train_serve"]
+        print(f"control  max PSI = {max(r['psi'] for r in quiet):.4f} "
+              f"(threshold {threshold})")
+
+        # 3) hot swap -> shadow canary against both versions
+        before = obs.counters_snapshot().get("recompiles", 0)
+        fleet.publish(v2)
+        minted = obs.counters_snapshot().get("recompiles", 0) - before
+        can = drift.status_block()["canaries"][0]
+        print(f"canary   v{can['version_from']}->v{can['version_to']}: "
+              f"disagreement {can['disagreement']:.2f} on "
+              f"{can['n_rows']} shadow rows, {minted} new compiles")
+
+        # 4) covariate shift: the page fires
+        for i in range(150):
+            lo = (i * 60) % (n - 60)
+            fleet.predict(X[lo:lo + 50] + 3.0)
+        loud = [r for r in drift.compute()
+                if r["pair"] == "train_serve" and r["version"] == 2]
+        worst = max(loud, key=lambda r: r["psi"])
+        alerts = obs.counters_snapshot().get("drift_alerts", 0)
+        print(f"shifted  max PSI = {worst['psi']:.2f} on "
+              f"{worst['feature']} -> drift_alerts_total = {alerts}")
+
+assert max(r["psi"] for r in quiet) < threshold
+assert worst["psi"] > threshold and alerts >= 1 and minted == 0
+drift.reset()
+print("quality plane OK: quiet control, loud shift, free canary")

@@ -1,0 +1,202 @@
+"""Drift verify gate (ISSUE 7): a SUBPROCESS fit + serve with an
+injected covariate shift must light up the quality plane end to end.
+
+The child streams an SGD fit (attaching a per-feature training
+profile), fronts it with a 1-replica FleetServer, and drives three
+traffic phases:
+
+1. CONTROL — requests drawn from the training distribution: the
+   train-vs-serve drift score must stay BELOW the alert threshold
+   (in-distribution traffic must not page anyone);
+2. HOT SWAP — a second version publishes mid-run: the shadow canary
+   scores the recent-traffic sample against BOTH versions through the
+   warmed entry points (zero new XLA compiles), publishing per-version
+   canary series;
+3. SHIFT — requests mean-shifted by +3σ: the new version's
+   ``drift_score`` must cross the threshold and ``drift_alerts_total``
+   must increment.
+
+The parent scrapes ``/metrics`` while the child lingers and asserts the
+gauges/counters actually EXPOSED: >= 1 ``drift_score`` series over the
+threshold, ``drift_alerts_total`` >= 1, and canary series for both
+versions of the swap. Prints one JSON line; exit 0 = gate holds.
+Run: ``python scripts/drift_smoke.py``.
+"""
+
+import json
+import os
+import re
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CHILD = r"""
+import json, os, time
+import numpy as np
+
+from dask_ml_tpu import config, observability as obs
+from dask_ml_tpu.models.sgd import SGDClassifier
+from dask_ml_tpu.observability import drift
+from dask_ml_tpu.serving import BucketLadder, FleetServer
+
+rng = np.random.RandomState(0)
+X = rng.randn(40_000, 8).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.float32)
+y2 = (X[:, 1] > 0).astype(np.float32)   # a different concept: the
+                                        # canary must see disagreement
+with config.set(stream_block_rows=4096):
+    a = SGDClassifier(max_iter=2, random_state=0).fit(X, y)
+    b = SGDClassifier(max_iter=2, random_state=7).fit(X, y2)
+assert a.training_profile_ and a.training_profile_["n_features"] == 8, \
+    "streamed fit must attach a training profile"
+
+verdict = {"ok": False}
+threshold = config.get_config().obs_drift_threshold
+fleet = FleetServer(a, name="clf", replicas=1,
+                    ladder=BucketLadder(8, 128, 2.0),
+                    batch_window_ms=0.5, timeout_ms=0).warmup()
+with fleet:
+    before = obs.counters_snapshot().get("recompiles", 0)
+    # phase 1: control traffic from the training distribution (enough
+    # requests that the worker's ~20 folds/s rate gate still samples
+    # north of a thousand rows)
+    for i in range(150):
+        lo = (i * 60) % 30_000
+        fleet.predict(X[lo:lo + 50])
+    control = drift.compute()
+    ctl = [r["psi"] for r in control if r["pair"] == "train_serve"]
+    # phase 2: hot swap (shadow canary scores both versions)
+    swapped_to = fleet.publish(b)
+    # phase 3: mean-shifted traffic against the new version
+    for i in range(150):
+        lo = (i * 60) % 30_000
+        fleet.predict(X[lo:lo + 50] + 3.0)
+    shifted = drift.compute()
+    sh = [r["psi"] for r in shifted
+          if r["pair"] == "train_serve" and r["version"] == swapped_to]
+    snap = obs.counters_snapshot()
+    recompiles = snap.get("recompiles", 0) - before
+    alerts = snap.get("drift_alerts", 0)
+    canaries = drift.status_block()["canaries"]
+    try:
+        assert ctl and max(ctl) < threshold, \
+            f"control drift {max(ctl) if ctl else None} >= {threshold}"
+        assert sh and max(sh) > threshold, \
+            f"shifted drift {max(sh) if sh else None} <= {threshold}"
+        assert alerts >= 1, "no drift alert recorded"
+        assert recompiles == 0, \
+            f"{recompiles} post-warmup compiles (canary must be free)"
+        assert canaries and canaries[0]["version_from"] == 1 \
+            and canaries[0]["version_to"] == 2, canaries
+        verdict.update(ok=True, control_max_psi=round(max(ctl), 4),
+                       shifted_max_psi=round(max(sh), 3),
+                       alerts=int(alerts), recompiles=int(recompiles),
+                       canary_disagreement=canaries[0]["disagreement"])
+    except AssertionError as exc:
+        verdict["error"] = str(exc)
+    print("DRIFT_DONE " + json.dumps(verdict), flush=True)
+    # hold the exporter up so the parent's scrape cannot race the exit
+    time.sleep(float(os.environ.get("DRIFT_SMOKE_LINGER", "20")))
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get(url, timeout=2.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode()
+
+
+def main():
+    out = {"ok": False}
+    port = _free_port()
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "DASK_ML_TPU_OBS_HTTP_PORT": str(port),
+           # every served row shadows + a fast monitor cadence: the
+           # smoke must see the canary and the background scores
+           "DASK_ML_TPU_OBS_SHADOW_FRACTION": "1.0",
+           "DASK_ML_TPU_OBS_DRIFT_INTERVAL_S": "0.5"}
+    child = subprocess.Popen(
+        [sys.executable, "-c", CHILD], env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    base = f"http://127.0.0.1:{port}"
+    deadline = time.time() + 180
+    try:
+        # 1) the child's own verdict (control low / shifted high /
+        #    alert fired / zero compiles)
+        verdict = None
+        while time.time() < deadline:
+            line = child.stdout.readline()
+            if not line:
+                break
+            if line.startswith("DRIFT_DONE "):
+                verdict = json.loads(line[len("DRIFT_DONE "):])
+                break
+        if verdict is None:
+            if child.poll() is None:
+                child.kill()
+                child.wait(10)
+            raise RuntimeError("child ended without a DRIFT_DONE line: "
+                               + child.stderr.read()[-2000:])
+        if not verdict.get("ok"):
+            raise RuntimeError(f"drift gate failed in child: {verdict}")
+        out.update(verdict)
+        # 2) the quality plane is EXPOSED: drift gauges over threshold,
+        #    the alert counter, and canary series for both versions
+        _, text = _get(base + "/metrics")
+        scores = {}
+        for m in re.finditer(
+                r'^dask_ml_tpu_drift_score\{([^}]*)\} (\S+)$', text,
+                re.MULTILINE):
+            scores[m.group(1)] = float(m.group(2))
+        if not scores:
+            raise RuntimeError("no drift_score series on /metrics")
+        if max(scores.values()) <= 0.2:
+            raise RuntimeError(
+                f"no drift_score over threshold: {scores}"
+            )
+        m = re.search(r"^dask_ml_tpu_drift_alerts_total (\d+)", text,
+                      re.MULTILINE)
+        if not m or int(m.group(1)) < 1:
+            raise RuntimeError("drift_alerts_total missing or zero")
+        for version in ("1", "2"):
+            if not re.search(
+                    r'^dask_ml_tpu_canary_prediction_\w+\{[^}]*'
+                    rf'version="{version}"', text, re.MULTILINE):
+                raise RuntimeError(
+                    f"no canary series for version {version} on /metrics"
+                )
+        # 3) /status carries the drift block
+        _, body = _get(base + "/status")
+        doc = json.loads(body)
+        if not doc.get("drift", {}).get("scores"):
+            raise RuntimeError("/status has no drift scores block")
+        out.update(port=port, exposed_series=len(scores),
+                   alerts_total=int(m.group(1)))
+    except Exception as exc:
+        out["ok"] = False
+        out["error"] = f"{type(exc).__name__}: {exc}"
+    finally:
+        child.terminate()
+        try:
+            child.wait(10)
+        except Exception:
+            child.kill()
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -178,6 +178,13 @@ def main():
         for p in fleet_entry["replicas"]:
             assert "replica" in p and "version" in p \
                 and "queue_depth" in p, p
+        # the /status registry block: what is serving, without
+        # instrumenting application code (ISSUE 7 satellite)
+        reg = doc.get("registry", {})
+        assert "clf" in reg, f"/status registry block missing: {reg}"
+        entry = reg["clf"]
+        assert entry["current"] in entry["versions"], entry
+        assert entry.get("t_publish") and entry.get("publisher"), entry
         # 3) the child's own verdict: zero compiles, zero lost requests
         verdict = None
         while time.time() < deadline:

@@ -32,6 +32,24 @@ if [ -n "$bad" ]; then
 fi
 echo "lint OK: serving package imports nothing from tests/"
 
+# -- lint: every public config knob must be documented in README -------------
+# (the config table is the operator's contract; a knob that ships
+# undocumented is how obs_programs' extra-AOT-compile surprise happened)
+knobs=$(grep -E '^    [a-z][a-z0-9_]*: ' dask_ml_tpu/config.py \
+        | sed -E 's/^ +([a-z0-9_]+):.*/\1/')
+missing=""
+for k in $knobs; do
+    if ! grep -q "$k" README.md; then
+        missing="$missing $k"
+    fi
+done
+if [ -n "$missing" ]; then
+    echo "LINT FAIL: config knobs missing from the README config table:"
+    echo "   $missing"
+    exit 1
+fi
+echo "lint OK: every config.py knob is documented in README.md"
+
 if [ "${1:-}" = "--lint" ]; then
     exit 0
 fi
@@ -75,6 +93,16 @@ fi
 # lose no request across the swap, and show per-replica stats on /status.
 if ! timeout -k 10 300 python scripts/fleet_smoke.py; then
     echo "VERIFY FAIL: serving fleet gate (hot-swap / replicas / status)"
+    exit 1
+fi
+
+# -- drift gate (ISSUE 7): a subprocess fit + serve with an injected
+# mean-shifted request stream must push drift_score over threshold and
+# increment drift_alerts_total while an in-distribution control stream
+# stays below; a mid-run hot swap must publish canary series for both
+# versions — all with zero post-warmup compiles.
+if ! timeout -k 10 300 python scripts/drift_smoke.py; then
+    echo "VERIFY FAIL: drift gate (quality observability)"
     exit 1
 fi
 

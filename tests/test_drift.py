@@ -1,0 +1,508 @@
+"""Data & model-quality observability (ISSUE 7): streaming sketches,
+train-serve drift scores, hot-swap canary deltas, the live-plane label
+cardinality guard, and the report/export drift views."""
+
+import json
+import os
+import re
+import threading
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu import config, observability as obs
+from dask_ml_tpu.observability import drift, live
+from dask_ml_tpu.observability.sketch import (
+    CategoricalSketch,
+    FeatureSketch,
+    merge_profiles,
+    profile_from_dict,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    drift.reset()
+    live.metrics_reset()
+    yield
+    drift.reset()
+    live.metrics_reset()
+
+
+def _read_jsonl(path):
+    return [json.loads(line) for line in open(path)]
+
+
+# -- sketches ----------------------------------------------------------------
+
+def test_feature_sketch_moments_match_numpy():
+    rng = np.random.RandomState(0)
+    X = rng.randn(4000, 5) * [1, 10, 0.1, 100, 1] + [0, 5, -2, 0, 1e4]
+    sk = FeatureSketch(5)
+    for lo in range(0, 4000, 700):       # ragged chunked folds
+        sk.fold(X[lo:lo + 700])
+    st = sk.stats()
+    assert np.allclose(st["mean"], X.mean(axis=0), rtol=1e-12)
+    assert np.allclose(st["std"], X.std(axis=0, ddof=1), rtol=1e-12)
+    assert np.allclose(st["min"], X.min(axis=0))
+    assert np.allclose(st["max"], X.max(axis=0))
+    assert sk.rows == 4000
+
+
+def test_feature_sketch_fold_merge_equivalence():
+    rng = np.random.RandomState(1)
+    X = rng.randn(3000, 3)
+    whole = FeatureSketch(3)
+    whole.fold(X)
+    a, b = FeatureSketch(3), FeatureSketch(3)
+    a.fold(X[:1200])
+    b.fold(X[1200:])
+    a.merge(b)
+    assert np.array_equal(whole.counts(), a.counts())
+    sa, sw = a.stats(), whole.stats()
+    for k in ("mean", "std", "min", "max"):
+        assert np.allclose(sa[k], sw[k], rtol=1e-10), k
+    # snapshot round-trip rebuilds an identical sketch
+    again = profile_from_dict(whole.to_dict())
+    assert np.array_equal(again.counts(), whole.counts())
+
+
+def test_feature_sketch_quantiles_bucket_accurate():
+    rng = np.random.RandomState(2)
+    X = rng.randn(20000, 2)
+    sk = FeatureSketch(2)
+    sk.fold(X)
+    med = sk.quantile(0.5)
+    p90 = sk.quantile(0.9)
+    assert np.all(np.abs(med - np.median(X, axis=0)) < 0.3)
+    assert np.all(np.abs(p90 - np.quantile(X, 0.9, axis=0)) < 0.5)
+
+
+def test_feature_sketch_nonfinite_isolated():
+    X = np.array([[1.0, 2.0], [np.nan, 3.0], [np.inf, 4.0]])
+    sk = FeatureSketch(2)
+    sk.fold(X)
+    st = sk.stats()
+    assert st["n"][0] == 1 and st["n"][1] == 3   # non-finite excluded
+    assert st["mean"][0] == 1.0 and st["mean"][1] == 3.0
+    snap = sk.to_dict()
+    assert snap["nonfinite"] == 2
+    assert json.loads(json.dumps(snap))          # JSON-safe (inf-free)
+
+
+def test_feature_sketch_thread_safe_folds():
+    rng = np.random.RandomState(3)
+    X = rng.randn(8000, 4)
+    sk = FeatureSketch(4)
+    errs = []
+
+    def worker(part):
+        try:
+            for lo in range(0, len(part), 500):
+                sk.fold(part[lo:lo + 500])
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(X[i::4],))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert sk.rows == 8000
+    assert int(sk.counts().sum()) == 8000 * 4
+
+
+def test_merge_profiles_handles_none():
+    sk = FeatureSketch(2)
+    sk.fold(np.ones((10, 2)))
+    snap = sk.to_dict()
+    assert merge_profiles(None, snap) is snap
+    assert merge_profiles(snap, None) is snap
+    double = merge_profiles(snap, snap)
+    assert double["rows"] == 20
+
+
+def test_categorical_sketch_topk_bounded():
+    cat = CategoricalSketch(k=3)
+    vals = ["a"] * 50 + ["b"] * 30 + ["c"] * 10 + [f"x{i}" for i in range(20)]
+    cat.fold(np.asarray(vals, dtype=object))
+    top = cat.top(2)
+    assert top[0][0] == "a" and top[0][1] >= 50    # upper-bound counts
+    assert len(cat.to_dict()["counts"]) <= 3
+    assert cat.total == len(vals)
+
+
+# -- drift scores ------------------------------------------------------------
+
+def test_psi_identical_zero_shifted_large():
+    rng = np.random.RandomState(4)
+    a, b = FeatureSketch(1), FeatureSketch(1)
+    a.fold(rng.randn(20000, 1))
+    b.fold(rng.randn(20000, 1))
+    same = drift.psi_from_counts(a.counts()[0], b.counts()[0])
+    assert 0 <= same < 0.02
+    c = FeatureSketch(1)
+    c.fold(rng.randn(20000, 1) + 2.0)
+    shifted = drift.psi_from_counts(a.counts()[0], c.counts()[0])
+    assert shifted > 1.0
+    ks_same = drift.ks_from_counts(a.counts()[0], b.counts()[0])
+    ks_shift = drift.ks_from_counts(a.counts()[0], c.counts()[0])
+    assert ks_same < 0.05 < ks_shift
+    assert np.isnan(drift.psi_from_counts([0, 0], [1, 2]))
+
+
+def test_train_serve_scoring_and_alert_latch(tmp_path):
+    rng = np.random.RandomState(5)
+    base = FeatureSketch(3)
+    base.fold(rng.randn(30000, 3))
+    obs.counters_reset()
+    drift.note_training_profile("m", 1, base.to_dict())
+    drift.fold_serving("m", 1, "predict", rng.randn(2000, 3) + 3.0)
+    trace = str(tmp_path / "t")
+    with config.set(trace_dir=trace):
+        recs = drift.compute(publish=False)
+    ts = [r for r in recs if r["pair"] == "train_serve"]
+    assert ts and max(r["psi"] for r in ts) > 0.2
+    assert any(r["alert"] for r in ts)
+    alerts = obs.counters_snapshot().get("drift_alerts", 0)
+    assert alerts >= 1
+    # the latch: a second compute on the SAME state must not re-count
+    with config.set(trace_dir=trace):
+        drift.compute(publish=False)
+    assert obs.counters_snapshot().get("drift_alerts", 0) == alerts
+    # drift records landed in the trace sink with wall-clock stamps
+    recs_file = _read_jsonl(os.path.join(trace, "trace.jsonl"))
+    dr = [r for r in recs_file if r.get("drift")]
+    assert dr and all("t_unix" in r for r in dr)
+
+
+def test_window_vs_window_detects_mid_serve_shift():
+    rng = np.random.RandomState(6)
+    drift.fold_serving("m", 1, "predict", rng.randn(3000, 2))
+    drift.compute(publish=False)          # window cursor 1
+    drift.fold_serving("m", 1, "predict", rng.randn(3000, 2))
+    drift.compute(publish=False)          # window 1 vs cursor: control
+    drift.fold_serving("m", 1, "predict", rng.randn(3000, 2) + 3.0)
+    recs = drift.compute(publish=False)   # shifted window vs control
+    win = [r for r in recs if r["pair"] == "window"]
+    assert win and max(r["psi"] for r in win) > 0.2
+
+
+def test_serving_fold_rate_budget_bounds_rows():
+    rng = np.random.RandomState(7)
+    total = 0
+    for _ in range(50):
+        total += drift.fold_serving("m", 1, "predict",
+                                    rng.randn(4096, 2))
+    # the token bucket caps the folded sample (burst + a trickle),
+    # far below the 200k rows offered
+    assert 0 < total <= drift._FOLD_BURST_ROWS + 4096
+
+
+def test_canary_delta_and_gauges():
+    old = np.asarray([0.0] * 90 + [1.0] * 10)
+    new = np.asarray([0.0] * 50 + [1.0] * 50)
+    verdict = drift.canary_delta(old, new)
+    assert verdict["disagreement"] == pytest.approx(0.4)
+    obs.counters_reset()
+    with obs.TelemetryServer(port=0):
+        drift.record_canary("m", 1, 2, "predict", old, new)
+        page = live.render_prometheus()
+    assert re.search(r'canary_disagreement\{[^}]*from="1"[^}]*to="2"',
+                     page)
+    # per-version prediction series for BOTH sides of the flip
+    assert re.search(r'canary_prediction_p50\{[^}]*version="1"', page)
+    assert re.search(r'canary_prediction_p50\{[^}]*version="2"', page)
+    sb = drift.status_block()
+    assert sb["canaries"] and sb["canaries"][0]["version_to"] == 2
+
+
+# -- serving integration -----------------------------------------------------
+
+def _fit_pair():
+    from dask_ml_tpu.models.sgd import SGDClassifier
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(20000, 6).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    y2 = (X[:, 1] > 0).astype(np.float32)
+    with config.set(stream_block_rows=2048):
+        a = SGDClassifier(max_iter=2, random_state=0).fit(X, y)
+        b = SGDClassifier(max_iter=2, random_state=7).fit(X, y2)
+    return X, a, b
+
+
+def test_streamed_fit_attaches_training_profile():
+    X, a, _ = _fit_pair()
+    prof = a.training_profile_
+    assert prof["n_features"] == 6 and prof["rows"] > 0
+    st = profile_from_dict(prof).stats()
+    assert np.all(np.abs(st["mean"]) < 0.1)       # N(0,1) features
+    assert np.all(np.abs(st["std"] - 1.0) < 0.1)
+
+
+def test_glm_streamed_fit_attaches_training_profile():
+    from dask_ml_tpu.linear_model import LinearRegression
+
+    rng = np.random.RandomState(1)
+    X = rng.randn(4000, 4).astype(np.float32)
+    y = (X @ rng.randn(4)).astype(np.float32)
+    with config.set(stream_block_rows=512):
+        est = LinearRegression(solver="gradient_descent",
+                               max_iter=3).fit(X, y)
+    assert est.training_profile_["n_features"] == 4
+
+
+def test_profile_off_when_disabled():
+    from dask_ml_tpu.models.sgd import SGDClassifier
+
+    rng = np.random.RandomState(2)
+    X = rng.randn(4000, 3).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    with config.set(stream_block_rows=512, obs_drift=False):
+        est = SGDClassifier(max_iter=1, random_state=0).fit(X, y)
+    assert est.training_profile_ is None
+
+
+def test_incremental_wrapper_exposes_inner_profile():
+    from dask_ml_tpu.models.sgd import SGDClassifier
+    from dask_ml_tpu.wrappers import Incremental
+
+    rng = np.random.RandomState(3)
+    X = rng.randn(6000, 4).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    with config.set(stream_block_rows=1024):
+        inc = Incremental(SGDClassifier(random_state=0)).fit(
+            X, y, classes=[0.0, 1.0]
+        )
+    assert inc.training_profile_["n_features"] == 4
+    assert hasattr(inc, "training_profile_")
+
+
+def test_server_folds_traffic_and_scores_against_profile():
+    from dask_ml_tpu.serving import BucketLadder, ModelServer
+
+    X, a, _ = _fit_pair()
+    with config.set(obs_shadow_fraction=0.0, obs_drift_interval_s=0.0):
+        srv = ModelServer(a, methods=("predict",), name="clf",
+                          ladder=BucketLadder(8, 128, 2.0),
+                          batch_window_ms=0.5, timeout_ms=0).warmup()
+        with srv:
+            for i in range(40):
+                srv.predict(X[i * 64:(i + 1) * 64])
+    recs = drift.compute(publish=False)
+    ts = [r for r in recs if r["pair"] == "train_serve"]
+    assert ts, "server must fold traffic into serving sketches"
+    assert max(r["psi"] for r in ts) < 0.2        # in-distribution
+    entry = drift.serving_sketch("clf", 0, "predict")
+    assert entry["features"].rows > 0
+    assert entry["classes"] is not None           # predict outputs
+    assert entry["predictions"].rows > 0
+
+
+def test_hot_swap_canary_zero_compiles_and_per_version_series():
+    from dask_ml_tpu.serving import BucketLadder, ModelServer
+
+    X, a, b = _fit_pair()
+    obs.counters_reset()
+    with config.set(obs_shadow_fraction=1.0, obs_drift_interval_s=0.0):
+        srv = ModelServer(a, methods=("predict",), name="clf",
+                          ladder=BucketLadder(8, 128, 2.0),
+                          batch_window_ms=0.5, timeout_ms=0).warmup()
+        with srv:
+            for i in range(30):
+                srv.predict(X[i * 64:(i + 1) * 64])
+            before = obs.counters_snapshot().get("recompiles", 0)
+            srv.swap_model(b, version=2)
+            minted = obs.counters_snapshot().get("recompiles", 0) - before
+    assert minted == 0, "canary must ride warmed entry points"
+    sb = drift.status_block()
+    assert sb["canaries"], "swap must record a canary"
+    can = sb["canaries"][0]
+    assert can["version_from"] == 0 and can["version_to"] == 2
+    # a (hinge) concept change must disagree on the shadow sample
+    assert can["disagreement"] > 0.1
+    # both versions' training profiles registered for train-vs-serve
+    assert drift.training_profile("clf", 0)
+    assert drift.training_profile("clf", 2)
+
+
+def test_drift_monitor_lifecycle():
+    with config.set(obs_drift_interval_s=0.05):
+        t = drift.ensure_monitor()
+        assert t is not None and drift.monitor_active()
+        assert drift.ensure_monitor() is t        # idempotent
+    drift.stop_monitor()
+    assert not drift.monitor_active()
+    with config.set(obs_drift=False):
+        assert drift.ensure_monitor() is None
+
+
+# -- label-cardinality guard (live metric registry) ---------------------------
+
+def test_series_cap_drops_and_counts_overflow():
+    obs.counters_reset()
+    with config.set(obs_max_series=8):
+        for i in range(30):
+            live.gauge_set("capped_family", float(i),
+                           (("feature", f"f{i}"),))
+        labeled = [k for k in live.gauges_snapshot()
+                   if k[0] == "capped_family"]
+        assert len(labeled) == 8
+        dropped = obs.counters_snapshot().get(
+            "telemetry_series_dropped", 0)
+        assert dropped == 22
+        # existing series still update past the cap
+        live.gauge_set("capped_family", 99.0, (("feature", "f0"),))
+        assert live.gauges_snapshot()[("capped_family",
+                                       (("feature", "f0"),))] == 99.0
+        # unlabeled series are never capped
+        live.gauge_set("capped_family_total_view", 1.0)
+        # histograms: overflow keys get a working detached sink
+        for i in range(30):
+            live.histogram("capped_hist",
+                           (("feature", f"f{i}"),)).observe(0.01)
+        hs = [k for k in live.histograms_snapshot()
+              if k[0] == "capped_hist"]
+        assert len(hs) == 8
+
+
+def test_series_drop_counted_once_per_series():
+    """The drop counter counts dropped SERIES: a publisher re-setting
+    the same over-cap gauges every monitor tick must not inflate it."""
+    obs.counters_reset()
+    with config.set(obs_max_series=2):
+        for _ in range(5):                  # 5 publish ticks
+            for i in range(4):              # 4 series, cap 2
+                live.gauge_set("once_family", 1.0, (("f", str(i)),))
+    assert obs.counters_snapshot().get(
+        "telemetry_series_dropped", 0) == 2
+
+
+def test_version_eviction_bounds_registries_and_drops_series():
+    """serve_while_training publishes a version per pass: the drift
+    registries keep only the newest ``_VERSIONS_KEEP`` versions per
+    model, and an evicted version's per-version gauge series leave
+    /metrics (releasing their cardinality-cap slots)."""
+    rng = np.random.RandomState(3)
+    X = rng.randn(256, 4)
+    prof = FeatureSketch(4)
+    prof.fold(X)
+    for v in range(1, 10):
+        drift.note_training_profile("m", v, prof.to_dict())
+        assert drift.fold_serving("m", v, "predict", X) > 0
+        live.gauge_set(
+            "drift_score", 0.5,
+            (("model", "m"), ("version", str(v)), ("feature", "f0")),
+        )
+    keep = list(range(10 - drift._VERSIONS_KEEP, 10))
+    with drift._lock:
+        assert sorted({k[1] for k in drift._serving}) == keep
+        assert sorted({k[1] for k in drift._train}) == keep
+    live_versions = sorted(
+        int(dict(k[1])["version"]) for k in live.gauges_snapshot()
+        if k[0] == "drift_score"
+    )
+    assert live_versions == keep
+    # evicted versions' scores are gone from /status too
+    drift.compute(publish=False)
+    assert all(s["version"] in keep
+               for s in drift.status_block()["scores"])
+
+
+def test_exposition_parseable_at_cap():
+    with config.set(obs_max_series=8):
+        for i in range(40):
+            live.gauge_set("drift_score", 0.5,
+                           (("model", "m"), ("feature", f"f{i}")))
+            live.histogram("lat", (("b", str(i)),)).observe(0.001)
+        page = live.render_prometheus()
+    for line in page.rstrip("\n").split("\n"):
+        assert line.startswith("#") or re.match(
+            r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? "
+            r"(-?\d+(\.\d+)?([eE][+-]?\d+)?|[+-]Inf|NaN)$", line
+        ), f"bad exposition line: {line!r}"
+    assert len(re.findall(r"^dask_ml_tpu_drift_score\{", page,
+                          re.MULTILINE)) == 8
+
+
+# -- report / export / merge -------------------------------------------------
+
+def _drift_records():
+    return [
+        {"time": 0.1, "t_unix": 100.0, "drift": True,
+         "pair": "train_serve", "model": "clf", "version": 1,
+         "method": "predict", "feature": "f0", "psi": 0.31, "ks": 0.2,
+         "alert": True},
+        {"time": 0.2, "t_unix": 101.0, "drift": True,
+         "pair": "train_serve", "model": "clf", "version": 1,
+         "method": "predict", "feature": "f1", "psi": 0.01, "ks": 0.02,
+         "alert": False},
+        {"time": 0.3, "t_unix": 102.0, "drift": True, "pair": "canary",
+         "model": "clf", "version_from": 1, "version_to": 2,
+         "method": "predict", "n_rows": 128, "disagreement": 0.4,
+         "max_quantile_shift": 0.1, "alert": True},
+    ]
+
+
+def test_report_renders_drift_and_canary_tables():
+    from dask_ml_tpu.observability.report import build_report, report_data
+
+    recs = _drift_records()
+    out = build_report(recs)
+    assert "drift (train vs serve / window vs window)" in out
+    assert "canary (version vs version prediction deltas)" in out
+    assert "1->2" in out and "f0" in out
+    data = report_data(recs)
+    assert data["drift"]["scores"][0]["max_psi"] == 0.31
+    assert data["drift"]["scores"][0]["worst_feature"] == "f0"
+    assert data["drift"]["scores"][0]["alerts"] == 1
+    assert data["drift"]["canaries"][0]["versions"] == "1->2"
+
+
+def test_report_merge_keeps_drift_records_on_timeline():
+    from dask_ml_tpu.observability.report import merge_records
+
+    a = [{"time": 0.1, "t_unix": 100.0, "span": "fit", "span_id": 1,
+          "parent_id": None, "wall_s": 1.0},
+         {"time": 5.0, "t_unix": 105.0, "drift": True,
+          "pair": "train_serve", "model": "m", "version": 1,
+          "method": "predict", "feature": "f0", "psi": 0.5,
+          "alert": True}]
+    b = [{"time": 0.2, "t_unix": 102.0, "drift": True, "pair": "canary",
+          "model": "m", "version_from": 1, "version_to": 2,
+          "method": "predict", "disagreement": 0.1,
+          "max_quantile_shift": 0.0, "n_rows": 8, "alert": False}]
+    merged = merge_records([a, b])
+    stamps = [r["t_unix"] for r in merged]
+    assert stamps == sorted(stamps)
+    # the canary from file b interleaves BETWEEN file a's records
+    assert merged[1].get("pair") == "canary"
+
+
+def test_perfetto_export_lanes_drift_alert_instants():
+    from dask_ml_tpu.observability.export import to_chrome_trace
+
+    trace = to_chrome_trace(_drift_records())
+    instants = [e for e in trace["traceEvents"] if e.get("ph") == "i"]
+    names = [e["name"] for e in instants]
+    assert any("drift alert" in n for n in names)
+    assert any("canary alert" in n for n in names)
+    # quiet drift records stay off the timeline
+    assert len(instants) == 2
+
+
+# -- host-only contract -------------------------------------------------------
+
+def test_sketch_and_drift_never_import_jax():
+    """The zero-sync guarantee, structurally: the quality plane is host
+    numpy only — no jax import can ever appear in sketch.py/drift.py
+    (a device sync or traced callback is impossible by construction)."""
+    import dask_ml_tpu.observability.drift as dmod
+    import dask_ml_tpu.observability.sketch as smod
+
+    for mod in (smod, dmod):
+        src = open(mod.__file__).read()
+        assert "import jax" not in src, mod.__name__

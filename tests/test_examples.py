@@ -11,7 +11,7 @@ import sys
 import pytest
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_EXAMPLES = sorted(glob.glob(os.path.join(_REPO, "examples", "0*.py")))
+_EXAMPLES = sorted(glob.glob(os.path.join(_REPO, "examples", "[0-9]*.py")))
 
 
 def test_examples_exist():

@@ -347,6 +347,52 @@ def test_live_plane_adds_nothing_when_port_unset():
     live.metrics_reset()
 
 
+def test_drift_plane_adds_nothing_when_disabled():
+    """ISSUE 7 extension of the zero-overhead contract: with
+    ``obs_drift`` off, a streamed SGD fit allocates NO sketch, attaches
+    no profile, arms no monitor thread, registers nothing with the
+    drift engine — and the streamed scan kernel's jaxpr is
+    byte-identical (trivially guaranteed: the quality plane is host
+    numpy that never imports jax, but the assertion pins it)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dask_ml_tpu.models.sgd import SGDClassifier, _sgd_sb_scan
+    from dask_ml_tpu.observability import drift
+    from dask_ml_tpu.observability._programs import unwrap
+
+    def scan_jaxpr():
+        body = unwrap(_sgd_sb_scan)
+        K, S, d = 2, 8, 3
+        return str(jax.make_jaxpr(
+            lambda W, Xs, ys, c, lrs: body(
+                W, Xs, ys, c, lrs, 1e-4, 1.0, 0.0, 1.0, "hinge", None
+            )
+        )(jnp.zeros(d + 1), jnp.zeros((K, S, d)), jnp.zeros((K, S)),
+          jnp.zeros(K, jnp.int32), jnp.zeros(K)))
+
+    drift.reset()
+    baseline = scan_jaxpr()
+    rng = np.random.RandomState(0)
+    X = rng.randn(4096, 6).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    with config.set(stream_block_rows=512, obs_drift=False):
+        est = SGDClassifier(max_iter=2, random_state=0).fit(X, y)
+        assert est.training_profile_ is None
+        assert scan_jaxpr() == baseline
+    assert not drift.monitor_active()
+    assert drift.status_block() == {
+        "scores": [], "canaries": [], "serving_sketches": [],
+        "training_profiles": [],
+    }
+    # with the default (on), the profile is host-side only: the traced
+    # program STILL cannot change — sketch.py/drift.py never import jax
+    with config.set(stream_block_rows=512):
+        SGDClassifier(max_iter=1, random_state=0).fit(X, y)
+        assert scan_jaxpr() == baseline
+    drift.reset()
+
+
 def test_jit_callbacks_probe_resettable(monkeypatch):
     from dask_ml_tpu.observability import _metrics
 

@@ -1,0 +1,179 @@
+"""ModelRegistry eviction / rollback / notification coverage (ISSUE 7
+satellite): the archival eviction policy (`serving_registry_keep`) had
+no direct tests — keep bounds, the current-version guard, typed errors
+on rollback to an evicted version, and subscriber notification ordering
+under rapid publish."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu.serving.registry import (
+    ModelRegistry,
+    ModelVersion,
+    UnknownModelError,
+)
+
+
+class _Est:
+    """Minimal 'fitted estimator' stand-in (deep-copyable)."""
+
+    def __init__(self, tag):
+        self.tag = tag
+        self.coef_ = np.asarray([float(tag)])
+
+
+# -- eviction ----------------------------------------------------------------
+
+def test_keep_zero_rejected():
+    with pytest.raises(ValueError):
+        ModelRegistry(keep=0)
+    with pytest.raises(ValueError):
+        ModelRegistry(keep=-3)
+
+
+def test_keep_one_holds_only_current():
+    reg = ModelRegistry(keep=1)
+    for i in range(1, 4):
+        reg.publish("m", _Est(i))
+    assert reg.versions("m") == (3,)
+    assert reg.current_version("m") == 3
+    assert reg.get("m").estimator.tag == 3
+
+
+def test_keep_n_evicts_oldest_first():
+    reg = ModelRegistry(keep=3)
+    for i in range(1, 6):
+        reg.publish("m", _Est(i))
+    assert reg.versions("m") == (3, 4, 5)
+    # ids never reused: the next publish continues the sequence
+    assert reg.publish("m", _Est(6)) == 6
+    assert reg.versions("m") == (4, 5, 6)
+
+
+def test_current_version_never_evicted():
+    # make an OLD version current via rollback, then publish past the
+    # keep bound: eviction must step around the rolled-back current
+    # until the new publish re-points it
+    reg = ModelRegistry(keep=2)
+    for i in range(1, 4):
+        reg.publish("m", _Est(i))
+    assert reg.versions("m") == (2, 3)
+    reg.rollback("m")           # current -> v2
+    assert reg.current_version("m") == 2
+    reg.publish("m", _Est(4))   # current -> v4; keep=2 evicts oldest
+    assert reg.current_version("m") == 4
+    assert reg.current_version("m") in reg.versions("m")
+    assert reg.get("m").estimator.tag == 4
+
+
+def test_rollback_to_evicted_version_raises_typed():
+    reg = ModelRegistry(keep=2)
+    for i in range(1, 5):
+        reg.publish("m", _Est(i))
+    assert reg.versions("m") == (3, 4)
+    with pytest.raises(UnknownModelError):
+        reg.rollback("m", version=1)     # evicted
+    with pytest.raises(UnknownModelError):
+        reg.get("m", version=1)
+    with pytest.raises(UnknownModelError):
+        reg.rollback("nope")             # unknown name
+    # registry state untouched by the refusals
+    assert reg.current_version("m") == 4
+
+
+def test_rollback_default_steps_one_back_and_is_typed_at_floor():
+    reg = ModelRegistry(keep=4)
+    reg.publish("m", _Est(1))
+    with pytest.raises(UnknownModelError):
+        reg.rollback("m")                # nothing older than v1
+    reg.publish("m", _Est(2))
+    assert reg.rollback("m") == 1
+    assert reg.current_version("m") == 1
+
+
+# -- subscriber notification ordering ----------------------------------------
+
+def test_notifications_in_order_under_rapid_publish():
+    reg = ModelRegistry(keep=4)
+    seen = []
+    reg.subscribe("m", lambda mv: seen.append(mv.version))
+    for i in range(1, 21):
+        reg.publish("m", _Est(i))
+    assert seen == list(range(1, 21))
+    # rollback notifies too, with the re-pointed version
+    reg.rollback("m", version=19)
+    assert seen[-1] == 19
+
+
+def test_concurrent_publishers_deliver_every_version_once():
+    reg = ModelRegistry(keep=64)
+    seen = []
+    lock = threading.Lock()
+
+    def cb(mv):
+        with lock:
+            seen.append(mv.version)
+
+    reg.subscribe("m", cb)
+    n_threads, per = 4, 10
+
+    def publisher(t):
+        for _ in range(per):
+            reg.publish("m", _Est(t))
+
+    threads = [threading.Thread(target=publisher, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = n_threads * per
+    # every publish notified exactly once, version ids unique and dense
+    assert sorted(seen) == list(range(1, total + 1))
+    assert reg.current_version("m") in seen
+
+
+def test_late_subscriber_gets_current_immediately():
+    reg = ModelRegistry(keep=4)
+    reg.publish("m", _Est(1))
+    reg.publish("m", _Est(2))
+    seen = []
+    reg.subscribe("m", lambda mv: seen.append(mv.version))
+    assert seen == [2]
+
+
+# -- version metadata (publisher / profile / status snapshot) ----------------
+
+def test_version_carries_publisher_and_profile():
+    est = _Est(1)
+    est.training_profile_ = {"n_features": 1, "rows": 10}
+    reg = ModelRegistry(keep=4)
+    reg.publish("m", est, publisher="trainer-7", tag="nightly")
+    mv = reg.get("m")
+    assert mv.publisher == "trainer-7"
+    assert mv.tag == "nightly"
+    # the drift baseline is archived WITH the version
+    assert mv.profile == {"n_features": 1, "rows": 10}
+    # default publisher: the publishing thread's name
+    reg.publish("m", _Est(2))
+    assert reg.get("m").publisher == threading.current_thread().name
+
+
+def test_status_snapshot_shape():
+    reg = ModelRegistry(keep=2)
+    for i in range(1, 4):
+        reg.publish("a", _Est(i))
+    reg.publish("b", _Est(1), publisher="svc")
+    snap = reg.status_snapshot()
+    assert set(snap) == {"a", "b"}
+    assert snap["a"]["current"] == 3
+    assert snap["a"]["versions"] == [2, 3]
+    assert snap["b"]["publisher"] == "svc"
+    assert snap["a"]["t_publish"] is not None
+
+
+def test_model_version_repr():
+    mv = ModelVersion("m", 3, _Est(3), tag="x")
+    assert "v3" in repr(mv) and "'x'" in repr(mv)
