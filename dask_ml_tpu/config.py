@@ -18,8 +18,17 @@ import threading
 
 @dataclasses.dataclass
 class Config:
-    # default dtype for device estimators ("float32" | "bfloat16")
-    dtype: str = "float32"
+    # fit compute dtype for device estimators ("auto" | "float32" |
+    # "bfloat16"; "f32"/"fp32"/"bf16" are accepted aliases). "auto" —
+    # the default — resolves to bfloat16 on TPU (where the MXU runs
+    # bf16 at full rate and the bf16 fits are benched within the
+    # documented parity tolerances, tests/test_bf16_policy.py +
+    # tests/test_precision.py) and float32 everywhere else (CPU/GPU pay
+    # a software bf16 penalty); the resolved choice and the fallback
+    # reason are recorded in each fit's info (solver_info_ /
+    # fit_dtype_). Estimators expose a per-instance ``fit_dtype``
+    # override that wins over this knob.
+    dtype: str = "auto"
     # rows per streamed block in out-of-core paths (0 = auto: n/8)
     stream_block_rows: int = 0
     # prefetch depth of the block streamer (1 = double buffering)
@@ -43,6 +52,24 @@ class Config:
     # opt-out: False forces the per-block dispatch path everywhere even
     # for consumers that support the fused scan
     stream_superblock: bool = True
+    # zero-copy CPU staging: on a single-device XLA:CPU mesh, full
+    # dense 64-byte-aligned blocks import into the runtime as ALIASES
+    # of the host memory (dlpack) instead of device_put copies — the
+    # staging memcpy that competes with the consumer's compute on small
+    # hosts disappears (the streamed hot loop reads X straight from the
+    # source/page cache). Safe because streamed data blocks are only
+    # ever READ (never donated) and source arrays outlive the stream;
+    # disable if the input array is mutated while a fit is running
+    stream_zero_copy: bool = True
+    # fused Pallas streamed kernels (ops/pallas_fused.py): on real TPU
+    # the super-block hot loops (SGD step, GLM val/vg/vgh reducers,
+    # KMeans assign-stats) run fused objective+gradient kernels — one
+    # VMEM pass over each block instead of separate forward/backward
+    # reads. Off-TPU (or when shapes don't fit the VMEM tile budget /
+    # the 128-row Mosaic grid) the XLA flavors run unchanged: with the
+    # knob off the streamed jaxprs are byte-identical to the
+    # pre-feature programs (asserted in tests)
+    pallas_stream: bool = True
     # persistent XLA compilation cache directory ("" = off): repeated
     # runs skip warm-up compiles for programs whose shapes/backends
     # match a cached entry (applies process-wide on first streamed fit
@@ -149,6 +176,15 @@ class Config:
     # versions a ModelRegistry keeps per model name for rollback (the
     # current version is never evicted)
     serving_registry_keep: int = 8
+    # extra serving entry-point flavors to PRE-BUILD and warm alongside
+    # the float32 ones (comma/space separated; only "int8" today).
+    # ModelServer.warmup() then compiles BOTH flavors' (method, bucket)
+    # grids, so a registry publish flagged quantize="int8" (and the
+    # rollback to f32) hot-swaps with ZERO new XLA compiles — the
+    # two-phase swap contract extended to precision flavors. Unlisted
+    # flavors swap via rebuild_model (fresh compiles off the serving
+    # path) instead
+    serving_warm_flavors: str = ""
 
 
 _ENV_PREFIX = "DASK_ML_TPU_"
@@ -176,24 +212,68 @@ def _from_env() -> Config:
     return cfg
 
 
-def mxu_dtype():
-    """The matmul compute dtype the current config asks for, or None for
-    plain f32 — the ONE mapping from ``config.dtype`` to the kernels'
-    ``mxu_dtype``/cast arguments (KMeans distances, PCA Gram, SGD epoch
-    grids, GLM design matrices). Unknown dtype strings raise — a typo
-    ("bf16") silently training f32 would corrupt every precision and
-    benchmark expectation downstream."""
-    dt = get_config().dtype
+# accepted config.dtype spellings -> canonical names; the error message
+# below enumerates them so a typo is a one-line fix, not a spelunk
+_DTYPE_ALIASES = {
+    "auto": "auto",
+    "float32": "float32", "f32": "float32", "fp32": "float32",
+    "bfloat16": "bfloat16", "bf16": "bfloat16",
+}
+
+
+def normalize_dtype(dt: str) -> str:
+    """Canonical dtype name for a config/estimator dtype string.
+    Unknown spellings raise — a typo silently training f32 would
+    corrupt every precision and benchmark expectation downstream."""
+    canon = _DTYPE_ALIASES.get(str(dt).strip().lower())
+    if canon is None:
+        raise ValueError(
+            f"dtype={dt!r} is not supported; accepted spellings: "
+            "'auto', 'float32' (aliases 'f32', 'fp32'), "
+            "'bfloat16' (alias 'bf16')"
+        )
+    return canon
+
+
+def resolve_dtype(override=None) -> tuple[str, str]:
+    """(resolved canonical dtype, why) for a fit: the per-estimator
+    ``override`` wins over ``config.dtype``; "auto" resolves to
+    bfloat16 on real TPU (benched parity, MXU-rate bf16) and float32
+    everywhere else — the automatic f32 fallback the fit info
+    records."""
+    src = "estimator" if override is not None else "config"
+    dt = normalize_dtype(override if override is not None
+                         else get_config().dtype)
+    if dt != "auto":
+        return dt, src
+    import jax
+
+    if jax.default_backend() == "tpu":
+        return "bfloat16", "auto:tpu"
+    return "float32", f"auto:{jax.default_backend()}-fallback"
+
+
+def mxu_dtype(override=None):
+    """The matmul compute dtype the current config (or the estimator's
+    ``fit_dtype`` override) asks for, or None for plain f32 — the ONE
+    mapping from ``config.dtype`` to the kernels' ``mxu_dtype``/cast
+    arguments (KMeans distances, PCA Gram, SGD epoch grids, GLM design
+    matrices)."""
+    dt, _ = resolve_dtype(override)
     if dt == "bfloat16":
         import jax.numpy as jnp
 
         return jnp.bfloat16
-    if dt in ("float32", "f32"):
-        return None
-    raise ValueError(
-        f"config.dtype={dt!r} is not supported; use 'float32' or "
-        "'bfloat16'"
-    )
+    return None
+
+
+def fit_dtype_info(override=None) -> dict:
+    """The resolved fit compute dtype as fit-info fields: estimators
+    merge this into ``solver_info_`` / expose it as ``fit_dtype_`` so
+    an automatic f32 fallback (auto policy off-TPU) is on record, not
+    silent."""
+    dt, src = resolve_dtype(override)
+    return {"fit_dtype": dt, "fit_dtype_source": src}
 
 
 _compile_cache_applied: str | None = None

@@ -113,7 +113,7 @@ class _GLMBase(BaseEstimator):
                  fit_intercept=True, intercept_scaling=1.0, class_weight=None,
                  random_state=None, solver="admm", max_iter=100,
                  multi_class="ovr", verbose=0, warm_start=False, n_jobs=1,
-                 solver_kwargs=None):
+                 solver_kwargs=None, fit_dtype=None):
         self.penalty = penalty
         self.dual = dual
         self.tol = tol
@@ -129,6 +129,11 @@ class _GLMBase(BaseEstimator):
         self.warm_start = warm_start
         self.n_jobs = n_jobs
         self.solver_kwargs = solver_kwargs
+        # per-estimator precision override: None follows config.dtype
+        # ("auto" = bf16 on TPU for the smooth solvers, f32 elsewhere);
+        # "float32" opts out, "bfloat16" forces on. Resolved choice is
+        # recorded as fit_dtype_ and in solver_info_ for streamed fits.
+        self.fit_dtype = fit_dtype
 
     # -- internals --------------------------------------------------------
     def _encode_y_host(self, y):
@@ -178,9 +183,18 @@ class _GLMBase(BaseEstimator):
                               if isinstance(v, (int, float))})
         B = np.asarray(B, np.float64)
         per_cand = info.get("n_iter_per_candidate")
+        # the C-grid design was prepared under the same rule as the
+        # plain lbfgs fit (to_bf16 = resolved mxu dtype; the fast path
+        # is lbfgs-only) — every fitted clone records the precision it
+        # actually trained at
+        from ..config import mxu_dtype as _mxu
+
+        dt_label = "bfloat16" if _mxu(self.fit_dtype) is not None \
+            else "float32"
         fitted = []
         for i, c in enumerate(Cs):
             est = clone(self).set_params(C=c)
+            est.fit_dtype_ = dt_label
             # the stacked solve shares one iteration budget; publish
             # each clone's OWN convergence point (last iteration its
             # per-block gradient norm exceeded tol) as its n_iter_ —
@@ -240,6 +254,8 @@ class _GLMBase(BaseEstimator):
         self._set_coef(coef, classes)
         self.n_iter_ = info.get("n_iter")
         self.solver_info_ = info
+        if "fit_dtype" in info:  # streamed fits resolve it in the solver
+            self.fit_dtype_ = info["fit_dtype"]
         self.n_features_in_ = n_features
         return self
 
@@ -291,7 +307,8 @@ class _GLMBase(BaseEstimator):
                     self.solver, stream, n, B0, self.family, self.penalty,
                     lam, pmask, l1_ratio=l1_ratio,
                     intercept=self.fit_intercept, max_iter=self.max_iter,
-                    tol=self.tol, logger=logger, reduce=reduce, **kwargs,
+                    tol=self.tol, logger=logger, reduce=reduce,
+                    fit_dtype=self.fit_dtype, **kwargs,
                 )
                 sp.add(n_iter=info.get("n_iter"),
                        data_passes=info.get("data_passes"))
@@ -306,7 +323,7 @@ class _GLMBase(BaseEstimator):
                 self.solver, stream, n, beta0, self.family, self.penalty,
                 lam, pmask, l1_ratio=l1_ratio, intercept=self.fit_intercept,
                 max_iter=self.max_iter, tol=self.tol, logger=logger,
-                reduce=reduce, **kwargs,
+                reduce=reduce, fit_dtype=self.fit_dtype, **kwargs,
             )
             sp.add(n_iter=info.get("n_iter"),
                    data_passes=info.get("data_passes"))
@@ -338,7 +355,7 @@ class _GLMBase(BaseEstimator):
         mask = X.row_mask(dtype=jnp.float32)
         data, y_data, packed = _prepare_fit(
             X.data, y.data, mask, fit_intercept=self.fit_intercept,
-            to_bf16=mxu_dtype() is not None,
+            to_bf16=mxu_dtype(self.fit_dtype) is not None,
             encode=self.family == "logistic",
         )
         if self.family == "poisson":
@@ -389,9 +406,13 @@ class _GLMBase(BaseEstimator):
         # silently upcast (no speedup) and bf16 Hessians risk conditioning
         from ..config import mxu_dtype
 
-        use_bf16 = mxu_dtype() is not None and self.solver in (
+        use_bf16 = mxu_dtype(self.fit_dtype) is not None and self.solver in (
             "lbfgs", "gradient_descent", "proximal_grad"
         )
+        # resolved precision on record: the auto policy's f32 fallback
+        # (off-TPU, or a solver whose Hessian math excludes bf16) must
+        # be visible, not silent
+        self.fit_dtype_ = "bfloat16" if use_bf16 else "float32"
         mask = X.row_mask(dtype=jnp.float32)
         data, y_data, packed = _prepare_fit(
             X.data, y.data, mask, fit_intercept=self.fit_intercept,
@@ -615,6 +636,8 @@ class LogisticRegression(_GLMBase):
         self.classes_ = classes
         self.n_iter_ = info.get("n_iter")
         self.solver_info_ = info
+        if "fit_dtype" in info:  # streamed fits resolve it in the solver
+            self.fit_dtype_ = info["fit_dtype"]
         self.n_features_in_ = n_features
         return self
 

@@ -232,6 +232,40 @@ def _sb_assign_stats(acc, Xs, counts, centers, mxu_dtype=None):
     return acc
 
 
+@track_program("pallas.kmeans_stream")
+@partial(jax.jit, static_argnames=("mxu_dtype", "interpret"),
+        donate_argnums=(0,))
+def _sb_assign_stats_pallas(acc, Xs, counts, centers, mxu_dtype=None,
+                            interpret=False):
+    """Pallas flavor of :func:`_sb_assign_stats` (ISSUE 8): each scan
+    step is the fused assign-and-accumulate kernel — X streams through
+    VMEM ONCE per block (the XLA flavor reads it twice: distance matmul
+    + segment_sum) and only (tile, k) distances ever materialize.
+    Selected by ``_streamed_lloyd`` on real TPU when the block shape
+    fits ``kmeans_stream_tile``; parity within float tolerance
+    (tests/test_precision.py)."""
+    from ..ops.pallas_fused import fused_kmeans_block_stats
+
+    unrolled = isinstance(Xs, (tuple, list))
+
+    def step(acc, X, c):
+        s, cnt, i = fused_kmeans_block_stats(
+            X, c, centers, mxu=mxu_dtype, interpret=interpret
+        )
+        return (acc[0] + s, acc[1] + cnt, acc[2] + i)
+
+    if unrolled:
+        for j in range(len(Xs)):
+            acc = step(acc, Xs[j], counts[j])
+        return acc
+
+    def scan_step(acc, inp):
+        return step(acc, *inp), jnp.float32(0.0)
+
+    acc, _ = jax.lax.scan(scan_step, acc, (Xs, counts))
+    return acc
+
+
 @partial(jax.jit, static_argnames=("l",))
 def _block_weighted_topl(X, weights, key, l):
     """Per-block Gumbel top-l: (keys, rows). Global weighted sampling
@@ -341,19 +375,32 @@ class _LloydCheckpoint:
 
 
 def _streamed_lloyd(stream, centers0, max_iter, tol2, logger=None,
-                    ckpt=None, start_it=0):
+                    ckpt=None, start_it=0, fit_dtype=None):
     """Host-loop Lloyd over streamed blocks; ``ckpt`` (a
     _LloydCheckpoint) persists every k passes so a killed multi-hour fit
     resumes mid-run, and clears on completion."""
     from ..config import mxu_dtype
     from ..parallel import distributed as dist
 
-    mxu = mxu_dtype()
+    mxu = mxu_dtype(fit_dtype)
     multi = dist.process_count() > 1
     centers = jnp.asarray(centers0)
     n_iter = start_it
     use_sb = hasattr(stream, "use_superblocks") and stream.use_superblocks()
     from ..observability import record_superblock_donation
+
+    # fused Pallas scan flavor (one VMEM pass per block) on real TPU
+    # when the block shape fits its grid — else the XLA flavor, which
+    # with mxu=None traces byte-identically to the pre-feature program
+    from ..ops.pallas_fused import kmeans_stream_tile, use_stream_kernels
+
+    k0, d0 = jnp.asarray(centers0).shape
+    fused = bool(
+        use_sb and use_stream_kernels()
+        and kmeans_stream_tile(int(stream.block_rows), int(d0),
+                               int(k0)) is not None
+    )
+    sb_run = _sb_assign_stats_pallas if fused else _sb_assign_stats
 
     for it in range(start_it, int(max_iter)):
         if use_sb:
@@ -365,8 +412,8 @@ def _streamed_lloyd(stream, centers0, max_iter, tol2, logger=None,
                    jnp.zeros((), jnp.float32))
             acc_bytes = 4 * (k_clusters * d + k_clusters + 1)
             for sb in stream.superblocks():
-                acc = _sb_assign_stats(acc, sb.arrays[0], sb.counts,
-                                       centers, mxu_dtype=mxu)
+                acc = sb_run(acc, sb.arrays[0], sb.counts,
+                             centers, mxu_dtype=mxu)
                 record_superblock_donation(acc_bytes)
             sums, counts, inertia = acc
         else:
@@ -560,7 +607,7 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                  max_iter=300, tol=1e-4, precompute_distances="auto",
                  random_state=None, copy_x=True, n_jobs=1, algorithm="full",
                  init_max_iter=None, use_pallas=None, checkpoint_path=None,
-                 checkpoint_every=0):
+                 checkpoint_every=0, fit_dtype=None):
         self.n_clusters = n_clusters
         self.init = init
         self.oversampling_factor = oversampling_factor
@@ -575,6 +622,10 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         self.use_pallas = use_pallas
         self.checkpoint_path = checkpoint_path
         self.checkpoint_every = checkpoint_every
+        # per-estimator precision override (None = config.dtype policy;
+        # "float32" opts out of the TPU bf16 default, "bfloat16" forces
+        # it); resolved choice lands on fit_dtype_
+        self.fit_dtype = fit_dtype
 
     def _init_centers(self, X: ShardedArray):
         if isinstance(self.init, np.ndarray) or isinstance(
@@ -673,8 +724,11 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         from ..observability import fit_logger
 
         n_local, d = X.shape
+        from ..config import fit_dtype_info
         from ..parallel import distributed as dist
 
+        # resolved precision on record (auto falls back to f32 off-TPU)
+        self.fit_dtype_ = fit_dtype_info(self.fit_dtype)["fit_dtype"]
         multi = dist.process_count() > 1
         # multi-host: X is the process-local memmap shard; every global
         # statistic (n, variance, Lloyd stats, inertia, the k-means||
@@ -720,7 +774,7 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                            n_clusters=self.n_clusters) as logger:
             centers, n_iter = _streamed_lloyd(
                 stream, centers0, self.max_iter, tol2, logger=logger,
-                ckpt=ckpt, start_it=start_it,
+                ckpt=ckpt, start_it=start_it, fit_dtype=self.fit_dtype,
             )
             sp.add(n_iter=int(n_iter))
         labels = np.empty(n_local, np.int32)  # labels stay process-local
@@ -765,16 +819,22 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         # sklearn-style tol scaling: tol * mean per-feature variance
         _, var = masked_mean_var(X.data, mask, X.n_rows)
         tol2 = jnp.asarray(self.tol, X.dtype) * jnp.mean(var)
-        from ..config import mxu_dtype as _mxu_dtype
+        from ..config import fit_dtype_info, mxu_dtype as _mxu_dtype
 
-        mxu = _mxu_dtype()
+        dt_info = fit_dtype_info(self.fit_dtype)
+        auto_pol = dt_info["fit_dtype_source"].startswith("auto")
+        mxu = _mxu_dtype(self.fit_dtype)
         use_pallas = self.use_pallas
         if use_pallas is None:
-            # auto: fused kernel on real TPU only — unless the user
-            # asked for bf16, which only the XLA distance path honors
-            # (the Pallas kernel's VMEM tiling is f32)
-            use_pallas = jax.default_backend() == "tpu" and mxu is None
-        elif use_pallas and mxu is not None:
+            # auto: fused kernel on real TPU only — an EXPLICIT bf16
+            # request routes to the XLA distance path instead (the
+            # resident Pallas kernel's VMEM tiling is f32); under the
+            # default "auto" policy the f32 Pallas kernel keeps
+            # priority — one X pass per Lloyd iteration beats a bf16
+            # cross-term at this arithmetic intensity
+            use_pallas = jax.default_backend() == "tpu" \
+                and (mxu is None or auto_pol)
+        elif use_pallas and mxu is not None and not auto_pol:
             import warnings
 
             warnings.warn(
@@ -782,6 +842,11 @@ class KMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                 "config.dtype='bfloat16' is ignored on this path",
                 RuntimeWarning,
             )
+        if use_pallas and mxu is not None:
+            mxu = None
+            dt_info = {"fit_dtype": "float32",
+                       "fit_dtype_source": "pallas-resident"}
+        self.fit_dtype_ = dt_info["fit_dtype"]
         from ..observability import (
             active_logger, fit_logger, jit_callbacks_supported,
         )

@@ -73,7 +73,7 @@ class PCA(TransformerMixin, BaseEstimator):
 
     def __init__(self, n_components=None, copy=True, whiten=False,
                  svd_solver="auto", tol=0.0, iterated_power=0,
-                 random_state=None):
+                 random_state=None, fit_dtype=None):
         self.n_components = n_components
         self.copy = copy
         self.whiten = whiten
@@ -81,6 +81,10 @@ class PCA(TransformerMixin, BaseEstimator):
         self.tol = tol
         self.iterated_power = iterated_power
         self.random_state = random_state
+        # per-estimator precision override (None = config.dtype policy;
+        # "float32" opts the streamed Gram out of the TPU bf16 default,
+        # "bfloat16" forces it); resolved choice lands on fit_dtype_
+        self.fit_dtype = fit_dtype
 
     def _solver(self, k, n, d):
         if self.svd_solver == "auto":
@@ -145,9 +149,13 @@ class PCA(TransformerMixin, BaseEstimator):
         else:
             shift = head.mean(axis=0)
         shift_dev = jnp.asarray(shift, jnp.float32)
-        from ..config import mxu_dtype
+        from ..config import fit_dtype_info, mxu_dtype
 
-        mxu = mxu_dtype()
+        mxu = mxu_dtype(getattr(self, "fit_dtype", None))
+        # resolved precision on record (auto falls back to f32 off-TPU)
+        self.fit_dtype_ = fit_dtype_info(
+            getattr(self, "fit_dtype", None)
+        )["fit_dtype"]
         s = np.zeros(d, np.float64)
         g = np.zeros((d, d), np.float64)
         for blk in stream:

@@ -41,11 +41,13 @@ _PENALTIES = ("l2", "l1", "elasticnet", None, "none")
 
 
 def _sgd_update_one(w, y, X, mask, n_valid, lr, alpha, l2w, l1w, iflag,
-                    loss):
+                    loss, mxu=None):
     """One minibatch-GD(+prox) update of one weight vector — the SINGLE
     definition of the objective and update shared by the model-batched
     and class-batched kernels (a divergence between them would silently
-    split binary and multiclass semantics)."""
+    split binary and multiclass semantics). ``mxu`` (static dtype, e.g.
+    bf16 under config.dtype="auto" on TPU) casts ONLY the eta matvec's
+    operands; with None the trace is unchanged."""
 
     def objective(w):
         # iflag=0 zeroes the intercept's contribution to eta, so grad[-1]
@@ -53,7 +55,8 @@ def _sgd_update_one(w, y, X, mask, n_valid, lr, alpha, l2w, l1w, iflag,
         # The matvec runs at X's dtype with f32 accumulation — a bf16
         # block (config.dtype="bfloat16" epoch grids) rides the MXU at
         # bf16 rate; for f32 X this is exactly `X @ w[:-1]`
-        eta = jnp.matmul(X, w[:-1].astype(X.dtype),
+        Xd = X if mxu is None else X.astype(mxu)
+        eta = jnp.matmul(Xd, w[:-1].astype(Xd.dtype),
                          preferred_element_type=jnp.float32) \
             + w[-1] * iflag
         if loss == "log_loss":
@@ -76,9 +79,9 @@ def _sgd_update_one(w, y, X, mask, n_valid, lr, alpha, l2w, l1w, iflag,
 
 
 @track_program("sgd.step_many")
-@partial(jax.jit, static_argnames=("loss",))
+@partial(jax.jit, static_argnames=("loss", "mxu"))
 def _sgd_step_many(X, y, mask, n_valid, W, lrs, alphas, l2_ws, l1_ws,
-                   int_flags, loss):
+                   int_flags, loss, mxu=None):
     """Advance N models one step in one program. W: (N, d+1) stacked
     weights (last column = intercept). X/y/mask are SHARED across models
     — the block is read once; lr/alpha/penalty weights/intercept flag
@@ -86,7 +89,7 @@ def _sgd_step_many(X, y, mask, n_valid, W, lrs, alphas, l2_ws, l1_ws,
 
     def one(w, lr, alpha, l2w, l1w, iflag):
         return _sgd_update_one(w, y, X, mask, n_valid, lr, alpha, l2w,
-                               l1w, iflag, loss)
+                               l1w, iflag, loss, mxu=mxu)
 
     return jax.vmap(one, in_axes=(0, 0, 0, 0, 0, 0))(
         W, lrs, alphas, l2_ws, l1_ws, int_flags
@@ -94,9 +97,9 @@ def _sgd_step_many(X, y, mask, n_valid, W, lrs, alphas, l2_ws, l1_ws,
 
 
 @track_program("sgd.step_multi")
-@partial(jax.jit, static_argnames=("loss",))
+@partial(jax.jit, static_argnames=("loss", "mxu"))
 def _sgd_step_multi(X, y_codes, mask, n_valid, W, lr, alpha, l2w, l1w,
-                    iflag, loss):
+                    iflag, loss, mxu=None):
     """Advance the C one-vs-rest problems of ONE multiclass model in one
     program. W: (C, d+1); ``y_codes`` holds class INDICES 0..C-1 (mapped
     at encode time — float32 equality on raw labels would collapse
@@ -106,15 +109,16 @@ def _sgd_step_multi(X, y_codes, mask, n_valid, W, lr, alpha, l2w, l1w,
     def one(w, c):
         y = (y_codes == c).astype(jnp.float32)
         return _sgd_update_one(w, y, X, mask, n_valid, lr, alpha, l2w,
-                               l1w, iflag, loss)
+                               l1w, iflag, loss, mxu=mxu)
 
     return jax.vmap(one)(W, jnp.arange(W.shape[0], dtype=jnp.float32))
 
 
 @track_program("superblock.sgd_scan")
-@partial(jax.jit, static_argnames=("loss", "n_out"), donate_argnums=(0,))
+@partial(jax.jit, static_argnames=("loss", "n_out", "mxu"),
+         donate_argnums=(0,))
 def _sgd_sb_scan(W, Xs, ys, counts, lrs, alpha, l2w, l1w, iflag, loss,
-                 n_out):
+                 n_out, mxu=None):
     """K streamed-block minibatch steps as ONE scan program over a
     super-block stack (ISSUE 3): ``Xs (K, S, d)`` / ``ys (K, S)`` /
     ``counts (K,)`` valid-row counts; the weight carry ``W`` is DONATED
@@ -139,7 +143,7 @@ def _sgd_sb_scan(W, Xs, ys, counts, lrs, alpha, l2w, l1w, iflag, loss,
             def one(w, cc):
                 yy = (yb == cc).astype(jnp.float32)
                 return _sgd_update_one(w, yy, Xb, mask, nv, lr, alpha,
-                                       l2w, l1w, iflag, loss)
+                                       l2w, l1w, iflag, loss, mxu=mxu)
 
             W2, losses = jax.vmap(one)(
                 W, jnp.arange(n_out, dtype=jnp.float32)
@@ -147,7 +151,58 @@ def _sgd_sb_scan(W, Xs, ys, counts, lrs, alpha, l2w, l1w, iflag, loss,
             loss_v = losses.sum()
         else:
             W2, loss_v = _sgd_update_one(W, yb, Xb, mask, nv, lr, alpha,
-                                         l2w, l1w, iflag, loss)
+                                         l2w, l1w, iflag, loss, mxu=mxu)
+        return jnp.where(c > 0, W2, W), loss_v
+
+    if unrolled:
+        losses = []
+        for j in range(len(Xs)):
+            W, loss_v = step(W, Xs[j], ys[j], counts[j], lrs[j])
+            losses.append(loss_v)
+        return W, jnp.stack(losses)
+
+    def scan_step(W, inp):
+        Xb, yb, c, lr = inp
+        return step(W, Xb, yb, c, lr)
+
+    return jax.lax.scan(scan_step, W, (Xs, ys, counts, lrs))
+
+
+@track_program("pallas.sgd_step")
+@partial(jax.jit, static_argnames=("loss", "mxu", "interpret"),
+         donate_argnums=(0,))
+def _sgd_sb_scan_pallas(W, Xs, ys, counts, lrs, alpha, l2w, l1w, iflag,
+                        loss, mxu=None, interpret=False):
+    """Pallas flavor of :func:`_sgd_sb_scan` (ISSUE 8 tentpole) for the
+    flat-weight case (binary / regression; multiclass keeps the XLA
+    scan): each block step is ONE fused VMEM pass — the
+    ``fused_sgd_block_grad`` kernel returns the objective and gradient
+    sums from a single X read where the XLA step reads X twice
+    (forward matvec + autodiff backward) — followed by the identical
+    O(d) lr/l2/prox epilogue in XLA. Selected by ``_SGDBase._sb_step``
+    only on real TPU with ``config.pallas_stream`` on and block shapes
+    satisfying ``sgd_stream_tile``; numerically within float tolerance
+    of the XLA flavor (tests/test_precision.py)."""
+    from ..ops.pallas_fused import fused_sgd_block_grad
+
+    unrolled = isinstance(Xs, (tuple, list))
+
+    def step(W, Xb, yb, c, lr):
+        nv = jnp.maximum(c.astype(jnp.float32), 1.0)
+        loss_sum, grad = fused_sgd_block_grad(
+            Xb, c, yb, W, iflag, loss, mxu=mxu, interpret=interpret
+        )
+        # the exact `_sgd_update_one` epilogue on the kernel's raw sums
+        loss_v = loss_sum / nv + 0.5 * alpha * l2w * jnp.sum(W[:-1] ** 2)
+        g = grad / nv
+        g = g.at[:-1].add(alpha * l2w * W[:-1])
+        g = g.at[-1].mul(iflag)
+        W2 = W - lr * g
+        thr = lr * alpha * l1w
+        coef = jnp.sign(W2[:-1]) * jnp.maximum(
+            jnp.abs(W2[:-1]) - thr, 0.0
+        )
+        W2 = W2.at[:-1].set(coef)
         return jnp.where(c > 0, W2, W), loss_v
 
     if unrolled:
@@ -216,9 +271,9 @@ def _sgd_epoch(Xr, yr, order, W, t0, eta0, power_t, alpha, l2w, l1w,
 
 
 @track_program("sgd.cohort_scan")
-@partial(jax.jit, static_argnames=("loss",))
+@partial(jax.jit, static_argnames=("loss", "mxu"))
 def _sgd_cohort_scan(Xr, yr, NV, order, W, LRS, alphas, l2ws, l1ws,
-                     iflags, loss):
+                     iflags, loss, mxu=None):
     """Advance N cohort models through S block steps in ONE program:
     ``lax.scan`` over ``order`` (indices into the DEDUPLICATED block
     stack Xr (B, bs, d) — a rung asking for several epochs revisits
@@ -241,7 +296,7 @@ def _sgd_cohort_scan(Xr, yr, NV, order, W, LRS, alphas, l2ws, l1ws,
 
         def one(w, lr, a, l2w, l1w, ifl):
             return _sgd_update_one(w, yb, Xb, m, n_valid, lr, a, l2w,
-                                   l1w, ifl, loss)
+                                   l1w, ifl, loss, mxu=mxu)
 
         W2, losses = jax.vmap(one, in_axes=(0, 0, 0, 0, 0, 0))(
             W, lrs, alphas, l2ws, l1ws, iflags
@@ -332,8 +387,13 @@ class _SGDBase(BaseEstimator):
     def __init__(self, loss=None, penalty="l2", alpha=1e-4, l1_ratio=0.15,
                  eta0=0.01, learning_rate="invscaling", power_t=0.25,
                  max_iter=5, tol=1e-3, shuffle=True, random_state=None,
-                 warm_start=False, fit_intercept=True):
+                 warm_start=False, fit_intercept=True, fit_dtype=None):
         self.loss = loss
+        # per-estimator precision override: None follows config.dtype
+        # ("auto" = bf16 on TPU, f32 elsewhere); "float32" opts this
+        # estimator out of the bf16 default, "bfloat16" forces it on.
+        # The resolved choice lands on `fit_dtype_` after fit.
+        self.fit_dtype = fit_dtype
         self.penalty = penalty
         self.alpha = alpha
         self.l1_ratio = l1_ratio
@@ -390,6 +450,13 @@ class _SGDBase(BaseEstimator):
             self._w = jnp.zeros(shape, jnp.float32)
             self._t = 0
         self._penalty_weights()  # validate penalty eagerly
+        # resolved fit compute dtype, on record (an auto policy that
+        # fell back to f32 off-TPU must be visible, not silent)
+        from ..config import fit_dtype_info
+
+        info = fit_dtype_info(self.fit_dtype)
+        self.fit_dtype_ = info["fit_dtype"]
+        self.fit_dtype_source_ = info["fit_dtype_source"]
 
     def _step_args(self):
         """Per-model dynamic scalars for the (batched) step. The model's
@@ -462,7 +529,7 @@ class _SGDBase(BaseEstimator):
         # X) and the scan's matvecs ride the MXU at bf16 rate with f32
         # accumulation; weights/targets/updates stay f32. Weight parity
         # vs f32 ~1e-2 relative (input rounding on the design matrix)
-        fX, fy = _grid_builders(mesh, B, S, mxu_dtype())
+        fX, fy = _grid_builders(mesh, B, S, mxu_dtype(self.fit_dtype))
         Xr = fX(X.data)
         yr = fy(y_enc.data)
         l2w, l1w = self._penalty_weights()
@@ -494,10 +561,15 @@ class _SGDBase(BaseEstimator):
         try:
             loss = self._loss()
             self._penalty_weights()
+            from ..config import fit_dtype_info
+
+            # the batched step is ONE program for the cohort, so only
+            # models resolving to the SAME compute dtype may share it
+            dtype = fit_dtype_info(self.fit_dtype)["fit_dtype"]
         except ValueError:
             return None  # invalid params: surface the error on the solo path
         classes = getattr(self, "classes_", None)
-        return (type(self).__name__, loss,
+        return (type(self).__name__, loss, dtype,
                 tuple(np.asarray(classes).tolist()) if classes is not None
                 else None)
 
@@ -517,11 +589,14 @@ class _SGDBase(BaseEstimator):
         mask = Xs.row_mask(jnp.float32)
         args = np.asarray([m._step_args() for m in models], np.float32)
         W = jnp.stack([m._w for m in models])
+        from ..config import mxu_dtype
+
         W, losses = _sgd_step_many(
             Xs.data, ys.data, mask, jnp.float32(Xs.n_rows), W,
             jnp.asarray(args[:, 0]), jnp.asarray(args[:, 1]),
             jnp.asarray(args[:, 2]), jnp.asarray(args[:, 3]),
             jnp.asarray(args[:, 4]), models[0]._loss(),
+            mxu=mxu_dtype(models[0].fit_dtype),  # cohort shares (keyed)
         )
         for i, m in enumerate(models):
             m._w = W[i]
@@ -594,11 +669,13 @@ class _SGDBase(BaseEstimator):
             np.float32,
         )
         W = jnp.stack([m._w for m in models])
+        from ..config import mxu_dtype
+
         W, losses = _sgd_cohort_scan(
             Xr, yr, NV, jnp.asarray(np.asarray(order, np.int32)), W,
             LRS, jnp.asarray(args[:, 0]), jnp.asarray(args[:, 1]),
             jnp.asarray(args[:, 2]), jnp.asarray(args[:, 3]),
-            enc._loss(),
+            enc._loss(), mxu=mxu_dtype(enc.fit_dtype),
         )
         for i, m in enumerate(models):
             m._w = W[i]
@@ -607,6 +684,9 @@ class _SGDBase(BaseEstimator):
         return models
 
     def _one_step(self, Xb, yb, mask, n_valid):
+        from ..config import mxu_dtype
+
+        mxu = mxu_dtype(self.fit_dtype)
         lr, alpha, l2w, l1w, iflag = self._step_args()
         if self._n_out() is not None:
             # multiclass: C one-vs-rest rows advance in one program; yb
@@ -615,6 +695,7 @@ class _SGDBase(BaseEstimator):
                 Xb, yb, mask, jnp.float32(n_valid), self._w,
                 jnp.float32(lr), jnp.float32(alpha), jnp.float32(l2w),
                 jnp.float32(l1w), jnp.float32(iflag), self._loss(),
+                mxu=mxu,
             )
             self._w = W
             self._last_loss = losses.sum()
@@ -623,9 +704,30 @@ class _SGDBase(BaseEstimator):
             Xb, yb, mask, jnp.float32(n_valid), self._w[None],
             jnp.asarray([lr]), jnp.asarray([alpha]), jnp.asarray([l2w]),
             jnp.asarray([l1w]), jnp.asarray([iflag]), self._loss(),
+            mxu=mxu,
         )
         self._w = W[0]
         self._last_loss = losses[0]
+
+    def _sb_scan_flavor(self, sb):
+        """(program, mxu) for one super-block: the Pallas fused-step
+        scan (``pallas.sgd_step`` — one VMEM pass per block) on real
+        TPU when opted in and the block shape fits its 128-row grid,
+        else the XLA scan. ``mxu`` is the resolved compute dtype
+        (config.dtype="auto" → bf16 on TPU only); both flavors honor
+        it, and with everything off/at-default the XLA program traces
+        byte-identically to the pre-feature one."""
+        from ..config import mxu_dtype
+        from ..ops.pallas_fused import sgd_stream_tile, use_stream_kernels
+
+        mxu = mxu_dtype(self.fit_dtype)
+        Xs = sb.arrays[0]
+        S, d = Xs[0].shape if isinstance(Xs, (tuple, list)) \
+            else Xs.shape[1:]
+        if (self._n_out() is None and use_stream_kernels()
+                and sgd_stream_tile(int(S), int(d)) is not None):
+            return _sgd_sb_scan_pallas, mxu
+        return None, mxu
 
     def _sb_step(self, sb):
         """Advance through one SuperBlock — K minibatch steps, ONE
@@ -640,13 +742,23 @@ class _SGDBase(BaseEstimator):
         lrs[:sb.n_blocks] = self._lr_schedule(sb.n_blocks)
         l2w, l1w = self._penalty_weights()
         w_bytes = int(np.prod(self._w.shape)) * 4
-        W, losses = _sgd_sb_scan(
-            self._w, sb.arrays[0], sb.arrays[1], sb.counts,
-            jnp.asarray(lrs), jnp.float32(self.alpha), jnp.float32(l2w),
-            jnp.float32(l1w),
-            jnp.float32(1.0 if self.fit_intercept else 0.0),
-            self._loss(), self._n_out(),
-        )
+        pallas_run, mxu = self._sb_scan_flavor(sb)
+        if pallas_run is not None:
+            W, losses = pallas_run(
+                self._w, sb.arrays[0], sb.arrays[1], sb.counts,
+                jnp.asarray(lrs), jnp.float32(self.alpha),
+                jnp.float32(l2w), jnp.float32(l1w),
+                jnp.float32(1.0 if self.fit_intercept else 0.0),
+                self._loss(), mxu=mxu,
+            )
+        else:
+            W, losses = _sgd_sb_scan(
+                self._w, sb.arrays[0], sb.arrays[1], sb.counts,
+                jnp.asarray(lrs), jnp.float32(self.alpha),
+                jnp.float32(l2w), jnp.float32(l1w),
+                jnp.float32(1.0 if self.fit_intercept else 0.0),
+                self._loss(), self._n_out(), mxu=mxu,
+            )
         record_superblock_donation(w_bytes)
         self._w = W
         self._t += sb.n_blocks

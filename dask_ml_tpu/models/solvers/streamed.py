@@ -254,12 +254,47 @@ import functools as _ft
 
 
 @_ft.lru_cache(maxsize=64)
-def _sb_reducer(kind, family, intercept, n_classes):
+def _sb_reducer(kind, family, intercept, n_classes, mxu=None,
+                fused=False, interpret=False):
     """The donated-carry super-block program for one objective flavor:
     ``kind`` in {"val", "vg", "vgh"} lifts the matching per-block kernel
     into a scan over the (K, S, ...) stacks, accumulating its sum tuple.
-    Cached per (kind, family, intercept, n_classes) so every pass reuses
-    ONE jitted callable (a fresh jax.jit per pass would retrace)."""
+    Cached per flavor so every pass reuses ONE jitted callable (a fresh
+    jax.jit per pass would retrace).
+
+    ``fused=True`` (binary objectives on real TPU — see
+    ``StreamedObjective._sb_pass``'s gate) swaps the per-block body for
+    the Pallas ``fused_glm_stream`` kernel: ONE VMEM pass per block for
+    loss+grad(+Hessian) where the XLA body reads X two to three times,
+    with ``mxu`` running the matmuls at bf16/f32-acc
+    (config.dtype="auto" on TPU). With ``fused=False`` and ``mxu``
+    unset this function is byte-for-byte the pre-feature program."""
+    if fused and not n_classes:
+        from ...ops.pallas_fused import fused_glm_stream
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def run_fused(acc, beta, Xs, ys, counts):
+            unrolled = isinstance(Xs, (tuple, list))
+
+            def step(acc, Xb, yb, c):
+                out = fused_glm_stream(
+                    kind, Xb, c, yb, beta, family, intercept,
+                    mxu=mxu, interpret=interpret,
+                )
+                return tuple(a + o for a, o in zip(acc, out))
+
+            if unrolled:
+                for j in range(len(Xs)):
+                    acc = step(acc, Xs[j], ys[j], counts[j])
+                return acc
+
+            def scan_step(acc, inp):
+                return step(acc, *inp), jnp.float32(0.0)
+
+            acc, _ = jax.lax.scan(scan_step, acc, (Xs, ys, counts))
+            return acc
+
+        return track_program(f"pallas.glm_{kind}")(run_fused)
     if n_classes:
         fn = {"val": _block_val_multi, "vg": _block_val_grad_multi,
               "vgh": _block_val_grad_hess_multi}[kind].__wrapped__
@@ -352,7 +387,7 @@ class StreamedObjective:
     n_classes = None  # multiclass subclass overrides
 
     def __init__(self, stream, n_rows, lam, pmask, l1_ratio, family, reg,
-                 intercept, logger=None, reduce=None):
+                 intercept, logger=None, reduce=None, fit_dtype=None):
         self.stream = stream
         self.n_rows = float(n_rows)
         self.lam = lam
@@ -364,6 +399,7 @@ class StreamedObjective:
         self.passes = 0
         self.logger = logger
         self.reduce = reduce
+        self.fit_dtype = fit_dtype
 
     def _smooth_clone(self):
         """Same objective with the penalty stripped (proximal solvers
@@ -374,7 +410,45 @@ class StreamedObjective:
             self.stream, self.n_rows, self.lam * 0.0, self.pmask,
             self.l1_ratio, self.family, "none", self.intercept,
             logger=self.logger, reduce=self.reduce,
+            fit_dtype=self.fit_dtype,
         )
+
+    def _sb_flavor(self, kind):
+        """(mxu, fused) for this stream's ``kind`` reducer: the Pallas
+        fused flavor (ISSUE 8) on real TPU when opted in and the block
+        shape fits its 128-row grid/VMEM budget — with the resolved
+        bf16 matmul policy riding along — else the XLA flavor,
+        untouched and f32 (the streamed XLA reducers accumulate in f32
+        carries by construction; bf16 streamed GLM compute is a
+        fused-kernel-only feature, so off-TPU fits fall back to f32
+        whatever config.dtype says)."""
+        if self.n_classes:
+            return None, False
+        from ...config import mxu_dtype
+        from ...ops.pallas_fused import (glm_stream_tile,
+                                         use_stream_kernels)
+
+        s = self.stream
+        try:
+            S = int(s.block_rows)
+            d = int(np.prod(s.arrays[0].shape[1:], dtype=np.int64))
+        except Exception:
+            return None, False
+        if not (use_stream_kernels()
+                and glm_stream_tile(S, d, kind) is not None):
+            return None, False
+        if kind in ("vgh", "val"):
+            # Hessian passes stay f32 even when fused — the SAME policy
+            # the resident path enforces (glm.py restricts bf16 to the
+            # smooth first-order solvers: bf16 Hessians risk
+            # conditioning, and the matmul they'd speed up is the one
+            # whose error a Newton step amplifies). "val" rides along:
+            # its ONLY streamed consumer is newton's step-halving line
+            # search, and comparing a bf16 objective against the f32
+            # vgh value would spuriously reject steps near convergence
+            # (the rounding gap exceeds the true decrease there)
+            return None, True
+        return mxu_dtype(self.fit_dtype), True
 
     def _merge(self, *accs):
         """Local pass sums → global sums (merged f64 on host, identical
@@ -399,8 +473,9 @@ class StreamedObjective:
             return None
         from ...observability import record_superblock_donation
 
+        mxu, fused = self._sb_flavor(kind)
         run = _sb_reducer(kind, self.family, self.intercept,
-                          self.n_classes or 0)
+                          self.n_classes or 0, mxu=mxu, fused=fused)
         acc = init
         acc_bytes = sum(4 * int(np.prod(a.shape) or 1) for a in acc)
         for sb in s.superblocks():
@@ -504,7 +579,7 @@ class MulticlassStreamedObjective(StreamedObjective):
             self.stream, self.n_rows, self.lam * 0.0, self.pmask,
             self.l1_ratio, self.family, "none", self.intercept,
             logger=self.logger, n_classes=self.n_classes,
-            reduce=self.reduce,
+            reduce=self.reduce, fit_dtype=self.fit_dtype,
         )
 
     def _B(self, beta_flat):
@@ -877,7 +952,7 @@ STREAMED_SOLVERS = {
 
 def solve_streamed(solver, stream, n_rows, beta0, family, reg, lam, pmask,
                    l1_ratio=0.5, intercept=True, max_iter=100, tol=1e-6,
-                   logger=None, reduce=None, **kwargs):
+                   logger=None, reduce=None, fit_dtype=None, **kwargs):
     """``reduce`` (``distributed.psum_host``): merge per-pass block sums
     across processes — each process streams its LOCAL shard, ``n_rows``
     is the GLOBAL count, and the fit equals the single-process fit over
@@ -889,12 +964,38 @@ def solve_streamed(solver, stream, n_rows, beta0, family, reg, lam, pmask,
     obj = StreamedObjective(
         stream, n_rows, jnp.asarray(lam, jnp.float32), jnp.asarray(pmask),
         l1_ratio, family, reg, intercept, logger=logger, reduce=reduce,
+        fit_dtype=fit_dtype,
     )
     beta, info = STREAMED_SOLVERS[solver](
         obj, beta0, max_iter=max_iter, tol=tol, **kwargs
     )
     info["streamed"] = True
     info["n_blocks"] = stream.n_blocks
+    # the resolved precision policy + whether the fused Pallas reducers
+    # carried the pass (streamed XLA flavors are f32-only — an auto
+    # policy that fell back must be on record). The flavor gate is
+    # checked for the reducer KIND this solver's passes actually run:
+    # newton's vgh tile budget (it also holds the (d, d) Hessian
+    # accumulator) can refuse a width the vg kernel accepts, and admm
+    # never uses the reducers at all
+    use_sb = hasattr(stream, "use_superblocks") and stream.use_superblocks()
+    info_kind = {"newton": "vgh", "admm": None}.get(solver, "vg")
+    if use_sb and info_kind is not None:
+        mxu, fused = obj._sb_flavor(info_kind)
+    else:
+        mxu, fused = None, False
+    info["fused_stream"] = bool(fused)
+    from ...config import fit_dtype_info
+
+    if fused and mxu is not None:
+        info.update(fit_dtype_info(fit_dtype))
+    elif fused:
+        # fused but f32 (the vgh/Hessian reducer rejects bf16)
+        info.update({"fit_dtype": "float32",
+                     "fit_dtype_source": "hessian-f32"})
+    else:
+        info.update({"fit_dtype": "float32",
+                     "fit_dtype_source": "streamed-xla"})
     from .solvers import check_finite_result
 
     return check_finite_result(beta, info, solver)
@@ -902,7 +1003,8 @@ def solve_streamed(solver, stream, n_rows, beta0, family, reg, lam, pmask,
 
 def solve_streamed_multi(solver, stream, n_rows, B0, family, reg, lam,
                          pmask, l1_ratio=0.5, intercept=True, max_iter=100,
-                         tol=1e-6, logger=None, reduce=None, **kwargs):
+                         tol=1e-6, logger=None, reduce=None,
+                         fit_dtype=None, **kwargs):
     """One-vs-rest streamed fit: ``B0``/result are (C, d); ``pmask`` is
     the per-class (d,) mask, tiled here. Every epoch reads the data
     ONCE for all classes (class-stacked block kernels); the host solvers
@@ -917,7 +1019,7 @@ def solve_streamed_multi(solver, stream, n_rows, B0, family, reg, lam,
     obj = MulticlassStreamedObjective(
         stream, n_rows, jnp.asarray(lam, jnp.float32),
         jnp.asarray(pmask_t), l1_ratio, family, reg, intercept,
-        logger=logger, n_classes=C, reduce=reduce,
+        logger=logger, n_classes=C, reduce=reduce, fit_dtype=fit_dtype,
     )
     beta, info = STREAMED_SOLVERS[solver](
         obj, B0.ravel(), max_iter=max_iter, tol=tol, **kwargs
@@ -925,6 +1027,11 @@ def solve_streamed_multi(solver, stream, n_rows, B0, family, reg, lam,
     info["streamed"] = True
     info["n_blocks"] = stream.n_blocks
     info["n_classes"] = C
+    # multiclass streamed reducers are XLA/f32-only today (the fused
+    # kernels cover the flat-weight objectives)
+    info["fused_stream"] = False
+    info["fit_dtype"] = "float32"
+    info["fit_dtype_source"] = "streamed-xla"
     from .solvers import check_finite_result
 
     beta, info = check_finite_result(np.asarray(beta), info, solver)

@@ -73,6 +73,7 @@ from ._counters import (
     record_superblock,
     record_superblock_donation,
     record_transfer,
+    record_zero_copy,
 )
 from ._metrics import (
     MetricsLogger,
@@ -171,6 +172,7 @@ __all__ = [
     "record_superblock",
     "record_superblock_donation",
     "record_transfer",
+    "record_zero_copy",
     "reset_jit_callbacks_probe",
     "span",
     "start_profiler_server",

@@ -469,6 +469,371 @@ def fused_glm_multi_value_grad(x, n_valid, y_codes, B, family,
     return loss[0, 0], grad
 
 
+# ---------------------------------------------------------------------------
+# streamed super-block kernels (ISSUE 8 tentpole): the per-block bodies
+# the donated-carry super-block scans call INSTEAD of their XLA flavors
+# when `config.pallas_stream` is on, the backend is a real TPU, and the
+# block shape fits the grid/VMEM rules below. Each kernel is ONE VMEM
+# pass over its block — objective AND gradient (AND Hessian) from a
+# single X read, where the XLA flavors read X two to three times
+# (forward matvec + autodiff backward + weighted Hessian matmul). Row
+# validity is the streamed block's prefix count (SuperBlock.counts),
+# exactly the scalar the resident kernels already take. ``mxu`` casts
+# the matmul operands to bf16 in VMEM (f32 accumulation — the
+# config.dtype="auto" TPU path); everything else stays f32.
+# ---------------------------------------------------------------------------
+
+
+def stream_tile(S, cost):
+    """Largest 128-multiple tile that DIVIDES the streamed block height
+    and fits the VMEM budget; None when the height isn't a 128-multiple
+    or nothing fits. Streamed kernels cannot pad: a pad inside the
+    consumer's scan would copy the block in HBM on every step, which is
+    exactly the traffic the fusion removes — callers fall back to the
+    XLA flavor instead (``use_stream_kernels`` gates on this)."""
+    if S <= 0 or S % 128:
+        return None
+    for t in (1024, 512, 256, 128):
+        if S % t == 0 and cost(t) <= _GLM_TILE_BUDGET:
+            return t
+    return None
+
+
+def sgd_stream_tile(S, d, itemsize=4):
+    return stream_tile(S, lambda t: t * d * itemsize)
+
+
+def glm_stream_tile(S, d, kind, itemsize=4):
+    """Tile for the streamed GLM ``kind`` reducer; the vgh budget also
+    covers the weighted copy and the (d, d) Hessian accumulator."""
+    if kind == "vgh":
+        return stream_tile(
+            S, lambda t: 2 * t * d * itemsize + d * d * 4
+        )
+    return stream_tile(S, lambda t: t * d * itemsize)
+
+
+def kmeans_stream_tile(S, d, k, itemsize=4):
+    return stream_tile(
+        S, lambda t: t * d * itemsize + t * k * 4 + 2 * k * d * 4
+    )
+
+
+def use_stream_kernels(backend=None):
+    """The auto-gate for the fused streamed kernel family: opted in
+    (config.pallas_stream, default on) AND a real TPU backend. Off-TPU
+    the XLA flavors run unchanged — with the knob off their jaxprs are
+    byte-identical to the pre-feature programs."""
+    from ..config import get_config
+
+    if not get_config().pallas_stream:
+        return False
+    if backend is None:
+        backend = jax.default_backend()
+    return backend == "tpu"
+
+
+def _mxu_cast(a, mxu):
+    return a if mxu is None else a.astype(mxu)
+
+
+def sgd_objective_terms(eta, yv, loss):
+    """(pointwise loss, dloss/deta) for the SGD losses — the ONE
+    definition shared by the fused step kernel and any epilogue, so the
+    Pallas and autodiff (models/sgd.py::_sgd_update_one) objectives
+    cannot diverge. ``eta``/``yv`` rank-2."""
+    if loss == "log_loss":
+        per = jax.nn.softplus(eta) - yv * eta
+        resid = jax.nn.sigmoid(eta) - yv
+    elif loss == "hinge":
+        sign = 2.0 * yv - 1.0
+        margins = sign * eta
+        per = jnp.maximum(0.0, 1.0 - margins)
+        resid = -sign * (margins < 1.0).astype(jnp.float32)
+    elif loss == "squared_error":
+        diff = eta - yv
+        per = 0.5 * diff * diff
+        resid = diff
+    else:  # pragma: no cover - validated upstream
+        raise ValueError(f"unknown SGD loss {loss!r}")
+    return per, resid
+
+
+def _sgd_grad_kernel(x_ref, y_ref, nv_ref, w_ref, b0_ref, loss_ref,
+                     gw_ref, gb_ref, *, tile, loss, mxu):
+    """Σ pointwise-loss, Σ ∂/∂coef, Σ ∂/∂intercept of one streamed
+    block in ONE X pass (the XLA step reads X twice: forward matvec +
+    autodiff backward). Same layout rules as every kernel here: rank-2
+    throughout, prefix-count validity, constant-index accumulators on
+    the sequential TPU grid."""
+    i = pl.program_id(0)
+    x = x_ref[:]                        # (tile, d) f32
+    yv = y_ref[:]                       # (tile, 1) f32
+    w = w_ref[:]                        # (1, d) f32 coef row
+    b0 = b0_ref[:]                      # (1, 1) intercept*iflag
+    m = _tile_mask(x, nv_ref, i, tile)
+    xd = _mxu_cast(x, mxu)
+    eta = jax.lax.dot_general(
+        xd, w.astype(xd.dtype), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) + b0                              # (tile, 1)
+    per, resid = sgd_objective_terms(eta, yv, loss)
+    rm = resid * m
+
+    @pl.when(i == 0)
+    def _init():
+        loss_ref[:] = jnp.zeros_like(loss_ref)
+        gw_ref[:] = jnp.zeros_like(gw_ref)
+        gb_ref[:] = jnp.zeros_like(gb_ref)
+
+    loss_ref[:] += jnp.sum(per * m, axis=0, keepdims=True)
+    gw_ref[:] += jax.lax.dot_general(
+        rm.astype(xd.dtype), xd, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                   # (1, d)
+    gb_ref[:] += jnp.sum(rm, axis=0, keepdims=True)
+
+
+def fused_sgd_block_grad(x, n_valid, y, w_ext, iflag, loss,
+                         mxu=None, interpret=False):
+    """(Σ pointwise-loss, Σ ∂/∂w (d+1,)) of one streamed block in ONE
+    X pass. ``w_ext`` is the (d+1,) weight vector (last entry the
+    intercept); ``iflag`` zeroes the intercept's contribution exactly
+    like the XLA step. Raw sums — the caller divides by n_valid and
+    adds the l2/prox terms (models/sgd.py's epilogue). Traced inside
+    the consumer's scan: shapes must already satisfy
+    ``sgd_stream_tile`` (no padding here, by design)."""
+    S, d = x.shape
+    tile = sgd_stream_tile(S, d, x.dtype.itemsize)
+    grid = (S // tile,)
+    nv = jnp.asarray(n_valid, jnp.int32).reshape(1, 1)
+    b0 = (w_ext[-1] * iflag).astype(jnp.float32).reshape(1, 1)
+    loss_sum, gw, gb = pl.pallas_call(
+        functools.partial(_sgd_grad_kernel, tile=tile, loss=loss,
+                          mxu=mxu),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile, d), lambda i: (i, 0)),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((1, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, y[:, None], nv, w_ext[None, :-1], b0)
+    grad = jnp.concatenate([gw[0], gb[0]])
+    return loss_sum[0, 0], grad
+
+
+def _glm_stream_kernel(x_ref, y_ref, nv_ref, b_ref, b0_ref, *outs,
+                       tile, family, kind, mxu):
+    """Streamed-GLM reducer body: ``kind`` picks which sums accumulate
+    (val: loss; vg: + gradient; vgh: + Gauss-Newton Hessian pieces).
+    The intercept rides as the (1, 1) ``b0`` operand and its gradient/
+    Hessian border accumulate as separate outputs — the caller
+    assembles the bordered (d+1, d+1) form in XLA, identical to
+    ``_block_val_grad_hess``'s ``jnp.block``."""
+    i = pl.program_id(0)
+    x = x_ref[:]                        # (tile, d)
+    yv = y_ref[:]                       # (tile, 1)
+    b = b_ref[:]                        # (1, d)
+    b0 = b0_ref[:]                      # (1, 1)
+    m = _tile_mask(x, nv_ref, i, tile)
+    xd = _mxu_cast(x, mxu)
+    eta = jax.lax.dot_general(
+        xd, b.astype(xd.dtype), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) + b0
+    from ..models.solvers.families import get_family
+
+    fam = get_family(family)
+    per = fam.pointwise(eta, yv)
+
+    @pl.when(i == 0)
+    def _init():
+        for o in outs:
+            o[:] = jnp.zeros_like(o)
+
+    loss_ref = outs[0]
+    loss_ref[:] += jnp.sum(per * m, axis=0, keepdims=True)
+    if kind == "val":
+        return
+    resid = (fam.mean(eta) - yv) * m
+    grad_ref, gb_ref = outs[1], outs[2]
+    grad_ref[:] += jax.lax.dot_general(
+        resid.astype(xd.dtype), xd, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                   # (1, d)
+    gb_ref[:] += jnp.sum(resid, axis=0, keepdims=True)
+    if kind == "vg":
+        return
+    hess_ref, col_ref, wsum_ref = outs[3], outs[4], outs[5]
+    w = fam.hess_weight(eta, yv) * m
+    xw = xd * w.astype(xd.dtype)
+    hess_ref[:] += jax.lax.dot_general(
+        xw, xd, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                   # (d, d)
+    col_ref[:] += jnp.sum(xw.astype(jnp.float32), axis=0, keepdims=True)
+    wsum_ref[:] += jnp.sum(w, axis=0, keepdims=True)
+
+
+def fused_glm_stream(kind, x, n_valid, y, beta, family, intercept,
+                     mxu=None, interpret=False):
+    """One streamed block's ``kind`` sums in ONE X pass, matching the
+    XLA block kernels in models/solvers/streamed.py:
+
+    - "val":  Σ pointwise-NLL (scalar)
+    - "vg":   (Σ NLL, Σ ∂/∂beta) — beta is (d+1,) when ``intercept``
+    - "vgh":  (Σ NLL, Σ ∂/∂beta, Σ bordered Gauss-Newton Hessian)
+
+    Raw sums over valid rows (prefix count ``n_valid``); the streamed
+    objective's epilogue adds mean scaling and penalties exactly as for
+    the XLA flavors."""
+    S, d_ext = x.shape[0], x.shape[1]
+    beta = beta.astype(jnp.float32)
+    tile = glm_stream_tile(S, d_ext, kind, x.dtype.itemsize)
+    nv = jnp.asarray(n_valid, jnp.int32).reshape(1, 1)
+    if intercept:
+        b, b0 = beta[None, :-1], beta[-1].reshape(1, 1)
+    else:
+        b, b0 = beta[None, :], jnp.zeros((1, 1), jnp.float32)
+    d = b.shape[1]
+    out_specs = [pl.BlockSpec((1, 1), lambda i: (0, 0))]
+    out_shape = [jax.ShapeDtypeStruct((1, 1), jnp.float32)]
+    if kind != "val":
+        out_specs += [pl.BlockSpec((1, d), lambda i: (0, 0)),
+                      pl.BlockSpec((1, 1), lambda i: (0, 0))]
+        out_shape += [jax.ShapeDtypeStruct((1, d), jnp.float32),
+                      jax.ShapeDtypeStruct((1, 1), jnp.float32)]
+    if kind == "vgh":
+        out_specs += [pl.BlockSpec((d, d), lambda i: (0, 0)),
+                      pl.BlockSpec((1, d), lambda i: (0, 0)),
+                      pl.BlockSpec((1, 1), lambda i: (0, 0))]
+        out_shape += [jax.ShapeDtypeStruct((d, d), jnp.float32),
+                      jax.ShapeDtypeStruct((1, d), jnp.float32),
+                      jax.ShapeDtypeStruct((1, 1), jnp.float32)]
+    outs = pl.pallas_call(
+        functools.partial(_glm_stream_kernel, tile=tile, family=family,
+                          kind=kind, mxu=mxu),
+        grid=(S // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, d), lambda i: (i, 0)),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(x, y[:, None], nv, b, b0)
+    loss = outs[0][0, 0]
+    if kind == "val":
+        return (loss,)
+    grad = outs[1][0]
+    if intercept:
+        grad = jnp.concatenate([grad, outs[2][0]])
+    if kind == "vg":
+        return loss, grad
+    hess, col, wsum = outs[3], outs[4][0], outs[5]
+    if intercept:
+        hess = jnp.block([
+            [hess, col[:, None]],
+            [col[None, :], wsum],
+        ])
+    return loss, grad, hess
+
+
+def _kmeans_stream_kernel(x_ref, nv_ref, c_ref, c2_ref, sums_ref,
+                          counts_ref, inertia_ref, *, tile, mxu):
+    """``_lloyd_stats_kernel`` with the streamed blocks' bf16 policy:
+    only the cross-term matmul runs at ``mxu`` (f32 accumulation), the
+    norms/statistics stay f32 — mirroring
+    ``euclidean_distances_sq(mxu_dtype=...)`` on the XLA flavor."""
+    i = pl.program_id(0)
+    x = x_ref[:]                        # (tile, d)
+    c = c_ref[:]                        # (k, d)
+    c2 = c2_ref[:]                      # (1, k)
+    k = c.shape[0]
+    m = _tile_mask(x, nv_ref, i, tile)
+    xd, cd = _mxu_cast(x, mxu), _mxu_cast(c, mxu)
+    xc = jax.lax.dot_general(
+        xd, cd, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    d2 = jnp.sum(x * x, axis=1, keepdims=True) - 2.0 * xc + c2
+    d2 = jnp.maximum(d2, 0.0)
+    mind = jnp.min(d2, axis=1, keepdims=True)
+    iota = jax.lax.broadcasted_iota(
+        jnp.int32, (x.shape[0], k), 1
+    ).astype(jnp.float32)
+    labf = jnp.min(jnp.where(d2 <= mind, iota, float(k)), axis=1,
+                   keepdims=True)
+    onehot = (iota == labf).astype(jnp.float32) * m
+
+    @pl.when(i == 0)
+    def _init():
+        sums_ref[:] = jnp.zeros_like(sums_ref)
+        counts_ref[:] = jnp.zeros_like(counts_ref)
+        inertia_ref[:] = jnp.zeros_like(inertia_ref)
+
+    sums_ref[:] += jax.lax.dot_general(
+        onehot, x, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    counts_ref[:] += jnp.sum(onehot, axis=0, keepdims=True)
+    inertia_ref[:] += jnp.sum(mind * m, axis=0, keepdims=True)
+
+
+def fused_kmeans_block_stats(x, n_valid, centers, mxu=None,
+                             interpret=False):
+    """(Σ x per label (k, d), count per label (k,), Σ min-d² scalar) of
+    one streamed block in ONE X pass — the fused flavor of
+    ``models/kmeans.py::_block_assign_stats`` (whose XLA form reads X
+    twice: distance matmul + segment_sum) with the same prefix-count
+    validity as the resident ``fused_lloyd_stats``. No padding: shapes
+    must satisfy ``kmeans_stream_tile``."""
+    S, d = x.shape
+    k = centers.shape[0]
+    centers = centers.astype(jnp.float32)
+    tile = kmeans_stream_tile(S, d, k, x.dtype.itemsize)
+    nv = jnp.asarray(n_valid, jnp.int32).reshape(1, 1)
+    c2 = jnp.sum(centers * centers, axis=1)[None, :]
+    sums, counts, inertia = pl.pallas_call(
+        functools.partial(_kmeans_stream_kernel, tile=tile, mxu=mxu),
+        grid=(S // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+            pl.BlockSpec((k, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, k), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((k, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, k), lambda i: (0, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((k, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, k), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, nv, centers, c2)
+    return sums, counts[0], inertia[0, 0]
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def fused_assign_update(x, mask, centers, interpret=False):
     """One Lloyd-iteration data pass over a (per-device) block.

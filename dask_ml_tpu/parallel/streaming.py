@@ -146,6 +146,54 @@ class SuperBlock:
         self.n_rows = n_rows
 
 
+# XLA:CPU's dlpack import aliases host memory (zero-copy) only at
+# >=64-byte alignment; below it the runtime silently copies — correct
+# but pointless, so misaligned blocks keep the plain device_put path
+_ZC_ALIGN = 64
+
+
+def _dlpack_alias(a):
+    """Import one host block into the runtime as a zero-copy ALIAS of
+    its memory (XLA:CPU dlpack), or None when the import cannot be
+    zero-copy (alignment / layout) or fails — callers then device_put a
+    copy as before.
+
+    Safety contract (why aliasing host memory is sound here): streamed
+    data blocks are only ever READ by the consumers (input buffers are
+    immutable to XLA unless donated, and no streamed kernel donates its
+    data operands — only accumulator/weight carries), the block is
+    either a view of a source array the stream holds alive for its own
+    lifetime or a freshly allocated buffer the returned array's dlpack
+    capsule keeps alive, and staging-ring slabs (which ARE refilled)
+    never take this path. ``config.stream_zero_copy`` opts out for
+    callers that mutate the source mid-fit."""
+    if (a.ctypes.data % _ZC_ALIGN) or not a.flags["C_CONTIGUOUS"] \
+            or a.nbytes == 0:
+        return None
+    try:
+        if not a.flags.writeable:
+            # numpy refuses dlpack export of readonly arrays (e.g.
+            # mode="r" memmaps). XLA only reads the buffer, so re-wrap
+            # the same memory writeable for the export alone. The
+            # ctypes buffer owns NOTHING (from_address) — pin the
+            # original view on it so the capsule chain
+            # (jax.Array -> wrapper -> ctypes buf -> view -> mmap)
+            # keeps the mapping alive for as long as the device array
+            # exists, even if the caller drops the source mid-pass.
+            import ctypes
+
+            buf = (ctypes.c_byte * a.nbytes).from_address(a.ctypes.data)
+            buf._keepalive = a
+            src = np.frombuffer(buf, dtype=a.dtype).reshape(a.shape)
+        else:
+            src = a
+        from jax import dlpack as _jdl
+
+        return _jdl.from_dlpack(src)
+    except Exception:
+        return None
+
+
 _PUT_ALIASES = None
 
 
@@ -194,6 +242,11 @@ _AUTO_BLOCK_BYTES = 256 << 20
 # bound the per-block budget establishes (peak ≈ (prefetch + 1)
 # super-blocks while a pass is in flight)
 _SUPERBLOCK_BYTES = 512 << 20
+
+# training-profile sample budget in VALUES (rows x features): the
+# first-pass fold must stay a rounding error next to the pass compute
+# at ANY design width
+_PROFILE_VALUE_BUDGET = 1 << 20
 
 # widest feature count the training profile sketches: past this the
 # per-feature histogram matrix (d x ~80 int64 buckets) and the fold's
@@ -366,6 +419,19 @@ class BlockStream:
         from ..config import ensure_compile_cache, get_config
         from ..observability.live import ensure_telemetry
 
+        # zero-copy staging (config.stream_zero_copy): on a
+        # single-device XLA:CPU mesh, full-height aligned dense blocks
+        # import as dlpack ALIASES of host memory instead of paying a
+        # device_put memcpy — see _dlpack_alias for the safety
+        # contract. Multi-device meshes keep the sharded put (an
+        # aliased import is single-device), other backends have real
+        # device memory to copy into.
+        self._zero_copy = bool(
+            get_config().stream_zero_copy
+            and jax.default_backend() == "cpu"
+            and self.mesh.devices.size == 1
+        )
+
         # per-feature training profile (observability/sketch.py): the
         # staging path folds a strided row sample of the FIRST pass's
         # host slabs — pure numpy on buffers already in hand, so it can
@@ -383,11 +449,20 @@ class BlockStream:
             profile and get_config().obs_drift
             and not any(_is_sparse_source(a) for a in self.arrays)
         )
-        # row budget for the profile sample: bounds the fold cost per
-        # fit to ~64k rows regardless of dataset size (the profile is a
-        # uniform strided sample either way)
+        # VALUE budget for the profile sample: bounds the fold cost per
+        # fit regardless of dataset size AND width (the profile is a
+        # uniform strided sample either way). A row budget alone let
+        # wide designs blow the first-pass fold up proportionally to d
+        # (d=128 folded 7.3M values, ~0.5s on the staging worker's
+        # critical path — measured as a streamed-SGD throughput
+        # regression); a value budget keeps the fold ~0.1s at any
+        # width. 1M values = the old 64k rows at d=16.
+        d0 = int(np.prod(
+            getattr(self.arrays[0], "shape", (0, 1))[1:], dtype=np.int64
+        ) or 1)
+        budget_rows = max(_PROFILE_VALUE_BUDGET // max(d0, 1), 1024)
         self._profile_stride = max(
-            int(np.ceil(self.n_rows / 65536)), 1
+            int(np.ceil(self.n_rows / budget_rows)), 1
         )
 
         # streamed fits are the repeated-warmup-compile hot spot the
@@ -478,6 +553,42 @@ class BlockStream:
         prof = self.profile
         return prof.to_dict() if prof is not None and prof.rows else None
 
+    def _view_ok(self, a):
+        # a full-height dense block whose dtype already matches can
+        # skip host staging as a VIEW of the source — zero host copy
+        # (np.memmap is an ndarray subclass, so sequential memmap
+        # passes stage straight from the page cache)
+        return (isinstance(a, np.ndarray)
+                and not isinstance(a, np.generic)
+                and a.dtype == self.dtype)
+
+    def _zc_block_guarantee(self, a):
+        """True when EVERY full-height block of ``a`` is guaranteed to
+        import zero-copy: dtype matches (view staging), the source is
+        C-contiguous, and both the base pointer and the per-block byte
+        stride are 64-byte aligned (a block's offset is
+        ``b * block_rows * strides[0]``). A dtype-match alone is NOT
+        enough to reroute staging — a misaligned or non-contiguous
+        source would lose the readahead/overlap machinery and then pay
+        full copies on the consumer thread anyway."""
+        return (self._view_ok(a)
+                and a.flags["C_CONTIGUOUS"]
+                and a.ctypes.data % _ZC_ALIGN == 0
+                and (self.block_rows * a.strides[0]) % _ZC_ALIGN == 0)
+
+    def _gate_readers_for_zero_copy(self, readers):
+        """Null out (and close) readahead readers for arrays whose full
+        blocks are GUARANTEED to stage as zero-copy aliases — the view
+        path then pays neither the reader's copy-out nor a
+        device_put. Arrays without the guarantee keep their reader."""
+        if readers is None or not self._zero_copy:
+            return readers
+        for i, (r, a) in enumerate(zip(readers, self.arrays)):
+            if r is not None and self._zc_block_guarantee(a):
+                r.close()
+                readers[i] = None
+        return readers if any(r is not None for r in readers) else None
+
     def _block_host(self, b, readers=None):
         lo = b * self.block_rows
         hi = min(lo + self.block_rows, self.n_rows)
@@ -503,13 +614,23 @@ class BlockStream:
 
     def _put(self, host_block):
         outs, m, mask = host_block
-        from ..observability import record_transfer
+        from ..observability import record_transfer, record_zero_copy
 
-        record_transfer(sum(a.nbytes for a in outs) + mask.nbytes)
-        dev = tuple(
-            jax.device_put(a, s) for a, s in zip(outs, self._shardings)
-        )
-        return Block(dev, m, jax.device_put(mask, self._mask_sharding))
+        dev = []
+        copied = mask.nbytes
+        for a, s in zip(outs, self._shardings):
+            # full blocks reach here as source views (or fresh reader
+            # copies); both are safe to alias — see _dlpack_alias
+            zc = _dlpack_alias(a) if self._zero_copy else None
+            if zc is not None:
+                record_zero_copy(a.nbytes)
+                dev.append(zc)
+            else:
+                copied += a.nbytes
+                dev.append(jax.device_put(a, s))
+        record_transfer(copied)
+        return Block(tuple(dev), m,
+                     jax.device_put(mask, self._mask_sharding))
 
     def __iter__(self):
         import time as _time
@@ -523,6 +644,7 @@ class BlockStream:
                 readers = self._native_readers()
             except Exception:
                 readers = None
+        readers = self._gate_readers_for_zero_copy(readers)
         # per-pass overlap accounting (SURVEY §7 B0: the double buffer is
         # the heart of the system — measure it, don't assume it):
         #   host_s   — disk/densify/pad time building host blocks
@@ -729,7 +851,8 @@ class BlockStream:
         import time as _time
 
         from ..observability import (record_superblock,
-                                     record_transfer, span)
+                                     record_transfer, record_zero_copy,
+                                     span)
 
         k = self.resolve_superblock_k()
         if order is None:
@@ -760,14 +883,9 @@ class BlockStream:
 
         pending = deque()
 
-        def view_ok(a):
-            # a full-height dense block whose dtype already matches can
-            # go to device_put as a VIEW of the source — zero host copy
-            # (np.memmap is an ndarray subclass, so sequential memmap
-            # passes stage straight from the page cache)
-            return (isinstance(a, np.ndarray)
-                    and not isinstance(a, np.generic)
-                    and a.dtype == self.dtype)
+        view_ok = self._view_ok
+
+        readers = self._gate_readers_for_zero_copy(readers)
 
         def fill(slot, blocks):
             """Assemble ``blocks`` (block indices) into host parts:
@@ -794,7 +912,17 @@ class BlockStream:
                             and m == self.block_rows and view_ok(a)):
                         if i == 0:
                             self._profile_fold(a[lo:hi])
-                        parts[i].append(a[lo:hi])
+                        blk = a[lo:hi]
+                        if self._zero_copy:
+                            # source view -> zero-copy alias now, ON
+                            # the staging thread; put() passes the
+                            # already-imported array through
+                            dev = _dlpack_alias(blk)
+                            if dev is not None:
+                                record_zero_copy(blk.nbytes)
+                                parts[i].append(dev)
+                                continue
+                        parts[i].append(blk)
                         continue
                     if from_reader:
                         buf[j, :m] = readers[i].next()
@@ -815,15 +943,26 @@ class BlockStream:
 
         def put(slot, parts, counts, n_real):
             if unroll:
-                nbytes = sum(b.nbytes for p in parts for b in p)
+                nbytes = sum(b.nbytes for p in parts for b in p
+                             if not isinstance(b, jax.Array))
                 record_transfer(nbytes + counts.nbytes)
-                # ONE pytree device_put: the K block transfers are
-                # issued together (concurrent copies — a single stacked
-                # put is one serial memcpy on CPU)
+                # ONE pytree device_put per array: the K block
+                # transfers are issued together (concurrent copies — a
+                # single stacked put is one serial memcpy on CPU).
+                # Blocks the staging thread already imported zero-copy
+                # (jax.Array entries) pass straight through; the
+                # leftovers (ragged tail, padding slots, unaligned
+                # arrays) are put individually — they are the small
+                # minority whenever aliasing is on at all
                 dev = tuple(
                     tuple(jax.device_put(
                         p, [self._shardings[i]] * len(p)
-                    ))
+                    )) if not any(isinstance(b, jax.Array) for b in p)
+                    else tuple(
+                        b if isinstance(b, jax.Array)
+                        else jax.device_put(b, self._shardings[i])
+                        for b in p
+                    )
                     for i, p in enumerate(parts)
                 )
             else:
@@ -876,9 +1015,43 @@ class BlockStream:
             yield sb
             stats["consume_s"] += _time.perf_counter() - t_y
 
-        from concurrent.futures import ThreadPoolExecutor
+        # when every array's staging is guaranteed (near-)free — its
+        # full blocks alias zero-copy, or its per-block bytes are so
+        # small the copy is noise — the background staging worker has
+        # nothing real to overlap, and the per-pass executor spin-up,
+        # future hand-offs, and GIL ping-pong between the two threads
+        # cost more than they hide (~30% of a steady-state CPU pass at
+        # bench shapes). Stage inline there; keep the worker wherever a
+        # real memcpy/densify/device_put pipeline exists to overlap
+        # (non-contiguous or misaligned sources, dtype conversion).
+        def _cheap_to_stage(a):
+            if self._zc_block_guarantee(a):
+                return True
+            row_bytes = 4 * int(np.prod(a.shape[1:], dtype=np.int64)
+                                or 1)
+            return row_bytes * self.block_rows <= (1 << 20)
 
-        staging = ThreadPoolExecutor(max_workers=1)
+        inline = self._zero_copy and all(
+            _cheap_to_stage(a) for a in self.arrays
+        )
+
+        class _Done:
+            __slots__ = ("v",)
+
+            def __init__(self, v):
+                self.v = v
+
+            def result(self):
+                return self.v
+
+        if inline:
+            staging = None
+            submit = lambda fn, i: _Done(fn(i))  # noqa: E731
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            staging = ThreadPoolExecutor(max_workers=1)
+            submit = staging.submit
         with span("streaming.superblock") as sp:
             # recording spans only: a span tracked solely for the
             # watchdog (sinkless, armed timeout) must not switch on the
@@ -889,13 +1062,14 @@ class BlockStream:
             )
             try:
                 for i in range(n_sb):
-                    pending.append(staging.submit(produce, i))
+                    pending.append(submit(produce, i))
                     if len(pending) > self.prefetch:
                         yield from emit(pop())
                 while pending:
                     yield from emit(pop())
             finally:
-                staging.shutdown(wait=True)
+                if staging is not None:
+                    staging.shutdown(wait=True)
                 stats["pass_s"] = _time.perf_counter() - t_pass
                 self.stats = stats
                 self._passes = getattr(self, "_passes", 0) + 1

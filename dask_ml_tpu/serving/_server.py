@@ -142,6 +142,24 @@ class ModelServer:
         self._next_fold_t = 0.0         # backpressure gate (see _execute)
         self._fns = {m: compiled_batch_fn(estimator, m, device=device)
                      for m in methods}
+        # precision-flavor table: "" (float32) plus every flavor named
+        # in config.serving_warm_flavors gets its OWN entry-point set,
+        # built now and warmed by warmup() — so a registry publish
+        # flagged quantize="int8" (and the rollback to f32) hot-swaps
+        # between flavors with ZERO new XLA compiles. Methods without
+        # an int8 path (predict_proba, non-linear families) build a
+        # fresh higher-precision entry point inside the flavor, so a
+        # quantized server still serves them.
+        self._flavor_fns = {"": self._fns}
+        for fl in str(cfg.serving_warm_flavors).replace(",", " ").split():
+            if fl in self._flavor_fns:
+                continue
+            self._flavor_fns[fl] = {
+                m: compiled_batch_fn(estimator, m, device=device,
+                                     quantize=fl)
+                for m in methods
+            }
+        self._active_flavor = ""
         self._queue = BoundedQueue(self.max_queue)
         self._staging = PingPongStaging()
         self._latency = smetrics.LatencyWindow()
@@ -268,7 +286,7 @@ class ModelServer:
         return thread is None or thread.is_alive()
 
     # -- hot-swap ----------------------------------------------------------
-    def swap_model(self, estimator, version=None):
+    def swap_model(self, estimator, version=None, quantize=None):
         """Zero-recompile hot-swap: replace the served parameters with
         ``estimator``'s under the SAME compiled entry points
         (``CompiledBatchFn.swap_params`` — programs close over shapes,
@@ -278,14 +296,31 @@ class ModelServer:
         version is structurally incompatible — use :meth:`rebuild_model`
         then. In-flight batches finish on the old version; batches
         packed after return serve the new one. Safe under live traffic.
+
+        ``quantize`` selects the serving precision FLAVOR for the new
+        version ("int8" or None = float32). Flavors named in
+        ``config.serving_warm_flavors`` were pre-built at construction
+        and warmed with warmup(), so flipping a model between f32 and
+        int8 is the same zero-compile swap as a same-flavor version
+        push; an un-warmed flavor refuses with ParamSwapError (the
+        rebuild_model cue), keeping the no-compiles-on-the-serving-path
+        contract explicit.
         """
+        flavor = quantize or ""
+        fns = self._flavor_fns.get(flavor)
+        if fns is None:
+            raise ParamSwapError(
+                f"serving flavor {flavor!r} was not pre-built on this "
+                "server; add it to config.serving_warm_flavors (and "
+                "re-warm) or install via rebuild_model"
+            )
         # validate EVERY method against the new estimator before
         # mutating ANY entry point: a multi-method server must never be
         # left half-swapped (predict on v2, predict_proba on v1).
         # prepare_swap covers every entry-point flavor — compiled,
         # pipeline, host fallback — and touches no live state.
         tokens = {}
-        for m, fn in self._fns.items():
+        for m, fn in fns.items():
             try:
                 tokens[m] = fn.prepare_swap(estimator)
             except ParamSwapError as exc:
@@ -302,8 +337,13 @@ class ModelServer:
             # version key before the flip
             self._flush_quality()
         old_outs = self._canary_pass() if self._drift_on else {}
-        for m, fn in self._fns.items():
+        for m, fn in fns.items():
             fn.commit_swap(tokens[m])
+        # flavor flip is one dict-reference assignment: the worker reads
+        # self._fns[method] per batch, so it sees either the complete
+        # old flavor or the complete new one
+        self._fns = fns
+        self._active_flavor = flavor
         self.estimator = estimator
         if version is not None:
             self.model_version = int(version)
@@ -367,16 +407,37 @@ class ModelServer:
     def _canary_run(self, method, padded, n_rows):
         return np.asarray(self._fns[method](padded))[:n_rows]
 
-    def rebuild_model(self, estimator, version=None, warm=None):
+    _KEEP_FLAVOR = object()  # "caller didn't say": keep current flavor
+
+    def rebuild_model(self, estimator, version=None, warm=None,
+                      quantize=_KEEP_FLAVOR):
         """The slow path a shape-incompatible publish needs: build fresh
         compiled entry points for ``estimator`` (paying compiles), warm
         them off the serving path, then install atomically. ``warm``
-        defaults to whether this server was warmed."""
-        fns = {m: compiled_batch_fn(estimator, m, device=self.device)
-               for m in self._fns}
+        defaults to whether this server was warmed. Every pre-built
+        flavor rebuilds (a shape change invalidates all of them);
+        ``quantize`` picks which flavor serves afterward — with the
+        SAME semantics as :meth:`swap_model` (None = float32; an
+        int8-serving replica receiving a shape-changed f32 publish must
+        come out serving f32, not its old flavor). Omitting the
+        argument keeps the current flavor. Naming a flavor that wasn't
+        in the table adds it (this is the paid path, so growing the
+        flavor set here is fine)."""
+        flavor = self._active_flavor \
+            if quantize is ModelServer._KEEP_FLAVOR else (quantize or "")
+        flavors = set(self._flavor_fns) | {flavor}
+        table = {
+            fl: {m: compiled_batch_fn(estimator, m, device=self.device,
+                                      quantize=(fl or None))
+                 for m in self._fns}
+            for fl in flavors
+        }
         if warm or (warm is None and self._warmed):
-            self._warm_fns(fns)
-        self._fns = fns
+            for fns in table.values():
+                self._warm_fns(fns)
+        self._flavor_fns = table
+        self._fns = table[flavor]
+        self._active_flavor = flavor
         self.estimator = estimator
         if version is not None:
             self.model_version = int(version)
@@ -414,7 +475,10 @@ class ModelServer:
         from ..config import ensure_compile_cache
 
         ensure_compile_cache()
-        self._warm_fns(self._fns)
+        # every pre-built flavor warms (config.serving_warm_flavors):
+        # a later f32 <-> int8 flavor swap then hits only warm caches
+        for fns in self._flavor_fns.values():
+            self._warm_fns(fns)
         self._warmed = True
         return self
 

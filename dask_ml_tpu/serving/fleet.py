@@ -214,10 +214,13 @@ class FleetServer:
             for r in self.replicas:
                 if r.model_version == mv.version:
                     continue
+                q = getattr(mv, "quantize", None)
                 try:
-                    r.swap_model(mv.estimator, version=mv.version)
+                    r.swap_model(mv.estimator, version=mv.version,
+                                 quantize=q)
                 except ParamSwapError:
-                    r.rebuild_model(mv.estimator, version=mv.version)
+                    r.rebuild_model(mv.estimator, version=mv.version,
+                                    quantize=q)
                 smetrics.set_replica_gauges(r.replica_id,
                                             version=mv.version)
                 changed += 1
@@ -225,10 +228,13 @@ class FleetServer:
             if changed:
                 self._swaps += 1
 
-    def publish(self, estimator, tag=None) -> int:
+    def publish(self, estimator, tag=None, quantize=None) -> int:
         """Publish a new version of this fleet's model (and hot-swap
-        every replica before returning)."""
-        return self.registry.publish(self.name, estimator, tag=tag)
+        every replica before returning). ``quantize="int8"`` serves the
+        version through the replicas' pre-warmed weight-quantized entry
+        points (config.serving_warm_flavors)."""
+        return self.registry.publish(self.name, estimator, tag=tag,
+                                     quantize=quantize)
 
     def rollback(self, version=None) -> int:
         """Roll the fleet back to an archived registry version."""

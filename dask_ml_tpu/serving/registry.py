@@ -53,10 +53,10 @@ class ModelVersion:
     restores the matching baseline, not the current one's."""
 
     __slots__ = ("name", "version", "estimator", "t_publish", "tag",
-                 "publisher", "profile")
+                 "publisher", "profile", "quantize")
 
     def __init__(self, name, version, estimator, tag=None,
-                 publisher=None):
+                 publisher=None, quantize=None):
         self.name = name
         self.version = int(version)
         self.estimator = estimator
@@ -65,6 +65,12 @@ class ModelVersion:
         self.publisher = str(publisher) if publisher is not None \
             else threading.current_thread().name
         self.profile = getattr(estimator, "training_profile_", None)
+        # serving precision flavor for THIS version (None = float32,
+        # "int8" = weight-quantized entry points): subscribers
+        # (ModelServer/FleetServer swap hooks) serve the version
+        # through the matching pre-warmed flavor, so flipping a model
+        # f32 <-> int8 is an ordinary zero-recompile hot-swap
+        self.quantize = quantize
 
     def __repr__(self):
         tag = f", tag={self.tag!r}" if self.tag else ""
@@ -101,11 +107,15 @@ class ModelRegistry:
 
     # -- write plane -------------------------------------------------------
     def publish(self, name, estimator, tag=None, snapshot=True,
-                publisher=None) -> int:
+                publisher=None, quantize=None) -> int:
         """Store ``estimator`` as the next version of ``name``, make it
         current, notify subscribers. Returns the new version id.
         ``publisher`` labels the version on /status (defaults to the
-        publishing thread's name).
+        publishing thread's name). ``quantize="int8"`` flags the
+        version for the weight-quantized serving flavor — subscribers
+        swap it in through their pre-warmed int8 entry points
+        (per-channel scales are computed at swap time from this
+        snapshot's weights).
 
         ``snapshot=True`` (default) deep-copies the estimator so later
         in-place training (``partial_fit``) cannot mutate the archive;
@@ -116,7 +126,7 @@ class ModelRegistry:
             version = self._next.get(name, 1)
             self._next[name] = version + 1
             mv = ModelVersion(name, version, est, tag=tag,
-                              publisher=publisher)
+                              publisher=publisher, quantize=quantize)
             versions = self._models.setdefault(name, {})
             versions[version] = mv
             self._current[name] = version
@@ -235,6 +245,7 @@ class ModelRegistry:
                     "t_publish": round(mv.t_publish, 3) if mv else None,
                     "publisher": mv.publisher if mv else None,
                     "tag": mv.tag if mv else None,
+                    "quantize": mv.quantize if mv else None,
                 }
         return out
 

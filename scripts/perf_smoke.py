@@ -54,8 +54,25 @@ def main():
         snap = obs.counters_snapshot()
         st = dict(getattr(clf, "_last_stream_stats", None) or {})
 
+    # fused-kernel dispatch contract (ISSUE 8): enabling the fused
+    # streamed kernels must NOT change the dispatch shape of a pass —
+    # the Pallas flavor replaces the per-block BODY inside the same
+    # scan, never the scan structure (and off-TPU it must be inert).
+    with config.set(stream_block_rows=n // 32, stream_autotune=False,
+                    pallas_stream=False):
+        off = SGDClassifier(max_iter=1, random_state=0, shuffle=False)
+        off.fit(X, y)
+    off_st = dict(getattr(off, "_last_stream_stats", None) or {})
+
     budget = math.ceil(n_blocks / max(k, 1)) + 1
     dpp = st.get("dispatches_per_pass")
+    if off_st.get("dispatches_per_pass") != dpp:
+        failures.append(
+            f"fused SGD step changed dispatches_per_pass: "
+            f"{dpp} (pallas_stream=on) vs "
+            f"{off_st.get('dispatches_per_pass')} (off) — the fused "
+            "path must not add dispatches"
+        )
     if dpp is None:
         failures.append("no dispatches_per_pass in stream stats — the "
                         "fit did not take the super-block path")

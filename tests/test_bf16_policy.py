@@ -97,7 +97,13 @@ def test_sgd_fused_epoch_bf16_parity():
 def test_unknown_dtype_raises_and_pallas_warns():
     from dask_ml_tpu.config import mxu_dtype
 
+    # "bf16" is an accepted ALIAS since ISSUE 8 (it used to be the
+    # canonical example typo); a real typo still raises
     with config.set(dtype="bf16"):
+        import jax.numpy as jnp
+
+        assert mxu_dtype() is jnp.bfloat16
+    with config.set(dtype="b16"):
         with pytest.raises(ValueError, match="not supported"):
             mxu_dtype()
     # explicit Pallas + bf16: warned, not silently dropped
@@ -117,7 +123,10 @@ def test_bf16_leaves_f32_defaults_untouched():
     from dask_ml_tpu.models.sgd import _grid_builders
     from dask_ml_tpu.parallel import as_sharded
 
-    assert config.get_config().dtype == "float32"
+    # default policy is "auto" — which must resolve to f32 dtypes
+    # everywhere on this CPU backend
+    assert config.get_config().dtype == "auto"
+    assert config.mxu_dtype() is None
     X = rng.randn(64, 4).astype(np.float32)
     Xs = as_sharded(X)
     fX, _ = _grid_builders(Xs.mesh, 8, 8, None)

@@ -81,7 +81,7 @@ def test_config_set_overrides_and_env():
     from dask_ml_tpu import config
 
     base = config.get_config()
-    assert base.dtype in ("float32", "bfloat16")
+    assert base.dtype in ("auto", "float32", "bfloat16")
     with config.set(stream_block_rows=4096, dtype="bfloat16"):
         cfg = config.get_config()
         assert cfg.stream_block_rows == 4096

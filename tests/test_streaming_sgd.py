@@ -137,7 +137,7 @@ def test_config():
     from dask_ml_tpu import config
 
     base = config.get_config()
-    assert base.dtype == "float32"
+    assert base.dtype == "auto"   # bf16 on TPU, f32 elsewhere (ISSUE 8)
     with config.set(stream_block_rows=123):
         assert config.get_config().stream_block_rows == 123
         with config.set(dtype="bfloat16"):  # nested set layers, not replaces
