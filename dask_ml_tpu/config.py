@@ -52,6 +52,20 @@ class Config:
     # opt-out: False forces the per-block dispatch path everywhere even
     # for consumers that support the fused scan
     stream_superblock: bool = True
+    # -- data-parallel superblock streaming (ISSUE 9) ---------------------
+    # data-axis shards for the STREAMED superblock hot loop: every
+    # super-block stages as a batch-sharded jax.Array (per-shard host
+    # slabs placed onto their own device by the staging worker, ragged
+    # tails padded per shard with zero valid-row counts) and the scan
+    # programs run under shard_map with REPLICATED carries — GLM
+    # val/vg/vgh reducers and KMeans assign-stats pay one lax.psum over
+    # "data" per super-block, streamed SGD one gradient psum per block
+    # step. 0 = auto (all local devices — the sharded flavor engages
+    # whenever more than one device is visible); 1 = single-device
+    # streaming (the sharded machinery never enters the trace and the
+    # streamed jaxprs are byte-identical to the pre-mesh programs);
+    # N > 1 = shard over the first N local devices
+    stream_mesh: int = 0
     # zero-copy CPU staging: on a single-device XLA:CPU mesh, full
     # dense 64-byte-aligned blocks import into the runtime as ALIASES
     # of the host memory (dlpack) instead of device_put copies — the

@@ -232,6 +232,63 @@ def _sb_assign_stats(acc, Xs, counts, centers, mxu_dtype=None):
     return acc
 
 
+import functools as _ft
+
+
+@_ft.lru_cache(maxsize=16)
+def _sb_assign_stats_sharded(mesh, mxu_dtype=None):
+    """Data-parallel flavor of :func:`_sb_assign_stats` (ISSUE 9): the
+    K-step assign+accumulate scan runs under ``shard_map`` over the
+    stream mesh's "data" axis — each device scans only its own row slab
+    of every block (local masks from the per-shard valid-row counts),
+    the (sums, counts, inertia) carry stays REPLICATED, and the whole
+    super-block pays exactly ONE ``lax.psum`` over "data" to fold the
+    local delta into the running carry. Donated at the jit level like
+    the single-device flavor."""
+    from jax.sharding import PartitionSpec as P
+
+    from .._compat import shard_map
+    from ..parallel.mesh import DATA_AXIS, data_shard_spec as spec_of
+
+    def body(acc, Xs, counts, centers):
+        unrolled = isinstance(Xs, (tuple, list))
+        r = jnp.arange(Xs[0].shape[0] if unrolled else Xs.shape[1])
+        cts = counts[0]
+        local = jax.tree.map(jnp.zeros_like, acc)
+
+        def step(lacc, X, c):
+            mask = (r < c).astype(X.dtype)
+            s, cnt, i = _block_assign_stats.__wrapped__(
+                X, mask, centers, mxu_dtype=mxu_dtype
+            )
+            return (lacc[0] + s, lacc[1] + cnt, lacc[2] + i)
+
+        if unrolled:
+            for j in range(len(Xs)):
+                local = step(local, Xs[j], cts[j])
+        else:
+            def scan_step(lacc, inp):
+                return step(lacc, *inp), jnp.float32(0.0)
+
+            local, _ = jax.lax.scan(scan_step, local, (Xs, cts))
+        local = jax.lax.psum(local, DATA_AXIS)
+        return tuple(a + l for a, l in zip(acc, local))
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def run(acc, Xs, counts, centers):
+        unrolled = isinstance(Xs, (tuple, list))
+        xs_spec = tuple(spec_of(a, 0) for a in Xs) if unrolled \
+            else spec_of(Xs, 1)
+        f = shard_map(
+            body, mesh,
+            in_specs=(P(), xs_spec, P(DATA_AXIS, None), P()),
+            out_specs=P(),
+        )
+        return f(acc, Xs, counts, centers)
+
+    return track_program("superblock.kmeans_assign.psum")(run)
+
+
 @track_program("pallas.kmeans_stream")
 @partial(jax.jit, static_argnames=("mxu_dtype", "interpret"),
         donate_argnums=(0,))
@@ -395,12 +452,36 @@ def _streamed_lloyd(stream, centers0, max_iter, tol2, logger=None,
     from ..ops.pallas_fused import kmeans_stream_tile, use_stream_kernels
 
     k0, d0 = jnp.asarray(centers0).shape
+    sharded = bool(
+        use_sb and getattr(stream, "sb_sharded", lambda: False)()
+    )
     fused = bool(
-        use_sb and use_stream_kernels()
+        use_sb and not sharded and use_stream_kernels()
         and kmeans_stream_tile(int(stream.block_rows), int(d0),
                                int(k0)) is not None
     )
     sb_run = _sb_assign_stats_pallas if fused else _sb_assign_stats
+    rep = None
+    if sharded:
+        # data-parallel flavor (ISSUE 9): one psum over "data" per
+        # super-block; carry AND centers committed replicated so every
+        # dispatch of the fit reuses one executable
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..config import resolve_dtype
+
+        _, src = resolve_dtype(fit_dtype)
+        if src.startswith("auto"):
+            # mirror the resident auto-gate: under dtype="auto" the
+            # single-device streamed flavor this displaces is the f32
+            # Pallas kernel, so the sharded XLA body stays f32 too —
+            # bf16 distance assignments would put sharded-vs-single
+            # parity at the mercy of argmin ties, not reassociation.
+            # An EXPLICIT bfloat16 request is still honored
+            mxu = None
+        rep = NamedSharding(stream.mesh, P())
+        centers = jax.device_put(centers, rep)
+        sharded_run = _sb_assign_stats_sharded(stream.mesh, mxu)
 
     for it in range(start_it, int(max_iter)):
         if use_sb:
@@ -411,10 +492,17 @@ def _streamed_lloyd(stream, centers0, max_iter, tol2, logger=None,
                    jnp.zeros((k_clusters,), jnp.float32),
                    jnp.zeros((), jnp.float32))
             acc_bytes = 4 * (k_clusters * d + k_clusters + 1)
-            for sb in stream.superblocks():
-                acc = sb_run(acc, sb.arrays[0], sb.counts,
-                             centers, mxu_dtype=mxu)
-                record_superblock_donation(acc_bytes)
+            if sharded:
+                acc = jax.device_put(acc, rep)
+                for sb in stream.superblocks():
+                    acc = sharded_run(acc, sb.arrays[0],
+                                      sb.shard_counts, centers)
+                    record_superblock_donation(acc_bytes)
+            else:
+                for sb in stream.superblocks():
+                    acc = sb_run(acc, sb.arrays[0], sb.counts,
+                                 centers, mxu_dtype=mxu)
+                    record_superblock_donation(acc_bytes)
             sums, counts, inertia = acc
         else:
             sums = counts = inertia = None

@@ -219,6 +219,126 @@ def _sgd_sb_scan_pallas(W, Xs, ys, counts, lrs, alpha, l2w, l1w, iflag,
     return jax.lax.scan(scan_step, W, (Xs, ys, counts, lrs))
 
 
+import functools as _ft_sharded
+
+
+@_ft_sharded.lru_cache(maxsize=32)
+def _sgd_sb_scan_sharded(mesh, loss, n_out, mxu=None):
+    """Data-parallel flavor of :func:`_sgd_sb_scan` (ISSUE 9): the K
+    block steps run under ``shard_map`` over the stream mesh's "data"
+    axis with a REPLICATED weight carry. SGD's update is sequential in
+    the blocks, so unlike the additive GLM/KMeans reducers it cannot
+    defer merging to one pass-end collective: each block step computes
+    its shard's raw (loss-sum, gradient-sum) from purely local rows and
+    pays ONE ``lax.psum`` over "data" before the identical lr/l2/prox
+    epilogue applies the GLOBAL gradient — the classic data-parallel
+    minibatch step, K psums per super-block dispatch. Counts split per
+    shard (``shard_counts``, local masks) with the global ``counts``
+    riding replicated for the normalizer and the padding-slot
+    pass-through; parity with the single-device scan is float-roundoff
+    only (per-shard partial sums reassociate the same additions).
+
+    Cached per (mesh, loss, n_out, mxu) so every pass of a fit reuses
+    ONE jitted, donated-carry callable."""
+    from jax.sharding import PartitionSpec as P
+
+    from .._compat import shard_map
+    from ..parallel.mesh import DATA_AXIS, data_shard_spec as spec_of
+
+    def body(W, Xs, ys, shard_counts, counts, lrs, alpha, l2w, l1w,
+             iflag):
+        unrolled = isinstance(Xs, (tuple, list))
+        S = Xs[0].shape[0] if unrolled else Xs.shape[1]
+        r = jnp.arange(S)
+        cts_local = shard_counts[0]
+
+        def step(W, Xb, yb, c_loc, c_glob, lr):
+            mask = (r < c_loc).astype(jnp.float32)
+            nv = jnp.maximum(c_glob.astype(jnp.float32), 1.0)
+
+            def one(w, y):
+                def local_sums(w):
+                    # the raw UNNORMALIZED data term over this shard's
+                    # rows — same eta/loss math as _sgd_update_one
+                    # (iflag rides inside eta, so grad[-1] is already 0
+                    # with the intercept off)
+                    Xd = Xb if mxu is None else Xb.astype(mxu)
+                    eta = jnp.matmul(Xd, w[:-1].astype(Xd.dtype),
+                                     preferred_element_type=jnp.float32
+                                     ) + w[-1] * iflag
+                    if loss == "log_loss":
+                        per = jax.nn.softplus(eta) - y * eta
+                    elif loss == "hinge":
+                        margins = (2.0 * y - 1.0) * eta
+                        per = jnp.maximum(0.0, 1.0 - margins)
+                    else:  # squared_error
+                        per = 0.5 * (eta - y) ** 2
+                    return jnp.sum(per * mask)
+
+                v, g = jax.value_and_grad(local_sums)(w)
+                # the data-parallel gradient psum INSIDE the scan: the
+                # next block step needs the GLOBAL update
+                loss_sum, grad = jax.lax.psum((v, g), DATA_AXIS)
+                loss_v = loss_sum / nv \
+                    + 0.5 * alpha * l2w * jnp.sum(w[:-1] ** 2)
+                g = grad / nv
+                g = g.at[:-1].add(alpha * l2w * w[:-1])
+                w2 = w - lr * g
+                thr = lr * alpha * l1w
+                coef = jnp.sign(w2[:-1]) * jnp.maximum(
+                    jnp.abs(w2[:-1]) - thr, 0.0
+                )
+                return w2.at[:-1].set(coef), loss_v
+
+            if n_out is not None:
+                def one_class(w, cc):
+                    return one(w, (yb == cc).astype(jnp.float32))
+
+                W2, losses = jax.vmap(one_class)(
+                    W, jnp.arange(n_out, dtype=jnp.float32)
+                )
+                loss_v = losses.sum()
+            else:
+                W2, loss_v = one(W, yb)
+            return jnp.where(c_glob > 0, W2, W), loss_v
+
+        if unrolled:
+            losses = []
+            for j in range(len(Xs)):
+                W, loss_v = step(W, Xs[j], ys[j], cts_local[j],
+                                 counts[j], lrs[j])
+                losses.append(loss_v)
+            return W, jnp.stack(losses)
+
+        def scan_step(W, inp):
+            Xb, yb, cl, cg, lr = inp
+            return step(W, Xb, yb, cl, cg, lr)
+
+        return jax.lax.scan(scan_step, W,
+                            (Xs, ys, cts_local, counts, lrs))
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def run(W, Xs, ys, shard_counts, counts, lrs, alpha, l2w, l1w,
+            iflag):
+        unrolled = isinstance(Xs, (tuple, list))
+        if unrolled:
+            xs_spec = tuple(spec_of(a, 0) for a in Xs)
+            ys_spec = tuple(spec_of(a, 0) for a in ys)
+        else:
+            xs_spec = spec_of(Xs, 1)
+            ys_spec = spec_of(ys, 1)
+        f = shard_map(
+            body, mesh,
+            in_specs=(P(), xs_spec, ys_spec, P(DATA_AXIS, None), P(),
+                      P(), P(), P(), P(), P()),
+            out_specs=(P(), P()),
+        )
+        return f(W, Xs, ys, shard_counts, counts, lrs, alpha, l2w,
+                 l1w, iflag)
+
+    return track_program("superblock.sgd_scan.psum")(run)
+
+
 @track_program("sgd.fused_epoch")
 @partial(jax.jit, static_argnames=("loss", "schedule", "n_out"))
 def _sgd_epoch(Xr, yr, order, W, t0, eta0, power_t, alpha, l2w, l1w,
@@ -742,6 +862,35 @@ class _SGDBase(BaseEstimator):
         lrs[:sb.n_blocks] = self._lr_schedule(sb.n_blocks)
         l2w, l1w = self._penalty_weights()
         w_bytes = int(np.prod(self._w.shape)) * 4
+        if sb.shard_counts is not None:
+            # data-parallel flavor (ISSUE 9): blocks staged batch-
+            # sharded over the stream mesh; the scan runs under
+            # shard_map with the weight carry replicated and one
+            # gradient psum per block step. The carry is committed
+            # replicated ONCE so every dispatch of the fit hits the
+            # same executable (and donation aliases in place)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..config import mxu_dtype
+
+            mesh = sb.shard_counts.sharding.mesh
+            rep = NamedSharding(mesh, P())
+            if getattr(self._w, "sharding", None) != rep:
+                self._w = jax.device_put(self._w, rep)
+            run = _sgd_sb_scan_sharded(mesh, self._loss(),
+                                       self._n_out(),
+                                       mxu_dtype(self.fit_dtype))
+            W, losses = run(
+                self._w, sb.arrays[0], sb.arrays[1], sb.shard_counts,
+                sb.counts, jnp.asarray(lrs), jnp.float32(self.alpha),
+                jnp.float32(l2w), jnp.float32(l1w),
+                jnp.float32(1.0 if self.fit_intercept else 0.0),
+            )
+            record_superblock_donation(w_bytes)
+            self._w = W
+            self._t += sb.n_blocks
+            self._last_loss = losses[sb.n_blocks - 1]
+            return
         pallas_run, mxu = self._sb_scan_flavor(sb)
         if pallas_run is not None:
             W, losses = pallas_run(

@@ -253,14 +253,99 @@ def _block_admm_local_multi(X, y, mask, B, U, Z, rho, n_rows, local_iter,
 import functools as _ft
 
 
+def _reducer_blocks(kind, n_classes):
+    """(per-block kernel, extra static args) for one objective flavor —
+    shared by the single-device scan and the sharded shard_map scan so
+    the two flavors can never diverge on the per-block math."""
+    if n_classes:
+        fn = {"val": _block_val_multi, "vg": _block_val_grad_multi,
+              "vgh": _block_val_grad_hess_multi}[kind].__wrapped__
+        return fn, (n_classes,)
+    fn = {"val": _block_val, "vg": _block_val_grad,
+          "vgh": _block_val_grad_hess}[kind].__wrapped__
+    return fn, ()
+
+
+def _sb_reducer_sharded(kind, family, intercept, n_classes, mesh):
+    """Data-parallel super-block reducer (ISSUE 9): the same K-step
+    accumulation as :func:`_sb_reducer`, run under ``shard_map`` over
+    the stream mesh's "data" axis. Each device scans ONLY its own row
+    slab of every block (masks derive from the per-shard valid-row
+    counts — ragged tails pad per shard with zero counts), the carry is
+    REPLICATED (in/out spec P()), and the dispatch pays exactly ONE
+    ``lax.psum`` over "data": the local K-block delta merges once, then
+    adds to the running replicated carry. Donation at the jit level
+    keeps the carry advancing in place exactly like the single-device
+    flavor."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..._compat import shard_map
+    from ...parallel.mesh import DATA_AXIS, data_shard_spec as spec_of
+
+    fn, extra = _reducer_blocks(kind, n_classes)
+
+    def body(acc, beta, Xs, ys, counts):
+        # LOCAL view: Xs (K, S/D, d) or a K-tuple of (S/D, d) blocks,
+        # counts (1, K) — this shard's own valid-row counts
+        unrolled = isinstance(Xs, (tuple, list))
+        r = jnp.arange(Xs[0].shape[0] if unrolled else Xs.shape[1])
+        cts = counts[0]
+        local = jax.tree.map(jnp.zeros_like, acc)
+
+        def step(lacc, Xb, yb, c):
+            mask = (r < c).astype(Xb.dtype)
+            out = fn(beta, Xb, yb, mask, family, intercept, *extra)
+            out = out if isinstance(out, tuple) else (out,)
+            return tuple(l + o for l, o in zip(lacc, out))
+
+        if unrolled:
+            for j in range(len(Xs)):
+                local = step(local, Xs[j], ys[j], cts[j])
+        else:
+            def scan_step(lacc, inp):
+                return step(lacc, *inp), jnp.float32(0.0)
+
+            local, _ = jax.lax.scan(scan_step, local, (Xs, ys, cts))
+        # the super-block's ONE collective: local sums -> replicated
+        # global sums, folded into the replicated running carry
+        local = jax.lax.psum(local, DATA_AXIS)
+        return tuple(a + l for a, l in zip(acc, local))
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def run(acc, beta, Xs, ys, counts):
+        unrolled = isinstance(Xs, (tuple, list))
+        if unrolled:
+            xs_spec = tuple(spec_of(a, 0) for a in Xs)
+            ys_spec = tuple(spec_of(a, 0) for a in ys)
+        else:
+            xs_spec = spec_of(Xs, 1)
+            ys_spec = spec_of(ys, 1)
+        f = shard_map(
+            body, mesh,
+            in_specs=(P(), P(), xs_spec, ys_spec, P(DATA_AXIS, None)),
+            out_specs=P(),
+        )
+        return f(acc, beta, Xs, ys, counts)
+
+    suffix = "_multi" if n_classes else ""
+    return track_program(f"superblock.glm.{kind}{suffix}.psum")(run)
+
+
 @_ft.lru_cache(maxsize=64)
 def _sb_reducer(kind, family, intercept, n_classes, mxu=None,
-                fused=False, interpret=False):
+                fused=False, interpret=False, mesh=None):
     """The donated-carry super-block program for one objective flavor:
     ``kind`` in {"val", "vg", "vgh"} lifts the matching per-block kernel
     into a scan over the (K, S, ...) stacks, accumulating its sum tuple.
     Cached per flavor so every pass reuses ONE jitted callable (a fresh
     jax.jit per pass would retrace).
+
+    ``mesh`` (a >1-shard stream mesh, ISSUE 9) selects the shard_map
+    data-parallel flavor — replicated carry, per-shard blocks, one
+    psum per super-block; its counts operand is the (D, K) per-shard
+    matrix, not the global (K,) vector. With ``mesh=None`` (and the
+    other knobs at default) this function is byte-for-byte the
+    pre-mesh program.
 
     ``fused=True`` (binary objectives on real TPU — see
     ``StreamedObjective._sb_pass``'s gate) swaps the per-block body for
@@ -269,6 +354,9 @@ def _sb_reducer(kind, family, intercept, n_classes, mxu=None,
     with ``mxu`` running the matmuls at bf16/f32-acc
     (config.dtype="auto" on TPU). With ``fused=False`` and ``mxu``
     unset this function is byte-for-byte the pre-feature program."""
+    if mesh is not None:
+        return _sb_reducer_sharded(kind, family, intercept, n_classes,
+                                   mesh)
     if fused and not n_classes:
         from ...ops.pallas_fused import fused_glm_stream
 
@@ -295,14 +383,7 @@ def _sb_reducer(kind, family, intercept, n_classes, mxu=None,
             return acc
 
         return track_program(f"pallas.glm_{kind}")(run_fused)
-    if n_classes:
-        fn = {"val": _block_val_multi, "vg": _block_val_grad_multi,
-              "vgh": _block_val_grad_hess_multi}[kind].__wrapped__
-        extra = (n_classes,)
-    else:
-        fn = {"val": _block_val, "vg": _block_val_grad,
-              "vgh": _block_val_grad_hess}[kind].__wrapped__
-        extra = ()
+    fn, extra = _reducer_blocks(kind, n_classes)
 
     @partial(jax.jit, donate_argnums=(0,))
     def run(acc, beta, Xs, ys, counts):
@@ -429,6 +510,12 @@ class StreamedObjective:
                                          use_stream_kernels)
 
         s = self.stream
+        if getattr(s, "sb_sharded", lambda: False)():
+            # the data-parallel flavor runs the XLA per-block bodies
+            # under shard_map; the fused Pallas body is a single-device
+            # feature for now (its tile gate reasons about the whole
+            # block, not a shard's slab)
+            return None, False
         try:
             S = int(s.block_rows)
             d = int(np.prod(s.arrays[0].shape[1:], dtype=np.int64))
@@ -473,13 +560,27 @@ class StreamedObjective:
             return None
         from ...observability import record_superblock_donation
 
-        mxu, fused = self._sb_flavor(kind)
-        run = _sb_reducer(kind, self.family, self.intercept,
-                          self.n_classes or 0, mxu=mxu, fused=fused)
+        sharded = bool(getattr(s, "sb_sharded", lambda: False)())
+        if sharded:
+            # data-parallel superblock flavor (ISSUE 9): shard_map over
+            # the stream mesh, one psum per super-block. The carry
+            # enters COMMITTED-replicated so every dispatch (including
+            # the first) hits the same compiled executable and the
+            # donated buffers alias in place
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            run = _sb_reducer(kind, self.family, self.intercept,
+                              self.n_classes or 0, mesh=s.mesh)
+            init = jax.device_put(init, NamedSharding(s.mesh, P()))
+        else:
+            mxu, fused = self._sb_flavor(kind)
+            run = _sb_reducer(kind, self.family, self.intercept,
+                              self.n_classes or 0, mxu=mxu, fused=fused)
         acc = init
         acc_bytes = sum(4 * int(np.prod(a.shape) or 1) for a in acc)
         for sb in s.superblocks():
-            acc = run(acc, B, sb.arrays[0], sb.arrays[1], sb.counts)
+            counts = sb.shard_counts if sharded else sb.counts
+            acc = run(acc, B, sb.arrays[0], sb.arrays[1], counts)
             record_superblock_donation(acc_bytes)
         return acc
 
@@ -971,6 +1072,12 @@ def solve_streamed(solver, stream, n_rows, beta0, family, reg, lam, pmask,
     )
     info["streamed"] = True
     info["n_blocks"] = stream.n_blocks
+    # data-parallel width of the superblock hot loop (1 = single-device
+    # programs; >1 = shard_map/psum flavor over the stream mesh)
+    info["stream_shards"] = int(
+        getattr(stream, "sb_data_shards", lambda: 1)()
+    ) if (hasattr(stream, "use_superblocks")
+          and stream.use_superblocks()) else 1
     # the resolved precision policy + whether the fused Pallas reducers
     # carried the pass (streamed XLA flavors are f32-only — an auto
     # policy that fell back must be on record). The flavor gate is
@@ -1027,6 +1134,10 @@ def solve_streamed_multi(solver, stream, n_rows, B0, family, reg, lam,
     info["streamed"] = True
     info["n_blocks"] = stream.n_blocks
     info["n_classes"] = C
+    info["stream_shards"] = int(
+        getattr(stream, "sb_data_shards", lambda: 1)()
+    ) if (hasattr(stream, "use_superblocks")
+          and stream.use_superblocks()) else 1
     # multiclass streamed reducers are XLA/f32-only today (the fused
     # kernels cover the flat-weight objectives)
     info["fused_stream"] = False

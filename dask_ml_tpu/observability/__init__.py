@@ -70,6 +70,7 @@ from ._counters import (
     record_serving_reroute,
     record_serving_slo_violation,
     record_serving_swap,
+    record_shard_staging,
     record_superblock,
     record_superblock_donation,
     record_transfer,
@@ -169,6 +170,7 @@ __all__ = [
     "record_serving_reroute",
     "record_serving_slo_violation",
     "record_serving_swap",
+    "record_shard_staging",
     "record_superblock",
     "record_superblock_donation",
     "record_transfer",

@@ -88,6 +88,16 @@ def record_zero_copy(nbytes: int) -> None:
         counter_add("zero_copy_blocks", 1)
 
 
+def record_shard_staging(n_shards: int) -> None:
+    """One batch-sharded staging assembly: ``n_shards`` per-shard host
+    slabs were placed onto their own devices (ISSUE 9 data-parallel
+    streaming) — shard_slab_puts / shard_staging_batches is the
+    measured data-axis width of the streamed hot loop."""
+    if counters_enabled():
+        counter_add("shard_staging_batches", 1)
+        counter_add("shard_slab_puts", int(n_shards))
+
+
 def record_superblock_donation(nbytes: int) -> None:
     """A super-block scan's donated carry was handed back to XLA for
     in-place reuse (the accumulator/weights buffer never reallocates

@@ -260,6 +260,10 @@ def summarize_stream(records):
     )
     sb = [int(p["superblock_k"]) for p in passes if p.get("superblock_k")]
     tot["superblock_k"] = max(sb) if sb else 1
+    # data-parallel width of the sharded superblock flavor (ISSUE 9):
+    # 1 = single-device streaming, D = shard_map/psum scans over D chips
+    sh = [int(p["sb_shards"]) for p in passes if p.get("sb_shards")]
+    tot["sb_shards"] = max(sh) if sh else 1
     return tot
 
 
@@ -441,10 +445,11 @@ def build_report(records, path="<records>"):
     if st:
         lines += _table(
             "streaming overlap",
-            ("passes", "blocks", "dispatches", "sb_k", "host", "put",
-             "wait", "consume"),
+            ("passes", "blocks", "dispatches", "sb_k", "shards",
+             "host", "put", "wait", "consume"),
             [(st["n_passes"], st["n_blocks"], st["dispatches"],
-              st["superblock_k"], _fmt_seconds(st["host_s"]),
+              st["superblock_k"], st.get("sb_shards", 1),
+              _fmt_seconds(st["host_s"]),
               _fmt_seconds(st["put_s"]), _fmt_seconds(st["wait_s"]),
               _fmt_seconds(st["consume_s"]))],
         )

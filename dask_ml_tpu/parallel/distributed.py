@@ -435,6 +435,51 @@ def array_from_process_local(local, mesh=None, dtype=np.float32):
     return ShardedArray(data, n, mesh)
 
 
+_MULTIHOST_CAPABLE = None
+
+
+def multihost_capability():
+    """(ok, reason): can this runtime span processes with a DEVICE
+    collective? The runtime twin of tests/_mp_capability's subprocess
+    probe: cached, one tiny cross-process barrier on first ask — some
+    CPU jax builds bring the distributed runtime up but refuse the
+    first collective ("Multiprocess computations aren't implemented on
+    the CPU backend"), and a streamed fit must degrade to its host
+    psum merge there instead of crashing mid-pass. Virtual worlds
+    answer False: their ranks share one real process, so there is
+    nothing for ``multihost_utils`` to span."""
+    global _MULTIHOST_CAPABLE
+    if _MULTIHOST_CAPABLE is not None:
+        return _MULTIHOST_CAPABLE
+    if process_count() == 1:
+        return (False, "single-process")
+    if _virtual() is not None:
+        return (False, "virtual world (one real process)")
+    try:
+        barrier("multihost-capability-probe")
+        _MULTIHOST_CAPABLE = (True, "")
+    except Exception as exc:  # noqa: BLE001 - the probe IS the catch
+        _MULTIHOST_CAPABLE = (False, f"{type(exc).__name__}: {exc}")
+    return _MULTIHOST_CAPABLE
+
+
+def sync_stream_pass(tag="stream_pass") -> bool:
+    """Process-spanning sync point between streamed passes
+    (``multihost_utils.sync_global_devices``): on a live multi-host
+    runtime every process streams the same pass sequence over its
+    LOCAL shard, and the barrier keeps a fast host from racing ahead
+    into pass N+1 transfers while a slow peer still owns the fabric
+    for pass N's psum merge. No-op (returns False) single-process, in
+    virtual worlds, and on backends whose capability probe failed."""
+    ok, _ = multihost_capability()
+    if not ok:
+        return False
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(tag)
+    return True
+
+
 def barrier(name="barrier"):
     """Cross-host sync point: a tiny psum over every device (virtual
     ranks rendezvous in-process and report the same device-count sum)."""

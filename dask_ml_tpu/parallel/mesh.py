@@ -125,6 +125,40 @@ def resolve_mesh(mesh=None) -> Mesh:
     return mesh if mesh is not None else default_mesh()
 
 
+def data_shard_spec(a, lead: int = 0) -> P:
+    """PartitionSpec sharding axis ``lead`` of ``a`` over the "data"
+    axis, every other axis replicated — the ONE spec builder the
+    sharded superblock scan programs (GLM reducers, SGD scan, KMeans
+    assign-stats) use for their block operands, so a future mesh-shape
+    change lands in one place."""
+    return P(*((None,) * lead + (DATA_AXIS,)
+               + (None,) * (a.ndim - lead - 1)))
+
+
+def stream_data_mesh() -> Mesh:
+    """The mesh streamed (out-of-core) fits shard over, resolved from
+    ``config.stream_mesh``: 0 = the ambient/default mesh (all local
+    devices — data-parallel streaming engages whenever >1 device is
+    visible), 1 = a single-device mesh (the sharded superblock flavor
+    never engages), N = the first N local devices. Cached per resolved
+    device set so every BlockStream of a fit sees the SAME Mesh object
+    (scan programs are lru-cached with the mesh in their key)."""
+    from ..config import get_config
+
+    n = int(get_config().stream_mesh)
+    if n <= 0:
+        return default_mesh()
+    devices = jax.devices()[: max(min(n, len(jax.devices())), 1)]
+    key = (n, len(devices), tuple(d.id for d in devices))
+    cached = getattr(_state, "stream_meshes", None)
+    if cached is None:
+        cached = _state.stream_meshes = {}
+    mesh = cached.get(key)
+    if mesh is None:
+        mesh = cached[key] = device_mesh(devices=devices)
+    return mesh
+
+
 def data_shards(mesh: Mesh) -> int:
     """Number of shards along the data (row) axis."""
     return mesh.shape[DATA_AXIS] if DATA_AXIS in mesh.shape else 1

@@ -135,15 +135,26 @@ class SuperBlock:
     super-block of a pass is padded to the same K — missing block slots
     carry ``counts == 0`` and all-zero data, so every dispatch compiles
     once — and ``n_blocks`` says how many slots are real. ``n_rows`` is
-    the super-block's total valid rows."""
+    the super-block's total valid rows.
 
-    __slots__ = ("arrays", "counts", "n_blocks", "n_rows")
+    On a >1-device stream mesh (ISSUE 9) every array is BATCH-SHARDED
+    over the mesh's "data" axis (each device owns a contiguous
+    ``block_rows / D`` row slab of every block) and ``shard_counts``
+    holds the device ``(D, K)`` per-shard valid-row counts — row ``s``
+    lives on shard ``s``'s device, so a shard_map consumer reads its
+    own ragged-tail counts locally (a block's trailing shards see 0).
+    ``shard_counts`` is None on a single-device mesh."""
 
-    def __init__(self, arrays, counts, n_blocks, n_rows):
+    __slots__ = ("arrays", "counts", "n_blocks", "n_rows",
+                 "shard_counts")
+
+    def __init__(self, arrays, counts, n_blocks, n_rows,
+                 shard_counts=None):
         self.arrays = arrays
         self.counts = counts
         self.n_blocks = n_blocks
         self.n_rows = n_rows
+        self.shard_counts = shard_counts
 
 
 # XLA:CPU's dlpack import aliases host memory (zero-copy) only at
@@ -289,6 +300,41 @@ def grid_partition(n_pad: int, D: int) -> tuple[int, int]:
     return -(-n_pad // S), S
 
 
+def resolve_stream_mesh(mesh=None):
+    """The mesh a host-streamed fit runs over: an explicit ``mesh``
+    wins; under a live multi-process runtime blocks are PROCESS-LOCAL
+    data (they shard over this process's devices only — a global-mesh
+    device_put asserts value equality across processes, and the
+    cross-process merge is the consumer's explicit psum_host); else
+    ``config.stream_mesh`` picks the local device set (see
+    ``mesh.stream_data_mesh``). The ONE resolution point shared by
+    ``BlockStream`` and ``fit_block_rows`` so block partitions and
+    staging shardings always agree."""
+    if mesh is not None:
+        return mesh
+    from . import distributed as dist
+
+    if dist.process_count() > 1:
+        local = dist.local_mesh()
+        from ..config import get_config
+
+        n = int(get_config().stream_mesh)
+        if n <= 0 or n >= local.devices.size:
+            return local
+        # config.stream_mesh still applies per process: N restricts to
+        # the first N LOCAL devices, and stream_mesh=1 remains the
+        # documented single-device escape hatch (the sharded flavor
+        # never engages) even under a live multi-host runtime — the
+        # exact environment where an un-validated path most needs an
+        # opt-out
+        from .mesh import device_mesh
+
+        return device_mesh(devices=list(local.devices.flat)[:n])
+    from .mesh import stream_data_mesh
+
+    return stream_data_mesh()
+
+
 def fit_block_rows(X, mesh=None) -> int:
     """Rows per block for an epoch-style fit over host data: the
     ``grid_partition`` size for the resolved mesh, capped by
@@ -297,7 +343,7 @@ def fit_block_rows(X, mesh=None) -> int:
     ONE block-size policy shared by the SGD fit loop and
     ``Incremental._block_size``."""
     n = int(X.shape[0]) if hasattr(X, "shape") else len(X)
-    D = max(data_shards(resolve_mesh(mesh)), 1)
+    D = max(data_shards(resolve_stream_mesh(mesh)), 1)
     S = max(grid_partition(-(-max(n, 1) // D) * D, D)[1], 1)
     budget = stream_plan(X)
     return S if budget is None else max(min(S, budget), 1)
@@ -357,17 +403,10 @@ class BlockStream:
     def __init__(self, arrays, block_rows=None, mesh=None, shuffle=False,
                  seed=None, dtype=np.float32, prefetch=None,
                  profile=True):
-        if mesh is None:
-            from . import distributed as dist
-
-            if dist.process_count() > 1:
-                # live multi-process runtime: blocks are PROCESS-LOCAL
-                # data — they shard over this process's devices only
-                # (a global-mesh device_put asserts value equality
-                # across processes); cross-process merging is the
-                # consumer's explicit psum_host of its block sums
-                mesh = dist.local_mesh()
-        self.mesh = resolve_mesh(mesh)
+        # stream_mesh / multi-process resolution lives in ONE place so
+        # the data-parallel superblock flavor, the block partition and
+        # the staging shardings can never disagree
+        self.mesh = resolve_stream_mesh(mesh)
         # sparse sources normalize to CSR once: COO/BSR don't support
         # row slicing at all and CSC slices rows in O(nnz)
         self.arrays = tuple(
@@ -415,6 +454,11 @@ class BlockStream:
             for a in self.arrays
         )
         self._counts_sharding = NamedSharding(self.mesh, P())
+        # per-shard valid-row counts of the sharded superblock flavor:
+        # a (D, K) matrix whose row s lives on shard s's device
+        self._shard_counts_sharding = NamedSharding(
+            self.mesh, P(DATA_AXIS, None)
+        )
         self._superblock_k_override = None  # set by the K autotuner
         from ..config import ensure_compile_cache, get_config
         from ..observability.live import ensure_telemetry
@@ -756,6 +800,10 @@ class BlockStream:
         cap = min(int(np.ceil(self.n_rows / shards)) * shards,
                   max(budget_rows, self.block_rows))
         new_rows = min(self.block_rows * 2, cap)
+        # a grown block must stay a SHARD MULTIPLE: the byte-budget cap
+        # is not rounded, and the sharded superblock flavor's per-shard
+        # staging/counts (block_rows / D exactly) require even division
+        new_rows = max(new_rows // shards * shards, shards)
         if new_rows <= self.block_rows:
             return
         self.block_rows = new_rows
@@ -811,6 +859,37 @@ class BlockStream:
         """True when a fused-scan consumer should take the super-block
         path (K > 1); False falls back to the per-block loop."""
         return self.resolve_superblock_k() > 1
+
+    def sb_data_shards(self) -> int:
+        """Data-axis shards of this stream's mesh — the D the sharded
+        superblock flavor (shard_map + psum scan programs) runs over.
+        1 means the single-device programs run untouched (their jaxprs
+        stay byte-identical to the pre-mesh feature)."""
+        return max(data_shards(self.mesh), 1)
+
+    def sb_sharded(self) -> bool:
+        """True when super-blocks stage batch-sharded and consumers
+        should run their shard_map/psum scan flavor."""
+        return self.sb_data_shards() > 1
+
+    def _put_sharded(self, a, sharding):
+        """One batch-sharded ``jax.Array`` from PER-SHARD host slabs,
+        each placed onto its own device (the overlapped staging worker
+        issues the D per-device transfers together — one slab, one
+        device, no runtime-side splitting of a monolithic host
+        buffer). Slabs of a C-contiguous source whose shard boundary
+        falls on a row boundary are zero-copy VIEWS until the transfer
+        reads them."""
+        from ..observability import record_shard_staging
+
+        imap = sharding.devices_indices_map(a.shape)
+        devs = list(imap)
+        slabs = [np.ascontiguousarray(a[imap[dv]]) for dv in devs]
+        parts = jax.device_put(slabs, devs)
+        record_shard_staging(len(devs))
+        return jax.make_array_from_single_device_arrays(
+            a.shape, sharding, parts
+        )
 
     def _sb_ring(self, k):
         """Fixed ring of host staging slabs, one slab set per in-flight
@@ -873,10 +952,13 @@ class BlockStream:
                 readers = None
         ring = self._sb_ring(k)
         unroll = superblock_unrolled()
+        D = self.sb_data_shards()
+        sharded = D > 1
         stats = {"host_s": 0.0, "put_s": 0.0, "wait_s": 0.0,
                  "consume_s": 0.0, "n_blocks": int(len(order)),
                  "block_rows": int(self.block_rows),
                  "superblock_k": int(k),
+                 "sb_shards": int(D),
                  "dispatches_per_pass": int(n_sb)}
         t_pass = _time.perf_counter()
         from collections import deque
@@ -941,7 +1023,51 @@ class BlockStream:
                         parts[i].append(slot["bufs"][i][j])
             return (parts if unroll else slot["bufs"]), counts
 
+        def shard_counts_of(counts):
+            """(D, K) per-shard valid-row counts: shard s owns rows
+            [s*Sd, (s+1)*Sd) of every block (Sd = block_rows / D — the
+            stream rounds block_rows to a shard multiple), so a ragged
+            tail block fills shard 0..j and pads the rest with ZERO
+            counts, exactly like the ragged final super-block pads its
+            missing block slots."""
+            sd = self.block_rows // D
+            return np.clip(
+                counts[None, :].astype(np.int64)
+                - np.arange(D, dtype=np.int64)[:, None] * sd,
+                0, sd,
+            ).astype(np.int32)
+
         def put(slot, parts, counts, n_real):
+            if sharded:
+                # data-parallel staging (ISSUE 9): each array becomes a
+                # batch-sharded jax.Array assembled from per-shard host
+                # slabs placed onto their own device — the consumer's
+                # shard_map scan then reads purely local rows and pays
+                # ONE psum per super-block for its reducers
+                if unroll:
+                    nbytes = sum(b.nbytes for p in parts for b in p)
+                    record_transfer(nbytes + counts.nbytes)
+                    dev = tuple(
+                        tuple(self._put_sharded(b, self._shardings[i])
+                              for b in p)
+                        for i, p in enumerate(parts)
+                    )
+                else:
+                    record_transfer(
+                        sum(b.nbytes for b in parts) + counts.nbytes
+                    )
+                    dev = tuple(
+                        self._put_sharded(b, s)
+                        for b, s in zip(parts, self._sb_shardings)
+                    )
+                counts_d = jax.device_put(counts, self._counts_sharding)
+                shard_d = self._put_sharded(
+                    shard_counts_of(counts), self._shard_counts_sharding
+                )
+                slot["dev"] = dev + (counts_d, shard_d)
+                return SuperBlock(dev, counts_d, n_real,
+                                  int(counts[:n_real].sum()),
+                                  shard_counts=shard_d)
             if unroll:
                 nbytes = sum(b.nbytes for p in parts for b in p
                              if not isinstance(b, jax.Array))
@@ -1091,6 +1217,15 @@ class BlockStream:
                     for r in readers:
                         if r is not None:
                             r.close()
+                # process-spanning pass barrier (multi-host streaming):
+                # every process streams the same pass sequence, so the
+                # sync matches up; behind the runtime capability probe —
+                # a backend that cannot span processes makes this a
+                # no-op instead of a crash
+                from . import distributed as dist
+
+                if dist.process_count() > 1:
+                    dist.sync_stream_pass("superblock_pass")
 
     def superblock_epochs(self, n_epochs, autotune=None):
         """Epoch iterator over super-blocks (the superblocks() analog of

@@ -67,6 +67,24 @@ def main():
             lb = LogisticRegression(solver="lbfgs", max_iter=20).fit(Xs, ys)
             ad = LogisticRegression(solver="admm", max_iter=20).fit(Xs, ys)
             assert lb.score(Xs, ys) > 0.6 and ad.score(Xs, ys) > 0.6
+            # sharded STREAMED fits (ISSUE 9): host data, super-blocks
+            # batch-sharded over the 8-device mesh, psum-bearing
+            # shard_map scan programs — SGD (per-step gradient psum)
+            # and the streamed GLM vg reducer (one psum per super-block)
+            from dask_ml_tpu.models.sgd import SGDClassifier
+
+            with config.set(stream_block_rows=n // 8,
+                            trace_dir=trace_dir, obs_programs=True):
+                ssgd = SGDClassifier(max_iter=2, random_state=0,
+                                     shuffle=False).fit(X, y)
+                sglm = LogisticRegression(solver="lbfgs",
+                                          max_iter=10).fit(X, y)
+            sgd_st = dict(getattr(ssgd, "_last_stream_stats", None)
+                          or {})
+            assert sgd_st.get("sb_shards") == 8, sgd_st
+            assert ssgd.score(X, y) > 0.6
+            assert sglm.solver_info_.get("stream_shards") == 8, \
+                sglm.solver_info_
             trace = os.path.join(trace_dir, "trace.jsonl")
             # counters/programs land in a SEPARATE file, the shape a
             # multi-process run produces (bench child + serving worker
@@ -94,6 +112,13 @@ def main():
             in report
         assert any(p == "glm.lbfgs" for p in programs), programs
         assert any(p == "glm.admm" for p in programs), programs
+        # the psum-bearing SHARDED superblock scan programs (ISSUE 9)
+        # must rank in the same programs table — per-device attribution
+        # of the streamed hot loop
+        assert any(p == "superblock.sgd_scan.psum" for p in programs), \
+            programs
+        assert any(p == "superblock.glm.vg.psum" for p in programs), \
+            programs
         # counters came from the aux file: the merge really folded both
         assert data["counters"].get("recompiles", 0) > 0, data["counters"]
         # the CLI flag itself renders the same merged timeline

@@ -1,4 +1,5 @@
-"""Perf smoke gate for the super-block streaming hot loop (ISSUE 3).
+"""Perf smoke gate for the super-block streaming hot loop (ISSUE 3 +
+the ISSUE 9 data-parallel flavor).
 
 Runs a scaled-down version of bench.py's streamed-SGD section and fails
 (exit 1) when the dispatch-collapse contract regresses:
@@ -10,7 +11,11 @@ Runs a scaled-down version of bench.py's streamed-SGD section and fails
 - after the first pass has warmed the compile caches, later passes must
   pay ZERO new XLA compiles — a shape wobble (ragged tail leaking into
   the compiled signature, ring buffers changing layout) shows up here
-  long before it shows up as a throughput number.
+  long before it shows up as a throughput number;
+- the SHARDED flavor (8 virtual devices, shard_map + psum scan
+  programs) must keep exactly the same dispatch shape: ceil(n_blocks/K)
+  dispatches per pass — one per super-block, NOT one per shard — and
+  the same zero-compiles-after-pass-1 contract.
 
 Kept small (~64k rows) so verify.sh stays fast; bench.py carries the
 full-size throughput numbers.
@@ -21,6 +26,16 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# 8 virtual devices BEFORE jax initializes so the sharded section has a
+# mesh to shard over; the single-device section pins stream_mesh=1,
+# which restores the exact pre-mesh staging (including zero-copy).
+# force_cpu_platform APPENDS/RAISES the device-count flag inside an
+# already-set XLA_FLAGS instead of silently losing it (a setdefault
+# would fail the gate on any box that exports XLA_FLAGS for tuning)
+from dask_ml_tpu._platform import force_cpu_platform  # noqa: E402
+
+force_cpu_platform(n_devices=8)
 
 import numpy as np  # noqa: E402
 
@@ -37,7 +52,9 @@ def main():
     y = (X[:, 0] > 0).astype(np.float32)
 
     failures = []
-    with config.set(stream_block_rows=n // 32, stream_autotune=False):
+    # -- single-device section (stream_mesh=1: the pre-mesh hot loop) --
+    with config.set(stream_block_rows=n // 32, stream_autotune=False,
+                    stream_mesh=1):
         stream = BlockStream((X, y), block_rows=n // 32)
         k = stream.resolve_superblock_k()
         n_blocks = stream.n_blocks
@@ -59,7 +76,7 @@ def main():
     # the Pallas flavor replaces the per-block BODY inside the same
     # scan, never the scan structure (and off-TPU it must be inert).
     with config.set(stream_block_rows=n // 32, stream_autotune=False,
-                    pallas_stream=False):
+                    stream_mesh=1, pallas_stream=False):
         off = SGDClassifier(max_iter=1, random_state=0, shuffle=False)
         off.fit(X, y)
     off_st = dict(getattr(off, "_last_stream_stats", None) or {})
@@ -90,9 +107,65 @@ def main():
     if snap.get("superblock_dispatches", 0) <= 0:
         failures.append("superblock_dispatches counter never moved")
 
+    # -- sharded section (ISSUE 9): 8-way data-parallel streaming ------
+    import jax
+
+    sh_dpp = sh_recompiles = sh_shards = None
+    if len(jax.devices()) < 8:
+        failures.append(
+            f"expected 8 virtual devices for the sharded section, got "
+            f"{len(jax.devices())} (XLA_FLAGS not honored?)"
+        )
+    else:
+        with config.set(stream_block_rows=n // 32,
+                        stream_autotune=False, stream_mesh=0):
+            sh_stream = BlockStream((X, y), block_rows=n // 32)
+            sh_k = sh_stream.resolve_superblock_k()
+            sh_blocks = sh_stream.n_blocks
+            SGDClassifier(max_iter=1, random_state=0,
+                          shuffle=False).fit(X, y)  # warmup pass
+            obs.counters_reset()
+            sh = SGDClassifier(max_iter=2, random_state=0,
+                               shuffle=False)
+            sh.fit(X, y)
+            sh_snap = obs.counters_snapshot()
+            sh_st = dict(getattr(sh, "_last_stream_stats", None) or {})
+        sh_dpp = sh_st.get("dispatches_per_pass")
+        sh_shards = sh_st.get("sb_shards")
+        sh_recompiles = sh_snap.get("recompiles", 0)
+        if sh_shards != 8:
+            failures.append(
+                f"sharded fit ran at sb_shards={sh_shards}, wanted 8 — "
+                "the data-parallel flavor did not engage"
+            )
+        # ONE dispatch per super-block, never per shard: the sharded
+        # budget is EXACT (no +1 slack — a per-shard dispatch leak
+        # would multiply dispatches by D, and this is the gate that
+        # catches it)
+        if sh_dpp != math.ceil(sh_blocks / max(sh_k, 1)):
+            failures.append(
+                f"sharded dispatches_per_pass={sh_dpp} != "
+                f"ceil({sh_blocks}/{sh_k})="
+                f"{math.ceil(sh_blocks / max(sh_k, 1))} — one dispatch "
+                "per super-block, NOT per shard"
+            )
+        if sh_recompiles > 0:
+            failures.append(
+                f"{sh_recompiles} new XLA compiles after pass 1 on the "
+                "SHARDED path — sharding must not break the warm-cache "
+                "contract"
+            )
+        if sh_snap.get("shard_slab_puts", 0) <= 0:
+            failures.append(
+                "shard_slab_puts counter never moved — super-blocks "
+                "did not stage per-shard"
+            )
+
     print(f"perf smoke: n_blocks={n_blocks} K={k} "
           f"dispatches_per_pass={dpp} (budget {budget}) "
-          f"recompiles_after_pass1={recompiles}")
+          f"recompiles_after_pass1={recompiles} | sharded: "
+          f"shards={sh_shards} dispatches_per_pass={sh_dpp} "
+          f"recompiles_after_pass1={sh_recompiles}")
     if failures:
         for f in failures:
             print(f"PERF SMOKE FAIL: {f}", file=sys.stderr)

@@ -377,6 +377,84 @@ def main():
         f32 = compiled_batch_fn(glm, "predict")
         assert np.mean(q8(Xh[:4096]) == f32(Xh[:4096])) >= 0.995
 
+    def sharded_stream_round9():
+        """ISSUE 9 surfaces on a real multi-chip slice: the streamed
+        superblock hot loop sharded over the mesh — per-shard staging,
+        shard_map/psum scan programs, replicated carries. Parity vs
+        the single-chip path to 1e-5 (bf16 stays off: f32 pin) and
+        per-chip throughput within 0.8x of single-chip — the
+        data-parallel plumbing must not eat the chip it runs on. On a
+        1-chip attach (or the CPU dry-run) the sharded flavor must
+        simply never engage."""
+        import time as _time
+
+        from dask_ml_tpu import config
+        from dask_ml_tpu.cluster import KMeans
+        from dask_ml_tpu.linear_model import LogisticRegression
+        from dask_ml_tpu.models.sgd import SGDClassifier
+
+        n_dev = len(jax.devices())
+        rng = np.random.RandomState(9)
+        n, d = 131_072, 64
+        Xh = rng.randn(n, d).astype(np.float32)
+        yh = (Xh[:, 0] > 0).astype(np.float32)
+        # 2048-row blocks: a 128-multiple (single-chip fused kernels)
+        # that also splits per shard on any power-of-two slice
+        base = dict(stream_block_rows=2048, stream_autotune=False,
+                    dtype="float32")
+
+        def timed_fit(stream_mesh):
+            with config.set(stream_mesh=stream_mesh, **base):
+                SGDClassifier(max_iter=1, random_state=0,
+                              shuffle=False).fit(Xh, yh)  # warm
+                clf = SGDClassifier(max_iter=2, random_state=0,
+                                    shuffle=False)
+                t0 = _time.perf_counter()
+                clf.fit(Xh, yh)
+                return clf, _time.perf_counter() - t0
+
+        single, t1 = timed_fit(1)
+        st1 = dict(single._last_stream_stats or {})
+        assert st1.get("sb_shards", 1) == 1, st1
+        if n_dev == 1:
+            return  # nothing to shard on a 1-chip attach
+        sharded, tN = timed_fit(0)
+        stN = dict(sharded._last_stream_stats or {})
+        assert stN.get("sb_shards") == n_dev, stN
+        # one dispatch per super-block, never per shard
+        assert stN["dispatches_per_pass"] == \
+            -(-stN["n_blocks"] // stN["superblock_k"]), stN
+        # parity: same minibatches, psum-reassociated float sums only
+        assert np.allclose(sharded.coef_, single.coef_, atol=1e-5), \
+            np.abs(sharded.coef_ - single.coef_).max()
+        # GLM reducer + KMeans assign-stats flavors run + agree
+        with config.set(stream_mesh=0, **base):
+            glm = LogisticRegression(solver="lbfgs",
+                                     max_iter=15).fit(Xh, yh)
+            assert glm.solver_info_.get("stream_shards") == n_dev, \
+                glm.solver_info_
+            km = KMeans(n_clusters=4, random_state=0, max_iter=5,
+                        init="random").fit(Xh)
+            assert np.isfinite(km.cluster_centers_).all()
+        with config.set(stream_mesh=1, **base):
+            glm1 = LogisticRegression(solver="lbfgs",
+                                      max_iter=15).fit(Xh, yh)
+        assert np.allclose(glm.coef_, glm1.coef_, atol=1e-4), \
+            np.abs(glm.coef_ - glm1.coef_).max()
+        if jax.default_backend() != "tpu":
+            return  # forced virtual devices share silicon: parity and
+            # dispatch shape hold above, but the per-chip throughput
+            # criterion is a real-chip claim
+        # scaling: per-chip throughput within 0.8x of single-chip
+        per_chip = (n * 2 / tN) / n_dev
+        single_chip = n * 2 / t1
+        assert per_chip >= 0.8 * single_chip, (
+            f"sharded per-chip throughput {per_chip:.0f} samples/s < "
+            f"0.8x single-chip {single_chip:.0f}"
+        )
+        print(f"    round-9: {n_dev} chips, single {single_chip:.0f} "
+              f"samples/s, sharded {per_chip:.0f} samples/s/chip")
+
     passed = _load_state()
     for name, fn in [
         ("glm solvers x3 families", glms),
@@ -393,6 +471,7 @@ def main():
         ("round-4 multiclass/drop/subsample", multiclass_round4),
         ("round-5 sparse/scorers/bf16/overlap", round5_surfaces),
         ("round-8 fused-stream/bf16-auto/int8", fused_stream_round8),
+        ("round-9 sharded superblock streaming", sharded_stream_round9),
     ]:
         results.append(run(name, fn, passed))
 
