@@ -84,6 +84,46 @@ class Config:
     # knob off the streamed jaxprs are byte-identical to the
     # pre-feature programs (asserted in tests)
     pallas_stream: bool = True
+    # -- reliability / chaos plane (dask_ml_tpu/reliability/) -------------
+    # deterministic fault-injection plan ("" = off, the zero-overhead
+    # default: every site costs one config read + branch and the
+    # streamed jaxprs are byte-identical). Arms named host-side sites
+    # by seeded invocation-index schedules — e.g.
+    # "staging_read:io@2;replica_worker:crash@40" — so chaos runs
+    # replay exactly; see reliability/faults.py for the grammar and
+    # the site/kind tables
+    fault_plan: str = ""
+    # bounded exponential-backoff retries for transient staging/reader
+    # IO failures (real disk hiccups and injected "io" faults alike):
+    # a failing host block read is re-read positionally up to this many
+    # times (stream_retries_total counts attempts) before raising the
+    # typed StreamIORetriesExhausted. 0 = fail on first error
+    stream_io_retries: int = 3
+    # non-finite streamed-block policy: "off" (no check — today's
+    # behavior; staging never reads blocks it can zero-copy), "raise"
+    # (typed NonFiniteBlock at the staging boundary), "quarantine"
+    # (zero the block's data AND its valid-row count so the existing
+    # masked prefix-count folds it out — no shape change, no recompile;
+    # stream_quarantined_blocks counts). Inference streams treat
+    # quarantine as raise (silently dropping prediction rows would
+    # corrupt output alignment)
+    stream_nonfinite: str = "off"
+    # pass-granular checkpoint/auto-resume for streamed GLM/SGD/
+    # Incremental fits ("" = off): the carry pytree + pass/lr-clock
+    # state persist here (orbax, atomic rename) under a fingerprint
+    # token — a killed fit rerun with the same data/knobs resumes at
+    # the last saved pass, a wrong-fingerprint checkpoint is ignored,
+    # completion clears it. Refused (fit runs uncheckpointed) under a
+    # multi-process runtime: resume must be a collective decision
+    stream_checkpoint_path: str = ""
+    # passes between checkpoint saves when stream_checkpoint_path is
+    # set (1 = every pass)
+    stream_checkpoint_every: int = 1
+    # deadline (seconds) on the multihost pass barrier
+    # (distributed.sync_stream_pass): a lost peer turns the barrier
+    # hang into a typed StreamSyncTimeout instead of wedging the fit
+    # forever. 0 = no deadline
+    stream_sync_timeout_s: float = 600.0
     # persistent XLA compilation cache directory ("" = off): repeated
     # runs skip warm-up compiles for programs whose shapes/backends
     # match a cached entry (applies process-wide on first streamed fit
@@ -187,6 +227,20 @@ class Config:
     # is already doomed — backpressure before the latency collapse, not
     # after
     serving_slo_shed: bool = True
+    # replica supervision (reliability/supervisor.py): FleetServer.start
+    # arms a background supervisor that REBUILDS a dead replica off the
+    # serving path — fresh ModelServer at the registry's current
+    # version, warmed before it rejoins routing, its stranded queue
+    # drained onto the replacement (serving_replica_restarts counts).
+    # Off by default: restart-on-death is an operational policy;
+    # failover-only fleets keep today's behavior
+    serving_supervise: bool = False
+    # max rebuilds per replica slot before it degrades to PERMANENT
+    # failover (serving_replica_failures; stale gauges dropped) — a
+    # crash-looping replica must not burn the fleet on rebuild loops
+    serving_restart_budget: int = 3
+    # supervisor sweep cadence (seconds)
+    serving_supervise_interval_s: float = 0.5
     # versions a ModelRegistry keeps per model name for rollback (the
     # current version is never evicted)
     serving_registry_keep: int = 8

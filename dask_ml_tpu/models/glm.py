@@ -290,6 +290,25 @@ class _GLMBase(BaseEstimator):
         stream = BlockStream((X, y_host), block_rows=block_rows)
         kwargs = dict(self.solver_kwargs or {})
         l1_ratio = kwargs.pop("l1_ratio", 0.5)
+        # pass-granular checkpoint/auto-resume (ISSUE 11): the solver
+        # saves its host state each outer iteration under a fingerprint
+        # token and clears on completion; None (knobs off, multi-host,
+        # warm start) leaves the fit exactly as before
+        ckpt = None
+        if not (multi_host or getattr(self, "warm_start", False)):
+            from ..reliability.stream_ckpt import stream_checkpoint
+
+            ckpt = stream_checkpoint(
+                "glm",
+                (type(self).__name__, self.solver, self.penalty,
+                 getattr(self, "C", None), float(np.asarray(lam)),
+                 l1_ratio, self.fit_intercept, self.max_iter, self.tol,
+                 self.family, repr(sorted(kwargs.items())), n, d,
+                 int(stream.block_rows),
+                 None if classes is None
+                 else tuple(np.asarray(classes).tolist())),
+                arrays=(X, y_host),
+            )
         if classes is not None and len(classes) > 2:
             # one-vs-rest out-of-core: y_host carries class CODES; every
             # epoch streams X once for all C classes
@@ -308,7 +327,7 @@ class _GLMBase(BaseEstimator):
                     lam, pmask, l1_ratio=l1_ratio,
                     intercept=self.fit_intercept, max_iter=self.max_iter,
                     tol=self.tol, logger=logger, reduce=reduce,
-                    fit_dtype=self.fit_dtype, **kwargs,
+                    fit_dtype=self.fit_dtype, ckpt=ckpt, **kwargs,
                 )
                 sp.add(n_iter=info.get("n_iter"),
                        data_passes=info.get("data_passes"))
@@ -323,7 +342,8 @@ class _GLMBase(BaseEstimator):
                 self.solver, stream, n, beta0, self.family, self.penalty,
                 lam, pmask, l1_ratio=l1_ratio, intercept=self.fit_intercept,
                 max_iter=self.max_iter, tol=self.tol, logger=logger,
-                reduce=reduce, fit_dtype=self.fit_dtype, **kwargs,
+                reduce=reduce, fit_dtype=self.fit_dtype, ckpt=ckpt,
+                **kwargs,
             )
             sp.add(n_iter=info.get("n_iter"),
                    data_passes=info.get("data_passes"))

@@ -400,11 +400,11 @@ class _LloydCheckpoint:
 
     def restore(self):
         """(centers, it) if a matching checkpoint exists, else None."""
-        import os
-
         from ..utils import checkpoint as ckpt
 
-        if not os.path.exists(os.path.abspath(self.path)):
+        # checkpoint_exists covers the atomic writer's crash window
+        # (state parked at <path>.old after a kill mid-publish)
+        if not ckpt.checkpoint_exists(self.path):
             return None
         like = {"token": np.zeros(40, np.uint8),
                 "centers": jnp.zeros((self.k, self.d), jnp.float32),
@@ -428,7 +428,9 @@ class _LloydCheckpoint:
         import os
         import shutil
 
-        shutil.rmtree(os.path.abspath(self.path), ignore_errors=True)
+        for suffix in ("", ".old", ".tmp"):
+            shutil.rmtree(os.path.abspath(self.path) + suffix,
+                          ignore_errors=True)
 
 
 def _streamed_lloyd(stream, centers0, max_iter, tol2, logger=None,

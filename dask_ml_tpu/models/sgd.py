@@ -960,6 +960,68 @@ class _SGDBase(BaseEstimator):
         self._publish(Xh.shape[1])
         return True
 
+    def _stream_fit_checkpoint(self, Xh, y_enc, stream):
+        """A fingerprint-keyed pass-granular checkpoint slot for this
+        host-streamed fit (reliability/stream_ckpt.py), or None when
+        checkpointing is off, refused (multi-process), or the fit is a
+        ``warm_start`` continuation (its starting weights are not
+        derivable from the hyperparameters, so the identity token
+        cannot cover them)."""
+        if self.warm_start:
+            return None
+        from ..reliability.stream_ckpt import stream_checkpoint
+
+        classes = getattr(self, "classes_", None)
+        parts = (
+            type(self).__name__, self._loss(), self.penalty,
+            self.alpha, self.l1_ratio, self.eta0, self.learning_rate,
+            self.power_t, self.max_iter, self.tol, self.shuffle,
+            self.random_state, self.fit_intercept, self.fit_dtype,
+            None if classes is None
+            else tuple(np.asarray(classes).tolist()),
+            tuple(Xh.shape), int(stream.block_rows),
+        )
+        return stream_checkpoint("sgd", parts, arrays=(Xh, y_enc))
+
+    def _fit_stream_checkpointed(self, stream, ckpt):
+        """The checkpointed flavor of the streamed epoch loop:
+        identical minibatches and lr clock to the plain loops (the
+        shuffle stream is fast-forwarded by one permutation draw per
+        completed pass — np.random's shuffle consumption depends only
+        on the array LENGTH, so the resumed pass sequence is
+        bit-identical to the uninterrupted fit's), with the weight
+        carry + lr clock saved after each pass and the slot cleared on
+        completion. Autotune never applies here: a mid-fit partition
+        resize would invalidate the checkpoint's identity token."""
+        from ..observability._counters import record_stream_checkpoint
+
+        start = 0
+        st = ckpt.restore()
+        if st is not None:
+            self._w = jnp.asarray(np.asarray(st["w"], np.float32))
+            self._t = int(st["t"])
+            start = int(st["epoch"])
+            record_stream_checkpoint(resume=True)
+        if self.shuffle:
+            burn = np.arange(stream.n_blocks)
+            for _ in range(min(start, int(self.max_iter))):
+                stream.rng.shuffle(burn)
+        use_sb = stream.use_superblocks()
+        for e in range(start, int(self.max_iter)):
+            if use_sb:
+                for sb in stream.superblocks():
+                    self._sb_step(sb)
+            else:
+                for block in stream:
+                    if block.n_rows == 0:
+                        self._t += 1
+                        continue
+                    Xb, yb = block.arrays
+                    self._one_step(Xb, yb, block.mask, block.n_rows)
+            if ckpt.due(e + 1):
+                ckpt.save(w=np.asarray(self._w), t=self._t, epoch=e + 1)
+        ckpt.clear()
+
     def _fit_device(self, X: ShardedArray, y, kwargs):
         """Epoch loop over DEVICE-resident blocks: each block is a sharded
         gather (take_rows) of the input — the (n, d) data never
@@ -1037,13 +1099,20 @@ class _SGDBase(BaseEstimator):
                 self._set_classes(np.asarray(classes))
             elif getattr(self, "classes_", None) is None:
                 self._set_classes(np.unique(yh))
+        y_enc = np.asarray(self._encode_y(yh))
         stream = BlockStream(
-            (Xh, np.asarray(self._encode_y(yh))),
+            (Xh, y_enc),
             block_rows=fit_block_rows(Xh),
             shuffle=self.shuffle, seed=self.random_state,
         )
         self._ensure_state(Xh.shape[1])
-        if stream.use_superblocks():
+        ckpt = self._stream_fit_checkpoint(Xh, y_enc, stream)
+        if ckpt is not None:
+            # pass-granular checkpoint/auto-resume (ISSUE 11): same
+            # minibatches and lr clock as the plain loops below, plus a
+            # carry save after each pass and a clear on completion
+            self._fit_stream_checkpointed(stream, ckpt)
+        elif stream.use_superblocks():
             # super-block hot loop: one scan dispatch per K blocks with
             # the weight carry donated (same minibatches, same shuffled
             # order, same lr clock as the per-block loop below)
@@ -1051,6 +1120,12 @@ class _SGDBase(BaseEstimator):
                 self._sb_step(sb)
         else:
             for block in stream.epochs(self.max_iter):
+                if block.n_rows == 0:
+                    # quarantined block (stream_nonfinite): no update,
+                    # but the lr clock advances exactly like the
+                    # superblock scan's zero-count pass-through slot
+                    self._t += 1
+                    continue
                 Xb, yb = block.arrays
                 self._one_step(Xb, yb, block.mask, block.n_rows)
         # last pass's overlap accounting (host/put/wait vs compute) for

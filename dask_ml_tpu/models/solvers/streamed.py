@@ -781,19 +781,49 @@ def _armijo(obj, beta, val, grad, direction, t0=1.0, c=1e-4, backtrack=0.5,
 # solvers (host optimizer state — a handful of d-vectors — over streamed
 # device evaluation)
 # ---------------------------------------------------------------------------
+#
+# Every solver takes an optional ``ckpt`` (reliability/stream_ckpt.py):
+# the host optimizer state — the iterate plus whatever the solver needs
+# to continue bit-exactly — saves after each outer iteration (each
+# iteration = one-plus data passes) and clears on completion, so a
+# killed multi-hour streamed GLM fit resumes at iteration granularity
+# instead of restarting from scratch. A wrong-fingerprint checkpoint
+# restores as None and the fit simply starts fresh.
+
+def _ckpt_restore(ckpt):
+    if ckpt is None:
+        return None
+    st = ckpt.restore()
+    if st is not None:
+        from ...observability._counters import record_stream_checkpoint
+
+        record_stream_checkpoint(resume=True)
+    return st
+
 
 def lbfgs(obj: StreamedObjective, beta0, max_iter=100, tol=1e-6, memory=10,
-          **_):
+          ckpt=None, **_):
     if obj.reg not in regularizers.SMOOTH:
         raise ValueError(
             "streamed lbfgs handles smooth penalties only (l2/none); use "
             "solver='proximal_grad' or 'admm' for l1/elastic_net"
         )
     beta = np.asarray(beta0, np.float64)
-    val, grad = obj.value_and_grad(beta)
     S, Y = [], []
-    n_iter = 0
-    for it in range(int(max_iter)):
+    it0 = n_iter = 0
+    st = _ckpt_restore(ckpt)
+    if st is not None:
+        beta = np.asarray(st["beta"], np.float64)
+        val = float(st["val"])
+        grad = np.asarray(st["grad"], np.float64)
+        if "S" in st:
+            S = [np.asarray(r, np.float64) for r in np.asarray(st["S"])]
+            Y = [np.asarray(r, np.float64) for r in np.asarray(st["Y"])]
+        it0 = n_iter = int(st["it"])
+        obj.passes = int(st["passes"])
+    else:
+        val, grad = obj.value_and_grad(beta)
+    for it in range(it0, int(max_iter)):
         gnorm = float(np.linalg.norm(grad))
         obj.log(it, val, gnorm)
         if gnorm <= tol:
@@ -822,21 +852,38 @@ def lbfgs(obj: StreamedObjective, beta0, max_iter=100, tol=1e-6, memory=10,
         beta = beta + s
         val, grad = nv, ng
         n_iter = it + 1
+        if ckpt is not None and ckpt.due(n_iter):
+            state = dict(beta=beta, val=np.float64(val), grad=grad,
+                         it=n_iter, passes=obj.passes)
+            if S:
+                state["S"], state["Y"] = np.stack(S), np.stack(Y)
+            ckpt.save(**state)
+    if ckpt is not None:
+        ckpt.clear()
     return beta, {"n_iter": n_iter, "grad_norm": float(np.linalg.norm(grad)),
                   "data_passes": obj.passes}
 
 
 def gradient_descent(obj: StreamedObjective, beta0, max_iter=100, tol=1e-6,
-                     init_step=1.0, **_):
+                     init_step=1.0, ckpt=None, **_):
     if obj.reg not in regularizers.SMOOTH:
         raise ValueError(
             "streamed gradient_descent handles smooth penalties only"
         )
     beta = np.asarray(beta0, np.float64)
-    val, grad = obj.value_and_grad(beta)
-    step = init_step
-    n_iter = 0
-    for it in range(int(max_iter)):
+    it0 = n_iter = 0
+    st = _ckpt_restore(ckpt)
+    if st is not None:
+        beta = np.asarray(st["beta"], np.float64)
+        val = float(st["val"])
+        grad = np.asarray(st["grad"], np.float64)
+        step = float(st["step"])
+        it0 = n_iter = int(st["it"])
+        obj.passes = int(st["passes"])
+    else:
+        val, grad = obj.value_and_grad(beta)
+        step = init_step
+    for it in range(it0, int(max_iter)):
         gnorm = float(np.linalg.norm(grad))
         obj.log(it, val, gnorm)
         if gnorm <= tol:
@@ -846,11 +893,17 @@ def gradient_descent(obj: StreamedObjective, beta0, max_iter=100, tol=1e-6,
         val, grad = nv, ng
         step = t * 2.0
         n_iter = it + 1
+        if ckpt is not None and ckpt.due(n_iter):
+            ckpt.save(beta=beta, val=np.float64(val), grad=grad,
+                      step=np.float64(step), it=n_iter, passes=obj.passes)
+    if ckpt is not None:
+        ckpt.clear()
     return beta, {"n_iter": n_iter, "grad_norm": float(np.linalg.norm(grad)),
                   "data_passes": obj.passes}
 
 
-def newton(obj: StreamedObjective, beta0, max_iter=50, tol=1e-6, **_):
+def newton(obj: StreamedObjective, beta0, max_iter=50, tol=1e-6, ckpt=None,
+           **_):
     if obj.reg not in regularizers.SMOOTH:
         raise ValueError("streamed newton handles smooth penalties only")
     beta = np.asarray(beta0, np.float64)
@@ -858,9 +911,17 @@ def newton(obj: StreamedObjective, beta0, max_iter=50, tol=1e-6, **_):
     pmask = np.asarray(obj.pmask, np.float64)
     ridge = (float(obj.lam) * pmask if obj.reg == "l2"
              else np.zeros(d)) + 1e-8
-    n_iter = 0
+    it0 = n_iter = 0
+    st = _ckpt_restore(ckpt)
+    if st is not None:
+        # newton recomputes val/grad/hess at the loop top, so the
+        # iterate + clocks are the whole state (resume pays one extra
+        # pass re-evaluating the saved iterate; the math is identical)
+        beta = np.asarray(st["beta"], np.float64)
+        it0 = n_iter = int(st["it"])
+        obj.passes = int(st["passes"])
     gnorm = np.inf
-    for it in range(int(max_iter)):
+    for it in range(it0, int(max_iter)):
         val, grad, hess = obj.value_and_grad_and_hess(beta)
         gnorm = float(np.linalg.norm(grad))
         obj.log(it, val, gnorm)
@@ -885,21 +946,34 @@ def newton(obj: StreamedObjective, beta0, max_iter=50, tol=1e-6, **_):
             t *= 0.5
         beta = beta - t * delta
         n_iter = it + 1
+        if ckpt is not None and ckpt.due(n_iter):
+            ckpt.save(beta=beta, it=n_iter, passes=obj.passes)
+    if ckpt is not None:
+        ckpt.clear()
     return beta, {"n_iter": n_iter, "grad_norm": gnorm,
                   "data_passes": obj.passes}
 
 
 def proximal_grad(obj: StreamedObjective, beta0, max_iter=100, tol=1e-7,
-                  init_step=1.0, **_):
+                  init_step=1.0, ckpt=None, **_):
     # penalty handled by the prox; the streamed objective evaluates the
     # smooth part only
     smooth = obj._smooth_clone()
     lam = float(np.asarray(obj.lam))
     pmask_j = jnp.asarray(obj.pmask)
     beta = np.asarray(beta0, np.float64)
-    val, grad = smooth.value_and_grad(beta)
-    step = init_step
-    n_iter = 0
+    it0 = n_iter = 0
+    st = _ckpt_restore(ckpt)
+    if st is not None:
+        beta = np.asarray(st["beta"], np.float64)
+        val = float(st["val"])
+        grad = np.asarray(st["grad"], np.float64)
+        step = float(st["step"])
+        it0 = n_iter = int(st["it"])
+        smooth.passes = int(st["passes"])
+    else:
+        val, grad = smooth.value_and_grad(beta)
+        step = init_step
     delta = np.inf
 
     def candidate(t):
@@ -908,7 +982,7 @@ def proximal_grad(obj: StreamedObjective, beta0, max_iter=100, tol=1e-7,
             obj.l1_ratio,
         ), np.float64)
 
-    for it in range(int(max_iter)):
+    for it in range(it0, int(max_iter)):
         t = step
         while True:
             z = candidate(t)
@@ -927,15 +1001,21 @@ def proximal_grad(obj: StreamedObjective, beta0, max_iter=100, tol=1e-7,
         smooth.log(it, val, delta)
         step = t * 1.2
         n_iter = it + 1
+        if ckpt is not None and ckpt.due(n_iter):
+            ckpt.save(beta=beta, val=np.float64(val), grad=grad,
+                      step=np.float64(step), it=n_iter,
+                      passes=smooth.passes)
         if delta <= tol:
             break
+    if ckpt is not None:
+        ckpt.clear()
     obj.passes = smooth.passes
     return beta, {"n_iter": n_iter, "opt_residual": float(delta),
                   "data_passes": obj.passes}
 
 
 def admm(obj: StreamedObjective, beta0, max_iter=250, tol=1e-4, rho=1.0,
-         local_iter=8, **_):
+         local_iter=8, ckpt=None, **_):
     """Block-consensus ADMM: each streamed block is a consensus member
     (the in-memory version's mesh shard, ``solvers.py::_admm_run``).
     Per-block (b, u) state is (n_blocks, d) on host — tiny next to X."""
@@ -954,12 +1034,20 @@ def admm(obj: StreamedObjective, beta0, max_iter=250, tol=1e-4, rho=1.0,
     z = jnp.asarray(beta0, jnp.float32)
     pmask_j = jnp.asarray(obj.pmask)
     rho_f = float(rho)
-    n_iter = 0
+    it0 = n_iter = 0
+    st = _ckpt_restore(ckpt)
+    if st is not None and np.asarray(st["B"]).shape == B.shape:
+        B = np.asarray(st["B"], np.float32)
+        U = np.asarray(st["U"], np.float32)
+        z = jnp.asarray(np.asarray(st["z"], np.float32))
+        rho_f = float(st["rho"])
+        it0 = n_iter = int(st["it"])
+        obj.passes = int(st["passes"])
     primal = dual = np.inf
     C = obj.n_classes
     s = obj.stream
     use_sb = hasattr(s, "use_superblocks") and s.use_superblocks()
-    for it in range(int(max_iter)):
+    for it in range(it0, int(max_iter)):
         obj.passes += 1
         bi = 0
         if use_sb:
@@ -1037,6 +1125,15 @@ def admm(obj: StreamedObjective, beta0, max_iter=250, tol=1e-4, rho=1.0,
         elif dual > 10.0 * primal:
             rho_f *= 0.5
             U *= 2.0
+        if ckpt is not None and ckpt.due(n_iter):
+            # saved AFTER the rho adaptation so a resumed iteration
+            # continues with exactly the state an uninterrupted run
+            # would carry into it
+            ckpt.save(B=B, U=U, z=np.asarray(z, np.float32),
+                      rho=np.float64(rho_f), it=n_iter,
+                      passes=obj.passes)
+    if ckpt is not None:
+        ckpt.clear()
     return (np.asarray(z, np.float64),
             {"n_iter": n_iter, "primal_residual": primal,
              "dual_residual": dual, "data_passes": obj.passes})
@@ -1053,11 +1150,13 @@ STREAMED_SOLVERS = {
 
 def solve_streamed(solver, stream, n_rows, beta0, family, reg, lam, pmask,
                    l1_ratio=0.5, intercept=True, max_iter=100, tol=1e-6,
-                   logger=None, reduce=None, fit_dtype=None, **kwargs):
+                   logger=None, reduce=None, fit_dtype=None, ckpt=None,
+                   **kwargs):
     """``reduce`` (``distributed.psum_host``): merge per-pass block sums
     across processes — each process streams its LOCAL shard, ``n_rows``
     is the GLOBAL count, and the fit equals the single-process fit over
-    the concatenated data."""
+    the concatenated data. ``ckpt`` (a reliability.StreamCheckpoint)
+    arms iteration-granular save/auto-resume in the solver."""
     if solver not in STREAMED_SOLVERS:
         raise ValueError(
             f"Unknown solver {solver!r}; options: {sorted(STREAMED_SOLVERS)}"
@@ -1068,7 +1167,7 @@ def solve_streamed(solver, stream, n_rows, beta0, family, reg, lam, pmask,
         fit_dtype=fit_dtype,
     )
     beta, info = STREAMED_SOLVERS[solver](
-        obj, beta0, max_iter=max_iter, tol=tol, **kwargs
+        obj, beta0, max_iter=max_iter, tol=tol, ckpt=ckpt, **kwargs
     )
     info["streamed"] = True
     info["n_blocks"] = stream.n_blocks
@@ -1111,7 +1210,7 @@ def solve_streamed(solver, stream, n_rows, beta0, family, reg, lam, pmask,
 def solve_streamed_multi(solver, stream, n_rows, B0, family, reg, lam,
                          pmask, l1_ratio=0.5, intercept=True, max_iter=100,
                          tol=1e-6, logger=None, reduce=None,
-                         fit_dtype=None, **kwargs):
+                         fit_dtype=None, ckpt=None, **kwargs):
     """One-vs-rest streamed fit: ``B0``/result are (C, d); ``pmask`` is
     the per-class (d,) mask, tiled here. Every epoch reads the data
     ONCE for all classes (class-stacked block kernels); the host solvers
@@ -1129,7 +1228,7 @@ def solve_streamed_multi(solver, stream, n_rows, B0, family, reg, lam,
         logger=logger, n_classes=C, reduce=reduce, fit_dtype=fit_dtype,
     )
     beta, info = STREAMED_SOLVERS[solver](
-        obj, B0.ravel(), max_iter=max_iter, tol=tol, **kwargs
+        obj, B0.ravel(), max_iter=max_iter, tol=tol, ckpt=ckpt, **kwargs
     )
     info["streamed"] = True
     info["n_blocks"] = stream.n_blocks

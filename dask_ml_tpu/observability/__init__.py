@@ -63,7 +63,10 @@ from ._counters import (
     install_recompile_tracking,
     log_counters,
     record_donation,
+    record_fault_injected,
     record_registry_publish,
+    record_replica_failure,
+    record_replica_restart,
     record_serving_batch,
     record_serving_drop,
     record_serving_request,
@@ -71,6 +74,9 @@ from ._counters import (
     record_serving_slo_violation,
     record_serving_swap,
     record_shard_staging,
+    record_stream_checkpoint,
+    record_stream_quarantine,
+    record_stream_retry,
     record_superblock,
     record_superblock_donation,
     record_transfer,
@@ -163,7 +169,10 @@ __all__ = [
     "programs_reset",
     "programs_snapshot",
     "record_donation",
+    "record_fault_injected",
     "record_registry_publish",
+    "record_replica_failure",
+    "record_replica_restart",
     "record_serving_batch",
     "record_serving_drop",
     "record_serving_request",
@@ -171,6 +180,9 @@ __all__ = [
     "record_serving_slo_violation",
     "record_serving_swap",
     "record_shard_staging",
+    "record_stream_checkpoint",
+    "record_stream_quarantine",
+    "record_stream_retry",
     "record_superblock",
     "record_superblock_donation",
     "record_transfer",

@@ -188,6 +188,57 @@ def record_telemetry_series_dropped() -> None:
         counter_add("telemetry_series_dropped", 1)
 
 
+# -- reliability / chaos plane (dask_ml_tpu/reliability/) --------------------
+
+def record_fault_injected(site: str, kind: str) -> None:
+    """One armed fault fired at a named site (config.fault_plan) —
+    ``faults_injected`` totals plus a per-site breakdown so a chaos
+    run's /metrics shows WHERE the plan struck."""
+    if counters_enabled():
+        counter_add("faults_injected", 1)
+        counter_add(f"faults_injected_{site}", 1)
+
+
+def record_stream_retry() -> None:
+    """One staging/reader IO failure absorbed by the bounded-backoff
+    retry (config.stream_io_retries) — ``stream_retries_total`` on
+    /metrics is the transient-IO burn signal."""
+    if counters_enabled():
+        counter_add("stream_retries", 1)
+
+
+def record_stream_quarantine() -> None:
+    """One streamed block quarantined by the non-finite policy
+    (config.stream_nonfinite="quarantine"): its data zeroed and its
+    valid-row count folded to 0 by the existing prefix-count mask."""
+    if counters_enabled():
+        counter_add("stream_quarantined_blocks", 1)
+
+
+def record_stream_checkpoint(resume: bool = False) -> None:
+    """One pass-granular stream checkpoint saved — or, with
+    ``resume=True``, a killed streamed fit restored from one
+    (``stream_resumes``)."""
+    if counters_enabled():
+        counter_add("stream_resumes" if resume
+                    else "stream_checkpoint_saves", 1)
+
+
+def record_replica_restart() -> None:
+    """The replica supervisor rebuilt a dead fleet replica (fresh
+    server at the registry's current version, warmed off the serving
+    path, rejoined routing)."""
+    if counters_enabled():
+        counter_add("serving_replica_restarts", 1)
+
+
+def record_replica_failure() -> None:
+    """A replica exceeded its restart budget and degraded to permanent
+    failover — the page-an-operator signal."""
+    if counters_enabled():
+        counter_add("serving_replica_failures", 1)
+
+
 def record_serving_slo_violation() -> None:
     """A served request's end-to-end latency exceeded the configured
     ``serving_slo_ms`` — the request still SUCCEEDED (unlike the drop

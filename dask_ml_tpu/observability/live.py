@@ -475,6 +475,15 @@ def status_data() -> dict:
         drift_block = _drift.status_block()
     except Exception:
         drift_block = {}
+    # the reliability block: armed fault plan + per-site fired counts,
+    # retry/quarantine/resume/restart counters — "is chaos armed, what
+    # has it hit, what did the hardening absorb"
+    try:
+        from ..reliability import status_block as _rel_status
+
+        reliability_block = _rel_status()
+    except Exception:
+        reliability_block = {}
     out = {
         "pid": os.getpid(),
         "t_unix": round(now, 3),
@@ -487,6 +496,7 @@ def status_data() -> dict:
         "serving": serving,
         "registry": registry,
         "drift": drift_block,
+        "reliability": reliability_block,
         "watchdog_stalls": stalls,
         "report": report_data(records),
     }

@@ -385,6 +385,21 @@ def watchdog_stalls(records):
     return out
 
 
+def reliability_summary(records):
+    """The chaos-plane slice of the run's counters: injected faults
+    (total + per-site), retry/quarantine absorption, checkpoint
+    saves/resumes, replica restarts/permanent failures. [] when the run
+    recorded none (the usual, fault-free case)."""
+    from ..reliability import RELIABILITY_COUNTERS
+
+    ctr = final_counters(records)
+    rows = []
+    for k in sorted(ctr):
+        if k in RELIABILITY_COUNTERS or k.startswith("faults_injected_"):
+            rows.append({"counter": k, "total": ctr[k]})
+    return rows
+
+
 def report_data(records):
     """The full report as one JSON-ready dict (the ``--json`` output;
     ``build_report`` renders the same content as tables)."""
@@ -409,6 +424,7 @@ def report_data(records):
         "streaming": summarize_stream(records),
         "drift": summarize_drift(records),
         "counters": final_counters(records),
+        "reliability": reliability_summary(records),
         "programs": final_programs(records),
         "peak": peak,
         "watchdog_stalls": [
@@ -525,6 +541,14 @@ def build_report(records, path="<records>"):
             ("span", "thread", "age_s", "threads_dumped"),
             [(s["span"], s["thread"], s["age_s"], s["threads_dumped"])
              for s in stalls],
+        )
+    rel = data.get("reliability") or []
+    if rel:
+        lines += _table(
+            "reliability (injected faults / retries / resumes / "
+            "restarts)",
+            ("counter", "total"),
+            [(r["counter"], r["total"]) for r in rel],
         )
     ctr = data["counters"]
     if ctr:

@@ -463,20 +463,84 @@ def multihost_capability():
     return _MULTIHOST_CAPABLE
 
 
-def sync_stream_pass(tag="stream_pass") -> bool:
+class StreamSyncTimeout(RuntimeError):
+    """The multihost pass barrier did not complete within
+    ``config.stream_sync_timeout_s`` — a peer process is likely gone
+    (TPU slices fail whole), and without the deadline the surviving
+    hosts would hang in the collective forever. Typed so the driver can
+    checkpoint-restart the fit (``utils/checkpoint.py`` contract)
+    instead of diagnosing a wedged process."""
+
+
+def run_with_deadline(fn, timeout_s, tag="stream_pass"):
+    """Run ``fn`` (a blocking collective body) on a helper thread and
+    raise :class:`StreamSyncTimeout` if it hasn't completed within
+    ``timeout_s``. The collective itself cannot be interrupted — the
+    helper thread is abandoned (daemon) on timeout, which is fine: the
+    typed error's whole point is that the process restarts. ``fn``'s
+    own exception re-raises in the caller."""
+    done = threading.Event()
+    err = []
+
+    def runner():
+        try:
+            fn()
+        except BaseException as exc:  # noqa: BLE001 - re-raised below
+            err.append(exc)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=runner, daemon=True,
+                         name=f"stream-sync-{tag}")
+    t.start()
+    if not done.wait(timeout_s):
+        raise StreamSyncTimeout(
+            f"pass barrier {tag!r} did not complete within "
+            f"{timeout_s:g}s — a peer process is likely gone; restart "
+            "the fit from its checkpoint"
+        )
+    if err:
+        raise err[0]
+
+
+def sync_stream_pass(tag="stream_pass", timeout_s=None) -> bool:
     """Process-spanning sync point between streamed passes
     (``multihost_utils.sync_global_devices``): on a live multi-host
     runtime every process streams the same pass sequence over its
     LOCAL shard, and the barrier keeps a fast host from racing ahead
     into pass N+1 transfers while a slow peer still owns the fabric
     for pass N's psum merge. No-op (returns False) single-process, in
-    virtual worlds, and on backends whose capability probe failed."""
+    virtual worlds, and on backends whose capability probe failed.
+
+    ``timeout_s`` (default ``config.stream_sync_timeout_s``; 0 = wait
+    forever) bounds the barrier: a lost peer raises the typed
+    :class:`StreamSyncTimeout` instead of wedging the fit."""
     ok, _ = multihost_capability()
     if not ok:
         return False
-    from jax.experimental import multihost_utils
+    from ..config import get_config
 
-    multihost_utils.sync_global_devices(tag)
+    cfg = get_config()
+    if timeout_s is None:
+        timeout_s = float(cfg.stream_sync_timeout_s)
+    # the fault-plan spec is captured HERE, on the caller's thread: with
+    # a deadline armed the body runs on a fresh helper thread whose
+    # thread-local config would not carry a config.set override (the
+    # same capture rule BlockStream._fault_spec follows)
+    spec = cfg.fault_plan
+
+    def body():
+        from ..reliability.faults import fire_plan
+
+        fire_plan(spec, "pass_barrier")
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+
+    if timeout_s and timeout_s > 0:
+        run_with_deadline(body, timeout_s, tag)
+    else:
+        body()
     return True
 
 

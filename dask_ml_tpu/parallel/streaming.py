@@ -402,7 +402,7 @@ class BlockStream:
 
     def __init__(self, arrays, block_rows=None, mesh=None, shuffle=False,
                  seed=None, dtype=np.float32, prefetch=None,
-                 profile=True):
+                 profile=True, nonfinite=None):
         # stream_mesh / multi-process resolution lives in ONE place so
         # the data-parallel superblock flavor, the block partition and
         # the staging shardings can never disagree
@@ -462,6 +462,26 @@ class BlockStream:
         self._superblock_k_override = None  # set by the K autotuner
         from ..config import ensure_compile_cache, get_config
         from ..observability.live import ensure_telemetry
+
+        # reliability plane (ISSUE 11), captured once like _zero_copy:
+        # bounded-backoff IO retry budget, the non-finite block policy,
+        # and whether any fault plan is armed (the zero-overhead gate
+        # for the staging-read fault site on the zero-copy view path)
+        cfg_rel = get_config()
+        self._io_retries = max(int(cfg_rel.stream_io_retries), 0)
+        nf = (cfg_rel.stream_nonfinite if nonfinite is None
+              else nonfinite)
+        if nf not in ("off", "raise", "quarantine"):
+            raise ValueError(
+                f"stream_nonfinite={nf!r} is not supported; accepted: "
+                "'off', 'raise', 'quarantine'"
+            )
+        self._nonfinite = nf
+        # the plan SPEC is captured (not re-read per site): super-block
+        # staging runs on a worker thread whose thread-local config does
+        # not carry the creator's config.set overrides
+        self._fault_spec = cfg_rel.fault_plan
+        self._fault_armed = bool(self._fault_spec)
 
         # zero-copy staging (config.stream_zero_copy): on a
         # single-device XLA:CPU mesh, full-height aligned dense blocks
@@ -633,30 +653,138 @@ class BlockStream:
                 readers[i] = None
         return readers if any(r is not None for r in readers) else None
 
+    @staticmethod
+    def _disable_reader(readers, i):
+        """A reader whose read failed mid-stream has an untrustworthy
+        cursor (the failed ``next()`` may or may not have consumed its
+        block) — drop it for the rest of the pass; reads fall back to
+        POSITIONAL slices of the source, which are idempotent."""
+        try:
+            readers[i].close()
+        except Exception:
+            pass
+        readers[i] = None
+
+    def _retry_io(self, fn, what):
+        """Run ``fn`` (an IDEMPOTENT staging step) with bounded
+        exponential-backoff IO retry: OSError — a real disk/reader
+        hiccup or an injected ``io`` fault — retries up to
+        ``stream_io_retries`` times before raising the typed
+        :class:`~dask_ml_tpu.reliability.StreamIORetriesExhausted`;
+        :class:`InjectedCrash` (a modeled death, not a flaky read)
+        propagates immediately."""
+        import time as _time
+
+        from ..observability import record_stream_retry
+        from ..reliability import faults as _flt
+
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except _flt.InjectedCrash:
+                raise
+            except OSError as exc:
+                if attempt >= self._io_retries:
+                    raise _flt.StreamIORetriesExhausted(
+                        f"{what} still failing after {attempt + 1} "
+                        f"attempt(s): {exc}"
+                    ) from exc
+                record_stream_retry()
+                _time.sleep(min(0.02 * (2 ** attempt), 1.0))
+                attempt += 1
+
+    def _read_block_host(self, i, a, lo, hi, readers, out=None):
+        """One host block read — dtype-cast dense rows [lo, hi) of
+        array ``i`` — through the ``staging_read`` fault site with
+        bounded exponential-backoff IO retry (``stream_io_retries``).
+        With ``out`` the rows are written into ``out[:hi-lo]`` (the
+        super-block slab path's single copy); else the block is
+        returned (a source VIEW when dtype already matches)."""
+        from ..observability import record_stream_retry
+        from ..reliability import faults as _flt
+
+        if readers is not None and readers[i] is not None:
+            try:
+                raw = _flt.fire_plan(self._fault_spec, "staging_read",
+                                     readers[i].next())
+                if out is not None:
+                    out[: hi - lo] = raw
+                    return None
+                # copy out: the reader's ring buffer is reused, and
+                # device_put reads the host buffer asynchronously
+                return raw.astype(self.dtype, copy=True)
+            except OSError:
+                record_stream_retry()
+                self._disable_reader(readers, i)
+
+        def read():
+            blk = _flt.fire_plan(
+                self._fault_spec, "staging_read",
+                _slice_dense(a, lo, hi, self.dtype)
+            )
+            if out is not None:
+                out[: hi - lo] = blk
+                return None
+            return blk
+
+        return self._retry_io(read,
+                              f"staging read of rows [{lo}, {hi})")
+
+    def _guard_block_host(self, outs, m):
+        """Apply ``stream_nonfinite`` to one per-block staging result:
+        returns (outs, m) unchanged, raises typed, or quarantines —
+        data zeroed AND the valid-row count folded to 0, so the
+        existing mask/prefix-count machinery drops the block with no
+        shape change and no recompile."""
+        if self._nonfinite == "off" or m == 0:
+            return outs, m
+        if all(bool(np.isfinite(np.asarray(o)[:m]).all()) for o in outs):
+            return outs, m
+        from ..reliability.faults import NonFiniteBlock
+
+        if self._nonfinite == "raise":
+            raise NonFiniteBlock(
+                f"non-finite values in a streamed host block of {m} "
+                "rows (config.stream_nonfinite='raise')"
+            )
+        from ..observability import record_stream_quarantine
+
+        record_stream_quarantine()
+        return [np.zeros_like(np.asarray(o)) for o in outs], 0
+
     def _block_host(self, b, readers=None):
         lo = b * self.block_rows
         hi = min(lo + self.block_rows, self.n_rows)
         m = hi - lo
         outs = []
         for i, a in enumerate(self.arrays):
-            if readers is not None and readers[i] is not None:
-                raw = readers[i].next()
-                # copy out: the reader's ring buffer is reused, and
-                # device_put reads the host buffer asynchronously
-                blk = raw.astype(self.dtype, copy=True)
-            else:
-                blk = _slice_dense(a, lo, hi, self.dtype)
+            blk = self._read_block_host(i, a, lo, hi, readers)
             if i == 0:
                 self._profile_fold(blk[:m])
             if m < self.block_rows:  # fixed shape: pad the tail block
                 pad = [(0, self.block_rows - m)] + [(0, 0)] * (blk.ndim - 1)
                 blk = np.pad(blk, pad)
             outs.append(blk)
+        outs, m = self._guard_block_host(outs, m)
         mask = np.zeros(self.block_rows, self.dtype)
         mask[:m] = 1.0
         return outs, m, mask
 
     def _put(self, host_block):
+        """Per-block device staging through the ``stream_put`` fault
+        site, IO failures retried with the same bounded backoff as the
+        reads (an injected transient fault must heal, not kill the
+        pass)."""
+        from ..reliability import faults as _flt
+
+        def put():
+            _flt.fire_plan(self._fault_spec, "stream_put")
+            return self._put_impl(host_block)
+
+        return self._retry_io(put, "device staging put")
+
+    def _put_impl(self, host_block):
         outs, m, mask = host_block
         from ..observability import record_transfer, record_zero_copy
 
@@ -881,7 +1009,9 @@ class BlockStream:
         falls on a row boundary are zero-copy VIEWS until the transfer
         reads them."""
         from ..observability import record_shard_staging
+        from ..reliability.faults import fire_plan
 
+        fire_plan(self._fault_spec, "stream_put_sharded")
         imap = sharding.devices_indices_map(a.shape)
         devs = list(imap)
         slabs = [np.ascontiguousarray(a[imap[dv]]) for dv in devs]
@@ -908,6 +1038,39 @@ class BlockStream:
         self._ring = ring
         self._ring_key = shape_key
         return ring
+
+    def _guard_sb_block(self, slot, parts, j, m, counts, unroll):
+        """Apply ``stream_nonfinite`` to one staged super-block slot:
+        a non-finite block either raises typed or quarantines — data
+        zeroed and ``counts[j]`` folded to 0, exactly the shape the
+        ragged-final-super-block padding already compiles for (no new
+        program, no recompile; the scan's masked prefix-count drops
+        it). No-op at the default policy."""
+        if self._nonfinite == "off" or m == 0:
+            return
+        n_arr = len(self.arrays)
+        pieces = ([parts[i][j] for i in range(n_arr)] if unroll
+                  else [slot["bufs"][i][j] for i in range(n_arr)])
+        if all(bool(np.isfinite(np.asarray(p)[:m]).all())
+               for p in pieces):
+            return
+        from ..reliability.faults import NonFiniteBlock
+
+        if self._nonfinite == "raise":
+            raise NonFiniteBlock(
+                f"non-finite values in streamed super-block slot {j} "
+                f"({m} rows; config.stream_nonfinite='raise')"
+            )
+        from ..observability import record_stream_quarantine
+
+        counts[j] = 0
+        for i in range(n_arr):
+            slot["bufs"][i][j] = 0
+            if unroll:
+                # a view / zero-copy alias can't be zeroed in place —
+                # swap the slot's zeroed staging buffer in instead
+                parts[i][j] = slot["bufs"][i][j]
+        record_stream_quarantine()
 
     def _sb_slot(self, k):
         return {
@@ -994,7 +1157,13 @@ class BlockStream:
                             and m == self.block_rows and view_ok(a)):
                         if i == 0:
                             self._profile_fold(a[lo:hi])
-                        blk = a[lo:hi]
+                        # with a fault plan armed the view read runs
+                        # through the staging_read site (which may
+                        # return a poisoned COPY — never the source);
+                        # unarmed, the pristine zero-copy view path is
+                        # untouched
+                        blk = self._read_block_host(i, a, lo, hi, None) \
+                            if self._fault_armed else a[lo:hi]
                         if self._zero_copy:
                             # source view -> zero-copy alias now, ON
                             # the staging thread; put() passes the
@@ -1006,16 +1175,15 @@ class BlockStream:
                                 continue
                         parts[i].append(blk)
                         continue
-                    if from_reader:
-                        buf[j, :m] = readers[i].next()
-                    else:
-                        buf[j, :m] = _slice_dense(a, lo, hi, self.dtype)
+                    self._read_block_host(i, a, lo, hi, readers,
+                                          out=buf[j])
                     if i == 0:
                         self._profile_fold(buf[j, :m])
                     if m < self.block_rows:
                         buf[j, m:] = 0
                     if unroll:
                         parts[i].append(buf[j])
+                self._guard_sb_block(slot, parts, j, m, counts, unroll)
             for i in range(len(self.arrays)):
                 for j in range(len(blocks), k):
                     slot["bufs"][i][j] = 0
@@ -1136,6 +1304,13 @@ class BlockStream:
             return sb
 
         def emit(sb):
+            # the superblock dispatch boundary fault site: a "crash"
+            # arm here aborts the consumer MID-PASS — the in-process
+            # stand-in for a killed fit that the pass-granular
+            # checkpoint/resume machinery recovers from
+            from ..reliability.faults import fire_plan
+
+            fire_plan(self._fault_spec, "superblock_dispatch")
             record_superblock(sb.n_blocks)
             t_y = _time.perf_counter()
             yield sb
@@ -1291,7 +1466,14 @@ def streamed_map(X, block_rows, fn):
     every streamed inference path (GLM decision values, KMeans labels /
     distances, PCA scores). ``fn`` receives the padded device block; its
     output is sliced to the block's logical rows here."""
+    from ..config import get_config
+
+    # inference streams must keep row alignment: quarantining (dropping)
+    # a block would silently misalign the concatenated output against
+    # the input rows, so the quarantine policy hardens to "raise" here
+    nf = get_config().stream_nonfinite
     outs = []
-    for blk in BlockStream((X,), block_rows=block_rows, profile=False):
+    for blk in BlockStream((X,), block_rows=block_rows, profile=False,
+                           nonfinite="raise" if nf != "off" else "off"):
         outs.append(np.asarray(fn(blk))[: blk.n_rows])
     return np.concatenate(outs, axis=0)

@@ -1,0 +1,92 @@
+"""Reliability / chaos plane (ISSUE 11): deterministic fault injection
+plus the runtime hardening that makes each injected fault survivable.
+
+- ``faults``      — :class:`FaultPlan` / :func:`fault_point`: named
+  host-side fault sites armed by ``config.fault_plan`` (off by default,
+  zero overhead and jaxpr-byte-identical when off), firing by seeded
+  invocation-index schedules so chaos runs replay exactly;
+- ``stream_ckpt`` — fingerprint-keyed pass-granular checkpoint/resume
+  for streamed GLM/SGD/Incremental fits (the Lloyd contract
+  generalized; ``config.stream_checkpoint_path`` / ``_every``);
+- ``supervisor``  — :class:`ReplicaSupervisor`: rebuilds dead fleet
+  replicas off the serving path, warmed before they rejoin routing,
+  under a bounded restart budget (``config.serving_supervise``).
+
+The hardening the sites exercise lives where the faults strike:
+bounded-backoff staging retry + the non-finite block policy in
+``parallel/streaming.py``, the pass-barrier deadline
+(:class:`~dask_ml_tpu.parallel.distributed.StreamSyncTimeout`) in
+``parallel/distributed.py``, and the serving worker guard in
+``serving/_server.py``.
+"""
+
+from __future__ import annotations
+
+from .faults import (
+    FAULT_KINDS,
+    FAULT_SITES,
+    FaultInjected,
+    FaultPlan,
+    InjectedCrash,
+    InjectedIOError,
+    NonFiniteBlock,
+    StreamIORetriesExhausted,
+    active_plan,
+    fault_point,
+    reset_plans,
+)
+from .stream_ckpt import StreamCheckpoint, stream_checkpoint
+from .supervisor import ReplicaSupervisor
+
+__all__ = [
+    "FAULT_KINDS",
+    "FAULT_SITES",
+    "FaultInjected",
+    "FaultPlan",
+    "InjectedCrash",
+    "InjectedIOError",
+    "NonFiniteBlock",
+    "ReplicaSupervisor",
+    "StreamCheckpoint",
+    "StreamIORetriesExhausted",
+    "active_plan",
+    "fault_point",
+    "reset_plans",
+    "status_block",
+    "stream_checkpoint",
+]
+
+# counters the /status reliability block and the report CLI's
+# reliability table surface (flat names; /metrics renders them with the
+# _total suffix)
+RELIABILITY_COUNTERS = (
+    "faults_injected",
+    "stream_retries",
+    "stream_quarantined_blocks",
+    "stream_checkpoint_saves",
+    "stream_resumes",
+    "serving_replica_restarts",
+    "serving_replica_failures",
+)
+
+
+def status_block() -> dict:
+    """The /status ``reliability`` block: the armed plan (if any) with
+    per-site invocation/fired counts, plus the hardening counters —
+    what an operator needs to answer "is chaos armed, and what has it
+    hit so far"."""
+    from ..config import get_config
+    from ..observability._counters import counters_snapshot
+
+    snap = counters_snapshot()
+    counters = {
+        k: v for k, v in snap.items()
+        if k in RELIABILITY_COUNTERS or k.startswith("faults_injected_")
+    }
+    spec = get_config().fault_plan
+    plan = active_plan() if spec else None
+    return {
+        "fault_plan": spec or None,
+        "sites": plan.snapshot() if plan is not None else {},
+        "counters": counters,
+    }

@@ -1,0 +1,132 @@
+"""Pass-granular checkpoint / auto-resume for streamed fits.
+
+``utils/checkpoint.py`` states the recovery contract (TPU slices fail
+whole: checkpoint-restart, no lineage recompute) but before this module
+only KMeans Lloyd (``models/kmeans.py::_LloydCheckpoint``) and the
+adaptive searches honored it — a killed streamed GLM/SGD/Incremental
+fit restarted from scratch. :class:`StreamCheckpoint` generalizes the
+Lloyd contract:
+
+- **fingerprint-keyed identity**: the checkpoint carries a token over
+  the fit's hyperparameters, partition, and a data-content fingerprint
+  (``utils.validation.data_fingerprint``); a checkpoint written by a
+  DIFFERENT fit (other data, other knobs, other shapes) is ignored, not
+  silently resumed;
+- **pass granularity**: consumers save their carry pytree + pass /
+  lr-clock state after each streamed pass (``stream_checkpoint_every``
+  thins the cadence) via orbax, through ``utils.checkpoint``'s atomic
+  temp-sibling-fsync-rename writer — a kill mid-save leaves the
+  previous checkpoint intact;
+- **cleared on completion**: a finished fit removes its checkpoint so
+  it can never be resumed into a new one;
+- **multihost refusal**: under a >1-process runtime resume must be a
+  COLLECTIVE decision (every process restarts from the same pass or
+  none does — the same refusal ``models/kmeans.py`` documents), so the
+  builder returns None there and the fit simply runs uncheckpointed.
+
+Knobs: ``config.stream_checkpoint_path`` ("" = off) and
+``config.stream_checkpoint_every`` (passes between saves).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+
+import numpy as np
+
+__all__ = ["StreamCheckpoint", "stream_checkpoint"]
+
+_TOKEN_BYTES = 40  # sha1 hex digest length, padded like _LloydCheckpoint
+
+
+class StreamCheckpoint:
+    """One streamed fit's checkpoint slot: a directory holding the
+    carry pytree + host clocks under an identity token."""
+
+    def __init__(self, path, token: str, every: int = 1):
+        self.path = os.path.abspath(path)
+        self.token = np.frombuffer(
+            token.encode()[:_TOKEN_BYTES].ljust(_TOKEN_BYTES), np.uint8
+        )
+        self.every = max(int(every), 1)
+
+    def due(self, pass_no: int) -> bool:
+        """Save after this pass? (every N-th, counting from 1)."""
+        return pass_no % self.every == 0
+
+    def restore(self):
+        """The saved state dict (numpy leaves) when a checkpoint with a
+        MATCHING token exists, else None — wrong-fingerprint / corrupt /
+        absent checkpoints all mean "start fresh", never an error."""
+        from ..utils import checkpoint as ckpt
+
+        if not ckpt.checkpoint_exists(self.path):
+            return None
+        try:
+            state = ckpt.restore_pytree(self.path)
+        except Exception:
+            return None
+        try:
+            tok = np.asarray(state.get("token"))
+            if tok.shape != self.token.shape or \
+                    not np.array_equal(tok, self.token):
+                return None
+        except Exception:
+            return None
+        return {k: v for k, v in state.items() if k != "token"}
+
+    def save(self, **state) -> None:
+        """Persist ``state`` (numpy-able leaves) under the token. Rides
+        ``utils.checkpoint.save_pytree``'s atomic rename, so a kill at
+        ANY point leaves either the previous or the new checkpoint
+        restorable."""
+        from ..observability._counters import record_stream_checkpoint
+        from ..utils import checkpoint as ckpt
+
+        tree = {"token": self.token}
+        for k, v in state.items():
+            if v is None:
+                continue
+            tree[k] = np.asarray(v)
+        ckpt.save_pytree(self.path, tree)
+        record_stream_checkpoint()
+
+    def clear(self) -> None:
+        """Remove the checkpoint (called on successful completion)."""
+        for suffix in ("", ".old", ".tmp"):
+            shutil.rmtree(self.path + suffix, ignore_errors=True)
+
+
+def fit_token(kind, token_parts, arrays=()) -> str:
+    """The identity token: fit kind + stringified hyperparameter parts
+    + a content fingerprint of every data array."""
+    from ..utils.validation import data_fingerprint
+
+    parts = [str(kind)] + [repr(p) for p in token_parts]
+    for a in arrays:
+        parts.append(data_fingerprint(a))
+    return hashlib.sha1("|".join(parts).encode()).hexdigest()
+
+
+def stream_checkpoint(kind, token_parts, arrays=()):
+    """A :class:`StreamCheckpoint` for one streamed fit, or None when
+    checkpointing is off (``stream_checkpoint_path`` unset) or refused
+    (multi-process / virtual-world runtime — resume must be collective).
+    ``kind`` ("sgd" / "glm" / "incremental") namespaces the slot so
+    concurrent fits of different kinds under one path don't clobber."""
+    from ..config import get_config
+
+    cfg = get_config()
+    if not cfg.stream_checkpoint_path:
+        return None
+    from ..parallel import distributed as dist
+
+    if dist.process_count() > 1 or dist.in_virtual_world():
+        return None
+    path = os.path.join(cfg.stream_checkpoint_path, str(kind))
+    return StreamCheckpoint(
+        path, fit_token(kind, token_parts, arrays),
+        every=cfg.stream_checkpoint_every,
+    )

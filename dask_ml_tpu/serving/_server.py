@@ -677,7 +677,14 @@ class ModelServer:
                 self._run_loop()
 
     def _run_loop(self):
+        from ..reliability.faults import fault_point
+
         while True:
+            # the replica-worker fault site, BEFORE any request is
+            # popped (a crash here kills this worker thread with zero
+            # requests in hand — the queued backlog stays recoverable
+            # for the fleet supervisor's drain-and-requeue)
+            fault_point("replica_worker")
             if not self._paused.is_set():
                 if self._stop.is_set():
                     break
@@ -866,6 +873,13 @@ class ModelServer:
         # batch's futures, never kill the worker thread — a dead worker
         # would strand every later request behind a queue nobody drains
         try:
+            # the serving-execute fault site sits INSIDE the guard: an
+            # injected fault fails THIS batch's futures typed (the
+            # worker survives) — the documented batch-failure contract,
+            # now deterministically exercisable
+            from ..reliability.faults import fault_point
+
+            fault_point("serving_execute")
             method = batch[0].method
             fn = self._fns[method]
             buf, segments, bucket, rows = pack_batch(

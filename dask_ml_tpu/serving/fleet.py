@@ -100,7 +100,8 @@ class FleetServer:
 
     def __init__(self, model=None, registry=None, name="model",
                  methods=("predict",), replicas=None, ladder=None,
-                 max_queue=None, batch_window_ms=None, timeout_ms=None):
+                 max_queue=None, batch_window_ms=None, timeout_ms=None,
+                 supervise=None):
         import jax
 
         from ..config import get_config
@@ -122,27 +123,50 @@ class FleetServer:
             else BucketLadder.from_config()
         self._slo_s = float(cfg.serving_slo_ms) / 1e3
         self._slo_shed = bool(cfg.serving_slo_shed)
+        self._methods = tuple(methods)
+        # replica ctor args, kept so the supervisor can rebuild a dead
+        # replica slot with the fleet's exact configuration
+        self._max_queue = max_queue
+        self._batch_window_ms = batch_window_ms
+        self._timeout_ms = timeout_ms
         self.replicas = tuple(
-            ModelServer(
-                current.estimator, methods=methods, ladder=self.ladder,
-                max_queue=max_queue, batch_window_ms=batch_window_ms,
-                timeout_ms=timeout_ms,
-                device=devices[i % len(devices)]
-                if len(devices) > 1 else None,
-                replica_id=i, name=self.name,
-            )
+            self._make_replica(i, current.estimator, current.version)
             for i in range(n)
         )
-        for r in self.replicas:
-            r.model_version = current.version
         self.version = current.version
-        self._methods = tuple(methods)
         self._lock = threading.Lock()   # serializes swaps vs stop
         self._started = False
         self._swaps = 0
+        # replica supervision (reliability/supervisor.py): a dead
+        # replica is rebuilt off the serving path instead of merely
+        # routed around (config.serving_supervise; default off)
+        self._supervise = bool(
+            cfg.serving_supervise if supervise is None else supervise
+        )
+        self._supervisor = None
         # follow the name: every publish/rollback becomes a rolling
         # swap (the immediate initial callback is version-matched away)
         self._sub = self.registry.subscribe(self.name, self._on_publish)
+
+    def _make_replica(self, i, estimator, version):
+        """One replica ModelServer for slot ``i`` with this fleet's
+        configuration — shared by construction and the supervisor's
+        rebuild path (a replacement must be configured IDENTICALLY to
+        the replica it replaces, device placement included)."""
+        import jax
+
+        devices = list(jax.local_devices())
+        r = ModelServer(
+            estimator, methods=self._methods, ladder=self.ladder,
+            max_queue=self._max_queue,
+            batch_window_ms=self._batch_window_ms,
+            timeout_ms=self._timeout_ms,
+            device=devices[i % len(devices)]
+            if len(devices) > 1 else None,
+            replica_id=i, name=self.name,
+        )
+        r.model_version = int(version)
+        return r
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -164,17 +188,31 @@ class FleetServer:
             smetrics.set_replica_gauges(r.replica_id,
                                         version=r.model_version,
                                         healthy=True)
+        if self._supervise and self._supervisor is None:
+            from ..reliability.supervisor import ReplicaSupervisor
+
+            self._supervisor = ReplicaSupervisor(self).start()
         return self
 
     def stop(self, drain=True, timeout=None):
         from ..observability.live import unregister_server
 
         unregister_server(self)
+        if self._supervisor is not None:
+            # the supervisor must stand down BEFORE replicas stop, or
+            # it would read the deliberate shutdown as a fleet-wide
+            # crash and start rebuilding corpses
+            self._supervisor.stop()
+            self._supervisor = None
         self.registry.unsubscribe(self.name, self._sub)
         with self._lock:
             self._started = False
             for r in self.replicas:
                 r.stop(drain=drain, timeout=timeout)
+        for r in self.replicas:
+            # unregistered replicas must not leave stale
+            # serving_replica_*/queue gauge series latched on /metrics
+            smetrics.drop_replica_gauges(r.replica_id)
 
     def __enter__(self):
         return self.start()
@@ -295,10 +333,13 @@ class FleetServer:
             except ServerClosed as exc:
                 # replica died between the health check and the put —
                 # its own queue resolves with typed errors; THIS request
-                # fails over to the next-least-loaded survivor
+                # fails over to the next-least-loaded survivor. The dead
+                # replica's gauge series are DROPPED, not left latched
+                # at stale values forever (a supervisor restart re-adds
+                # them at the new version)
                 last_exc = exc
                 smetrics.record_reroute()
-                smetrics.set_replica_gauges(r.replica_id, healthy=False)
+                smetrics.drop_replica_gauges(r.replica_id)
             except ServerOverloaded as exc:
                 last_exc = exc
                 if i + 1 < len(ranked):
@@ -380,15 +421,40 @@ def serve_while_training(fleet, incremental, X, y=None, passes=1,
     version)`` observes each flip (progress bars, tests). Returns the
     trained ``incremental``.
     """
-    for p in range(int(passes)):
+    # pass-granular resume (ISSUE 11): with stream checkpointing armed
+    # (config.stream_checkpoint_path) the wrapper tracks
+    # ``completed_passes_`` across kills — the checkpoint is restored
+    # BEFORE the first pass runs, and ``passes`` becomes the TOTAL pass
+    # target: already-completed passes are not re-trained (a driver
+    # killed after its final pass but before the clear resumes to ZERO
+    # remaining work, not one extra pass). Without checkpointing (the
+    # default) the loop is byte-for-byte the old fixed-count behavior.
+    done = 0
+    resume = getattr(incremental, "resume_from_checkpoint", None)
+    if resume is not None:
+        try:
+            kw = {} if classes is None else {"classes": classes}
+            done = int(resume(X, y, **kw) or 0)
+        except Exception:
+            done = 0
+    p_done = done
+    for _ in range(max(int(passes) - done, 0) if done
+                   else int(passes)):
         if classes is not None:
             incremental.partial_fit(X, y, classes=classes)
         elif y is not None:
             incremental.partial_fit(X, y)
         else:
             incremental.partial_fit(X)
+        tracked = getattr(incremental, "completed_passes_", None)
+        p_done = int(tracked) if tracked is not None else p_done + 1
         est = getattr(incremental, "estimator_", incremental)
-        version = fleet.publish(est, tag=f"pass{p + 1}")
+        version = fleet.publish(est, tag=f"pass{p_done}")
         if on_pass is not None:
-            on_pass(p + 1, version)
+            on_pass(p_done, version)
+        if tracked is not None and p_done >= int(passes):
+            break
+    # the pass sequence completed: the checkpoint slot must not resume
+    # into a future training run
+    getattr(incremental, "_clear_pass_checkpoint", lambda: None)()
     return incremental

@@ -49,10 +49,11 @@ from ..observability._hist import (
 )
 from ..observability.live import gauge_set, histogram, live_publishing
 
-__all__ = ["LatencyWindow", "batch_span", "record_batch",
-           "record_request", "record_drop", "observe_request_latency",
-           "set_queue_gauges", "set_replica_gauges", "record_swap",
-           "record_reroute", "record_publish"]
+__all__ = ["LatencyWindow", "batch_span", "drop_replica_gauges",
+           "record_batch", "record_request", "record_drop",
+           "observe_request_latency", "set_queue_gauges",
+           "set_replica_gauges", "record_swap", "record_reroute",
+           "record_publish"]
 
 # counter recording lives in observability/_counters.py (the shared
 # registry the report CLI and span deltas read); these are the serving
@@ -115,6 +116,22 @@ def set_queue_gauges(depth: int, inflight_rows: int,
     labels = () if replica is None else (("replica", str(replica)),)
     gauge_set("serving_queue_depth", depth, labels)
     gauge_set("serving_inflight_rows", inflight_rows, labels)
+
+
+def drop_replica_gauges(replica) -> None:
+    """Remove a dead/unregistered replica's labeled gauge series
+    (``serving_replica_version`` / ``serving_replica_healthy`` and its
+    ``serving_queue_depth`` / ``serving_inflight_rows`` children) from
+    the live registry — the same ``drop_labeled_series`` mechanism
+    drift's version eviction uses. Without this a replica marked dead
+    kept its stale series latched on /metrics forever (and pinned
+    cardinality-cap slots live replicas need)."""
+    from ..observability.live import drop_labeled_series
+
+    labels = (("replica", str(replica)),)
+    for family in ("serving_replica", "serving_queue_depth",
+                   "serving_inflight_rows"):
+        drop_labeled_series(family, labels)
 
 
 def set_replica_gauges(replica, version=None, healthy=None) -> None:

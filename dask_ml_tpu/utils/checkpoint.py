@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import shutil
 
 import jax
 import numpy as np
@@ -25,28 +26,119 @@ def _orbax():
     return ocp
 
 
+def _fsync_tree(root):
+    """Best-effort fsync of every file (and directory) under ``root``
+    so the atomic rename below publishes DURABLE bytes — a rename of
+    unflushed data can survive a process kill but not a power cut."""
+    try:
+        for dirpath, _dirnames, filenames in os.walk(root):
+            for name in filenames:
+                try:
+                    fd = os.open(os.path.join(dirpath, name), os.O_RDONLY)
+                    try:
+                        os.fsync(fd)
+                    finally:
+                        os.close(fd)
+                except OSError:
+                    pass
+            try:
+                fd = os.open(dirpath, os.O_RDONLY)
+                try:
+                    os.fsync(fd)
+                finally:
+                    os.close(fd)
+            except OSError:
+                pass
+    except OSError:
+        pass
+
+
+def checkpoint_exists(path) -> bool:
+    """Is there a restorable checkpoint at ``path``? Covers the
+    atomic-writer's crash window: after a kill between "retire the old
+    checkpoint" and "publish the new one", the state lives at
+    ``path + '.old'`` and restore falls back to it."""
+    path = os.path.abspath(path)
+    return os.path.exists(path) or os.path.exists(path + ".old")
+
+
 def save_pytree(path, tree, force=True):
-    """Save a jax pytree (solver/optimizer state) with orbax."""
+    """Save a jax pytree (solver/optimizer state) with orbax —
+    ATOMICALLY. Orbax (and the previous implementation's
+    ``force=True``) deletes the live target before writing, so a kill
+    mid-save used to corrupt the very checkpoint the restart needed.
+    Now the write lands in a temp sibling (fsynced), the previous
+    checkpoint retires to ``path + '.old'``, and one rename publishes:
+    at EVERY kill point either the old or the new state restores."""
     ocp = _orbax()
     path = os.path.abspath(path)
+    tmp, old = path + ".tmp", path + ".old"
+    shutil.rmtree(tmp, ignore_errors=True)
     with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path, tree, force=force)
+        ckptr.save(tmp, tree, force=force)
+    _fsync_tree(tmp)
+    if os.path.exists(path):
+        # retire the live checkpoint to .old (replacing a stale one)
+        shutil.rmtree(old, ignore_errors=True)
+        os.rename(path, old)
+    # else: a previous crash may have left the ONLY good state at .old
+    # — it must survive until the new checkpoint has PUBLISHED, or a
+    # kill right here would leave nothing restorable
+    os.rename(tmp, path)
+    shutil.rmtree(old, ignore_errors=True)
 
 
 def restore_pytree(path, like=None):
     ocp = _orbax()
     path = os.path.abspath(path)
-    with ocp.StandardCheckpointer() as ckptr:
-        if like is not None:
-            return ckptr.restore(path, like)
-        return ckptr.restore(path)
+
+    def _restore(p):
+        import logging
+
+        with ocp.StandardCheckpointer() as ckptr:
+            if like is not None:
+                return ckptr.restore(p, like)
+            # template-less restore is the stream-checkpoint contract
+            # (the token check rejects foreign topologies) — silence
+            # orbax's per-call UNSAFE warning for the duration
+            absl = logging.getLogger("absl")
+            prev = absl.level
+            absl.setLevel(logging.ERROR)
+            try:
+                return ckptr.restore(p)
+            finally:
+                absl.setLevel(prev)
+
+    try:
+        return _restore(path)
+    except Exception:
+        # the atomic writer's crash window: the previous checkpoint
+        # retired to .old but the new one never published
+        old = path + ".old"
+        if os.path.isdir(old):
+            return _restore(old)
+        raise
 
 
 def save_host(path, obj):
-    """Pickle host-side state (search history, sklearn models)."""
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(obj, f)
+    """Pickle host-side state (search history, sklearn models) —
+    atomically: temp sibling, flush+fsync, rename. A kill mid-save
+    leaves the previous file intact, never a truncated pickle."""
+    path = os.path.abspath(path)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            pickle.dump(obj, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
 
 
 def restore_host(path):

@@ -904,6 +904,88 @@ class Incremental(ParallelPostFit):
             publish_progress(block=done + 1, blocks_total=len(starts))
         return est
 
+    # -- pass-granular checkpoint/auto-resume (ISSUE 11) -------------------
+    # With config.stream_checkpoint_path set, every partial_fit pass of
+    # a device SGD-family inner estimator persists (w, lr clock,
+    # classes, completed pass count) under a fingerprint token; a FRESH
+    # wrapper whose first partial_fit finds a matching checkpoint
+    # resumes the inner model and exposes ``completed_passes_`` so a
+    # killed pass-driver loop (serve_while_training, chaos harnesses)
+    # skips the passes already done. Host estimators and non-numeric
+    # class sets opt out; fit() (a fresh one-pass fit) clears any
+    # matching slot rather than resuming into it.
+
+    def _pass_checkpoint(self, est, X, y, fit_kwargs):
+        from .config import get_config
+        from .reliability.stream_ckpt import stream_checkpoint
+
+        if not get_config().stream_checkpoint_path:
+            return None   # knobs off: touch nothing, cost one read
+        if not (hasattr(est, "_stream_pass") and hasattr(est, "_loss")):
+            return None   # device SGD-family only (w/t carry contract)
+        if isinstance(X, ShardedArray) or y is None:
+            return None
+        classes = fit_kwargs.get("classes",
+                                 getattr(est, "classes_", None))
+        if classes is not None:
+            classes = np.asarray(classes)
+            if classes.dtype.kind not in "fiub":
+                return None   # string labels don't round-trip orbax
+        Xh, yh = _host_matrix(X), np.asarray(y)
+        parts = (
+            "incremental", type(est).__name__,
+            repr(sorted(est.get_params().items())),
+            self.shuffle_blocks, self.random_state,
+            None if classes is None else tuple(classes.tolist()),
+            tuple(Xh.shape) if hasattr(Xh, "shape") else len(Xh),
+        )
+        ckpt = stream_checkpoint("incremental", parts, arrays=(Xh, yh))
+        self._pass_ckpt_ = ckpt
+        return ckpt
+
+    def _clear_pass_checkpoint(self):
+        """Completion hook (serve_while_training calls it): the pass
+        sequence is done, the slot must not resume into a future fit."""
+        ckpt = getattr(self, "_pass_ckpt_", None)
+        if ckpt is not None:
+            ckpt.clear()
+
+    def resume_from_checkpoint(self, X, y=None, **fit_kwargs):
+        """Restore a matching pass checkpoint into this FRESH wrapper
+        WITHOUT training — pass-driver loops (serve_while_training)
+        call it before their first pass so a driver killed after its
+        final pass resumes to zero remaining work instead of training
+        one pass past the target. Returns the completed pass count
+        (0 when nothing restored / already fitted / knobs off)."""
+        from .config import get_config
+
+        if not get_config().stream_checkpoint_path:
+            return 0
+        if getattr(self, "estimator_", None) is not None:
+            return int(getattr(self, "completed_passes_", 0))
+        est = clone(self.estimator)
+        ckpt = self._pass_checkpoint(est, X, y, fit_kwargs)
+        if ckpt is None:
+            return 0
+        st = ckpt.restore()
+        if st is None:
+            return 0
+        from .observability._counters import record_stream_checkpoint
+
+        import jax.numpy as jnp
+
+        classes = st.get("classes")
+        if classes is not None:
+            est._set_classes(np.asarray(classes))
+        est._ensure_state(int(st["d"]))
+        est._w = jnp.asarray(np.asarray(st["w"], np.float32))
+        est._t = int(st["t"])
+        est._publish(int(st["d"]))
+        self.estimator_ = est
+        self.completed_passes_ = int(st["passes"])
+        record_stream_checkpoint(resume=True)
+        return self.completed_passes_
+
     def fit(self, X, y=None, **fit_kwargs):
         est = clone(self.estimator)
         if not hasattr(est, "partial_fit"):
@@ -926,6 +1008,13 @@ class Incremental(ParallelPostFit):
                 fit_kwargs["classes"] = device_classes(y)
             else:
                 fit_kwargs["classes"] = np.unique(np.asarray(y))
+        # a fresh fit() must never resume a stale pass sequence
+        try:
+            ckpt = self._pass_checkpoint(est, X, y, fit_kwargs)
+            if ckpt is not None:
+                ckpt.clear()
+        except Exception:
+            pass
         rng = np.random.RandomState(self.random_state)
         self.estimator_ = self._partial_fit_pass(
             est, X, y, self._block_size(X), rng, **fit_kwargs
@@ -933,13 +1022,31 @@ class Incremental(ParallelPostFit):
         return self
 
     def partial_fit(self, X, y=None, **fit_kwargs):
+        if getattr(self, "estimator_", None) is None:
+            # fresh wrapper: a matching checkpoint restores the killed
+            # driver's inner carry before this pass runs
+            self.resume_from_checkpoint(X, y, **fit_kwargs)
         est = getattr(self, "estimator_", None)
         if est is None:
             est = clone(self.estimator)
+        ckpt = self._pass_checkpoint(est, X, y, fit_kwargs)
         rng = np.random.RandomState(self.random_state)
         self.estimator_ = self._partial_fit_pass(
             est, X, y, self._block_size(X), rng, **fit_kwargs
         )
+        if ckpt is not None:
+            self.completed_passes_ = \
+                getattr(self, "completed_passes_", 0) + 1
+            if ckpt.due(self.completed_passes_):
+                inner = self.estimator_
+                classes = getattr(inner, "classes_", None)
+                ckpt.save(
+                    w=np.asarray(inner._w), t=int(inner._t),
+                    d=int(np.asarray(inner._w).shape[-1]) - 1,
+                    passes=self.completed_passes_,
+                    classes=None if classes is None
+                    else np.asarray(classes),
+                )
         return self
 
     @staticmethod

@@ -1,0 +1,348 @@
+"""Chaos verify gate (ISSUE 11): the failure paths must actually work.
+
+Three gates, each exercising one leg of the reliability plane:
+
+1. **kill-mid-pass resume parity** — a SUBPROCESS streamed SGD fit with
+   ``stream_checkpoint_path`` set is SIGKILLed mid-pass (a watcher
+   thread in the child kills the process the moment the first pass's
+   checkpoint publishes — so the kill often lands during the NEXT
+   save, exercising the atomic writer too); rerunning the identical fit
+   auto-resumes and must match an uninterrupted control fit to 1e-6,
+   with the checkpoint slot cleared on completion.
+2. **injected staging IO fault retried** — the same fit under
+   ``fault_plan=staging_read:io@3`` + ``stream_io_retries`` completes
+   bit-identically, with ``stream_retries_total`` /
+   ``faults_injected_total`` > 0 scraped off the child's /metrics.
+3. **replica kill under ragged traffic** — a 2-replica fleet with the
+   supervisor armed loses one worker to an injected crash mid-traffic:
+   the replica must be rebuilt+rewarmed off the serving path and rejoin
+   routing, ZERO requests may be lost, and traffic after the rebuild's
+   warmup must mint ZERO new XLA compiles.
+
+Prints one JSON line per gate; exit 0 = all gates hold.
+Run: ``python scripts/chaos_smoke.py``.
+"""
+
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# one fit definition shared by control / killed / resumed / faulted
+# children: deterministic data, shuffled passes (the lr-clock identity
+# the resume contract must preserve)
+CHILD_FIT = r"""
+import json, os, sys, threading, time
+import numpy as np
+from dask_ml_tpu import config
+from dask_ml_tpu.models.sgd import SGDClassifier
+from dask_ml_tpu.observability import counters_snapshot
+
+ckpt_dir = os.environ.get("CHAOS_CKPT", "")
+kill = os.environ.get("CHAOS_KILL") == "1"
+
+rng = np.random.RandomState(7)
+X = rng.randn(200_000, 16).astype(np.float32)
+y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(np.float32)
+
+if kill:
+    def killer():
+        # SIGKILL the moment the first pass's checkpoint publishes:
+        # no cleanup handlers run — the restart sees exactly what
+        # survived the atomic writer
+        target = os.path.join(ckpt_dir, "sgd")
+        while not os.path.exists(target):
+            time.sleep(0.005)
+        time.sleep(0.05)
+        os.kill(os.getpid(), 9)
+
+    threading.Thread(target=killer, daemon=True).start()
+
+overrides = dict(stream_block_rows=8192)
+if ckpt_dir:
+    overrides["stream_checkpoint_path"] = ckpt_dir
+with config.set(**overrides):
+    clf = SGDClassifier(max_iter=10, random_state=0, shuffle=True).fit(X, y)
+snap = counters_snapshot()
+print("RESULT " + json.dumps({
+    "coef": np.ravel(clf.coef_).tolist(),
+    "intercept": np.ravel(np.atleast_1d(clf.intercept_)).tolist(),
+    "resumes": snap.get("stream_resumes", 0),
+    "saves": snap.get("stream_checkpoint_saves", 0),
+    "retries": snap.get("stream_retries", 0),
+    "ckpt_left": bool(ckpt_dir) and os.path.exists(
+        os.path.join(ckpt_dir, "sgd")),
+}), flush=True)
+time.sleep(float(os.environ.get("CHAOS_LINGER", "0")))
+"""
+
+CHILD_FLEET = r"""
+import json, threading, time
+import numpy as np
+from dask_ml_tpu import config
+from dask_ml_tpu.models.sgd import SGDClassifier
+from dask_ml_tpu.observability import counters_snapshot
+from dask_ml_tpu.serving.fleet import FleetServer
+
+rng = np.random.RandomState(3)
+X = rng.randn(4000, 12).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.float32)
+
+with config.set(stream_block_rows=0):
+    clf = SGDClassifier(max_iter=2, random_state=0).fit(X, y)
+
+out = {"ok": False}
+with config.set(serving_min_batch=8, serving_max_batch=64,
+                serving_supervise=True,
+                serving_supervise_interval_s=0.05,
+                obs_drift=False,
+                fault_plan="replica_worker:crash@120"):
+    fleet = FleetServer(clf, replicas=2, timeout_ms=20000).warmup()
+    with fleet:
+        # per-thread result slots summed after join (a shared counter
+        # += would lose increments under the GIL's preemption points)
+        N_CLIENTS, PER = 4, 120
+        oks = [0] * N_CLIENTS
+        errs = []
+
+        def client(slot):
+            crng = np.random.RandomState(slot)
+            for i in range(PER):
+                n = int(crng.randint(1, 64))
+                try:
+                    p = fleet.predict(X[:n])
+                    assert len(p) == n
+                    oks[slot] += 1
+                except Exception as exc:
+                    errs.append(f"{type(exc).__name__}: {exc}")
+
+        # phase 1: traffic that overlaps the injected worker crash
+        threads = [threading.Thread(target=client, args=(s,))
+                   for s in range(N_CLIENTS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        # the supervisor must have rebuilt the dead replica
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            snap = counters_snapshot()
+            if snap.get("serving_replica_restarts", 0) >= 1 and \
+                    sum(1 for r in fleet.replicas if r.healthy) == 2:
+                break
+            time.sleep(0.05)
+        snap = counters_snapshot()
+        out["restarts"] = snap.get("serving_replica_restarts", 0)
+        out["healthy"] = sum(1 for r in fleet.replicas if r.healthy)
+        out["phase1_ok"] = sum(oks)
+        out["phase1_errors"] = errs[:5]
+        # phase 2: the rebuilt replica is warmed — steady-state ragged
+        # traffic must mint ZERO new XLA compiles from here on
+        base_compiles = counters_snapshot().get("recompiles", 0)
+        oks2 = [0] * N_CLIENTS
+        errs2 = []
+
+        def client2(slot):
+            crng = np.random.RandomState(100 + slot)
+            for i in range(PER):
+                n = int(crng.randint(1, 64))
+                try:
+                    p = fleet.predict(X[:n])
+                    assert len(p) == n
+                    oks2[slot] += 1
+                except Exception as exc:
+                    errs2.append(f"{type(exc).__name__}: {exc}")
+
+        threads = [threading.Thread(target=client2, args=(s,))
+                   for s in range(N_CLIENTS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        out["phase2_ok"] = sum(oks2)
+        out["phase2_errors"] = errs2[:5]
+        out["phase2_compiles"] = \
+            counters_snapshot().get("recompiles", 0) - base_compiles
+        out["ok"] = (
+            out["restarts"] >= 1 and out["healthy"] == 2
+            and not errs and not errs2
+            and sum(oks) == N_CLIENTS * PER
+            and sum(oks2) == N_CLIENTS * PER
+            and out["phase2_compiles"] == 0
+        )
+print("RESULT " + json.dumps(out), flush=True)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_child(code, env_extra=None, expect_kill=False, timeout=240):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", **(env_extra or {})}
+    child = subprocess.Popen(
+        [sys.executable, "-c", code], env=env, cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    try:
+        stdout, stderr = child.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        child.kill()
+        stdout, stderr = child.communicate()
+        raise RuntimeError(
+            f"child timed out; stderr: {stderr.decode()[-2000:]}"
+        )
+    if expect_kill:
+        if child.returncode == -signal.SIGKILL:
+            return None
+        raise RuntimeError(
+            f"expected SIGKILL death, got rc={child.returncode}; "
+            f"stderr: {stderr.decode()[-2000:]}"
+        )
+    for line in stdout.decode().splitlines():
+        if line.startswith("RESULT "):
+            return json.loads(line[len("RESULT "):])
+    raise RuntimeError(
+        f"child (rc={child.returncode}) printed no RESULT; stderr: "
+        + stderr.decode()[-2000:]
+    )
+
+
+def gate_resume(tmpdir):
+    """Gate 1: SIGKILL mid-pass -> auto-resume -> parity 1e-6."""
+    control = _run_child(CHILD_FIT)
+    ckpt = os.path.join(tmpdir, "ckpt")
+    os.makedirs(ckpt, exist_ok=True)
+    _run_child(CHILD_FIT, {"CHAOS_CKPT": ckpt, "CHAOS_KILL": "1"},
+               expect_kill=True)
+    if not os.path.exists(os.path.join(ckpt, "sgd")):
+        raise RuntimeError("killed child left no checkpoint behind")
+    resumed = _run_child(CHILD_FIT, {"CHAOS_CKPT": ckpt})
+    if resumed["resumes"] < 1:
+        raise RuntimeError(f"rerun did not resume: {resumed}")
+    if resumed["ckpt_left"]:
+        raise RuntimeError("completed fit left its checkpoint behind")
+    import numpy as np
+
+    err = float(np.abs(
+        np.asarray(resumed["coef"]) - np.asarray(control["coef"])
+    ).max())
+    ierr = float(np.abs(
+        np.asarray(resumed["intercept"])
+        - np.asarray(control["intercept"])
+    ).max())
+    if max(err, ierr) > 1e-6:
+        raise RuntimeError(
+            f"resume parity {max(err, ierr):.3g} > 1e-6"
+        )
+    return {"gate": "resume", "ok": True, "coef_err": err,
+            "resumes": resumed["resumes"], "saves": resumed["saves"]}, \
+        control
+
+
+def gate_io_retry(control):
+    """Gate 2: injected staging IOError retried; counters on /metrics;
+    result bit-identical to the clean control fit."""
+    port = _free_port()
+    env = {
+        "DASK_ML_TPU_FAULT_PLAN": "staging_read:io@3",
+        "DASK_ML_TPU_STREAM_IO_RETRIES": "2",
+        "DASK_ML_TPU_OBS_HTTP_PORT": str(port),
+        "CHAOS_LINGER": "15",
+    }
+    child = subprocess.Popen(
+        [sys.executable, "-c", CHILD_FIT],
+        env={**os.environ, "JAX_PLATFORMS": "cpu", **env}, cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    try:
+        result = None
+        metrics = ""
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            line = child.stdout.readline().decode()
+            if line.startswith("RESULT "):
+                result = json.loads(line[len("RESULT "):])
+                break
+            if not line and child.poll() is not None:
+                raise RuntimeError(
+                    "fault child died: "
+                    + child.stderr.read().decode()[-2000:]
+                )
+        if result is None:
+            raise RuntimeError("fault child never printed RESULT")
+        # scrape the lingering child's /metrics for the counters
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+            metrics = r.read().decode()
+    finally:
+        child.terminate()
+        try:
+            child.wait(10)
+        except Exception:
+            child.kill()
+    retried = re.search(r"^dask_ml_tpu_stream_retries_total (\d+)",
+                        metrics, re.MULTILINE)
+    injected = re.search(r"^dask_ml_tpu_faults_injected_total (\d+)",
+                         metrics, re.MULTILINE)
+    if not retried or int(retried.group(1)) < 1:
+        raise RuntimeError("stream_retries_total missing/zero on /metrics")
+    if not injected or int(injected.group(1)) < 1:
+        raise RuntimeError("faults_injected_total missing/zero on /metrics")
+    import numpy as np
+
+    err = float(np.abs(
+        np.asarray(result["coef"]) - np.asarray(control["coef"])
+    ).max())
+    if err > 1e-6:
+        raise RuntimeError(f"faulted-fit parity {err:.3g} > 1e-6")
+    return {"gate": "io_retry", "ok": True,
+            "retries": int(retried.group(1)),
+            "injected": int(injected.group(1)), "coef_err": err}
+
+
+def gate_replica_restart():
+    """Gate 3: replica crash under ragged traffic -> supervised rebuild,
+    zero lost requests, zero post-rewarm compiles."""
+    result = _run_child(CHILD_FLEET, timeout=400)
+    if not result.get("ok"):
+        raise RuntimeError(f"fleet chaos gate failed: {result}")
+    return {"gate": "replica_restart", **result}
+
+
+def main():
+    import tempfile
+
+    tmpdir = tempfile.mkdtemp(prefix="chaos_smoke_")
+    rc = 0
+    try:
+        g1, control = gate_resume(tmpdir)
+        print(json.dumps(g1))
+        print(json.dumps(gate_io_retry(control)))
+        print(json.dumps(gate_replica_restart()))
+    except Exception as exc:
+        print(json.dumps({"ok": False,
+                          "error": f"{type(exc).__name__}: {exc}"}))
+        rc = 1
+    finally:
+        import shutil
+
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

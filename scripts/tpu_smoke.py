@@ -455,6 +455,90 @@ def main():
         print(f"    round-9: {n_dev} chips, single {single_chip:.0f} "
               f"samples/s, sharded {per_chip:.0f} samples/s/chip")
 
+    def chaos_round10():
+        """ISSUE 11 surfaces on real hardware: one injected-fault
+        streamed resume and one supervised replica restart. Auto-
+        degrades like round-9 — every leg runs identically on a 1-chip
+        attach (thread replicas; the sharded flavor simply never
+        engages), so the round gates correctness, not scale."""
+        import tempfile
+        import time as _time
+
+        from dask_ml_tpu import config
+        from dask_ml_tpu.models.sgd import SGDClassifier
+        from dask_ml_tpu.observability import (counters_reset,
+                                               counters_snapshot)
+        from dask_ml_tpu.reliability import FaultInjected, reset_plans
+        from dask_ml_tpu.serving.fleet import FleetServer
+
+        rng = np.random.RandomState(11)
+        n, d = 65_536, 32
+        Xh = rng.randn(n, d).astype(np.float32)
+        yh = (Xh[:, 0] > 0).astype(np.float32)
+        base = dict(stream_block_rows=2048, stream_autotune=False,
+                    dtype="float32")
+        # (a) injected staging IO fault absorbed by retry, bit-parity
+        counters_reset()
+        reset_plans()
+        with config.set(**base):
+            clean = SGDClassifier(max_iter=2, random_state=0,
+                                  shuffle=True).fit(Xh, yh)
+        with config.set(fault_plan="staging_read:io@5",
+                        stream_io_retries=3, **base):
+            faulted = SGDClassifier(max_iter=2, random_state=0,
+                                    shuffle=True).fit(Xh, yh)
+        assert counters_snapshot().get("stream_retries", 0) >= 1
+        assert np.allclose(faulted.coef_, clean.coef_, atol=1e-6)
+        # (b) kill-mid-pass resume parity (crash at the dispatch
+        # boundary, then rerun with the same knobs auto-resumes)
+        tmp = tempfile.mkdtemp(prefix="tpu_chaos_")
+        reset_plans()
+        n_sb = -(-((n + 2047) // 2048) // 8)   # dispatches per pass
+        with config.set(stream_checkpoint_path=tmp,
+                        fault_plan=f"superblock_dispatch:crash@{n_sb}",
+                        **base):
+            try:
+                SGDClassifier(max_iter=2, random_state=0,
+                              shuffle=True).fit(Xh, yh)
+                raise AssertionError("injected crash never fired")
+            except FaultInjected:
+                pass
+        reset_plans()
+        with config.set(stream_checkpoint_path=tmp, **base):
+            resumed = SGDClassifier(max_iter=2, random_state=0,
+                                    shuffle=True).fit(Xh, yh)
+        assert counters_snapshot().get("stream_resumes", 0) >= 1
+        assert np.allclose(resumed.coef_, clean.coef_, atol=1e-6), \
+            np.abs(resumed.coef_ - clean.coef_).max()
+        # (c) supervised replica restart under live traffic
+        counters_reset()
+        reset_plans()
+        with config.set(serving_min_batch=8, serving_max_batch=64,
+                        serving_supervise=True, obs_drift=False,
+                        serving_supervise_interval_s=0.1,
+                        fault_plan="replica_worker:crash@60",
+                        dtype="float32"):
+            fleet = FleetServer(clean, replicas=2,
+                                timeout_ms=20000).warmup()
+            with fleet:
+                served = 0
+                deadline = _time.time() + 60
+                while _time.time() < deadline:
+                    p = fleet.predict(Xh[: int(rng.randint(1, 64))])
+                    served += len(p)
+                    snap = counters_snapshot()
+                    if snap.get("serving_replica_restarts", 0) >= 1 \
+                            and sum(1 for r in fleet.replicas
+                                    if r.healthy) == 2:
+                        break
+                assert counters_snapshot().get(
+                    "serving_replica_restarts", 0) >= 1, \
+                    counters_snapshot()
+                assert len(fleet.predict(Xh[:32])) == 32
+        print(f"    round-10: resume parity "
+              f"{np.abs(resumed.coef_ - clean.coef_).max():.1e}, "
+              f"retries absorbed, replica restarted under load")
+
     passed = _load_state()
     for name, fn in [
         ("glm solvers x3 families", glms),
@@ -472,6 +556,7 @@ def main():
         ("round-5 sparse/scorers/bf16/overlap", round5_surfaces),
         ("round-8 fused-stream/bf16-auto/int8", fused_stream_round8),
         ("round-9 sharded superblock streaming", sharded_stream_round9),
+        ("round-10 chaos/resume/supervision", chaos_round10),
     ]:
         results.append(run(name, fn, passed))
 

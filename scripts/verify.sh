@@ -106,6 +106,16 @@ if ! timeout -k 10 300 python scripts/drift_smoke.py; then
     exit 1
 fi
 
+# -- chaos gate (ISSUE 11): a subprocess streamed fit SIGKILLed mid-pass
+# must auto-resume to 1e-6 parity; an injected staging IOError must be
+# retried (counters visible on /metrics) with a bit-identical result;
+# a replica killed under ragged traffic must be supervisor-rebuilt with
+# zero lost requests and zero post-rewarm XLA compiles.
+if ! timeout -k 10 500 python scripts/chaos_smoke.py; then
+    echo "VERIFY FAIL: chaos gate (fault injection / resume / supervision)"
+    exit 1
+fi
+
 # -- serving suite (fast, targeted): the online-inference subsystem gates
 # the same as lint — a broken server should fail verify in ~1min, before
 # the full tier-1 wait. timeout-wrapped like tier-1: a hung serving
