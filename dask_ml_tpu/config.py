@@ -84,6 +84,30 @@ class Config:
     # knob off the streamed jaxprs are byte-identical to the
     # pre-feature programs (asserted in tests)
     pallas_stream: bool = True
+    # interpret-mode opt-in for the fused Pallas streamed kernels
+    # off-TPU: with this on, the fused bodies (including the ones
+    # running INSIDE the shard_map scan programs) execute through the
+    # Pallas interpreter on CPU/GPU — the fused x sharded composition
+    # is then testable/benchable without a chip, at interpreter speed.
+    # Off (the default) keeps the off-TPU XLA flavors byte-identical;
+    # real-TPU behavior is unaffected either way
+    pallas_stream_interpret: bool = False
+    # gradient-accumulation streamed SGD (models/sgd.py): 0 = off (the
+    # sequential flavor; host-streamed SGD under a multi-process
+    # runtime stays refused, because sequential per-block updates
+    # cannot psum across process-local streams). A >= 1 accumulates
+    # each process's raw gradient sums over A micro-blocks, merges ONCE
+    # across processes (psum_host), and applies a single shared update
+    # — the documented optimizer variant that lifts the cross-host
+    # refusal. Exact parity with the sequential fit at A=1
+    # single-process (bit-exact vs the single-device sequential
+    # flavor; the sharded sequential scan differs at
+    # float-reassociation level); at A>1 (or multi-process) the
+    # effective batch per
+    # update grows A x processes-fold, so expect fewer, larger steps
+    # per pass (see README "Pod-scale streaming" for the convergence
+    # caveat). Recorded in solver_info_["grad_accum"]
+    stream_grad_accum: int = 0
     # -- reliability / chaos plane (dask_ml_tpu/reliability/) -------------
     # deterministic fault-injection plan ("" = off, the zero-overhead
     # default: every site costs one config read + branch and the

@@ -236,7 +236,8 @@ import functools as _ft
 
 
 @_ft.lru_cache(maxsize=16)
-def _sb_assign_stats_sharded(mesh, mxu_dtype=None):
+def _sb_assign_stats_sharded(mesh, mxu_dtype=None, fused=False,
+                             interpret=False):
     """Data-parallel flavor of :func:`_sb_assign_stats` (ISSUE 9): the
     K-step assign+accumulate scan runs under ``shard_map`` over the
     stream mesh's "data" axis — each device scans only its own row slab
@@ -244,11 +245,22 @@ def _sb_assign_stats_sharded(mesh, mxu_dtype=None):
     the (sums, counts, inertia) carry stays REPLICATED, and the whole
     super-block pays exactly ONE ``lax.psum`` over "data" to fold the
     local delta into the running carry. Donated at the jit level like
-    the single-device flavor."""
+    the single-device flavor.
+
+    ``fused=True`` (ISSUE 12): each shard's block stats come from the
+    fused Pallas assign-and-accumulate kernel running INSIDE the
+    shard_map on its own (S/D, d) slab — one VMEM pass per block where
+    the XLA body reads X twice — with the identical single psum per
+    super-block; tracked as ``pallas.kmeans_stream.psum``. The
+    replication checker is disabled on the fused trace only
+    (pallas_call has no replication rule)."""
     from jax.sharding import PartitionSpec as P
 
     from .._compat import shard_map
     from ..parallel.mesh import DATA_AXIS, data_shard_spec as spec_of
+
+    if fused:
+        from ..ops.pallas_fused import fused_kmeans_block_stats
 
     def body(acc, Xs, counts, centers):
         unrolled = isinstance(Xs, (tuple, list))
@@ -257,10 +269,15 @@ def _sb_assign_stats_sharded(mesh, mxu_dtype=None):
         local = jax.tree.map(jnp.zeros_like, acc)
 
         def step(lacc, X, c):
-            mask = (r < c).astype(X.dtype)
-            s, cnt, i = _block_assign_stats.__wrapped__(
-                X, mask, centers, mxu_dtype=mxu_dtype
-            )
+            if fused:
+                s, cnt, i = fused_kmeans_block_stats(
+                    X, c, centers, mxu=mxu_dtype, interpret=interpret
+                )
+            else:
+                mask = (r < c).astype(X.dtype)
+                s, cnt, i = _block_assign_stats.__wrapped__(
+                    X, mask, centers, mxu_dtype=mxu_dtype
+                )
             return (lacc[0] + s, lacc[1] + cnt, lacc[2] + i)
 
         if unrolled:
@@ -283,10 +300,13 @@ def _sb_assign_stats_sharded(mesh, mxu_dtype=None):
             body, mesh,
             in_specs=(P(), xs_spec, P(DATA_AXIS, None), P()),
             out_specs=P(),
+            check_vma=False if fused else None,
         )
         return f(acc, Xs, counts, centers)
 
-    return track_program("superblock.kmeans_assign.psum")(run)
+    name = ("pallas.kmeans_stream.psum" if fused
+            else "superblock.kmeans_assign.psum")
+    return track_program(name)(run)
 
 
 @track_program("pallas.kmeans_stream")
@@ -448,19 +468,25 @@ def _streamed_lloyd(stream, centers0, max_iter, tol2, logger=None,
     use_sb = hasattr(stream, "use_superblocks") and stream.use_superblocks()
     from ..observability import record_superblock_donation
 
-    # fused Pallas scan flavor (one VMEM pass per block) on real TPU
-    # when the block shape fits its grid — else the XLA flavor, which
-    # with mxu=None traces byte-identically to the pre-feature program
-    from ..ops.pallas_fused import kmeans_stream_tile, use_stream_kernels
+    # fused Pallas scan flavor (one VMEM pass per block) when opted in
+    # (real TPU, or interpret mode via pallas_stream_interpret) and the
+    # PER-SHARD slab shape fits its grid — composed with the sharded
+    # flavor by running inside its shard_map (ISSUE 12) — else the XLA
+    # flavor, which with mxu=None traces byte-identically to the
+    # pre-feature program
+    from ..ops.pallas_fused import kmeans_stream_tile, stream_kernel_mode
 
     k0, d0 = jnp.asarray(centers0).shape
     sharded = bool(
         use_sb and getattr(stream, "sb_sharded", lambda: False)()
     )
+    use_k, interp = stream_kernel_mode()
+    slab_rows = int(stream.block_rows) // (
+        int(stream.sb_data_shards()) if sharded else 1
+    )
     fused = bool(
-        use_sb and not sharded and use_stream_kernels()
-        and kmeans_stream_tile(int(stream.block_rows), int(d0),
-                               int(k0)) is not None
+        use_sb and use_k
+        and kmeans_stream_tile(slab_rows, int(d0), int(k0)) is not None
     )
     sb_run = _sb_assign_stats_pallas if fused else _sb_assign_stats
     rep = None
@@ -476,14 +502,16 @@ def _streamed_lloyd(stream, centers0, max_iter, tol2, logger=None,
         if src.startswith("auto"):
             # mirror the resident auto-gate: under dtype="auto" the
             # single-device streamed flavor this displaces is the f32
-            # Pallas kernel, so the sharded XLA body stays f32 too —
+            # Pallas kernel, so the sharded body stays f32 too —
             # bf16 distance assignments would put sharded-vs-single
             # parity at the mercy of argmin ties, not reassociation.
             # An EXPLICIT bfloat16 request is still honored
             mxu = None
         rep = NamedSharding(stream.mesh, P())
         centers = jax.device_put(centers, rep)
-        sharded_run = _sb_assign_stats_sharded(stream.mesh, mxu)
+        sharded_run = _sb_assign_stats_sharded(stream.mesh, mxu,
+                                               fused=fused,
+                                               interpret=interp)
 
     for it in range(start_it, int(max_iter)):
         if use_sb:
@@ -499,6 +527,12 @@ def _streamed_lloyd(stream, centers0, max_iter, tol2, logger=None,
                 for sb in stream.superblocks():
                     acc = sharded_run(acc, sb.arrays[0],
                                       sb.shard_counts, centers)
+                    record_superblock_donation(acc_bytes)
+            elif fused:
+                for sb in stream.superblocks():
+                    acc = sb_run(acc, sb.arrays[0], sb.counts,
+                                 centers, mxu_dtype=mxu,
+                                 interpret=interp)
                     record_superblock_donation(acc_bytes)
             else:
                 for sb in stream.superblocks():

@@ -266,7 +266,8 @@ def _reducer_blocks(kind, n_classes):
     return fn, ()
 
 
-def _sb_reducer_sharded(kind, family, intercept, n_classes, mesh):
+def _sb_reducer_sharded(kind, family, intercept, n_classes, mesh,
+                        mxu=None, fused=False, interpret=False):
     """Data-parallel super-block reducer (ISSUE 9): the same K-step
     accumulation as :func:`_sb_reducer`, run under ``shard_map`` over
     the stream mesh's "data" axis. Each device scans ONLY its own row
@@ -276,13 +277,41 @@ def _sb_reducer_sharded(kind, family, intercept, n_classes, mesh):
     ``lax.psum`` over "data": the local K-block delta merges once, then
     adds to the running replicated carry. Donation at the jit level
     keeps the carry advancing in place exactly like the single-device
-    flavor."""
+    flavor.
+
+    ``fused=True`` (ISSUE 12 tentpole) swaps the per-block body for the
+    fused Pallas kernel running INSIDE the shard_map: each device's
+    kernel sees its OWN (S/D, d) slab (tile selection reasons about the
+    per-shard slab height, not the global block), produces local raw
+    sums from ONE VMEM pass, and the existing single psum per
+    super-block merges them — the per-chip kernel speed of the fused
+    flavor composed with the data mesh. The replication checker is
+    disabled on the fused trace only (pallas_call has no replication
+    rule); the unfused program is byte-identical to the pre-feature
+    one."""
     from jax.sharding import PartitionSpec as P
 
     from ..._compat import shard_map
     from ...parallel.mesh import DATA_AXIS, data_shard_spec as spec_of
 
-    fn, extra = _reducer_blocks(kind, n_classes)
+    if fused:
+        from ...ops.pallas_fused import (fused_glm_multi_stream,
+                                         fused_glm_stream)
+
+        if n_classes:
+            def block_sums(beta, Xb, yb, c):
+                return fused_glm_multi_stream(
+                    kind, Xb, c, yb, beta, family, intercept,
+                    mxu=mxu, interpret=interpret,
+                )
+        else:
+            def block_sums(beta, Xb, yb, c):
+                return fused_glm_stream(
+                    kind, Xb, c, yb, beta, family, intercept,
+                    mxu=mxu, interpret=interpret,
+                )
+    else:
+        fn, extra = _reducer_blocks(kind, n_classes)
 
     def body(acc, beta, Xs, ys, counts):
         # LOCAL view: Xs (K, S/D, d) or a K-tuple of (S/D, d) blocks,
@@ -293,9 +322,12 @@ def _sb_reducer_sharded(kind, family, intercept, n_classes, mesh):
         local = jax.tree.map(jnp.zeros_like, acc)
 
         def step(lacc, Xb, yb, c):
-            mask = (r < c).astype(Xb.dtype)
-            out = fn(beta, Xb, yb, mask, family, intercept, *extra)
-            out = out if isinstance(out, tuple) else (out,)
+            if fused:
+                out = block_sums(beta, Xb, yb, c)
+            else:
+                mask = (r < c).astype(Xb.dtype)
+                out = fn(beta, Xb, yb, mask, family, intercept, *extra)
+                out = out if isinstance(out, tuple) else (out,)
             return tuple(l + o for l, o in zip(lacc, out))
 
         if unrolled:
@@ -324,11 +356,14 @@ def _sb_reducer_sharded(kind, family, intercept, n_classes, mesh):
             body, mesh,
             in_specs=(P(), P(), xs_spec, ys_spec, P(DATA_AXIS, None)),
             out_specs=P(),
+            check_vma=False if fused else None,
         )
         return f(acc, beta, Xs, ys, counts)
 
     suffix = "_multi" if n_classes else ""
-    return track_program(f"superblock.glm.{kind}{suffix}.psum")(run)
+    name = (f"pallas.glm_{kind}{suffix}.psum" if fused
+            else f"superblock.glm.{kind}{suffix}.psum")
+    return track_program(name)(run)
 
 
 @_ft.lru_cache(maxsize=64)
@@ -347,28 +382,42 @@ def _sb_reducer(kind, family, intercept, n_classes, mxu=None,
     other knobs at default) this function is byte-for-byte the
     pre-mesh program.
 
-    ``fused=True`` (binary objectives on real TPU — see
-    ``StreamedObjective._sb_pass``'s gate) swaps the per-block body for
-    the Pallas ``fused_glm_stream`` kernel: ONE VMEM pass per block for
+    ``fused=True`` (see ``StreamedObjective._sb_flavor``'s gate) swaps
+    the per-block body for the Pallas ``fused_glm_stream`` /
+    ``fused_glm_multi_stream`` kernel: ONE VMEM pass per block for
     loss+grad(+Hessian) where the XLA body reads X two to three times,
     with ``mxu`` running the matmuls at bf16/f32-acc
-    (config.dtype="auto" on TPU). With ``fused=False`` and ``mxu``
-    unset this function is byte-for-byte the pre-feature program."""
+    (config.dtype="auto" on TPU). ``fused`` composes with ``mesh``
+    (ISSUE 12): the fused body then runs inside the shard_map program
+    on each device's own slab. With ``fused=False`` and ``mxu`` unset
+    this function is byte-for-byte the pre-feature program."""
     if mesh is not None:
         return _sb_reducer_sharded(kind, family, intercept, n_classes,
-                                   mesh)
-    if fused and not n_classes:
-        from ...ops.pallas_fused import fused_glm_stream
+                                   mesh, mxu=mxu, fused=fused,
+                                   interpret=interpret)
+    if fused:
+        from ...ops.pallas_fused import (fused_glm_multi_stream,
+                                         fused_glm_stream)
+
+        if n_classes:
+            def block_sums(beta, Xb, yb, c):
+                return fused_glm_multi_stream(
+                    kind, Xb, c, yb, beta, family, intercept,
+                    mxu=mxu, interpret=interpret,
+                )
+        else:
+            def block_sums(beta, Xb, yb, c):
+                return fused_glm_stream(
+                    kind, Xb, c, yb, beta, family, intercept,
+                    mxu=mxu, interpret=interpret,
+                )
 
         @partial(jax.jit, donate_argnums=(0,))
         def run_fused(acc, beta, Xs, ys, counts):
             unrolled = isinstance(Xs, (tuple, list))
 
             def step(acc, Xb, yb, c):
-                out = fused_glm_stream(
-                    kind, Xb, c, yb, beta, family, intercept,
-                    mxu=mxu, interpret=interpret,
-                )
+                out = block_sums(beta, Xb, yb, c)
                 return tuple(a + o for a, o in zip(acc, out))
 
             if unrolled:
@@ -382,7 +431,8 @@ def _sb_reducer(kind, family, intercept, n_classes, mxu=None,
             acc, _ = jax.lax.scan(scan_step, acc, (Xs, ys, counts))
             return acc
 
-        return track_program(f"pallas.glm_{kind}")(run_fused)
+        suffix = "_multi" if n_classes else ""
+        return track_program(f"pallas.glm_{kind}{suffix}")(run_fused)
     fn, extra = _reducer_blocks(kind, n_classes)
 
     @partial(jax.jit, donate_argnums=(0,))
@@ -412,12 +462,24 @@ def _sb_reducer(kind, family, intercept, n_classes, mxu=None,
 
 
 @_ft.lru_cache(maxsize=32)
-def _sb_admm_local(local_iter, family, intercept, n_classes):
+def _sb_admm_local(local_iter, family, intercept, n_classes,
+                   gspmd=False):
     """Super-block ADMM block-local Newton: the K consensus members of
     one super-block solve their independent local problems in ONE
     vmapped dispatch (their (b, u) state slices ride in stacked; the
     stacked B carry is donated). All-padding slots pass their b through
-    unchanged."""
+    unchanged.
+
+    ``gspmd=True`` (ROADMAP 1(c) measurement): the super-block arrays
+    arrived BATCH-SHARDED over the stream mesh and this plain jit rides
+    implicit GSPMD — XLA partitions each block's XᵀWX / Xᵀresid over
+    the row shards and inserts cross-device all-reduces of the (d, d)
+    Hessian and gradient per local-Newton iteration. Numerically
+    identical; tracked under its own ``...admm_local.gspmd`` program
+    name (so the report CLI ranks it separately, and with obs_programs
+    on XLA's own bytes-accessed lands beside it) while the caller
+    records the per-dispatch reduce-volume estimate on the
+    ``gspmd_reduce_bytes`` counter."""
 
     @partial(jax.jit, donate_argnums=(0,))
     def run(Bk, Uk, Xs, ys, counts, z, rho, n_rows):
@@ -447,7 +509,8 @@ def _sb_admm_local(local_iter, family, intercept, n_classes):
         return jax.vmap(one)(Bk, Uk, Xs, ys, counts)
 
     suffix = "_multi" if n_classes else ""
-    return track_program(f"superblock.glm.admm_local{suffix}")(run)
+    tail = ".gspmd" if gspmd else ""
+    return track_program(f"superblock.glm.admm_local{suffix}{tail}")(run)
 
 
 # ---------------------------------------------------------------------------
@@ -495,35 +558,52 @@ class StreamedObjective:
         )
 
     def _sb_flavor(self, kind):
-        """(mxu, fused) for this stream's ``kind`` reducer: the Pallas
-        fused flavor (ISSUE 8) on real TPU when opted in and the block
-        shape fits its 128-row grid/VMEM budget — with the resolved
-        bf16 matmul policy riding along — else the XLA flavor,
-        untouched and f32 (the streamed XLA reducers accumulate in f32
-        carries by construction; bf16 streamed GLM compute is a
-        fused-kernel-only feature, so off-TPU fits fall back to f32
-        whatever config.dtype says)."""
-        if self.n_classes:
-            return None, False
+        """(mxu, fused, interpret, reason) for this stream's ``kind``
+        reducer: the Pallas fused flavor (ISSUE 8, composed with the
+        data mesh by ISSUE 12) when opted in and the PER-SHARD slab
+        shape (S/D rows — the rows each kernel instance actually sees
+        inside shard_map; the whole block on a 1-shard mesh) fits the
+        128-row grid/VMEM budget — with the resolved bf16 matmul policy
+        riding along — else the XLA flavor, untouched and f32 (the
+        streamed XLA reducers accumulate in f32 carries by
+        construction; bf16 streamed GLM compute is a fused-kernel-only
+        feature, so off-TPU fits fall back to f32 whatever config.dtype
+        says). ``reason`` names why fused was gated off (None when it
+        engaged) — recorded as solver_info_["fused_stream_reason"] so
+        smoke suites can assert the kernels actually ran instead of
+        silently falling back."""
         from ...config import mxu_dtype
-        from ...ops.pallas_fused import (glm_stream_tile,
-                                         use_stream_kernels)
+        from ...ops.pallas_fused import (glm_multi_stream_tile,
+                                         glm_stream_tile,
+                                         stream_kernel_mode,
+                                         stream_mode_reason,
+                                         stream_tile_reason)
 
+        if self.n_classes and kind == "vgh":
+            # the per-class (C, d, d) Hessian stack stays XLA: a Pallas
+            # body would hold C Hessian accumulators in VMEM at once,
+            # and multiclass newton is not a streamed hot path
+            return None, False, False, "multiclass-hessian-xla"
+        reason = stream_mode_reason()
+        if reason is not None:
+            return None, False, False, reason
+        _, interp = stream_kernel_mode()
         s = self.stream
-        if getattr(s, "sb_sharded", lambda: False)():
-            # the data-parallel flavor runs the XLA per-block bodies
-            # under shard_map; the fused Pallas body is a single-device
-            # feature for now (its tile gate reasons about the whole
-            # block, not a shard's slab)
-            return None, False
         try:
             S = int(s.block_rows)
             d = int(np.prod(s.arrays[0].shape[1:], dtype=np.int64))
         except Exception:
-            return None, False
-        if not (use_stream_kernels()
-                and glm_stream_tile(S, d, kind) is not None):
-            return None, False
+            return None, False, False, "no-stream-shape"
+        # the fused body runs on each device's OWN slab: the tile gate
+        # must reason about S/D rows, not the global block height
+        D = max(int(getattr(s, "sb_data_shards", lambda: 1)()), 1)
+        S_local = S // D
+        tile = (glm_multi_stream_tile(S_local, d, self.n_classes)
+                if self.n_classes
+                else glm_stream_tile(S_local, d, kind))
+        reason = stream_tile_reason(S_local, tile)
+        if reason is not None:
+            return None, False, False, reason
         if kind in ("vgh", "val"):
             # Hessian passes stay f32 even when fused — the SAME policy
             # the resident path enforces (glm.py restricts bf16 to the
@@ -534,8 +614,8 @@ class StreamedObjective:
             # search, and comparing a bf16 objective against the f32
             # vgh value would spuriously reject steps near convergence
             # (the rounding gap exceeds the true decrease there)
-            return None, True
-        return mxu_dtype(self.fit_dtype), True
+            return None, True, interp, None
+        return mxu_dtype(self.fit_dtype), True, interp, None
 
     def _merge(self, *accs):
         """Local pass sums → global sums (merged f64 on host, identical
@@ -561,21 +641,25 @@ class StreamedObjective:
         from ...observability import record_superblock_donation
 
         sharded = bool(getattr(s, "sb_sharded", lambda: False)())
+        mxu, fused, interp, _ = self._sb_flavor(kind)
         if sharded:
             # data-parallel superblock flavor (ISSUE 9): shard_map over
-            # the stream mesh, one psum per super-block. The carry
-            # enters COMMITTED-replicated so every dispatch (including
-            # the first) hits the same compiled executable and the
-            # donated buffers alias in place
+            # the stream mesh, one psum per super-block — with the
+            # fused Pallas body inside it when the flavor gate passes
+            # (ISSUE 12). The carry enters COMMITTED-replicated so
+            # every dispatch (including the first) hits the same
+            # compiled executable and the donated buffers alias in
+            # place
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             run = _sb_reducer(kind, self.family, self.intercept,
-                              self.n_classes or 0, mesh=s.mesh)
+                              self.n_classes or 0, mxu=mxu, fused=fused,
+                              interpret=interp, mesh=s.mesh)
             init = jax.device_put(init, NamedSharding(s.mesh, P()))
         else:
-            mxu, fused = self._sb_flavor(kind)
             run = _sb_reducer(kind, self.family, self.intercept,
-                              self.n_classes or 0, mxu=mxu, fused=fused)
+                              self.n_classes or 0, mxu=mxu, fused=fused,
+                              interpret=interp)
         acc = init
         acc_bytes = sum(4 * int(np.prod(a.shape) or 1) for a in acc)
         for sb in s.superblocks():
@@ -1054,10 +1138,13 @@ def admm(obj: StreamedObjective, beta0, max_iter=250, tol=1e-4, rho=1.0,
             # one dispatch advances the K consensus members of each
             # super-block (GLM local-Newton, vmapped over the stack;
             # stacked-B carry donated)
-            from ...observability import record_superblock_donation
+            from ...observability import (record_gspmd_reduce,
+                                          record_superblock_donation)
 
+            sb_sharded = bool(getattr(s, "sb_sharded", lambda: False)())
             runner = _sb_admm_local(int(local_iter), obj.family,
-                                    obj.intercept, C or 0)
+                                    obj.intercept, C or 0,
+                                    gspmd=sb_sharded)
             for sb in s.superblocks():
                 k = int(sb.counts.shape[0])
                 kr = sb.n_blocks
@@ -1081,6 +1168,21 @@ def admm(obj: StreamedObjective, beta0, max_iter=250, tol=1e-4, rho=1.0,
                     )
                     B[bi:bi + kr] = np.asarray(out)[:kr]
                 record_superblock_donation(Bk.nbytes)
+                if sb_sharded:
+                    # implicit-GSPMD reduce volume of this dispatch
+                    # (ROADMAP 1(c)): per block slot, class, and
+                    # local-Newton iteration, the partitioned XᵀWX +
+                    # Xᵀresid pay one cross-device all-reduce of the
+                    # (p, p) Hessian and the (p,) gradient; logical
+                    # payload = iters * K * C * (p² + p) * 4 bytes,
+                    # counted once per crossing (ring traffic
+                    # multiplies by ~2(D-1)/D on real links — the
+                    # counter records the payload, the topology factor
+                    # belongs to the interconnect)
+                    p = d // (C or 1)
+                    record_gspmd_reduce(
+                        int(local_iter) * k * (C or 1) * (p * p + p) * 4
+                    )
                 bi += kr
         else:
             for blk in obj.stream:
@@ -1171,40 +1273,51 @@ def solve_streamed(solver, stream, n_rows, beta0, family, reg, lam, pmask,
     )
     info["streamed"] = True
     info["n_blocks"] = stream.n_blocks
-    # data-parallel width of the superblock hot loop (1 = single-device
-    # programs; >1 = shard_map/psum flavor over the stream mesh)
-    info["stream_shards"] = int(
-        getattr(stream, "sb_data_shards", lambda: 1)()
-    ) if (hasattr(stream, "use_superblocks")
-          and stream.use_superblocks()) else 1
-    # the resolved precision policy + whether the fused Pallas reducers
-    # carried the pass (streamed XLA flavors are f32-only — an auto
-    # policy that fell back must be on record). The flavor gate is
-    # checked for the reducer KIND this solver's passes actually run:
-    # newton's vgh tile budget (it also holds the (d, d) Hessian
-    # accumulator) can refuse a width the vg kernel accepts, and admm
-    # never uses the reducers at all
-    use_sb = hasattr(stream, "use_superblocks") and stream.use_superblocks()
-    info_kind = {"newton": "vgh", "admm": None}.get(solver, "vg")
-    if use_sb and info_kind is not None:
-        mxu, fused = obj._sb_flavor(info_kind)
-    else:
-        mxu, fused = None, False
-    info["fused_stream"] = bool(fused)
-    from ...config import fit_dtype_info
-
-    if fused and mxu is not None:
-        info.update(fit_dtype_info(fit_dtype))
-    elif fused:
-        # fused but f32 (the vgh/Hessian reducer rejects bf16)
-        info.update({"fit_dtype": "float32",
-                     "fit_dtype_source": "hessian-f32"})
-    else:
-        info.update({"fit_dtype": "float32",
-                     "fit_dtype_source": "streamed-xla"})
+    info.update(_fused_stream_info(obj, stream, solver, fit_dtype))
     from .solvers import check_finite_result
 
     return check_finite_result(beta, info, solver)
+
+
+def _fused_stream_info(obj, stream, solver, fit_dtype):
+    """The fit-info fields describing the streamed pass flavor: the
+    data-parallel width, whether the fused Pallas reducers carried the
+    pass, WHY they did not (``fused_stream_reason`` — None when fused
+    engaged, else e.g. "off-TPU" / "non-128-mult shard rows" /
+    "per-block-path", so tpu_smoke can assert fused actually ran
+    instead of silently falling back), and the resolved precision
+    policy (streamed XLA flavors are f32-only — an auto policy that
+    fell back must be on record). The flavor gate is checked for the
+    reducer KIND this solver's passes actually run: newton's vgh tile
+    budget (it also holds the (d, d) Hessian accumulator) can refuse a
+    width the vg kernel accepts, and admm never uses the reducers at
+    all."""
+    out = {}
+    use_sb = hasattr(stream, "use_superblocks") and stream.use_superblocks()
+    out["stream_shards"] = int(
+        getattr(stream, "sb_data_shards", lambda: 1)()
+    ) if use_sb else 1
+    info_kind = {"newton": "vgh", "admm": None}.get(solver, "vg")
+    if info_kind is None:
+        mxu, fused, reason = None, False, "admm-local-newton"
+    elif not use_sb:
+        mxu, fused, reason = None, False, "per-block-path"
+    else:
+        mxu, fused, _, reason = obj._sb_flavor(info_kind)
+    out["fused_stream"] = bool(fused)
+    out["fused_stream_reason"] = reason
+    from ...config import fit_dtype_info
+
+    if fused and mxu is not None:
+        out.update(fit_dtype_info(fit_dtype))
+    elif fused:
+        # fused but f32 (the vgh/Hessian reducer rejects bf16)
+        out.update({"fit_dtype": "float32",
+                    "fit_dtype_source": "hessian-f32"})
+    else:
+        out.update({"fit_dtype": "float32",
+                    "fit_dtype_source": "streamed-xla"})
+    return out
 
 
 def solve_streamed_multi(solver, stream, n_rows, B0, family, reg, lam,
@@ -1233,15 +1346,7 @@ def solve_streamed_multi(solver, stream, n_rows, B0, family, reg, lam,
     info["streamed"] = True
     info["n_blocks"] = stream.n_blocks
     info["n_classes"] = C
-    info["stream_shards"] = int(
-        getattr(stream, "sb_data_shards", lambda: 1)()
-    ) if (hasattr(stream, "use_superblocks")
-          and stream.use_superblocks()) else 1
-    # multiclass streamed reducers are XLA/f32-only today (the fused
-    # kernels cover the flat-weight objectives)
-    info["fused_stream"] = False
-    info["fit_dtype"] = "float32"
-    info["fit_dtype_source"] = "streamed-xla"
+    info.update(_fused_stream_info(obj, stream, solver, fit_dtype))
     from .solvers import check_finite_result
 
     beta, info = check_finite_result(np.asarray(beta), info, solver)

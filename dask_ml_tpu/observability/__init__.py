@@ -64,6 +64,7 @@ from ._counters import (
     log_counters,
     record_donation,
     record_fault_injected,
+    record_gspmd_reduce,
     record_registry_publish,
     record_replica_failure,
     record_replica_restart,
@@ -170,6 +171,7 @@ __all__ = [
     "programs_snapshot",
     "record_donation",
     "record_fault_injected",
+    "record_gspmd_reduce",
     "record_registry_publish",
     "record_replica_failure",
     "record_replica_restart",

@@ -98,6 +98,20 @@ def record_shard_staging(n_shards: int) -> None:
         counter_add("shard_slab_puts", int(n_shards))
 
 
+def record_gspmd_reduce(nbytes: int) -> None:
+    """Estimated cross-device reduce payload one implicit-GSPMD
+    dispatch moved (today: the sharded streamed-ADMM block-local
+    Newton, whose per-iteration Hessian/gradient partial sums XLA
+    all-reduces over the row shards — ROADMAP 1(c)'s previously
+    unmeasured traffic). An ANALYTIC payload estimate, not a NIC
+    counter: it sizes what must cross the mesh at least once; with
+    obs_programs on, the matching ``...admm_local.gspmd`` program row
+    carries XLA's own measured bytes beside it."""
+    if counters_enabled():
+        counter_add("gspmd_reduce_bytes", int(nbytes))
+        counter_add("gspmd_reduce_dispatches", 1)
+
+
 def record_superblock_donation(nbytes: int) -> None:
     """A super-block scan's donated carry was handed back to XLA for
     in-place reuse (the accumulator/weights buffer never reallocates

@@ -519,18 +519,70 @@ def kmeans_stream_tile(S, d, k, itemsize=4):
     )
 
 
+def glm_multi_stream_tile(S, d, n_classes, itemsize=4):
+    """Tile for the streamed multi-target GLM reducers: the x block,
+    the three (tile, C) intermediates (eta / targets / residual), and
+    the two (C, d) weight/gradient operands."""
+    return stream_tile(S, lambda t: (
+        t * d * itemsize + t * n_classes * 4 * 3 + 2 * n_classes * d * 4
+    ))
+
+
+def sgd_many_stream_tile(S, d, n_models, itemsize=4):
+    """Tile for the multi-weight streamed SGD kernel (multiclass OvR
+    rows or a batched-trial cohort): same footprint shape as the
+    multi-target GLM reducer."""
+    return glm_multi_stream_tile(S, d, n_models, itemsize)
+
+
+def stream_kernel_mode(backend=None):
+    """(use, interpret) for the fused streamed kernel family: opted in
+    (config.pallas_stream, default on) AND a real TPU backend —
+    compiled Mosaic kernels, interpret False. Off-TPU the fused bodies
+    only run when ``config.pallas_stream_interpret`` additionally opts
+    into the Pallas interpreter (CI parity / dry-run benches);
+    otherwise the XLA flavors run unchanged — with the knobs off their
+    jaxprs are byte-identical to the pre-feature programs."""
+    from ..config import get_config
+
+    cfg = get_config()
+    if not cfg.pallas_stream:
+        return False, False
+    if backend is None:
+        backend = jax.default_backend()
+    if backend == "tpu":
+        return True, False
+    return (True, True) if cfg.pallas_stream_interpret else (False, False)
+
+
 def use_stream_kernels(backend=None):
-    """The auto-gate for the fused streamed kernel family: opted in
-    (config.pallas_stream, default on) AND a real TPU backend. Off-TPU
-    the XLA flavors run unchanged — with the knob off their jaxprs are
-    byte-identical to the pre-feature programs."""
+    """The auto-gate for the fused streamed kernel family — see
+    :func:`stream_kernel_mode` (this keeps the historical bool shape
+    for callers that don't care about interpret mode)."""
+    return stream_kernel_mode(backend)[0]
+
+
+# the fused-flavor audit vocabulary lives HERE and only here — the GLM
+# and SGD flavor selectors both record these strings in
+# solver_info_["fused_stream_reason"], and tpu_smoke/README compare
+# them literally, so a renamed reason must change in exactly one place
+
+def stream_mode_reason():
+    """Why the fused streamed kernels are off for this process (knob or
+    backend), or None when :func:`stream_kernel_mode` says go."""
     from ..config import get_config
 
     if not get_config().pallas_stream:
-        return False
-    if backend is None:
-        backend = jax.default_backend()
-    return backend == "tpu"
+        return "pallas-stream-off"
+    return None if stream_kernel_mode()[0] else "off-TPU"
+
+
+def stream_tile_reason(S_local, tile):
+    """Why a tile gate refused the per-shard slab of ``S_local`` rows
+    (None when ``tile`` was accepted)."""
+    if tile is not None:
+        return None
+    return "non-128-mult shard rows" if S_local % 128 else "vmem-budget"
 
 
 def _mxu_cast(a, mxu):
@@ -753,6 +805,188 @@ def fused_glm_stream(kind, x, n_valid, y, beta, family, intercept,
             [col[None, :], wsum],
         ])
     return loss, grad, hess
+
+
+def _glm_multi_stream_kernel(x_ref, yc_ref, nv_ref, b_ref, b0_ref, *outs,
+                             tile, family, kind, mxu):
+    """Streamed multi-target GLM reducer body: ONE X pass serves all C
+    one-vs-rest problems of a streamed block. Class codes ride in as a
+    (tile, 1) operand and per-class 0/1 targets derive in-kernel from an
+    iota compare (the streamed twin of ``_glm_multi_value_grad_kernel``,
+    plus the streamed contracts: prefix-count validity, intercept as the
+    (1, C) ``b0`` operand with its gradient a separate output, no
+    padding)."""
+    i = pl.program_id(0)
+    x = x_ref[:]                        # (tile, d)
+    yc = yc_ref[:]                      # (tile, 1) f32 class codes
+    B = b_ref[:]                        # (C, d) f32
+    b0 = b0_ref[:]                      # (1, C)
+    C = B.shape[0]
+    m = _tile_mask(x, nv_ref, i, tile)
+    xd = _mxu_cast(x, mxu)
+    eta = jax.lax.dot_general(
+        xd, B.astype(xd.dtype), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) + b0                              # (tile, C)
+    iota = jax.lax.broadcasted_iota(
+        jnp.int32, (x.shape[0], C), 1
+    ).astype(jnp.float32)
+    yv = (iota == yc).astype(jnp.float32)
+    from ..models.solvers.families import get_family
+
+    fam = get_family(family)
+    per = fam.pointwise(eta, yv) * m
+
+    @pl.when(i == 0)
+    def _init():
+        for o in outs:
+            o[:] = jnp.zeros_like(o)
+
+    outs[0][:] += jnp.sum(per, axis=0, keepdims=True).sum(
+        axis=1, keepdims=True
+    )
+    if kind == "val":
+        return
+    resid = (fam.mean(eta) - yv) * m
+    grad_ref, gb_ref = outs[1], outs[2]
+    grad_ref[:] += jax.lax.dot_general(
+        resid.astype(xd.dtype), xd, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                   # (C, d)
+    gb_ref[:] += jnp.sum(resid, axis=0, keepdims=True)   # (1, C)
+
+
+def fused_glm_multi_stream(kind, x, n_valid, y_codes, B, family,
+                           intercept, mxu=None, interpret=False):
+    """One streamed block's multi-target ``kind`` sums in ONE X pass —
+    the fused flavor of ``_block_val_multi`` / ``_block_val_grad_multi``
+    (kinds "val" and "vg"; the per-class Hessian stack stays XLA). ``B``
+    is (C, d+1) when ``intercept`` (last column the intercepts); raw
+    sums over valid rows, shapes must satisfy
+    ``glm_multi_stream_tile``."""
+    S = x.shape[0]
+    d_full = x.shape[1]
+    B = B.astype(jnp.float32)
+    C = B.shape[0]
+    tile = glm_multi_stream_tile(S, d_full, C, x.dtype.itemsize)
+    nv = jnp.asarray(n_valid, jnp.int32).reshape(1, 1)
+    if intercept:
+        Bm, b0 = B[:, :-1], B[:, -1][None, :]
+    else:
+        Bm, b0 = B, jnp.zeros((1, C), jnp.float32)
+    d = Bm.shape[1]
+    out_specs = [pl.BlockSpec((1, 1), lambda i: (0, 0))]
+    out_shape = [jax.ShapeDtypeStruct((1, 1), jnp.float32)]
+    if kind != "val":
+        out_specs += [pl.BlockSpec((C, d), lambda i: (0, 0)),
+                      pl.BlockSpec((1, C), lambda i: (0, 0))]
+        out_shape += [jax.ShapeDtypeStruct((C, d), jnp.float32),
+                      jax.ShapeDtypeStruct((1, C), jnp.float32)]
+    outs = pl.pallas_call(
+        functools.partial(_glm_multi_stream_kernel, tile=tile,
+                          family=family, kind=kind, mxu=mxu),
+        grid=(S // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, d), lambda i: (i, 0)),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+            pl.BlockSpec((C, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, C), lambda i: (0, 0)),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(x, y_codes[:, None], nv, Bm, b0)
+    loss = outs[0][0, 0]
+    if kind == "val":
+        return (loss,)
+    grad = outs[1]
+    if intercept:
+        grad = jnp.concatenate([grad, outs[2].T], axis=1)
+    return loss, grad
+
+
+def _sgd_many_grad_kernel(x_ref, y_ref, nv_ref, w_ref, b0_ref, loss_ref,
+                          gw_ref, gb_ref, *, tile, loss, mxu, codes):
+    """Multi-weight twin of ``_sgd_grad_kernel``: ONE X pass serves N
+    weight rows — the C one-vs-rest rows of a multiclass model
+    (``codes=True``: y holds class indices, per-class 0/1 targets derive
+    in-kernel) or the N models of a batched-trial cohort (``codes=False``:
+    the (tile, 1) target broadcasts across the weight columns). eta is
+    one (tile, N) MXU matmul against the stacked coef rows; the (N, d)
+    gradient accumulates with a second MXU contraction."""
+    i = pl.program_id(0)
+    x = x_ref[:]                        # (tile, d)
+    yv = y_ref[:]                       # (tile, 1) targets or codes
+    W = w_ref[:]                        # (N, d) coef rows
+    b0 = b0_ref[:]                      # (1, N) intercept*iflag per row
+    N = W.shape[0]
+    m = _tile_mask(x, nv_ref, i, tile)
+    xd = _mxu_cast(x, mxu)
+    eta = jax.lax.dot_general(
+        xd, W.astype(xd.dtype), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) + b0                              # (tile, N)
+    if codes:
+        iota = jax.lax.broadcasted_iota(
+            jnp.int32, (x.shape[0], N), 1
+        ).astype(jnp.float32)
+        yv = (iota == yv).astype(jnp.float32)
+    per, resid = sgd_objective_terms(eta, yv, loss)
+    rm = resid * m
+
+    @pl.when(i == 0)
+    def _init():
+        loss_ref[:] = jnp.zeros_like(loss_ref)
+        gw_ref[:] = jnp.zeros_like(gw_ref)
+        gb_ref[:] = jnp.zeros_like(gb_ref)
+
+    loss_ref[:] += jnp.sum(per * m, axis=0, keepdims=True)   # (1, N)
+    gw_ref[:] += jax.lax.dot_general(
+        rm.astype(xd.dtype), xd, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                   # (N, d)
+    gb_ref[:] += jnp.sum(rm, axis=0, keepdims=True)          # (1, N)
+
+
+def fused_sgd_many_block_grad(x, n_valid, y, W_ext, iflags, loss,
+                              codes, mxu=None, interpret=False):
+    """(Σ pointwise-loss per row (N,), Σ ∂/∂W (N, d+1)) of one streamed
+    block in ONE X pass for N stacked weight vectors — the fused flavor
+    of the multiclass streamed SGD step (``codes=True``; ``iflags`` a
+    scalar) and of the cohort scan's vmapped step (``codes=False``;
+    ``iflags`` (N,) per-model). Raw sums — the caller divides by
+    n_valid and applies each row's lr/l2/prox epilogue."""
+    S, d = x.shape
+    N = W_ext.shape[0]
+    tile = sgd_many_stream_tile(S, d, N, x.dtype.itemsize)
+    nv = jnp.asarray(n_valid, jnp.int32).reshape(1, 1)
+    b0 = (W_ext[:, -1] * iflags).astype(jnp.float32)[None, :]
+    loss_sums, gw, gb = pl.pallas_call(
+        functools.partial(_sgd_many_grad_kernel, tile=tile, loss=loss,
+                          mxu=mxu, codes=codes),
+        grid=(S // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, d), lambda i: (i, 0)),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+            pl.BlockSpec((N, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, N), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, N), lambda i: (0, 0)),
+            pl.BlockSpec((N, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, N), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, N), jnp.float32),
+            jax.ShapeDtypeStruct((N, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, N), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, y[:, None], nv, W_ext[:, :-1], b0)
+    grads = jnp.concatenate([gw, gb.T], axis=1)   # (N, d+1)
+    return loss_sums[0], grads
 
 
 def _kmeans_stream_kernel(x_ref, nv_ref, c_ref, c2_ref, sums_ref,

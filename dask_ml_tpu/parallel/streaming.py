@@ -490,10 +490,15 @@ class BlockStream:
         # contract. Multi-device meshes keep the sharded put (an
         # aliased import is single-device), other backends have real
         # device memory to copy into.
+        # ... and the one device must BE the process default device: a
+        # dlpack import always lands on jax.devices()[0], so a stream
+        # pinned to any other device (a virtual rank's submesh) would
+        # stage its aliases onto the wrong chip
         self._zero_copy = bool(
             get_config().stream_zero_copy
             and jax.default_backend() == "cpu"
             and self.mesh.devices.size == 1
+            and self.mesh.devices.flat[0] == jax.devices()[0]
         )
 
         # per-feature training profile (observability/sketch.py): the

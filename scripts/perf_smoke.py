@@ -161,11 +161,61 @@ def main():
                 "did not stage per-shard"
             )
 
+    # -- fused x sharded section (ISSUE 12): the Pallas bodies inside
+    # the shard_map scan programs (interpret mode on this CPU box) must
+    # keep EXACTLY the unfused sharded flavor's dispatch shape and the
+    # zero-compiles-after-pass-1 contract — the fusion swaps the
+    # per-block BODY, never the scan/psum structure.
+    fu_dpp = fu_recompiles = None
+    if len(jax.devices()) >= 8:
+        nf, df = 16_384, 16
+        Xf = rng.randn(nf, df).astype(np.float32)
+        yf = (Xf[:, 0] > 0).astype(np.float32)
+        # 2048-row blocks -> 256-row per-shard slabs (128-multiple):
+        # the fused flavor's tile gate passes at D=8
+        def fused_run(interpret):
+            with config.set(stream_block_rows=2048,
+                            stream_autotune=False, stream_mesh=0,
+                            pallas_stream_interpret=interpret):
+                SGDClassifier(max_iter=1, random_state=0,
+                              shuffle=False).fit(Xf, yf)  # warmup
+                obs.counters_reset()
+                clf = SGDClassifier(max_iter=2, random_state=0,
+                                    shuffle=False)
+                clf.fit(Xf, yf)
+                return (dict(getattr(clf, "_last_stream_stats", None)
+                             or {}),
+                        obs.counters_snapshot(),
+                        dict(getattr(clf, "solver_info_", None) or {}))
+        fu_st, fu_snap, fu_info = fused_run(True)
+        base_st, _, _ = fused_run(False)
+        fu_dpp = fu_st.get("dispatches_per_pass")
+        fu_recompiles = fu_snap.get("recompiles", 0)
+        if not fu_info.get("fused_stream"):
+            failures.append(
+                "fused x sharded section did not engage the Pallas "
+                f"bodies (reason={fu_info.get('fused_stream_reason')})"
+            )
+        if fu_dpp != base_st.get("dispatches_per_pass"):
+            failures.append(
+                f"fused x sharded changed dispatches_per_pass: "
+                f"{fu_dpp} (fused) vs "
+                f"{base_st.get('dispatches_per_pass')} (unfused)"
+            )
+        if fu_recompiles > 0:
+            failures.append(
+                f"{fu_recompiles} new XLA compiles after pass 1 on the "
+                "FUSED sharded path — fusing the bodies must not break "
+                "the warm-cache contract"
+            )
+
     print(f"perf smoke: n_blocks={n_blocks} K={k} "
           f"dispatches_per_pass={dpp} (budget {budget}) "
           f"recompiles_after_pass1={recompiles} | sharded: "
           f"shards={sh_shards} dispatches_per_pass={sh_dpp} "
-          f"recompiles_after_pass1={sh_recompiles}")
+          f"recompiles_after_pass1={sh_recompiles} | fused-sharded: "
+          f"dispatches_per_pass={fu_dpp} "
+          f"recompiles_after_pass1={fu_recompiles}")
     if failures:
         for f in failures:
             print(f"PERF SMOKE FAIL: {f}", file=sys.stderr)

@@ -172,13 +172,17 @@ def test_xla_flavor_selected_and_unchanged_on_cpu():
     assert "bf16" not in jaxpr and "pallas" not in jaxpr
 
     # the estimator-level selector picks the XLA program on this backend
+    # and says why the fused flavor was gated off
     class _FakeSB:
         arrays = (jnp.zeros((2, 256, 8)), jnp.zeros((2, 256)))
         counts = jnp.zeros(2, jnp.int32)
+        shard_counts = None
 
     clf = SGDClassifier()
-    run, mxu = clf._sb_scan_flavor(_FakeSB())
-    assert run is None and mxu is None
+    fused, mxu, interp, reason = clf._sb_scan_flavor(_FakeSB())
+    assert not fused and mxu is None and reason == "off-TPU"
+    with config.set(pallas_stream=False):
+        assert clf._sb_scan_flavor(_FakeSB())[3] == "pallas-stream-off"
 
 
 # ---------------------------------------------------------------------------
