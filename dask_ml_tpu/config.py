@@ -92,6 +92,39 @@ class Config:
     # Off (the default) keeps the off-TPU XLA flavors byte-identical;
     # real-TPU behavior is unaffected either way
     pallas_stream_interpret: bool = False
+    # -- device-resident sparse streaming (parallel/sparse_stream.py) -----
+    # stream sparse (CSR / SparseBlocks) sources as DEVICE-RESIDENT
+    # bucketed-nnz blocks: values/column-indices/row-ids padded to a
+    # geometric nnz-bucket ladder and consumed by sparse superblock
+    # scan programs (take/segment_sum — nnz-proportional cost) instead
+    # of densifying every block on host to n x d. Off (the default this
+    # round) keeps today's per-block densify path byte-identical; on, a
+    # sparse source whose density stays under
+    # ``stream_sparse_max_density`` runs GLM val/vg/vgh, streamed SGD
+    # (incl. multiclass and grad-accum) and KMeans assign-stats through
+    # the ``superblock.sparse.*`` programs with the same one-dispatch-
+    # per-super-block / zero-compiles-after-pass-1 / donation contracts
+    # as the dense scan. Dense inputs are untouched either way
+    stream_sparse: bool = False
+    # automatic densify fallback threshold for the sparse streamed
+    # path: a source whose overall nnz density exceeds this fraction
+    # stages dense (the bucketed-nnz format stops paying for itself
+    # around here — padded nnz triples approach the dense block's
+    # bytes while paying gather/scatter instead of matmul)
+    stream_sparse_max_density: float = 0.25
+    # byte budget for one-shot dense materialization of a sparse corpus
+    # (feature_extraction.text.to_sharded_dense): a corpus whose dense
+    # form exceeds this refuses with the typed DenseBudgetExceeded
+    # pointing at the streamed sparse path instead of silently
+    # allocating tens of GB of host RAM
+    to_dense_byte_budget: int = 1 << 30
+    # expected nonzeros per row for the SPARSE serving entry points'
+    # nnz-bucket ladder (serving/wrappers sparse_batch_fn): the
+    # (rows, nnz) grid's nnz rungs run geometrically from
+    # serving_min_batch * this to serving_max_batch * this with
+    # serving_bucket_growth — a warmed grid then serves ragged hashed-
+    # text traffic at zero steady-state compiles
+    serving_sparse_nnz_per_row: int = 64
     # gradient-accumulation streamed SGD (models/sgd.py): 0 = off (the
     # sequential flavor; host-streamed SGD under a multi-process
     # runtime stays refused, because sequential per-block updates

@@ -28,7 +28,15 @@ from ..base import BaseEstimator, TransformerMixin
 from ..parallel.sharded import ShardedArray, as_sharded
 
 __all__ = ["HashingVectorizer", "FeatureHasher", "CountVectorizer",
-           "to_sharded_dense"]
+           "to_sharded_dense", "DenseBudgetExceeded"]
+
+
+class DenseBudgetExceeded(ValueError):
+    """A one-shot dense materialization of a sparse corpus would exceed
+    ``config.to_dense_byte_budget`` — use the streamed sparse path
+    (feed the CSR / ``transform_sparse`` output straight to a streamed
+    fit, or ``transform_blocks`` for custom block loops) instead of
+    densifying the whole corpus."""
 
 
 def _blocks(raw_documents, block_size=10_000):
@@ -40,8 +48,27 @@ def _blocks(raw_documents, block_size=10_000):
 
 
 def to_sharded_dense(csr, mesh=None, dtype=np.float32) -> ShardedArray:
-    """Densify a (host) CSR matrix onto the mesh — the bridge from text
-    hashing to TPU estimators. Use a modest n_features."""
+    """Densify a (host) CSR matrix onto the mesh — the SMALL-corpus
+    bridge from text hashing to TPU estimators. Refuses (typed
+    :class:`DenseBudgetExceeded`) when the dense form would exceed
+    ``config.to_dense_byte_budget``: every streamed fit consumes the
+    CSR directly at O(block) memory (and, with ``config.stream_sparse``
+    on, at nnz-proportional device cost), so a silent tens-of-GB host
+    allocation is never the right answer."""
+    from ..config import get_config
+
+    n, d = int(csr.shape[0]), int(csr.shape[1])
+    nbytes = n * d * np.dtype(dtype).itemsize
+    budget = int(get_config().to_dense_byte_budget)
+    if budget > 0 and nbytes > budget:
+        raise DenseBudgetExceeded(
+            f"densifying a {n} x {d} sparse corpus needs {nbytes >> 20} "
+            f"MiB > config.to_dense_byte_budget ({budget >> 20} MiB); "
+            "pass the sparse matrix straight to a streamed fit (it "
+            "densifies one block at a time — with config.stream_sparse "
+            "it streams device-resident at nnz cost), or raise the "
+            "budget explicitly"
+        )
     return as_sharded(np.asarray(csr.todense(), dtype=dtype), mesh=mesh)
 
 
@@ -81,6 +108,32 @@ class HashingVectorizer(TransformerMixin, BaseEstimator):
         inner = self._inner()
         parts = [inner.transform(b) for b in _blocks(raw_documents)]
         return sp.vstack(parts).tocsr()
+
+    def transform_blocks(self, raw_documents, block_size=10_000):
+        """Yield per-block CSR matrices directly — the streamed
+        emitter: no ``sp.vstack`` of the whole corpus, no giant host
+        CSR. Each yielded block is what sklearn's hashing kernel
+        produced for ``block_size`` documents; feed them to
+        :class:`~dask_ml_tpu.parallel.streaming.SparseBlocks` (or use
+        :meth:`transform_sparse`) to stream a fit at O(block) host
+        memory."""
+        inner = self._inner()
+        for b in _blocks(raw_documents, block_size):
+            yield inner.transform(b).tocsr()
+
+    def transform_sparse(self, raw_documents, block_size=10_000):
+        """The corpus as a
+        :class:`~dask_ml_tpu.parallel.streaming.SparseBlocks` view over
+        the hashed per-block CSRs — a row-concatenated source every
+        streamed fit consumes WITHOUT the ``sp.vstack`` copy
+        ``transform`` pays (and, with ``config.stream_sparse`` on,
+        without ever densifying a block: the stream stages bucketed-nnz
+        device slabs straight from these blocks)."""
+        from ..parallel.streaming import SparseBlocks
+
+        return SparseBlocks(
+            list(self.transform_blocks(raw_documents, block_size))
+        )
 
     def fit_transform(self, raw_documents, y=None):
         return self.transform(raw_documents)

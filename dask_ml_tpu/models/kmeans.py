@@ -309,6 +309,92 @@ def _sb_assign_stats_sharded(mesh, mxu_dtype=None, fused=False,
     return track_program(name)(run)
 
 
+def _sparse_block_assign_stats(db, cb, rb, c, centers, S):
+    """(Σ x per label, count per label, Σ min-dist²) of one bucketed-nnz
+    sparse block (ISSUE 13): distances via the expanded form with the
+    x·c matmul and ||x||² computed from the nnz alone
+    (ops/sparse_kernels), label-bucketed feature sums as one flat
+    segment_sum — nnz·k cost, no (S, d) densification."""
+    from ..ops.sparse_kernels import (sparse_center_dots,
+                                      sparse_label_sums, sparse_sq_norms)
+
+    k = centers.shape[0]
+    mask = (jnp.arange(S) < c).astype(jnp.float32)
+    xx = sparse_sq_norms(db, rb, S)
+    cc = jnp.sum(centers * centers, axis=1)[None, :]
+    d2 = jnp.maximum(
+        xx[:, None] + cc - 2.0 * sparse_center_dots(db, cb, rb, centers,
+                                                    S),
+        0.0,
+    )
+    labels = jnp.argmin(d2, axis=1)
+    sums = sparse_label_sums(db, cb, rb, labels, k, centers.shape[1])
+    counts = jax.ops.segment_sum(mask, labels, num_segments=k)
+    inertia = jnp.sum(jnp.min(d2, axis=1) * mask)
+    return sums, counts, inertia
+
+
+@_ft.lru_cache(maxsize=16)
+def _sb_assign_stats_sparse(S, mesh=None):
+    """Sparse flavor of :func:`_sb_assign_stats`: the K-step
+    assign+accumulate scan over bucketed-nnz COO stacks with the same
+    donated (sums, counts, inertia) carry — one dispatch per
+    super-block, zero compiles after pass 1. ``mesh`` selects the
+    shard_map flavor (each device scans its own nnz segments/local row
+    ids; ONE psum per super-block, the dense sharded flavor's exact
+    collective shape)."""
+    S = int(S)
+
+    if mesh is None:
+        @partial(jax.jit, donate_argnums=(0,))
+        def run(acc, data, cols, rows, counts, centers):
+            def scan_step(acc, inp):
+                db, cb, rb, c = inp
+                s, cnt, i = _sparse_block_assign_stats(db, cb, rb, c,
+                                                       centers, S)
+                return (acc[0] + s, acc[1] + cnt, acc[2] + i), \
+                    jnp.float32(0.0)
+
+            acc, _ = jax.lax.scan(scan_step, acc,
+                                  (data, cols, rows, counts))
+            return acc
+
+        return track_program("superblock.sparse.kmeans_assign")(run)
+
+    from jax.sharding import PartitionSpec as P
+
+    from .._compat import shard_map
+    from ..parallel.mesh import DATA_AXIS
+
+    def body(acc, data, cols, rows, counts, centers):
+        cts = counts[0]
+        local = jax.tree.map(jnp.zeros_like, acc)
+
+        def scan_step(lacc, inp):
+            db, cb, rb, c = inp
+            s, cnt, i = _sparse_block_assign_stats(db, cb, rb, c,
+                                                   centers, S)
+            return (lacc[0] + s, lacc[1] + cnt, lacc[2] + i), \
+                jnp.float32(0.0)
+
+        local, _ = jax.lax.scan(scan_step, local,
+                                (data, cols, rows, cts))
+        local = jax.lax.psum(local, DATA_AXIS)
+        return tuple(a + l for a, l in zip(acc, local))
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def run(acc, data, cols, rows, counts, centers):
+        f = shard_map(
+            body, mesh,
+            in_specs=(P(), P(None, DATA_AXIS), P(None, DATA_AXIS),
+                      P(None, DATA_AXIS), P(DATA_AXIS, None), P()),
+            out_specs=P(),
+        )
+        return f(acc, data, cols, rows, counts, centers)
+
+    return track_program("superblock.sparse.kmeans_assign.psum")(run)
+
+
 @track_program("pallas.kmeans_stream")
 @partial(jax.jit, static_argnames=("mxu_dtype", "interpret"),
         donate_argnums=(0,))
@@ -480,15 +566,26 @@ def _streamed_lloyd(stream, centers0, max_iter, tol2, logger=None,
     sharded = bool(
         use_sb and getattr(stream, "sb_sharded", lambda: False)()
     )
+    # bucketed-nnz sparse staging (ISSUE 13): assign-stats at nnz*k
+    # cost through the superblock.sparse.kmeans_assign programs; the
+    # fused Pallas flavor is a dense-slab feature and stays off
+    sb_sparse = bool(
+        use_sb and getattr(stream, "sb_sparse", lambda: False)()
+    )
     use_k, interp = stream_kernel_mode()
     slab_rows = int(stream.block_rows) // (
         int(stream.sb_data_shards()) if sharded else 1
     )
     fused = bool(
-        use_sb and use_k
+        use_sb and use_k and not sb_sparse
         and kmeans_stream_tile(slab_rows, int(d0), int(k0)) is not None
     )
     sb_run = _sb_assign_stats_pallas if fused else _sb_assign_stats
+    sparse_run = None
+    if sb_sparse:
+        sparse_run = _sb_assign_stats_sparse(
+            slab_rows, mesh=stream.mesh if sharded else None
+        )
     rep = None
     if sharded:
         # data-parallel flavor (ISSUE 9): one psum over "data" per
@@ -522,7 +619,16 @@ def _streamed_lloyd(stream, centers0, max_iter, tol2, logger=None,
                    jnp.zeros((k_clusters,), jnp.float32),
                    jnp.zeros((), jnp.float32))
             acc_bytes = 4 * (k_clusters * d + k_clusters + 1)
-            if sharded:
+            if sb_sparse:
+                if sharded:
+                    acc = jax.device_put(acc, rep)
+                for sb in stream.superblocks():
+                    slab = sb.arrays[0]
+                    cts = sb.shard_counts if sharded else sb.counts
+                    acc = sparse_run(acc, slab.data, slab.cols,
+                                     slab.rows, cts, centers)
+                    record_superblock_donation(acc_bytes)
+            elif sharded:
                 acc = jax.device_put(acc, rep)
                 for sb in stream.superblocks():
                     acc = sharded_run(acc, sb.arrays[0],

@@ -326,7 +326,193 @@ def _sgd_sb_scan_pallas(W, Xs, ys, counts, lrs, alpha, l2w, l1w, iflag,
     return jax.lax.scan(scan_step, W, (Xs, ys, counts, lrs))
 
 
+def _sgd_sparse_pointwise(eta, y, loss):
+    """The per-row loss switch on a precomputed eta — the sparse twin
+    of the expression inside ``_sgd_data_loss`` (kept textually
+    separate so the dense kernels' traced jaxprs stay byte-identical)."""
+    if loss == "log_loss":
+        return jax.nn.softplus(eta) - y * eta
+    if loss == "hinge":
+        margins = (2.0 * y - 1.0) * eta
+        return jnp.maximum(0.0, 1.0 - margins)
+    return 0.5 * (eta - y) ** 2  # squared_error
+
+
+def _sgd_update_one_sparse(w, y, data, cols, rows, S, mask, n_valid, lr,
+                           alpha, l2w, l1w, iflag, loss):
+    """``_sgd_update_one`` over one bucketed-nnz sparse block: the eta
+    matvec and its autodiff backward run at nnz cost (take →
+    scatter-add); objective normalization, l2 term and the l1 proximal
+    epilogue are the dense step's exactly."""
+    from ..ops.sparse_kernels import sparse_eta
+
+    def objective(w):
+        eta = sparse_eta(data, cols, rows, w[:-1], S) + w[-1] * iflag
+        data_loss = jnp.sum(_sgd_sparse_pointwise(eta, y, loss) * mask) \
+            / jnp.maximum(n_valid, 1.0)
+        return data_loss + 0.5 * alpha * l2w * jnp.sum(w[:-1] ** 2)
+
+    val, grad = jax.value_and_grad(objective)(w)
+    w = w - lr * grad
+    thr = lr * alpha * l1w
+    coef = jnp.sign(w[:-1]) * jnp.maximum(jnp.abs(w[:-1]) - thr, 0.0)
+    return w.at[:-1].set(coef), val
+
+
 import functools as _ft_sharded
+
+
+@_ft_sharded.lru_cache(maxsize=32)
+def _sgd_sb_scan_sparse(loss, n_out, S, mesh=None):
+    """Sparse flavor of :func:`_sgd_sb_scan` (ISSUE 13): K streamed
+    minibatch steps over bucketed-nnz COO stacks in ONE donated-carry
+    scan dispatch — same lr clock, same padding-slot pass-through, same
+    zero-compiles-after-pass-1 contract (the stream plan pads every
+    super-block of a fit to one nnz capacity). ``mesh`` selects the
+    shard_map data-parallel flavor: each shard's raw (loss, grad) sums
+    come from its own nnz segment/slab and psum ONCE per block step
+    before the identical lr/l2/prox epilogue — the dense sharded scan's
+    exact collective shape, tracked as
+    ``superblock.sparse.sgd_scan.psum``."""
+    from ..ops.sparse_kernels import sparse_eta
+
+    S = int(S)
+
+    if mesh is None:
+        @partial(jax.jit, donate_argnums=(0,))
+        def run(W, data, cols, rows, ys, counts, lrs, alpha, l2w, l1w,
+                iflag):
+            r = jnp.arange(S)
+
+            def step(W, db, cb, rb, yb, c, lr):
+                mask = (r < c).astype(jnp.float32)
+                nv = c.astype(jnp.float32)
+                if n_out is not None:
+                    def one(w, cc):
+                        yy = (yb == cc).astype(jnp.float32)
+                        return _sgd_update_one_sparse(
+                            w, yy, db, cb, rb, S, mask, nv, lr, alpha,
+                            l2w, l1w, iflag, loss,
+                        )
+
+                    W2, losses = jax.vmap(one)(
+                        W, jnp.arange(n_out, dtype=jnp.float32)
+                    )
+                    loss_v = losses.sum()
+                else:
+                    W2, loss_v = _sgd_update_one_sparse(
+                        W, yb, db, cb, rb, S, mask, nv, lr, alpha, l2w,
+                        l1w, iflag, loss,
+                    )
+                return jnp.where(c > 0, W2, W), loss_v
+
+            def scan_step(W, inp):
+                db, cb, rb, yb, c, lr = inp
+                return step(W, db, cb, rb, yb, c, lr)
+
+            return jax.lax.scan(scan_step, W,
+                                (data, cols, rows, ys, counts, lrs))
+
+        return track_program("superblock.sparse.sgd_scan")(run)
+
+    from jax.sharding import PartitionSpec as P
+
+    from .._compat import shard_map
+    from ..parallel.mesh import DATA_AXIS
+
+    def body(W, data, cols, rows, ys, shard_counts, counts, lrs, alpha,
+             l2w, l1w, iflag):
+        r = jnp.arange(S)               # LOCAL slab height
+        cts_local = shard_counts[0]
+
+        def step(W, db, cb, rb, yb, c_loc, c_glob, lr):
+            mask = (r < c_loc).astype(jnp.float32)
+            nv = jnp.maximum(c_glob.astype(jnp.float32), 1.0)
+
+            def one(w, y):
+                def local_sums(w):
+                    eta = sparse_eta(db, cb, rb, w[:-1], S) \
+                        + w[-1] * iflag
+                    return jnp.sum(
+                        _sgd_sparse_pointwise(eta, y, loss) * mask
+                    )
+
+                v, g = jax.value_and_grad(local_sums)(w)
+                loss_sum, grad = jax.lax.psum((v, g), DATA_AXIS)
+                loss_v = loss_sum / nv \
+                    + 0.5 * alpha * l2w * jnp.sum(w[:-1] ** 2)
+                g = grad / nv
+                g = g.at[:-1].add(alpha * l2w * w[:-1])
+                w2 = w - lr * g
+                thr = lr * alpha * l1w
+                coef = jnp.sign(w2[:-1]) * jnp.maximum(
+                    jnp.abs(w2[:-1]) - thr, 0.0
+                )
+                return w2.at[:-1].set(coef), loss_v
+
+            if n_out is not None:
+                def one_class(w, cc):
+                    return one(w, (yb == cc).astype(jnp.float32))
+
+                W2, losses = jax.vmap(one_class)(
+                    W, jnp.arange(n_out, dtype=jnp.float32)
+                )
+                loss_v = losses.sum()
+            else:
+                W2, loss_v = one(W, yb)
+            return jnp.where(c_glob > 0, W2, W), loss_v
+
+        def scan_step(W, inp):
+            db, cb, rb, yb, cl, cg, lr = inp
+            return step(W, db, cb, rb, yb, cl, cg, lr)
+
+        return jax.lax.scan(
+            scan_step, W,
+            (data, cols, rows, ys, cts_local, counts, lrs),
+        )
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def run(W, data, cols, rows, ys, shard_counts, counts, lrs, alpha,
+            l2w, l1w, iflag):
+        f = shard_map(
+            body, mesh,
+            in_specs=(P(), P(None, DATA_AXIS), P(None, DATA_AXIS),
+                      P(None, DATA_AXIS), P(None, DATA_AXIS),
+                      P(DATA_AXIS, None), P(), P(), P(), P(), P(),
+                      P()),
+            out_specs=(P(), P()),
+        )
+        return f(W, data, cols, rows, ys, shard_counts, counts, lrs,
+                 alpha, l2w, l1w, iflag)
+
+    return track_program("superblock.sparse.sgd_scan.psum")(run)
+
+
+@track_program("superblock.sparse.grad_accum_micro")
+@partial(jax.jit, static_argnames=("loss", "n_out", "S"))
+def _sgd_accum_micro_sparse(W, data, cols, rows, yb, mask, nv_group,
+                            iflag, loss, n_out, S):
+    """Sparse twin of :func:`_sgd_accum_micro` (the grad-accum flavor's
+    per-micro-block value_and_grad, normalized by the GROUP's global
+    valid-row count inside autodiff) over one bucketed-nnz block."""
+    from ..ops.sparse_kernels import sparse_eta
+
+    def data_loss(w, y):
+        eta = sparse_eta(data, cols, rows, w[:-1], int(S)) \
+            + w[-1] * iflag
+        return jnp.sum(_sgd_sparse_pointwise(eta, y, loss) * mask) \
+            / jnp.maximum(nv_group, 1.0)
+
+    if n_out is not None:
+        def one(w, c):
+            y = (yb == c).astype(jnp.float32)
+            return jax.value_and_grad(lambda ww: data_loss(ww, y))(w)
+
+        vals, grads = jax.vmap(one)(
+            W, jnp.arange(n_out, dtype=jnp.float32)
+        )
+        return vals.sum(), grads
+    return jax.value_and_grad(lambda w: data_loss(w, yb))(W)
 
 
 @_ft_sharded.lru_cache(maxsize=32)
@@ -1070,6 +1256,10 @@ class _SGDBase(BaseEstimator):
         lrs[:sb.n_blocks] = self._lr_schedule(sb.n_blocks)
         l2w, l1w = self._penalty_weights()
         w_bytes = int(np.prod(self._w.shape)) * 4
+        from ..parallel.sparse_stream import SparseSlab
+
+        if isinstance(sb.arrays[0], SparseSlab):
+            return self._sb_step_sparse(sb, lrs, l2w, l1w, w_bytes)
         fused, mxu, interp, reason = self._sb_scan_flavor(sb)
         # on record for solver_info_ (the fused-engagement audit trail
         # tpu_smoke asserts on)
@@ -1126,6 +1316,48 @@ class _SGDBase(BaseEstimator):
         self._t += sb.n_blocks
         self._last_loss = losses[sb.n_blocks - 1]
 
+    def _sb_step_sparse(self, sb, lrs, l2w, l1w, w_bytes):
+        """The bucketed-nnz flavor of :meth:`_sb_step` (ISSUE 13): K
+        minibatch steps over the staged sparse slab in ONE donated-carry
+        scan — eta/gradient at nnz cost, same lr clock and padding-slot
+        semantics; one gradient psum per block step under the sharded
+        flavor (the dense sharded scan's exact collective shape)."""
+        from ..observability import record_superblock_donation
+
+        slab = sb.arrays[0]
+        self._fused_stream = False
+        self._fused_stream_reason = "sparse-stream"
+        self._sparse_stream = True
+        if sb.shard_counts is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            mesh = sb.shard_counts.sharding.mesh
+            rep = NamedSharding(mesh, P())
+            if getattr(self._w, "sharding", None) != rep:
+                self._w = jax.device_put(self._w, rep)
+            run = _sgd_sb_scan_sparse(self._loss(), self._n_out(),
+                                      slab.n_rows, mesh=mesh)
+            W, losses = run(
+                self._w, slab.data, slab.cols, slab.rows, sb.arrays[1],
+                sb.shard_counts, sb.counts, jnp.asarray(lrs),
+                jnp.float32(self.alpha), jnp.float32(l2w),
+                jnp.float32(l1w),
+                jnp.float32(1.0 if self.fit_intercept else 0.0),
+            )
+        else:
+            run = _sgd_sb_scan_sparse(self._loss(), self._n_out(),
+                                      slab.n_rows)
+            W, losses = run(
+                self._w, slab.data, slab.cols, slab.rows, sb.arrays[1],
+                sb.counts, jnp.asarray(lrs), jnp.float32(self.alpha),
+                jnp.float32(l2w), jnp.float32(l1w),
+                jnp.float32(1.0 if self.fit_intercept else 0.0),
+            )
+        record_superblock_donation(w_bytes)
+        self._w = W
+        self._t += sb.n_blocks
+        self._last_loss = losses[sb.n_blocks - 1]
+
     def _stream_pass(self, Xh, yh, block_rows, order=None, classes=None,
                      shuffle=False, seed=None):
         """One partial_fit pass over host data as super-block scans (the
@@ -1137,8 +1369,7 @@ class _SGDBase(BaseEstimator):
         its per-block loop instead."""
         from ..parallel.streaming import BlockStream, _is_sparse_source
 
-        if _is_sparse_source(Xh):
-            return False
+        sparse_src = _is_sparse_source(Xh)
         if classes is not None:
             self._set_classes(np.asarray(classes))
         if isinstance(self, ClassifierMixin) and \
@@ -1146,10 +1377,16 @@ class _SGDBase(BaseEstimator):
             raise ValueError(
                 "classes must be passed on the first call to partial_fit."
             )
-        Xh = np.asarray(Xh)
+        if not sparse_src:
+            Xh = np.asarray(Xh)
         y_enc = np.asarray(self._encode_y(np.asarray(yh)))
         stream = BlockStream((Xh, y_enc), block_rows=block_rows,
                              shuffle=shuffle, seed=seed)
+        if sparse_src and stream.sparse_plan is None:
+            # sparse source without a device-resident staging plan
+            # (config.stream_sparse off, over-density fallback): the
+            # caller's per-block densify loop stays the path
+            return False
         if stream.block_rows != int(block_rows):
             # the stream rounds block_rows to a shard multiple; a caller
             # partition it cannot reproduce must keep its own loop —
@@ -1317,6 +1554,14 @@ class _SGDBase(BaseEstimator):
         rep = NamedSharding(stream.mesh, P())
         if getattr(self._w, "sharding", None) != rep:
             self._w = jax.device_put(self._w, rep)
+        # the sparse grad-accum micro flavor (ISSUE 13): bucketed-nnz
+        # per-block staging + nnz-cost value_and_grad. Single-device
+        # streams only — the sparse per-block slabs place on the
+        # stream's (replicated) mesh, and grad-accum's merge is the
+        # host psum anyway; sharded streams keep the densify micro path
+        use_sparse = (getattr(stream, "sparse_plan", None) is not None
+                      and stream.sb_data_shards() == 1)
+        self._sparse_stream = bool(use_sparse)
         for _ in range(int(self.max_iter)):
             order = np.arange(n_blocks)
             if self.shuffle:
@@ -1334,12 +1579,22 @@ class _SGDBase(BaseEstimator):
                 gsum, lsum = None, 0.0
                 nv = jnp.float32(group_nv[g])
                 for b in order[g * A:(g + 1) * A]:
-                    blk = stream._put(stream._block_host(int(b)))
-                    Xb, yb = blk.arrays
-                    v, gr = _sgd_accum_micro(
-                        self._w, Xb, yb, blk.mask, nv,
-                        jnp.float32(iflag), loss_name, n_out, mxu=mxu,
-                    )
+                    if use_sparse:
+                        slab, dense, mask_d, _m = \
+                            stream.sparse_block_put(int(b))
+                        v, gr = _sgd_accum_micro_sparse(
+                            self._w, slab.data, slab.cols, slab.rows,
+                            dense[0], mask_d, nv, jnp.float32(iflag),
+                            loss_name, n_out, slab.n_rows,
+                        )
+                    else:
+                        blk = stream._put(stream._block_host(int(b)))
+                        Xb, yb = blk.arrays
+                        v, gr = _sgd_accum_micro(
+                            self._w, Xb, yb, blk.mask, nv,
+                            jnp.float32(iflag), loss_name, n_out,
+                            mxu=mxu,
+                        )
                     lsum += float(v)
                     g64 = np.asarray(gr, np.float64)
                     gsum = g64 if gsum is None else gsum + g64
@@ -1455,10 +1710,11 @@ class _SGDBase(BaseEstimator):
             shuffle=self.shuffle, seed=self.random_state,
         )
         self._ensure_state(Xh.shape[1])
-        # fused-engagement audit defaults; _sb_step overwrites when the
-        # super-block path runs
+        # fused/sparse-engagement audit defaults; _sb_step overwrites
+        # when the super-block path runs
         self._fused_stream = False
         self._fused_stream_reason = "per-block-path"
+        self._sparse_stream = False
         if grad_accum >= 1:
             # gradient-accumulation flavor (cross-host capable): A
             # micro-blocks' sums -> one psum -> one shared update
@@ -1496,6 +1752,15 @@ class _SGDBase(BaseEstimator):
         # which flavor ran, why fused was gated off if it was, and the
         # grad-accum width — so smoke suites assert engagement instead
         # of trusting the gate
+        sparse_on = bool(getattr(self, "_sparse_stream", False))
+        if sparse_on:
+            sparse_reason = None
+        elif getattr(stream, "sparse_plan", None) is not None:
+            sparse_reason = "per-block-path"
+        elif getattr(stream, "sparse_reason", None) is not None:
+            sparse_reason = stream.sparse_reason
+        else:
+            sparse_reason = "dense-source"
         self.solver_info_ = {
             "streamed": True,
             "n_blocks": int(stream.n_blocks),
@@ -1506,6 +1771,11 @@ class _SGDBase(BaseEstimator):
             "fused_stream_reason": getattr(
                 self, "_fused_stream_reason", None
             ),
+            # the device-resident sparse audit trail (ISSUE 13),
+            # mirroring fused_stream_reason: None iff the bucketed-nnz
+            # programs carried the fit
+            "sparse_stream": sparse_on,
+            "sparse_stream_reason": sparse_reason,
         }
         self._publish(Xh.shape[1])
         self.n_iter_ = self.max_iter

@@ -461,6 +461,149 @@ def _sb_reducer(kind, family, intercept, n_classes, mxu=None,
     return track_program(f"superblock.glm.{kind}{suffix}")(run)
 
 
+# -- device-resident sparse reducers (ISSUE 13 tentpole) --------------------
+# The bucketed-nnz flavor of the super-block scan: blocks arrive as
+# fixed-shape COO triples (data/cols/rows, padding entries zero-valued)
+# and the objective's matvec/gradient run at nnz-proportional cost via
+# take + segment_sum (ops/sparse_kernels.py) — XLA's own cost analysis
+# then attributes nnz FLOPs to the `superblock.sparse.*` programs, not
+# n*d. The Newton Hessian (intrinsically O(d^2) math) scatters its
+# block dense ON DEVICE and reuses the exact dense per-block kernel, so
+# sparse-vs-dense Newton parity is float-roundoff only. Masks stay
+# row-based (the same prefix-count contract as the dense scan).
+
+def _sparse_reducer_sums(kind, family, intercept, n_classes, n_rows,
+                         n_features):
+    """Per-block sum tuple ``f(beta, data, cols, rows, yb, c)`` for one
+    sparse objective flavor — shared by the single-device scan and the
+    shard_map flavor (``n_rows`` is the LOCAL slab height there)."""
+    from ...ops.sparse_kernels import (sparse_densify, sparse_eta,
+                                       sparse_eta_multi)
+
+    S = int(n_rows)
+
+    if kind == "vgh":
+        fn, extra = _reducer_blocks("vgh", n_classes)
+
+        def sums(beta, data, cols, rows, yb, c):
+            mask = (jnp.arange(S) < c).astype(jnp.float32)
+            Xd = sparse_densify(data, cols, rows, S, int(n_features))
+            return fn(beta, Xd, yb, mask, family, intercept, *extra)
+
+        return sums
+
+    if n_classes:
+        def data_val(B, data, cols, rows, yb, mask):
+            W = B[:, :-1] if intercept else B
+            eta = sparse_eta_multi(data, cols, rows, W, S)   # (S, C)
+            if intercept:
+                eta = eta + B[:, -1][None, :]
+            Y = _codes_onehot(yb, mask, n_classes)           # (C, S)
+            per_class = jax.vmap(
+                lambda e, yc: jnp.sum(
+                    get_family(family).pointwise(e, yc) * mask
+                ),
+                in_axes=(1, 0),
+            )(eta, Y)
+            return jnp.sum(per_class)
+    else:
+        def data_val(beta, data, cols, rows, yb, mask):
+            w = beta[:-1] if intercept else beta
+            eta = sparse_eta(data, cols, rows, w, S)
+            if intercept:
+                eta = eta + beta[-1]
+            return jnp.sum(get_family(family).pointwise(eta, yb) * mask)
+
+    if kind == "val":
+        def sums(beta, data, cols, rows, yb, c):
+            mask = (jnp.arange(S) < c).astype(jnp.float32)
+            return (data_val(beta, data, cols, rows, yb, mask),)
+
+        return sums
+
+    def sums(beta, data, cols, rows, yb, c):     # "vg"
+        mask = (jnp.arange(S) < c).astype(jnp.float32)
+        return jax.value_and_grad(
+            lambda b: data_val(b, data, cols, rows, yb, mask)
+        )(beta)
+
+    return sums
+
+
+@_ft.lru_cache(maxsize=64)
+def _sb_reducer_sparse(kind, family, intercept, n_classes, n_rows,
+                       n_features, mesh=None):
+    """The donated-carry super-block program for one SPARSE objective
+    flavor: the scan steps through the (K, cap) COO stacks accumulating
+    the same sum tuple as :func:`_sb_reducer` — one dispatch per
+    super-block, zero recompiles after pass 1 (the plan pads every
+    super-block of a fit to ONE capacity). ``mesh`` selects the
+    shard_map data-parallel flavor: each device scans its own (K, cap)
+    nnz segment with shard-local row ids against its (K, S/D) slab of
+    the dense side arrays, and the dispatch pays exactly ONE psum —
+    identical collective shape to the dense flavor."""
+    suffix = "_multi" if n_classes else ""
+    if mesh is None:
+        sums = _sparse_reducer_sums(kind, family, intercept, n_classes,
+                                    n_rows, n_features)
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def run(acc, beta, data, cols, rows, ys, counts):
+            def scan_step(acc, inp):
+                db, cb, rb, yb, c = inp
+                out = sums(beta, db, cb, rb, yb, c)
+                out = out if isinstance(out, tuple) else (out,)
+                return tuple(a + o for a, o in zip(acc, out)), \
+                    jnp.float32(0.0)
+
+            acc, _ = jax.lax.scan(scan_step, acc,
+                                  (data, cols, rows, ys, counts))
+            return acc
+
+        return track_program(f"superblock.sparse.glm.{kind}{suffix}")(run)
+
+    from jax.sharding import PartitionSpec as P
+
+    from ..._compat import shard_map
+    from ...parallel.mesh import DATA_AXIS
+
+    sums = _sparse_reducer_sums(kind, family, intercept, n_classes,
+                                n_rows, n_features)
+
+    def body(acc, beta, data, cols, rows, ys, counts):
+        # LOCAL view: data/cols/rows (K, cap) — this shard's nnz
+        # segments with shard-local row ids; ys (K, S/D); counts (1, K)
+        cts = counts[0]
+        local = jax.tree.map(jnp.zeros_like, acc)
+
+        def scan_step(lacc, inp):
+            db, cb, rb, yb, c = inp
+            out = sums(beta, db, cb, rb, yb, c)
+            out = out if isinstance(out, tuple) else (out,)
+            return tuple(l + o for l, o in zip(lacc, out)), \
+                jnp.float32(0.0)
+
+        local, _ = jax.lax.scan(scan_step, local,
+                                (data, cols, rows, ys, cts))
+        local = jax.lax.psum(local, DATA_AXIS)
+        return tuple(a + l for a, l in zip(acc, local))
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def run(acc, beta, data, cols, rows, ys, counts):
+        f = shard_map(
+            body, mesh,
+            in_specs=(P(), P(), P(None, DATA_AXIS), P(None, DATA_AXIS),
+                      P(None, DATA_AXIS), P(None, DATA_AXIS),
+                      P(DATA_AXIS, None)),
+            out_specs=P(),
+        )
+        return f(acc, beta, data, cols, rows, ys, counts)
+
+    return track_program(
+        f"superblock.sparse.glm.{kind}{suffix}.psum"
+    )(run)
+
+
 @_ft.lru_cache(maxsize=32)
 def _sb_admm_local(local_iter, family, intercept, n_classes,
                    gspmd=False):
@@ -640,6 +783,8 @@ class StreamedObjective:
             return None
         from ...observability import record_superblock_donation
 
+        if bool(getattr(s, "sb_sparse", lambda: False)()):
+            return self._sb_pass_sparse(kind, B, init)
         sharded = bool(getattr(s, "sb_sharded", lambda: False)())
         mxu, fused, interp, _ = self._sb_flavor(kind)
         if sharded:
@@ -665,6 +810,39 @@ class StreamedObjective:
         for sb in s.superblocks():
             counts = sb.shard_counts if sharded else sb.counts
             acc = run(acc, B, sb.arrays[0], sb.arrays[1], counts)
+            record_superblock_donation(acc_bytes)
+        return acc
+
+    def _sb_pass_sparse(self, kind, B, init):
+        """The bucketed-nnz flavor of :meth:`_sb_pass` (ISSUE 13): the
+        stream stages sparse slabs, the reducers run take/segment_sum
+        math at nnz cost, and the dispatch/donation/psum contracts are
+        the dense scan's exactly."""
+        from ...observability import record_superblock_donation
+
+        s = self.stream
+        plan = s.sparse_plan
+        sharded = bool(getattr(s, "sb_sharded", lambda: False)())
+        D = s.sb_data_shards() if sharded else 1
+        S_local = s.block_rows // D
+        if sharded:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            run = _sb_reducer_sparse(kind, self.family, self.intercept,
+                                     self.n_classes or 0, S_local,
+                                     plan.n_features, mesh=s.mesh)
+            init = jax.device_put(init, NamedSharding(s.mesh, P()))
+        else:
+            run = _sb_reducer_sparse(kind, self.family, self.intercept,
+                                     self.n_classes or 0, S_local,
+                                     plan.n_features)
+        acc = init
+        acc_bytes = sum(4 * int(np.prod(a.shape) or 1) for a in acc)
+        for sb in s.superblocks():
+            slab = sb.arrays[0]
+            counts = sb.shard_counts if sharded else sb.counts
+            acc = run(acc, B, slab.data, slab.cols, slab.rows,
+                      sb.arrays[1], counts)
             record_superblock_donation(acc_bytes)
         return acc
 
@@ -1130,7 +1308,11 @@ def admm(obj: StreamedObjective, beta0, max_iter=250, tol=1e-4, rho=1.0,
     primal = dual = np.inf
     C = obj.n_classes
     s = obj.stream
-    use_sb = hasattr(s, "use_superblocks") and s.use_superblocks()
+    # ADMM's block-local Newton is O(d^2) per member whatever the input
+    # format — sparse-staged streams keep the per-block densify loop
+    # (reason recorded via _fused_stream_info as "admm-local-newton")
+    use_sb = (hasattr(s, "use_superblocks") and s.use_superblocks()
+              and not bool(getattr(s, "sb_sparse", lambda: False)()))
     for it in range(it0, int(max_iter)):
         obj.passes += 1
         bi = 0
@@ -1297,9 +1479,34 @@ def _fused_stream_info(obj, stream, solver, fit_dtype):
     out["stream_shards"] = int(
         getattr(stream, "sb_data_shards", lambda: 1)()
     ) if use_sb else 1
+    # the device-resident sparse flavor's audit trail (ISSUE 13),
+    # mirroring fused_stream_reason: None iff the bucketed-nnz scan
+    # carried the pass, else why it fell back — "stream-sparse-off",
+    # the plan's density/spill reason, "per-block-path" (K == 1),
+    # "admm-local-newton", or "dense-source" for dense inputs
+    sparse_sb = bool(getattr(stream, "sb_sparse", lambda: False)())
+    plan = getattr(stream, "sparse_plan", None)
+    src_reason = getattr(stream, "sparse_reason", None)
+    if sparse_sb and solver != "admm":
+        out["sparse_stream"] = True
+        out["sparse_stream_reason"] = None
+    else:
+        out["sparse_stream"] = False
+        if sparse_sb and solver == "admm":
+            out["sparse_stream_reason"] = "admm-local-newton"
+        elif plan is not None:
+            out["sparse_stream_reason"] = "per-block-path"
+        elif src_reason is not None:
+            out["sparse_stream_reason"] = src_reason
+        else:
+            out["sparse_stream_reason"] = "dense-source"
     info_kind = {"newton": "vgh", "admm": None}.get(solver, "vg")
     if info_kind is None:
         mxu, fused, reason = None, False, "admm-local-newton"
+    elif out["sparse_stream"]:
+        # the fused Pallas kernels are a dense-slab feature; the sparse
+        # scan runs its own XLA programs
+        mxu, fused, reason = None, False, "sparse-stream"
     elif not use_sb:
         mxu, fused, reason = None, False, "per-block-path"
     else:

@@ -75,6 +75,8 @@ from ._counters import (
     record_serving_slo_violation,
     record_serving_swap,
     record_shard_staging,
+    record_sparse_spill,
+    record_sparse_staging,
     record_stream_checkpoint,
     record_stream_quarantine,
     record_stream_retry,
@@ -182,6 +184,8 @@ __all__ = [
     "record_serving_slo_violation",
     "record_serving_swap",
     "record_shard_staging",
+    "record_sparse_spill",
+    "record_sparse_staging",
     "record_stream_checkpoint",
     "record_stream_quarantine",
     "record_stream_retry",

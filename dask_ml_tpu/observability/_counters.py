@@ -98,6 +98,25 @@ def record_shard_staging(n_shards: int) -> None:
         counter_add("shard_slab_puts", int(n_shards))
 
 
+def record_sparse_staging(n_blocks: int, nnz: int) -> None:
+    """One bucketed-nnz sparse staging assembly (ISSUE 13): ``n_blocks``
+    streamed blocks staged as device-resident COO triples carrying
+    ``nnz`` real nonzeros — sparse_nnz_staged / sparse_blocks_staged is
+    the measured per-block nnz, and its ratio against h2d_bytes shows
+    the densify traffic the sparse path did NOT pay."""
+    if counters_enabled():
+        counter_add("sparse_blocks_staged", int(n_blocks))
+        counter_add("sparse_nnz_staged", int(nnz))
+
+
+def record_sparse_spill() -> None:
+    """One served sparse batch whose nnz exceeded the warmed nnz-bucket
+    ladder's top rung and spilled to the densified dense entry point
+    (still zero new compiles — the dense (rows) bucket is warm)."""
+    if counters_enabled():
+        counter_add("serving_sparse_spills", 1)
+
+
 def record_gspmd_reduce(nbytes: int) -> None:
     """Estimated cross-device reduce payload one implicit-GSPMD
     dispatch moved (today: the sharded streamed-ADMM block-local

@@ -1,0 +1,76 @@
+"""Device-side building blocks for bucketed-nnz sparse blocks.
+
+A staged sparse block is a fixed-shape COO-expanded CSR triple —
+``data (cap,) float32``, ``cols (cap,) int32``, ``rows (cap,) int32``
+(row id per nonzero, local to the block/slab) — padded to an
+nnz-bucket capacity with ``data == 0`` entries (rows/cols of padding
+point at slot 0, which a zero value cannot perturb). Everything here is
+built from ``jnp.take`` + ``jax.ops.segment_sum`` so XLA's own cost
+model attributes nnz-proportional FLOPs/bytes (never n x d), and the
+take-based matvec is autodiff-friendly: the backward pass of ``take``
+is the scatter-add that computes the nnz-proportional gradient.
+
+Masking contract: validity is per ROW (the streamed prefix-count mask),
+exactly like the dense blocks — padding NNZ entries carry zero values
+and thus vanish from every sum on their own, while ragged-tail ROWS are
+dropped by the same ``(arange(S) < count)`` mask the dense kernels use.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "sparse_eta", "sparse_eta_multi", "sparse_densify",
+    "sparse_sq_norms", "sparse_center_dots", "sparse_label_sums",
+]
+
+
+def sparse_eta(data, cols, rows, w_feat, n_rows: int):
+    """``X @ w_feat`` of one sparse block: (n_rows,) row sums of
+    ``data * w_feat[cols]``. Differentiable in ``w_feat`` at nnz cost
+    (the take's backward is a scatter-add)."""
+    contrib = data * jnp.take(w_feat, cols)
+    return jax.ops.segment_sum(contrib, rows, num_segments=n_rows)
+
+
+def sparse_eta_multi(data, cols, rows, W_feat, n_rows: int):
+    """``X @ W_feat.T`` of one sparse block: (n_rows, C). One gather of
+    the (C,)-wide weight columns per nonzero — the multiclass OvR
+    analog of :func:`sparse_eta` (all C classes served by one pass over
+    the nnz)."""
+    contrib = data[:, None] * jnp.take(W_feat.T, cols, axis=0)  # (cap, C)
+    return jax.ops.segment_sum(contrib, rows, num_segments=n_rows)
+
+
+def sparse_densify(data, cols, rows, n_rows: int, n_features: int,
+                   dtype=jnp.float32):
+    """Scatter the block dense on DEVICE — the escape hatch for math
+    that is intrinsically O(d^2) anyway (the streamed Newton Hessian
+    X^T W X): one (n_rows, n_features) buffer per block, never the
+    corpus. Padding entries add zero at [0, 0]."""
+    out = jnp.zeros((n_rows, n_features), dtype)
+    return out.at[rows, cols].add(data.astype(dtype))
+
+
+def sparse_sq_norms(data, rows, n_rows: int):
+    """Per-row ||x||^2 of one sparse block."""
+    return jax.ops.segment_sum(data * data, rows, num_segments=n_rows)
+
+
+def sparse_center_dots(data, cols, rows, centers, n_rows: int):
+    """``X @ centers.T`` of one sparse block: (n_rows, k). The KMeans
+    assignment's matmul at nnz * k cost."""
+    contrib = data[:, None] * jnp.take(centers.T, cols, axis=0)
+    return jax.ops.segment_sum(contrib, rows, num_segments=n_rows)
+
+
+def sparse_label_sums(data, cols, rows, labels, k: int, n_features: int):
+    """Per-label feature sums of one sparse block: (k, n_features) with
+    ``out[labels[r]] += X[r]`` — the KMeans stats accumulation done as
+    ONE flat segment_sum over ``label * d + col`` ids (padding entries
+    carry zero values and land harmlessly in segment 0)."""
+    seg = jnp.take(labels, rows) * n_features + cols
+    flat = jax.ops.segment_sum(data, seg, num_segments=k * n_features)
+    return flat.reshape(k, n_features)

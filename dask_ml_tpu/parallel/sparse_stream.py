@@ -1,0 +1,242 @@
+"""Bucketed-nnz sparse block staging (the device-resident sparse path).
+
+The host side of ISSUE 13's tentpole: a sparse source (scipy CSR or the
+``SparseBlocks`` view) streams as fixed-shape COO-expanded triples —
+``data/cols/rows`` padded to a geometric nnz-bucket ladder — instead of
+densifying every block to ``block_rows x d`` on host. The ladder (the
+serving ``BucketLadder`` shape policy reused) bounds the number of
+compiled specializations a pass can mint; the STACKED scan capacity is
+the single top rung any staged block needs, so every super-block of a
+fit has the identical ``(K, D * cap)`` shape — one compiled scan
+specialization per fit, zero XLA compiles after pass 1 even under
+per-pass shuffling.
+
+Sharding: on a D-shard stream mesh each block's rows split into D
+contiguous slabs (exactly the dense path's partition); entries land in
+their shard's ``cap``-wide segment of the ``(D * cap,)`` staging row
+with SHARD-LOCAL row ids, so the shard_map consumers read purely local
+nonzeros and keep their one-psum-per-super-block contract.
+
+Fallbacks are decided at PLAN time (one pass over ``indptr``, no data
+touched): a corpus — or any single block — denser than
+``config.stream_sparse_max_density`` refuses with a recorded reason and
+the stream keeps today's per-block densify path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import scipy.sparse as sp
+
+__all__ = ["SparseSlab", "SparseStreamPlan", "plan_sparse_stream",
+           "sparse_row_nnz", "coo_rows"]
+
+# nnz-bucket ladder policy: rungs grow geometrically from _NNZ_MIN so
+# tiny blocks don't mint per-nnz shapes; growth 2.0 bounds padded-nnz
+# waste below 50% of any staged block
+_NNZ_MIN = 128
+_NNZ_GROWTH = 2.0
+
+
+class SparseSlab:
+    """One staged sparse operand: device ``data/cols/rows`` arrays of
+    shape ``(K, D * cap)`` (or ``(cap,)`` for a single per-block slab)
+    plus the static geometry the jitted consumers key on — ``n_rows``
+    (block height S), ``n_features``, ``shards`` (D) and ``cap`` (the
+    per-shard nnz capacity). Row ids are LOCAL to their shard's slab."""
+
+    __slots__ = ("data", "cols", "rows", "n_rows", "n_features",
+                 "shards", "cap")
+
+    def __init__(self, data, cols, rows, n_rows, n_features, shards,
+                 cap):
+        self.data = data
+        self.cols = cols
+        self.rows = rows
+        self.n_rows = int(n_rows)
+        self.n_features = int(n_features)
+        self.shards = int(shards)
+        self.cap = int(cap)
+
+
+def sparse_row_nnz(a) -> np.ndarray:
+    """Per-row nonzero counts of a CSR-like source (scipy CSR or
+    SparseBlocks) straight off ``indptr`` — no data touched."""
+    if sp.isspmatrix_csr(a):
+        return np.diff(a.indptr)
+    # SparseBlocks: member blocks are CSR by construction
+    from .streaming import SparseBlocks
+
+    if isinstance(a, SparseBlocks):
+        return np.concatenate([np.diff(b.indptr) for b in a.blocks])
+    return np.diff(a.tocsr().indptr)
+
+
+def coo_rows(a, lo, hi):
+    """(data float32, cols int32, rows int32) of rows [lo, hi) of a
+    CSR-like source, rows LOCAL (0-based at ``lo``) — pure index
+    arithmetic on the CSR arrays, no densify, no scipy row-slice copy
+    of anything but the touched nnz range."""
+    if sp.isspmatrix_csr(a):
+        s0, s1 = int(a.indptr[lo]), int(a.indptr[hi])
+        data = np.asarray(a.data[s0:s1], np.float32)
+        cols = np.asarray(a.indices[s0:s1], np.int32)
+        reps = np.diff(a.indptr[lo:hi + 1])
+        rows = np.repeat(np.arange(hi - lo, dtype=np.int32), reps)
+        return data, cols, rows
+    from .streaming import SparseBlocks
+
+    if isinstance(a, SparseBlocks):
+        parts_d, parts_c, parts_r = [], [], []
+        i = int(np.searchsorted(a.offsets, lo, side="right") - 1)
+        off = 0
+        while lo < hi and i < len(a.blocks):
+            b_lo, b_hi = int(a.offsets[i]), int(a.offsets[i + 1])
+            take = min(hi, b_hi) - lo
+            d_, c_, r_ = coo_rows(a.blocks[i], lo - b_lo,
+                                  lo - b_lo + take)
+            parts_d.append(d_)
+            parts_c.append(c_)
+            parts_r.append(r_ + off)
+            off += take
+            lo += take
+            i += 1
+        if not parts_d:
+            z = np.zeros(0, np.float32)
+            return z, np.zeros(0, np.int32), np.zeros(0, np.int32)
+        return (np.concatenate(parts_d), np.concatenate(parts_c),
+                np.concatenate(parts_r))
+    return coo_rows(a.tocsr(), lo, hi)
+
+
+def _nnz_rung(nnz: int, top: int) -> int:
+    """Smallest ladder rung >= nnz: geometric from _NNZ_MIN, clipped to
+    ``top`` (the max any block needs). Deliberately NOT serving's
+    BucketLadder even though the min/growth policy matches: the ladder
+    there CLAMPS its last rung to ``max_rows`` exactly (padding waste
+    matters per request), while the staging capacity must stay a pure
+    geometric rung — clamping cap to the observed max nnz would key the
+    compiled scan shape to the corpus's exact nnz instead of its
+    bucket, minting a fresh specialization per corpus."""
+    r = _NNZ_MIN
+    while r < nnz:
+        r = int(np.ceil(r * _NNZ_GROWTH))
+    return min(r, max(top, 1)) if top else r
+
+
+class SparseStreamPlan:
+    """The per-stream sparse staging decision: per-block nnz rungs (the
+    deterministic "bucket sequence" of a corpus), the stacked per-shard
+    capacity every super-block pads to, and byte accounting for the
+    super-block K budget. ``reason`` is None when the device-resident
+    path engages, else why it fell back (recorded in solver_info_)."""
+
+    __slots__ = ("n_rows", "n_features", "block_rows", "shards", "cap",
+                 "cap1", "block_buckets", "density", "reason",
+                 "total_nnz")
+
+    def __init__(self, n_rows, n_features, block_rows, shards, cap,
+                 cap1, block_buckets, density, total_nnz, reason=None):
+        self.n_rows = n_rows
+        self.n_features = n_features
+        self.block_rows = block_rows
+        self.shards = shards
+        self.cap = cap          # per-shard stacked capacity
+        self.cap1 = cap1        # single-slab (D=1) capacity
+        self.block_buckets = block_buckets  # per-block nnz rung sequence
+        self.density = density
+        self.total_nnz = total_nnz
+        self.reason = reason
+
+    @property
+    def engaged(self) -> bool:
+        return self.reason is None
+
+    def block_bytes(self) -> int:
+        """Device bytes one staged block costs (data f32 + cols i32 +
+        rows i32 across the D shard segments) — what the super-block K
+        byte budget reasons about in place of the dense S*d*4."""
+        return 12 * self.cap * self.shards
+
+
+def plan_sparse_stream(a, block_rows: int, shards: int,
+                       max_density: float) -> SparseStreamPlan:
+    """Build the staging plan for sparse source ``a`` at the stream's
+    resolved ``block_rows`` / shard count. One pass over ``indptr``."""
+    n, d = int(a.shape[0]), int(a.shape[1])
+    row_nnz = sparse_row_nnz(a).astype(np.int64)
+    total = int(row_nnz.sum())
+    density = total / max(n * d, 1)
+    n_blocks = max(-(-n // block_rows), 1)
+    sd = max(block_rows // max(shards, 1), 1)
+    # per-(block, shard) nnz: the capacity the stacked slabs must cover
+    pad = n_blocks * block_rows - n
+    padded = np.concatenate([row_nnz, np.zeros(pad, np.int64)])
+    per_shard = padded.reshape(n_blocks, max(shards, 1), sd).sum(axis=2)
+    per_block = per_shard.sum(axis=1)
+    top_shard = int(per_shard.max()) if per_shard.size else 0
+    top_block = int(per_block.max()) if per_block.size else 0
+    buckets = tuple(
+        _nnz_rung(int(b), _nnz_rung(top_block, 0)) for b in per_block
+    )
+    cap = _nnz_rung(top_shard, 0)
+    cap1 = _nnz_rung(top_block, 0)
+    reason = None
+    if density > max_density:
+        reason = (f"density {density:.4f} > stream_sparse_max_density "
+                  f"{max_density}")
+    else:
+        # a single over-dense block spills past any useful rung even in
+        # a sparse corpus — densify fallback, reason on record
+        blk_density = top_block / max(block_rows * d, 1)
+        if blk_density > max_density:
+            reason = (f"block density {blk_density:.4f} > "
+                      f"stream_sparse_max_density {max_density} "
+                      "(over-bucket spill)")
+    return SparseStreamPlan(n, d, block_rows, max(shards, 1), cap, cap1,
+                            buckets, density, total, reason=reason)
+
+
+def pack_block(a, lo, hi, shards, shard_rows, cap, data_out, cols_out,
+               rows_out) -> int:
+    """Pack rows [lo, hi) of ``a`` into one staging row — ``*_out`` are
+    ``(shards * cap,)`` host views (one slot row of the ring buffer),
+    zero-filled here so padding entries carry zero values. Entries land
+    in their shard's ``cap``-wide segment with SHARD-LOCAL row ids.
+    Returns the block's packed nnz. Raises when a shard's nnz exceeds
+    the planned capacity (a source mutated under the stream — the plan
+    covered every block at build time)."""
+    data_out[:] = 0
+    cols_out[:] = 0
+    rows_out[:] = 0
+    data, cols, rows = coo_rows(a, lo, hi)
+    if shards <= 1:
+        if len(data) > cap:
+            raise ValueError(
+                f"sparse block rows [{lo}, {hi}) holds {len(data)} nnz "
+                f"> planned capacity {cap}; source changed under the "
+                "stream"
+            )
+        data_out[: len(data)] = data
+        cols_out[: len(data)] = cols
+        rows_out[: len(data)] = rows
+        return len(data)
+    # shard s owns local rows [s*shard_rows, (s+1)*shard_rows); entries
+    # arrive row-sorted (CSR), so one searchsorted splits them
+    bounds = np.searchsorted(
+        rows, np.arange(1, shards, dtype=np.int32) * shard_rows
+    )
+    pieces = np.split(np.arange(len(data)), bounds)
+    for s, idx in enumerate(pieces):
+        if len(idx) > cap:
+            raise ValueError(
+                f"sparse block rows [{lo}, {hi}) shard {s} holds "
+                f"{len(idx)} nnz > planned capacity {cap}; source "
+                "changed under the stream"
+            )
+        base = s * cap
+        data_out[base: base + len(idx)] = data[idx]
+        cols_out[base: base + len(idx)] = cols[idx]
+        rows_out[base: base + len(idx)] = \
+            rows[idx] - s * shard_rows
+    return len(data)

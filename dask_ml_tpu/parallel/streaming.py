@@ -460,6 +460,36 @@ class BlockStream:
             self.mesh, P(DATA_AXIS, None)
         )
         self._superblock_k_override = None  # set by the K autotuner
+        # device-resident sparse staging (ISSUE 13): when opted in
+        # (config.stream_sparse) and the source stays under the density
+        # threshold, a sparse X streams as bucketed-nnz COO triples
+        # through _superblocks_sparse instead of densifying per block.
+        # The plan (capacities, per-block nnz rungs, fallback reason)
+        # is built ONCE here from indptr alone
+        self.sparse_plan = None
+        self.sparse_reason = None
+        if any(_is_sparse_source(a) for a in self.arrays):
+            from ..config import get_config as _gc
+
+            _cfg = _gc()
+            if not _cfg.stream_sparse:
+                self.sparse_reason = "stream-sparse-off"
+            elif not _is_sparse_source(self.arrays[0]) or any(
+                    _is_sparse_source(a) for a in self.arrays[1:]):
+                # only the X position streams sparse; sparse targets
+                # have no kernel story
+                self.sparse_reason = "sparse-operand-layout"
+            else:
+                from .sparse_stream import plan_sparse_stream
+
+                plan = plan_sparse_stream(
+                    self.arrays[0], self.block_rows,
+                    data_shards(self.mesh),
+                    float(_cfg.stream_sparse_max_density),
+                )
+                self.sparse_reason = plan.reason
+                if plan.engaged:
+                    self.sparse_plan = plan
         from ..config import ensure_compile_cache, get_config
         from ..observability.live import ensure_telemetry
 
@@ -510,13 +540,23 @@ class BlockStream:
         # opts inference streams (streamed_map) out — a predict stream's
         # distribution is not a training profile.
         self.profile = None
-        # sparse sources opt out: a hashed-text corpus is 2**16+ wide,
-        # and a per-feature sketch there is O(d * buckets) memory (tens
-        # of MB) on a path whose whole point is O(block) footprint;
-        # _PROFILE_MAX_FEATURES guards the dense equivalent
+        # WIDE sparse sources opt out: a hashed-text corpus is 2**16+
+        # wide, and a per-feature sketch there is O(d * buckets) memory
+        # (tens of MB) on a path whose whole point is O(block)
+        # footprint. NARROW sparse (d <= _PROFILE_MAX_FEATURES) folds a
+        # densified strided sample under the same per-VALUE budget as
+        # dense streams — drift monitoring works on sparse fits that
+        # can afford it, and the opt-out reason is on record
+        sparse_src = any(_is_sparse_source(a) for a in self.arrays)
+        d_prof = int(np.prod(
+            getattr(self.arrays[0], "shape", (0, 1))[1:], dtype=np.int64
+        ) or 1)
+        self.profile_reason = None
+        if sparse_src and d_prof > _PROFILE_MAX_FEATURES:
+            self.profile_reason = f"sparse-wide(d={d_prof})"
         self._profile_enabled = bool(
             profile and get_config().obs_drift
-            and not any(_is_sparse_source(a) for a in self.arrays)
+            and self.profile_reason is None
         )
         # VALUE budget for the profile sample: bounds the fold cost per
         # fit regardless of dataset size AND width (the profile is a
@@ -591,12 +631,14 @@ class BlockStream:
             for ok, a in zip(self._native_ok, self.arrays)
         ]
 
-    def _profile_fold(self, blk) -> None:
+    def _profile_fold(self, blk, strided=False) -> None:
         """Fold one host X slab (valid rows only, pre-padding) into the
         training profile — first pass only (later passes re-stream the
         same rows), strided to the row budget, never raising into the
         stream. Called from the per-block path and the super-block
-        staging worker alike (the sketch is thread-safe)."""
+        staging worker alike (the sketch is thread-safe). ``strided``
+        marks a sample the caller already strided (the sparse staging
+        path densifies ONLY the sampled rows)."""
         if not self._profile_enabled or getattr(self, "_passes", 0):
             return
         try:
@@ -611,9 +653,38 @@ class BlockStream:
                 from ..observability.sketch import FeatureSketch
 
                 prof = self.profile = FeatureSketch(blk.shape[1])
-            prof.fold(blk[:: self._profile_stride])
+            prof.fold(blk if strided else blk[:: self._profile_stride])
         except Exception:
             self._profile_enabled = False  # diagnostics never kill a fit
+
+    def _profile_fold_sparse(self, a, lo, hi) -> None:
+        """The sparse staging path's profile fold: densify ONLY the
+        strided sample rows of [lo, hi) (the sparse path never builds a
+        dense block, and narrow-sparse profiling must not reintroduce
+        one) and fold them pre-strided. No-op when profiling is off
+        (wide sparse keeps the recorded opt-out)."""
+        if not self._profile_enabled or getattr(self, "_passes", 0):
+            return
+        try:
+            step = self._profile_stride
+            if sp.isspmatrix_csr(a):
+                blk = np.asarray(a[lo:hi:step].toarray(), self.dtype)
+            else:
+                # SparseBlocks: scatter ONLY the strided rows' nonzeros
+                # into the sample buffer — O(block nnz) work, O(sample)
+                # dense memory, never the block_rows x d temp this path
+                # exists to avoid
+                from .sparse_stream import coo_rows
+
+                data, cols, rows = coo_rows(a, lo, hi)
+                sel = (rows % step) == 0
+                n_s = -(-(hi - lo) // step)
+                blk = np.zeros((n_s, a.shape[1]), self.dtype)
+                np.add.at(blk, (rows[sel] // step, cols[sel]),
+                          data[sel])
+            self._profile_fold(blk, strided=True)
+        except Exception:
+            self._profile_enabled = False
 
     def profile_snapshot(self):
         """The training profile as a JSON-safe dict (None when profiling
@@ -924,6 +995,11 @@ class BlockStream:
         st = getattr(self, "stats", None)
         if st is None or self._passes > 2 or self.n_blocks < 16:
             return
+        if self.sparse_plan is not None:
+            # the sparse staging plan (capacities, per-shard nnz) is
+            # keyed to the block partition — a mid-fit resize would
+            # invalidate it
+            return
         if not self._pass_data_bound(st):
             return
         shards = data_shards(self.mesh)
@@ -980,7 +1056,23 @@ class BlockStream:
         if not cfg.stream_superblock:
             return 1
         if any(_is_sparse_source(a) for a in self.arrays):
-            return 1
+            if self.sparse_plan is None:
+                return 1
+            # device-resident sparse blocks stack like dense slabs; the
+            # K byte budget reasons about the bucketed-nnz triples plus
+            # the dense side arrays, not the n x d densification
+            k = self._superblock_k_override or int(cfg.superblock_k)
+            if k <= 0:
+                k = _AUTO_SUPERBLOCK_K
+            dense_bytes = sum(
+                4 * int(np.prod(a.shape[1:], dtype=np.int64) or 1)
+                for a in self.arrays[1:]
+            ) * self.block_rows
+            block_bytes = max(
+                self.sparse_plan.block_bytes() + dense_bytes, 1
+            )
+            budget_k = max(_SUPERBLOCK_BYTES // block_bytes, 1)
+            return int(max(min(k, self.n_blocks, budget_k), 1))
         k = self._superblock_k_override or int(cfg.superblock_k)
         if k <= 0:
             k = _AUTO_SUPERBLOCK_K
@@ -1004,6 +1096,27 @@ class BlockStream:
         """True when super-blocks stage batch-sharded and consumers
         should run their shard_map/psum scan flavor."""
         return self.sb_data_shards() > 1
+
+    def sb_sparse(self) -> bool:
+        """True when super-blocks stage as device-resident bucketed-nnz
+        sparse slabs (``SuperBlock.arrays[0]`` is a SparseSlab) and
+        consumers should run their ``superblock.sparse.*`` flavor."""
+        return self.sparse_plan is not None and self.use_superblocks()
+
+    def _shard_counts_of(self, counts):
+        """(D, K) per-shard valid-row counts: shard s owns rows
+        [s*Sd, (s+1)*Sd) of every block (Sd = block_rows / D — the
+        stream rounds block_rows to a shard multiple), so a ragged
+        tail block fills shard 0..j and pads the rest with ZERO
+        counts, exactly like the ragged final super-block pads its
+        missing block slots."""
+        D = self.sb_data_shards()
+        sd = self.block_rows // D
+        return np.clip(
+            counts[None, :].astype(np.int64)
+            - np.arange(D, dtype=np.int64)[:, None] * sd,
+            0, sd,
+        ).astype(np.int32)
 
     def _put_sharded(self, a, sharding):
         """One batch-sharded ``jax.Array`` from PER-SHARD host slabs,
@@ -1095,6 +1208,12 @@ class BlockStream:
         steps through — block j of super-block i is ``order[i*K + j]``.
         The final super-block pads missing slots with zero counts so
         every dispatch has the identical [K, block_rows, d] shape."""
+        if self.sparse_plan is not None:
+            # device-resident sparse staging (ISSUE 13): bucketed-nnz
+            # COO triples instead of densified slabs, same dispatch /
+            # counts / sharding contract
+            yield from self._superblocks_sparse(order)
+            return
         import time as _time
 
         from ..observability import (record_superblock,
@@ -1196,19 +1315,7 @@ class BlockStream:
                         parts[i].append(slot["bufs"][i][j])
             return (parts if unroll else slot["bufs"]), counts
 
-        def shard_counts_of(counts):
-            """(D, K) per-shard valid-row counts: shard s owns rows
-            [s*Sd, (s+1)*Sd) of every block (Sd = block_rows / D — the
-            stream rounds block_rows to a shard multiple), so a ragged
-            tail block fills shard 0..j and pads the rest with ZERO
-            counts, exactly like the ragged final super-block pads its
-            missing block slots."""
-            sd = self.block_rows // D
-            return np.clip(
-                counts[None, :].astype(np.int64)
-                - np.arange(D, dtype=np.int64)[:, None] * sd,
-                0, sd,
-            ).astype(np.int32)
+        shard_counts_of = self._shard_counts_of
 
         def put(slot, parts, counts, n_real):
             if sharded:
@@ -1463,6 +1570,301 @@ class BlockStream:
         new_k = min(k * 2, cap)
         if new_k > k:
             self._superblock_k_override = new_k
+
+    # -- device-resident sparse staging (ISSUE 13 tentpole) ---------------
+    # A sparse X stages as fixed-shape bucketed-nnz COO triples
+    # (data/cols/rows padded to the plan's capacity) stacked K-deep —
+    # the sparse twin of the dense super-block path: same fixed host
+    # ring, same overlapped staging worker, same counts/shard_counts
+    # and dispatch contract, O(nnz) staged bytes instead of O(S * d).
+
+    def _sp_ring(self, k):
+        plan = self.sparse_plan
+        D = self.sb_data_shards()
+        width = plan.cap * D
+        shape_key = ("sparse", k, self.block_rows, width)
+        ring = getattr(self, "_sparse_ring", None)
+        if ring is not None and self._sparse_ring_key == shape_key:
+            return ring
+        n_slots = self.prefetch + 2
+
+        def slot():
+            return {
+                "data": np.zeros((k, width), np.float32),
+                "cols": np.zeros((k, width), np.int32),
+                "rows": np.zeros((k, width), np.int32),
+                "bufs": [
+                    np.zeros((k, self.block_rows) + a.shape[1:],
+                             self.dtype)
+                    for a in self.arrays[1:]
+                ],
+                "counts": np.zeros(k, np.int32),
+                "dev": None,
+            }
+
+        ring = [slot() for _ in range(n_slots)]
+        self._sparse_ring = ring
+        self._sparse_ring_key = shape_key
+        self._sparse_slot_fn = slot
+        return ring
+
+    def _guard_sparse_slot(self, slot, j, m, counts):
+        """``stream_nonfinite`` for one sparse-staged slot: non-finite
+        VALUES (the dense side arrays are checked too) raise typed or
+        quarantine — data zeroed, count folded to 0, no shape change."""
+        if self._nonfinite == "off" or m == 0:
+            return
+        finite = bool(np.isfinite(slot["data"][j]).all()) and all(
+            bool(np.isfinite(buf[j, :m]).all()) for buf in slot["bufs"]
+        )
+        if finite:
+            return
+        from ..reliability.faults import NonFiniteBlock
+
+        if self._nonfinite == "raise":
+            raise NonFiniteBlock(
+                f"non-finite values in streamed sparse super-block slot "
+                f"{j} ({m} rows; config.stream_nonfinite='raise')"
+            )
+        from ..observability import record_stream_quarantine
+
+        counts[j] = 0
+        slot["data"][j] = 0
+        slot["cols"][j] = 0
+        slot["rows"][j] = 0
+        for buf in slot["bufs"]:
+            buf[j] = 0
+        record_stream_quarantine()
+
+    def _superblocks_sparse(self, order=None):
+        """The sparse flavor of :meth:`superblocks`: one prefetched pass
+        of K-stacked bucketed-nnz slabs. Identical stats keys, span
+        record, fault sites, counts semantics and (on a >1-shard mesh)
+        per-shard staging + ``shard_counts`` — consumers see
+        ``SuperBlock.arrays[0]`` as a :class:`SparseSlab` and select
+        their ``superblock.sparse.*`` scan programs."""
+        import time as _time
+        from collections import deque
+
+        from ..observability import (record_sparse_staging,
+                                     record_superblock, record_transfer,
+                                     span)
+        from ..reliability import faults as _flt
+        from .sparse_stream import SparseSlab, pack_block
+
+        plan = self.sparse_plan
+        k = self.resolve_superblock_k()
+        if order is None:
+            order = np.arange(self.n_blocks)
+            if self.shuffle:
+                self.rng.shuffle(order)
+        order = np.asarray(order, np.int64)
+        n_sb = max(int(np.ceil(len(order) / k)), 1)
+        ring = self._sp_ring(k)
+        D = self.sb_data_shards()
+        sharded = D > 1
+        sd = self.block_rows // D
+        cap = plan.cap
+        sp_sharding = NamedSharding(
+            self.mesh, P(None, DATA_AXIS) if sharded else P()
+        )
+        stats = {"host_s": 0.0, "put_s": 0.0, "wait_s": 0.0,
+                 "consume_s": 0.0, "n_blocks": int(len(order)),
+                 "block_rows": int(self.block_rows),
+                 "superblock_k": int(k),
+                 "sb_shards": int(D),
+                 "dispatches_per_pass": int(n_sb),
+                 "sparse_cap": int(cap)}
+        t_pass = _time.perf_counter()
+        pending = deque()
+
+        def fill(slot, blocks):
+            if slot["dev"] is not None:
+                jax.block_until_ready(slot["dev"])
+                slot["dev"] = None
+            counts = slot["counts"]
+            counts[:] = 0
+            nnz = 0
+            X = self.arrays[0]
+            for j, b in enumerate(blocks):
+                lo = int(b) * self.block_rows
+                hi = min(lo + self.block_rows, self.n_rows)
+                m = hi - lo
+                counts[j] = m
+
+                def pack():
+                    _flt.fire_plan(self._fault_spec, "staging_read")
+                    return pack_block(
+                        X, lo, hi, D, sd, cap, slot["data"][j],
+                        slot["cols"][j], slot["rows"][j],
+                    )
+
+                nnz += self._retry_io(
+                    pack, f"sparse staging read of rows [{lo}, {hi})"
+                )
+                self._profile_fold_sparse(X, lo, hi)
+                for i, a in enumerate(self.arrays[1:], start=1):
+                    buf = slot["bufs"][i - 1]
+                    self._read_block_host(i, a, lo, hi, None,
+                                          out=buf[j])
+                    if m < self.block_rows:
+                        buf[j, m:] = 0
+                self._guard_sparse_slot(slot, j, m, counts)
+            for j in range(len(blocks), k):
+                slot["data"][j] = 0
+                slot["cols"][j] = 0
+                slot["rows"][j] = 0
+                for buf in slot["bufs"]:
+                    buf[j] = 0
+            return nnz
+
+        def put(slot, counts, n_real, nnz):
+            nbytes = (slot["data"].nbytes + slot["cols"].nbytes
+                      + slot["rows"].nbytes
+                      + sum(b.nbytes for b in slot["bufs"])
+                      + counts.nbytes)
+            record_transfer(nbytes)
+            record_sparse_staging(n_real, nnz)
+            if sharded:
+                triple = tuple(
+                    self._put_sharded(slot[name], sp_sharding)
+                    for name in ("data", "cols", "rows")
+                )
+                dense_d = tuple(
+                    self._put_sharded(buf, self._sb_shardings[i + 1])
+                    for i, buf in enumerate(slot["bufs"])
+                )
+                counts_d = jax.device_put(counts, self._counts_sharding)
+                shard_d = self._put_sharded(
+                    self._shard_counts_of(counts),
+                    self._shard_counts_sharding,
+                )
+            else:
+                def putp():
+                    _flt.fire_plan(self._fault_spec, "stream_put")
+                    t = tuple(
+                        jax.device_put(slot[name], sp_sharding)
+                        for name in ("data", "cols", "rows")
+                    )
+                    dd = tuple(
+                        jax.device_put(buf, self._sb_shardings[i + 1])
+                        for i, buf in enumerate(slot["bufs"])
+                    )
+                    return t, dd, jax.device_put(
+                        counts, self._counts_sharding
+                    )
+
+                triple, dense_d, counts_d = self._retry_io(
+                    putp, "sparse device staging put"
+                )
+                shard_d = None
+            slab = SparseSlab(*triple, n_rows=sd,
+                              n_features=plan.n_features, shards=D,
+                              cap=cap)
+            slot["dev"] = triple + dense_d + (counts_d,)
+            return SuperBlock((slab,) + dense_d, counts_d, n_real,
+                              int(counts[:n_real].sum()),
+                              shard_counts=shard_d)
+
+        def produce(i):
+            blocks = order[i * k:(i + 1) * k]
+            slot = self._sparse_slot_fn() if _device_put_aliases() \
+                else ring[i % len(ring)]
+            t0 = _time.perf_counter()
+            nnz = fill(slot, blocks)
+            t1 = _time.perf_counter()
+            stats["host_s"] += t1 - t0
+            sb = put(slot, slot["counts"], len(blocks), nnz)
+            stats["put_s"] += _time.perf_counter() - t1
+            return sb
+
+        def pop():
+            fut = pending.popleft()
+            t0 = _time.perf_counter()
+            sb = fut.result()
+            if measure_wait:
+                jax.block_until_ready(
+                    (sb.arrays[0].data,) + sb.arrays[1:]
+                )
+            stats["wait_s"] += _time.perf_counter() - t0
+            return sb
+
+        def emit(sb):
+            _flt.fire_plan(self._fault_spec, "superblock_dispatch")
+            record_superblock(sb.n_blocks)
+            t_y = _time.perf_counter()
+            yield sb
+            stats["consume_s"] += _time.perf_counter() - t_y
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        staging = ThreadPoolExecutor(max_workers=1)
+        with span("streaming.superblock") as sp_:
+            measure_wait = sp_.recording or getattr(
+                self, "_autotune_pass", False
+            )
+            try:
+                for i in range(n_sb):
+                    pending.append(staging.submit(produce, i))
+                    if len(pending) > self.prefetch:
+                        yield from emit(pop())
+                while pending:
+                    yield from emit(pop())
+            finally:
+                staging.shutdown(wait=True)
+                stats["pass_s"] = _time.perf_counter() - t_pass
+                self.stats = stats
+                self._passes = getattr(self, "_passes", 0) + 1
+                pass_rows = int(sum(
+                    min((int(b) + 1) * self.block_rows, self.n_rows)
+                    - int(b) * self.block_rows
+                    for b in order
+                ))
+                tot = getattr(self, "_epochs_total", None)
+                if tot:
+                    sp_.add(passes_total=int(tot))
+                sp_.add(stream_pass=self._passes,
+                        dispatches=int(n_sb), n_rows=pass_rows,
+                        **{key: (round(v, 6) if isinstance(v, float)
+                                 else v)
+                           for key, v in stats.items()})
+                from . import distributed as dist
+
+                if dist.process_count() > 1:
+                    dist.sync_stream_pass("superblock_pass")
+
+    def sparse_block_put(self, b):
+        """Stage ONE block as a single-slab sparse triple plus the
+        dense side arrays — the grad-accum micro path's per-block
+        staging (single-device placement; the grad-accum flavor merges
+        on host). Returns (SparseSlab, dense device arrays, mask, m)."""
+        from .sparse_stream import SparseSlab, pack_block
+
+        plan = self.sparse_plan
+        cap = plan.cap1
+        lo = int(b) * self.block_rows
+        hi = min(lo + self.block_rows, self.n_rows)
+        m = hi - lo
+        data = np.zeros(cap, np.float32)
+        cols = np.zeros(cap, np.int32)
+        rows = np.zeros(cap, np.int32)
+        pack_block(self.arrays[0], lo, hi, 1, self.block_rows, cap,
+                   data, cols, rows)
+        dense = []
+        for i, a in enumerate(self.arrays[1:], start=1):
+            blk = self._read_block_host(i, a, lo, hi, None)
+            if m < self.block_rows:
+                pad = [(0, self.block_rows - m)] \
+                    + [(0, 0)] * (blk.ndim - 1)
+                blk = np.pad(blk, pad)
+            dense.append(blk)
+        mask = np.zeros(self.block_rows, self.dtype)
+        mask[:m] = 1.0
+        devs = jax.device_put([data, cols, rows] + dense + [mask],
+                              NamedSharding(self.mesh, P()))
+        slab = SparseSlab(*devs[:3], n_rows=self.block_rows,
+                          n_features=plan.n_features, shards=1, cap=cap)
+        return slab, tuple(devs[3:-1]), devs[-1], m
 
 
 def streamed_map(X, block_rows, fn):

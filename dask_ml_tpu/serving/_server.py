@@ -142,6 +142,22 @@ class ModelServer:
         self._next_fold_t = 0.0         # backpressure gate (see _execute)
         self._fns = {m: compiled_batch_fn(estimator, m, device=device)
                      for m in methods}
+        # sparse (CSR-in) entry points (ISSUE 13): linear predict /
+        # decision_function bucketed by (rows, nnz) — built eagerly
+        # (compiles only when called/warmed) so hashed-text traffic
+        # stops paying the host fallback; methods/estimators without a
+        # sparse story simply have no entry here and a sparse submit
+        # refuses typed
+        from ..wrappers import sparse_batch_fn
+
+        self._sparse_fns = {}
+        for m in methods:
+            try:
+                sfn = sparse_batch_fn(estimator, m, device=device)
+            except Exception:
+                sfn = None
+            if sfn is not None:
+                self._sparse_fns[m] = sfn
         # precision-flavor table: "" (float32) plus every flavor named
         # in config.serving_warm_flavors gets its OWN entry-point set,
         # built now and warmed by warmup() — so a registry publish
@@ -325,6 +341,16 @@ class ModelServer:
                 tokens[m] = fn.prepare_swap(estimator)
             except ParamSwapError as exc:
                 raise ParamSwapError(f"method {m!r}: {exc}") from exc
+        # the sparse entry points swap in the same two-phase pass — a
+        # version flip must never leave dense serving v2 while sparse
+        # still serves v1
+        sparse_tokens = {}
+        for m, fn in self._sparse_fns.items():
+            try:
+                sparse_tokens[m] = fn.prepare_swap(estimator)
+            except ParamSwapError as exc:
+                raise ParamSwapError(f"sparse method {m!r}: {exc}") \
+                    from exc
         # canary phase 1 (obs_drift + a warmed server only): score the
         # shadow sample of recent traffic against the OUTGOING params
         # through the already-compiled entry points — the batch rides a
@@ -339,6 +365,8 @@ class ModelServer:
         old_outs = self._canary_pass() if self._drift_on else {}
         for m, fn in fns.items():
             fn.commit_swap(tokens[m])
+        for m, fn in self._sparse_fns.items():
+            fn.commit_swap(sparse_tokens[m])
         # flavor flip is one dict-reference assignment: the worker reads
         # self._fns[method] per batch, so it sees either the complete
         # old flavor or the complete new one
@@ -435,6 +463,22 @@ class ModelServer:
         if warm or (warm is None and self._warmed):
             for fns in table.values():
                 self._warm_fns(fns)
+        # sparse entry points rebuild alongside (fresh shapes) over the
+        # SERVED methods, not the old sparse table — a server whose
+        # previous estimator had no sparse story gains entry points
+        # when the rebuilt one supports them; the (rows, nnz) grid
+        # re-warms lazily or via warmup_sparse()
+        from ..wrappers import sparse_batch_fn
+
+        sparse_table = {}
+        for m in self._fns:
+            try:
+                sfn = sparse_batch_fn(estimator, m, device=self.device)
+            except Exception:
+                sfn = None
+            if sfn is not None:
+                sparse_table[m] = sfn
+        self._sparse_fns = sparse_table
         self._flavor_fns = table
         self._fns = table[flavor]
         self._active_flavor = flavor
@@ -501,6 +545,27 @@ class ModelServer:
             est = est.steps[0][1]
         return getattr(est, "n_features_in_", None)
 
+    def warmup_sparse(self, max_nnz=None):
+        """Compile the sparse entry points' (rows, nnz-bucket) grid —
+        every row rung x every nnz rung (bounded above by
+        ``max_nnz``'s rung when given, so a deployment that knows its
+        traffic density doesn't compile the whole ladder). After this,
+        sparse traffic whose batches stay on the grid mints zero new
+        XLA compiles; over-top-nnz batches spill to the (dense-warmed)
+        densify path."""
+        from ..config import ensure_compile_cache
+
+        ensure_compile_cache()
+        for fn in self._sparse_fns.values():
+            top = fn.nnz_ladder.max_rows if max_nnz is None \
+                else fn.nnz_bucket(min(max_nnz, fn.nnz_ladder.max_rows))
+            for rb in self.ladder:
+                for nb in fn.nnz_ladder:
+                    if nb > top:
+                        break
+                    fn.warm(rb, nb)
+        return self
+
     # -- request plane ----------------------------------------------------
     def submit(self, X, method="predict"):
         """Admit one request; returns a ``concurrent.futures.Future``
@@ -515,6 +580,10 @@ class ModelServer:
             )
         if not self._accepting:
             raise ServerClosed("server is not accepting requests")
+        import scipy.sparse as sp_
+
+        if sp_.issparse(X):
+            return self._submit_sparse(X, method)
         X = np.asarray(X, np.float32)
         if X.ndim == 1:
             X = X[None, :]
@@ -545,6 +614,50 @@ class ModelServer:
                 "max_queue or split the request"
             )
         reqs = [Request(p, method, self.timeout_s) for p in parts]
+        self._admit(reqs)
+        return _gather_futures([r.future for r in reqs])
+
+    def _submit_sparse(self, X, method):
+        """Admit a scipy-sparse request onto the sparse serving lane
+        (ISSUE 13): CSR blocks coalesce with other sparse requests of
+        the same method (never with dense ones — the lane key keeps the
+        batcher's packing homogeneous), bucket by (rows, nnz) and run
+        the warmed sparse entry point; over-nnz batches spill to the
+        densified dense rung. Refuses typed when the served estimator
+        has no sparse entry point for ``method``."""
+        if method not in self._sparse_fns:
+            raise ValueError(
+                f"method {method!r} has no sparse entry point on this "
+                "server (sparse serving covers linear predict / "
+                "decision_function); densify the request or serve a "
+                "linear model"
+            )
+        import scipy.sparse as sp_
+
+        X = X.tocsr() if not sp_.isspmatrix_csr(X) else X
+        if X.ndim != 2 or X.shape[0] == 0:
+            raise ValueError(
+                f"expected a non-empty sparse (n, d) request, got "
+                f"{X.shape}"
+            )
+        want = self._sparse_fns[method].n_features
+        if want is not None and X.shape[1] != want:
+            raise ValueError(
+                f"request has {X.shape[1]} features; the served model "
+                f"expects {want}"
+            )
+        lane = method + "#sparse"
+        top = self.ladder.max_rows
+        if X.shape[0] <= top:
+            return self._admit([Request(X, lane, self.timeout_s)])
+        parts = [X[i:i + top] for i in range(0, X.shape[0], top)]
+        if len(parts) > self.max_queue:
+            raise ValueError(
+                f"request of {X.shape[0]} rows needs {len(parts)} "
+                f"chunks but max_queue={self.max_queue}; raise "
+                "max_queue or split the request"
+            )
+        reqs = [Request(p, lane, self.timeout_s) for p in parts]
         self._admit(reqs)
         return _gather_futures([r.future for r in reqs])
 
@@ -867,6 +980,8 @@ class ModelServer:
                            outs, max_rows=X.shape[0])
 
     def _execute(self, batch):
+        if batch[0].method.endswith("#sparse"):
+            return self._execute_sparse(batch)
         # EVERYTHING from pack to demux sits inside the guard: an
         # exception anywhere (ragged widths slipping past validation,
         # a fallback output that isn't row-sliceable) must fail THIS
@@ -937,6 +1052,75 @@ class ModelServer:
         finally:
             # inflight back to 0 on the failure path too — a failed
             # batch must not leave /metrics showing phantom inflight rows
+            smetrics.set_queue_gauges(self._queue.depth, 0,
+                                      replica=self.replica_id)
+
+    def _execute_sparse(self, batch):
+        """The sparse lane's pack → run → demux (ISSUE 13): vstack the
+        coalesced CSR requests (O(nnz)), pick the (rows, nnz) grid
+        cell, run the sparse entry point, slice per-request rows back
+        out. A batch whose nnz overflows the warmed nnz ladder spills
+        to the DENSE entry point over a densified batch — the dense
+        row rung is already warm, so even the spill mints zero new XLA
+        compiles (serving_sparse_spills counts). Same immortal-worker
+        guard/metrics contract as the dense _execute."""
+        import scipy.sparse as sp_
+
+        try:
+            from ..reliability.faults import fault_point
+
+            fault_point("serving_execute")
+            lane = batch[0].method
+            method = lane[: -len("#sparse")]
+            fn = self._sparse_fns[method]
+            X = batch[0].X if len(batch) == 1 \
+                else sp_.vstack([r.X for r in batch]).tocsr()
+            rows = int(X.shape[0])
+            bucket = self.ladder.bucket_for(rows)
+            smetrics.set_queue_gauges(self._queue.depth, rows,
+                                      replica=self.replica_id)
+            t_exec = time.perf_counter()
+            with smetrics.batch_span(lane, bucket, rows, len(batch),
+                                     self._queue.depth):
+                # the spill decision is an EXPLICIT nnz check, not an
+                # exception catch — a real defect raised from the
+                # sparse entry point must fail the batch typed, never
+                # silently densify every batch forever
+                if int(X.nnz) > fn.nnz_ladder.max_rows:
+                    # nnz over the ladder top: densify THIS batch into
+                    # the warmed dense rung instead of minting a novel
+                    # sparse shape
+                    from ..observability import record_sparse_spill
+
+                    record_sparse_spill()
+                    padded = np.zeros((bucket, X.shape[1]), np.float32)
+                    padded[:rows] = X.toarray()
+                    out = np.asarray(self._fns[method](padded))[:rows]
+                else:
+                    out = fn(X, n_rows=bucket)
+            self._batches += 1
+            smetrics.record_batch(rows, bucket)
+            done = time.perf_counter()
+            self._exec.observe(lane, bucket, done - t_exec)
+            for r in batch:
+                lat = done - r.t_enqueue
+                self._latency.observe(lat)
+                smetrics.observe_request_latency(lane, bucket, lat)
+            out = np.asarray(out)
+            lo = 0
+            for r in batch:
+                f = r.future
+                if f.set_running_or_notify_cancel():
+                    f.set_result(out[lo:lo + r.n_rows])
+                lo += r.n_rows
+        except Exception as exc:
+            for _ in batch:
+                smetrics.record_drop("error")
+            fail_requests(batch, ServingError(
+                f"sparse batch execution failed: "
+                f"{type(exc).__name__}: {exc}"
+            ))
+        finally:
             smetrics.set_queue_gauges(self._queue.depth, 0,
                                       replica=self.replica_id)
 

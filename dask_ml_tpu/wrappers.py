@@ -28,7 +28,8 @@ from .metrics import accuracy_score, r2_score
 from .parallel.sharded import ShardedArray, as_sharded
 
 __all__ = ["ParallelPostFit", "Incremental", "CompiledBatchFn",
-           "compiled_batch_fn", "ParamSwapError"]
+           "compiled_batch_fn", "ParamSwapError", "SparseBatchFn",
+           "sparse_batch_fn"]
 
 
 def _data_shards(mesh):
@@ -669,6 +670,160 @@ def _jit_linear(est, method, device=None, quantize=None):
         params=_put_params(params, device), post=post,
         extract=lambda e: _linear_extract(e, method), sig=sig,
         device=device,
+    )
+
+
+def _sparse_linear_extract(est, method):
+    """The sparse twin of ``_linear_extract``: same params/post, a
+    "linear-sparse"-prefixed signature so a dense entry point can never
+    silently accept a sparse swap (or vice versa). predict /
+    decision_function only — the sparse serving family is the hashed-
+    text linear hot path."""
+    if method not in ("predict", "decision_function"):
+        return None
+    built = _linear_extract(est, method)
+    if built is None:
+        return None
+    params, post, sig = built
+    return params, post, ("linear-sparse",) + tuple(sig[1:])
+
+
+def _sparse_linear_core(kind, multi):
+    """Serving core over a packed bucketed-nnz CSR batch: eta via one
+    gather of the (C,)-wide weight columns per nonzero + a segment_sum
+    over rows (ops/sparse_kernels math inlined on the padded triple) —
+    nnz * C cost instead of B * d * C, which is the whole point at
+    2**14+ hashed-text widths. ``n_rows`` (the row bucket) is static:
+    the compiled set is the warmed (rows, nnz) grid."""
+    import jax
+    import jax.numpy as jnp
+
+    def eta(p, data, cols, rows, n_rows):
+        contrib = data[:, None] * jnp.take(p["W"].T, cols, axis=0)
+        return jax.ops.segment_sum(contrib, rows,
+                                   num_segments=n_rows) \
+            + p["b"][None, :]
+
+    if kind == "margin":
+        if multi:
+            return eta
+        return lambda p, d_, c_, r_, n: eta(p, d_, c_, r_, n)[:, 0]
+    if kind == "classify":
+        if multi:
+            return lambda p, d_, c_, r_, n: jnp.argmax(
+                eta(p, d_, c_, r_, n), axis=1
+            )
+        return lambda p, d_, c_, r_, n: (
+            eta(p, d_, c_, r_, n)[:, 0] > 0
+        ).astype(jnp.int32)
+    if kind == "poisson":
+        return lambda p, d_, c_, r_, n: jnp.exp(
+            eta(p, d_, c_, r_, n)[:, 0]
+        )
+    return lambda p, d_, c_, r_, n: eta(p, d_, c_, r_, n)[:, 0]
+
+
+class SparseBatchFn(CompiledBatchFn):
+    """A fitted linear estimator's ``method`` as a static-shape SPARSE
+    batch function: ``fn(csr)`` takes a scipy CSR block, packs it to
+    the (row-bucket, nnz-bucket) grid — rows padded up the serving
+    ladder, the nnz triple padded up the geometric nnz ladder
+    (``config.serving_sparse_nnz_per_row`` x the batch ladder's
+    min/max, same growth) — and runs ONE compiled program per grid
+    cell. Warm the grid (:meth:`warm`) and ragged hashed-text traffic
+    pays zero steady-state XLA compiles; a batch whose nnz overflows
+    the ladder's top rung raises ``ValueError`` for the caller to spill
+    (ModelServer densifies into the already-warm dense rung). Hot-swap
+    (prepare/commit) is inherited — the "linear-sparse" signature keys
+    the same zero-recompile same-shape contract."""
+
+    __slots__ = ("nnz_ladder",)
+
+    def __init__(self, fn, method, n_features, params=None, post=None,
+                 extract=None, sig=None, device=None, nnz_ladder=None):
+        super().__init__(fn, method, True, n_features, params=params,
+                         post=post, extract=extract, sig=sig,
+                         device=device)
+        self.nnz_ladder = nnz_ladder
+
+    def nnz_bucket(self, nnz: int) -> int:
+        return self.nnz_ladder.bucket_for(max(int(nnz), 1))
+
+    def _pack(self, X):
+        import scipy.sparse as sp
+
+        X = X.tocsr() if not sp.isspmatrix_csr(X) else X
+        n = int(X.shape[0])
+        nnz = int(X.nnz)
+        nb = self.nnz_bucket(nnz)
+        data = np.zeros(nb, np.float32)
+        cols = np.zeros(nb, np.int32)
+        rows = np.zeros(nb, np.int32)
+        data[:nnz] = X.data
+        cols[:nnz] = X.indices
+        rows[:nnz] = np.repeat(
+            np.arange(n, dtype=np.int32), np.diff(X.indptr)
+        )
+        return data, cols, rows, n
+
+    def __call__(self, X, n_rows=None):
+        """Run the packed batch; ``n_rows`` pins the row bucket (the
+        server picks it from the ladder), default = the batch's own
+        rows. Returns the LOGICAL rows only (padding sliced off)."""
+        params, post = self._state
+        data, cols, rows, n = self._pack(X)
+        out = self._fn(params, data, cols, rows,
+                       int(n_rows if n_rows is not None else n))
+        out = _host_out(out)[:n]
+        return post(out) if post is not None else out
+
+    def warm(self, row_bucket: int, nnz_bucket: int):
+        """Compile one (rows, nnz) grid cell now (zero-filled operands
+        — the program depends on shapes only)."""
+        params, _ = self._state
+        self._fn(params, np.zeros(nnz_bucket, np.float32),
+                 np.zeros(nnz_bucket, np.int32),
+                 np.zeros(nnz_bucket, np.int32), int(row_bucket))
+        return self
+
+
+def sparse_batch_fn(estimator, method="predict", device=None):
+    """Build the sparse (CSR-in) serving entry point for a fitted
+    LINEAR estimator's predict / decision_function — the hashed-text
+    twin of :func:`compiled_batch_fn`, bucketed by (rows, nnz) instead
+    of rows alone. Returns None for estimators/methods without a
+    sparse story (pipelines, KMeans/PCA, predict_proba) — callers fall
+    back to the dense path (which densifies per batch)."""
+    est = estimator
+    if not (_is_device_estimator(est) and hasattr(est, "coef_")):
+        return None
+    built = _sparse_linear_extract(est, method)
+    if built is None:
+        return None
+    params, post, sig = built
+    import jax
+
+    from .config import get_config
+    from .serving._buckets import BucketLadder
+
+    cfg = get_config()
+    npr = max(int(cfg.serving_sparse_nnz_per_row), 1)
+    nnz_ladder = BucketLadder(
+        min_rows=max(cfg.serving_min_batch * npr, 1),
+        max_rows=max(cfg.serving_max_batch * npr,
+                     cfg.serving_min_batch * npr, 1),
+        growth=cfg.serving_bucket_growth,
+    )
+    core = _sparse_linear_core(sig[1], sig[2])
+    from .observability import track_program
+
+    name = f"serving.{type(est).__name__}.{method}.sparse"
+    fn = track_program(name)(jax.jit(core, static_argnums=(4,)))
+    return SparseBatchFn(
+        fn, method, params["W"].shape[1],
+        params=_put_params(params, device), post=post,
+        extract=lambda e: _sparse_linear_extract(e, method), sig=sig,
+        device=device, nnz_ladder=nnz_ladder,
     )
 
 

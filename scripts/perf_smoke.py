@@ -209,13 +209,81 @@ def main():
                 "the warm-cache contract"
             )
 
+    # -- sparse section (ISSUE 13): device-resident bucketed-nnz staging
+    # must keep the EXACT dispatch shape (one per super-block — the
+    # stream plan pads every super-block of a fit to one nnz capacity,
+    # so this budget has no +1 slack), pay zero XLA compiles after
+    # pass 1 even though pass 2 shuffles, and the nnz-bucket ladder
+    # must stay small (<= 4 distinct per-block rungs).
+    import scipy.sparse as sp_
+
+    sp_dpp = sp_recompiles = sp_rungs = None
+    rng2 = np.random.RandomState(1)
+    Xsp = sp_.random(32_000, 64, density=0.05, format="csr",
+                     random_state=rng2, dtype=np.float64)
+    ssum = np.asarray(Xsp.sum(axis=1)).ravel()
+    ysp = (ssum > np.median(ssum)).astype(np.float64)
+    with config.set(stream_block_rows=2_000, stream_autotune=False,
+                    stream_mesh=1, stream_sparse=True):
+        sstream = BlockStream((Xsp, ysp.astype(np.float32)),
+                              block_rows=2_000)
+        sp_k = sstream.resolve_superblock_k()
+        sp_blocks = sstream.n_blocks
+        plan = sstream.sparse_plan
+        if plan is None:
+            failures.append(
+                "sparse staging plan did not engage "
+                f"(reason={sstream.sparse_reason})"
+            )
+        else:
+            sp_rungs = len(set(plan.block_buckets))
+            if sp_rungs > 4:
+                failures.append(
+                    f"nnz-bucket ladder used {sp_rungs} > 4 distinct "
+                    "rungs in one pass"
+                )
+        SGDClassifier(max_iter=1, random_state=0, shuffle=True).fit(
+            Xsp, ysp
+        )   # pass 1: warm
+        obs.counters_reset()
+        spc = SGDClassifier(max_iter=2, random_state=0,
+                            shuffle=True).fit(Xsp, ysp)
+        sp_snap = obs.counters_snapshot()
+        sp_st = dict(getattr(spc, "_last_stream_stats", None) or {})
+    sp_dpp = sp_st.get("dispatches_per_pass")
+    sp_recompiles = sp_snap.get("recompiles", 0)
+    if not (spc.solver_info_ or {}).get("sparse_stream"):
+        failures.append(
+            "sparse fit did not engage the device-resident path "
+            f"(reason={(spc.solver_info_ or {}).get('sparse_stream_reason')})"
+        )
+    if sp_dpp != math.ceil(sp_blocks / max(sp_k, 1)):
+        failures.append(
+            f"sparse dispatches_per_pass={sp_dpp} != "
+            f"ceil({sp_blocks}/{sp_k})="
+            f"{math.ceil(sp_blocks / max(sp_k, 1))} — one dispatch per "
+            "super-block with sparse staging"
+        )
+    if sp_recompiles > 0:
+        failures.append(
+            f"{sp_recompiles} new XLA compiles after pass 1 on the "
+            "SPARSE path — one capacity per fit means shuffled passes "
+            "must hit only warm caches"
+        )
+    if sp_snap.get("sparse_blocks_staged", 0) <= 0:
+        failures.append("sparse_blocks_staged counter never moved — "
+                        "blocks did not stage as bucketed-nnz slabs")
+
     print(f"perf smoke: n_blocks={n_blocks} K={k} "
           f"dispatches_per_pass={dpp} (budget {budget}) "
           f"recompiles_after_pass1={recompiles} | sharded: "
           f"shards={sh_shards} dispatches_per_pass={sh_dpp} "
           f"recompiles_after_pass1={sh_recompiles} | fused-sharded: "
           f"dispatches_per_pass={fu_dpp} "
-          f"recompiles_after_pass1={fu_recompiles}")
+          f"recompiles_after_pass1={fu_recompiles} | sparse: "
+          f"dispatches_per_pass={sp_dpp} "
+          f"recompiles_after_pass1={sp_recompiles} "
+          f"ladder_rungs={sp_rungs}")
     if failures:
         for f in failures:
             print(f"PERF SMOKE FAIL: {f}", file=sys.stderr)

@@ -1,0 +1,564 @@
+"""Device-resident sparse blocks (ISSUE 13): bucketed-nnz CSR through
+the superblock scan, the data mesh, and the serving ladder.
+
+Contracts under test, per the tentpole:
+
+- the nnz-bucket ladder is deterministic (same corpus → same per-block
+  rung sequence) and densify fallbacks are decided at PLAN time
+  (over-density corpus, over-bucket-spill block) with reasons recorded;
+- sparse-vs-dense parity 1e-6 for streamed GLM/SGD/KMeans on the same
+  data/partition at mesh {1, 2} — per-pass sums for GLM (line-search
+  trajectories amplify float dust), full-fit weights for SGD/KMeans;
+- the superblock contract holds for sparse: one dispatch per
+  super-block, zero XLA compiles after pass 1 (one capacity per fit —
+  shuffling can't mint shapes), donation intact, and ``solver_info_``
+  records the sparse flavor + fallback reason;
+- ``config.stream_sparse`` off keeps today's per-block densify path
+  (K == 1) and dense inputs are untouched either way;
+- serving: the sparse (rows, nnz)-bucketed linear entry points agree
+  with dense predict through a warmed grid at zero steady-state
+  compiles, over-nnz batches spill to the warm densified rung.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax.numpy as jnp
+
+from dask_ml_tpu import config
+from dask_ml_tpu import observability as obs
+from dask_ml_tpu.parallel.streaming import BlockStream
+from dask_ml_tpu.parallel.sparse_stream import (SparseSlab,
+                                                plan_sparse_stream)
+
+
+def _rand_csr(n, d, density=0.1, seed=0):
+    rng = np.random.RandomState(seed)
+    return sp.random(n, d, density=density, format="csr",
+                     random_state=rng, dtype=np.float64)
+
+
+def _xy(n=660, d=20, density=0.2, seed=3):
+    Xs = _rand_csr(n, d, density=density, seed=seed)
+    s = np.asarray(Xs.sum(axis=1)).ravel()
+    y = (s > np.median(s)).astype(np.float64)
+    return Xs, y
+
+
+class TestPlanAndLadder:
+    def test_bucket_sequence_deterministic(self):
+        Xs, _ = _xy(500, 16)
+        p1 = plan_sparse_stream(Xs, 96, 1, 0.5)
+        p2 = plan_sparse_stream(Xs.copy(), 96, 1, 0.5)
+        assert p1.block_buckets == p2.block_buckets
+        assert p1.cap == p2.cap and p1.engaged
+        # rungs are geometric: at most a handful of distinct shapes
+        assert len(set(p1.block_buckets)) <= 4
+
+    def test_over_density_falls_back(self):
+        Xs, y = _xy(400, 8, density=0.9, seed=1)
+        with config.set(stream_sparse=True, stream_mesh=1,
+                        stream_block_rows=96):
+            s = BlockStream((Xs, y.astype(np.float32)), block_rows=96)
+            assert s.sparse_plan is None
+            assert "density" in s.sparse_reason
+            assert s.resolve_superblock_k() == 1  # today's densify path
+
+    def test_over_bucket_spill_block_falls_back(self):
+        # one near-dense block inside an otherwise sparse corpus
+        Xs = _rand_csr(300, 16, density=0.02, seed=2).tolil()
+        Xs[100:140, :] = 1.0
+        Xs = Xs.tocsr()
+        plan = plan_sparse_stream(Xs, 96, 1, 0.25)
+        assert not plan.engaged
+        assert "spill" in plan.reason
+
+    def test_default_off_keeps_todays_path(self):
+        Xs, y = _xy()
+        with config.set(stream_mesh=1, stream_block_rows=96):
+            s = BlockStream((Xs, y.astype(np.float32)), block_rows=96)
+            assert s.sparse_plan is None
+            assert s.sparse_reason == "stream-sparse-off"
+            assert s.resolve_superblock_k() == 1
+
+    def test_normalizes_to_csr_once(self):
+        # satellite: block loops normalize via as_row_sliceable ONCE —
+        # the stream holds CSR, never re-converting per slice
+        Xs, y = _xy()
+        with config.set(stream_sparse=True, stream_mesh=1):
+            s = BlockStream((Xs.tocsc(), y.astype(np.float32)),
+                            block_rows=96)
+            assert sp.isspmatrix_csr(s.arrays[0])
+            assert s.sparse_plan is not None
+
+
+class TestSparseStaging:
+    @pytest.mark.parametrize("mesh_n", [1, 2])
+    def test_staged_slabs_reconstruct_dense(self, mesh_n):
+        # 660 rows / 96-row blocks: ragged tail block AND ragged final
+        # super-block both exercised
+        Xs, y = _xy(660, 12)
+        dense = Xs.toarray().astype(np.float32)
+        with config.set(stream_sparse=True, stream_mesh=mesh_n,
+                        stream_block_rows=96, superblock_k=3):
+            s = BlockStream((Xs, y.astype(np.float32)), block_rows=96)
+            D = s.sb_data_shards()
+            out = np.zeros_like(dense)
+            bi = 0
+            for sb in s.superblocks():
+                slab = sb.arrays[0]
+                assert isinstance(slab, SparseSlab)
+                data = np.asarray(slab.data)
+                cols = np.asarray(slab.cols)
+                rows = np.asarray(slab.rows)
+                cts = np.asarray(sb.counts)
+                for j in range(sb.n_blocks):
+                    blk = np.zeros((s.block_rows, Xs.shape[1]),
+                                   np.float32)
+                    for sh in range(D):
+                        seg = slice(sh * slab.cap, (sh + 1) * slab.cap)
+                        np.add.at(
+                            blk,
+                            (rows[j, seg] + sh * slab.n_rows,
+                             cols[j, seg]),
+                            data[j, seg],
+                        )
+                    lo = bi * s.block_rows
+                    out[lo:lo + cts[j]] = blk[:cts[j]]
+                    bi += 1
+            np.testing.assert_allclose(out, dense, atol=1e-6)
+
+    def test_dispatches_and_counters(self):
+        Xs, y = _xy(660, 12)
+        obs.counters_reset()
+        with config.set(stream_sparse=True, stream_mesh=1,
+                        stream_block_rows=96, superblock_k=3):
+            s = BlockStream((Xs, y.astype(np.float32)), block_rows=96)
+            n = sum(1 for _ in s.superblocks())
+        assert n == 3 == s.stats["dispatches_per_pass"]
+        snap = obs.counters_snapshot()
+        assert snap.get("sparse_blocks_staged", 0) == s.n_blocks
+        assert snap.get("sparse_nnz_staged", 0) == Xs.nnz
+
+    def test_nonfinite_quarantine_and_raise(self):
+        Xs, y = _xy(300, 10)
+        Xbad = Xs.copy()
+        Xbad.data[5] = np.nan
+        from dask_ml_tpu.reliability.faults import NonFiniteBlock
+
+        with config.set(stream_sparse=True, stream_mesh=1,
+                        stream_nonfinite="raise"):
+            s = BlockStream((Xbad, y.astype(np.float32)), block_rows=96)
+            with pytest.raises(NonFiniteBlock):
+                list(s.superblocks())
+        with config.set(stream_sparse=True, stream_mesh=1,
+                        stream_nonfinite="quarantine"):
+            s = BlockStream((Xbad, y.astype(np.float32)), block_rows=96)
+            counts = np.concatenate([
+                np.asarray(sb.counts)[: sb.n_blocks]
+                for sb in s.superblocks()
+            ])
+            assert counts[0] == 0               # poisoned block dropped
+            assert (counts[1:] > 0).all()
+
+
+class TestGLMParity:
+    @pytest.mark.parametrize("mesh_n", [1, 2])
+    def test_per_pass_sums_match_dense(self, mesh_n):
+        from dask_ml_tpu.models.solvers.streamed import StreamedObjective
+
+        Xs, y = _xy(660, 16)
+        beta = np.random.RandomState(0).randn(17).astype(np.float64)
+
+        def objective(src, sparse_on):
+            with config.set(stream_sparse=sparse_on, stream_mesh=mesh_n,
+                            stream_block_rows=96):
+                stream = BlockStream((src, y.astype(np.float32)),
+                                     block_rows=96)
+                o = StreamedObjective(
+                    stream, Xs.shape[0], jnp.asarray(0.1, jnp.float32),
+                    jnp.ones(17), 0.5, "logistic", "l2", True,
+                )
+                v, g = o.value_and_grad(beta)
+                vv, gg, h = o.value_and_grad_and_hess(beta)
+            return v, g, h
+
+        v_d, g_d, h_d = objective(Xs.toarray().astype(np.float32), False)
+        v_s, g_s, h_s = objective(Xs, True)
+        assert abs(v_d - v_s) <= 1e-6 * max(abs(v_d), 1.0)
+        np.testing.assert_allclose(g_s, g_d, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(h_s, h_d, rtol=1e-5, atol=1e-6)
+
+    def test_newton_fit_parity_and_info(self):
+        from dask_ml_tpu.linear_model import LogisticRegression
+
+        Xs, y = _xy(600, 14)
+        with config.set(stream_block_rows=96, stream_mesh=1):
+            ref = LogisticRegression(solver="newton", max_iter=8).fit(
+                Xs.toarray(), y
+            )
+        with config.set(stream_block_rows=96, stream_mesh=1,
+                        stream_sparse=True):
+            got = LogisticRegression(solver="newton", max_iter=8).fit(
+                Xs, y
+            )
+        np.testing.assert_allclose(got.coef_, ref.coef_, rtol=1e-5,
+                                   atol=1e-6)
+        info = got.solver_info_
+        assert info["sparse_stream"] is True
+        assert info["sparse_stream_reason"] is None
+        assert info["fused_stream_reason"] == "sparse-stream"
+
+    def test_fallback_reasons_recorded(self):
+        from dask_ml_tpu.linear_model import LogisticRegression
+
+        Xs, y = _xy(600, 14)
+        # knob off: sparse_stream False, reason names the knob
+        with config.set(stream_block_rows=96, stream_mesh=1):
+            off = LogisticRegression(solver="lbfgs", max_iter=3).fit(
+                Xs, y
+            )
+        assert off.solver_info_["sparse_stream"] is False
+        assert off.solver_info_["sparse_stream_reason"] \
+            == "stream-sparse-off"
+        # admm keeps the per-block densify loop, reason on record
+        with config.set(stream_block_rows=96, stream_mesh=1,
+                        stream_sparse=True):
+            adm = LogisticRegression(solver="admm", max_iter=3).fit(
+                Xs, y
+            )
+        assert adm.solver_info_["sparse_stream"] is False
+        assert adm.solver_info_["sparse_stream_reason"] \
+            == "admm-local-newton"
+
+    def test_dense_inputs_untouched_by_knob(self):
+        from dask_ml_tpu.linear_model import LogisticRegression
+
+        Xs, y = _xy(600, 14)
+        Xd = Xs.toarray()
+        with config.set(stream_block_rows=96, stream_mesh=1):
+            a = LogisticRegression(solver="lbfgs", max_iter=5).fit(Xd, y)
+        with config.set(stream_block_rows=96, stream_mesh=1,
+                        stream_sparse=True):
+            b = LogisticRegression(solver="lbfgs", max_iter=5).fit(Xd, y)
+        np.testing.assert_array_equal(a.coef_, b.coef_)
+        assert b.solver_info_["sparse_stream_reason"] == "dense-source"
+
+
+class TestSGDParity:
+    @pytest.mark.parametrize("mesh_n", [1, 2])
+    def test_fit_parity(self, mesh_n):
+        from dask_ml_tpu.models.sgd import SGDClassifier
+
+        Xs, y = _xy(660, 18)
+        kw = dict(loss="log_loss", random_state=0, shuffle=False,
+                  max_iter=2)
+        with config.set(stream_block_rows=96, stream_mesh=mesh_n):
+            ref = SGDClassifier(**kw).fit(
+                Xs.toarray().astype(np.float32), y
+            )
+        with config.set(stream_block_rows=96, stream_mesh=mesh_n,
+                        stream_sparse=True):
+            got = SGDClassifier(**kw).fit(Xs, y)
+        np.testing.assert_allclose(got.coef_, ref.coef_, rtol=1e-6,
+                                   atol=1e-6)
+        assert got.solver_info_["sparse_stream"] is True
+
+    def test_multiclass_and_shuffled(self):
+        from dask_ml_tpu.models.sgd import SGDClassifier
+
+        Xs, _ = _xy(660, 18)
+        s = np.asarray(Xs.sum(axis=1)).ravel()
+        y3 = ((s > np.percentile(s, 66)).astype(int)
+              + (s > np.percentile(s, 33)).astype(int)).astype(float)
+        kw = dict(loss="log_loss", random_state=7, shuffle=True,
+                  max_iter=2)
+        with config.set(stream_block_rows=96, stream_mesh=1):
+            ref = SGDClassifier(**kw).fit(
+                Xs.toarray().astype(np.float32), y3
+            )
+        with config.set(stream_block_rows=96, stream_mesh=1,
+                        stream_sparse=True):
+            got = SGDClassifier(**kw).fit(Xs, y3)
+        np.testing.assert_allclose(got.coef_, ref.coef_, rtol=1e-6,
+                                   atol=1e-6)
+
+    def test_grad_accum_sparse_micro(self):
+        from dask_ml_tpu.models.sgd import SGDClassifier
+
+        Xs, y = _xy(480, 16)
+        kw = dict(loss="log_loss", random_state=0, shuffle=False,
+                  max_iter=2)
+        with config.set(stream_block_rows=96, stream_mesh=1,
+                        stream_grad_accum=2):
+            ref = SGDClassifier(**kw).fit(
+                Xs.toarray().astype(np.float32), y
+            )
+        with config.set(stream_block_rows=96, stream_mesh=1,
+                        stream_grad_accum=2, stream_sparse=True):
+            got = SGDClassifier(**kw).fit(Xs, y)
+        np.testing.assert_allclose(got.coef_, ref.coef_, rtol=1e-6,
+                                   atol=1e-6)
+        assert got.solver_info_["sparse_stream"] is True
+        assert got.solver_info_["grad_accum"] == 2
+
+    def test_incremental_stream_pass_sparse(self):
+        from dask_ml_tpu.models.sgd import SGDClassifier
+        from dask_ml_tpu.wrappers import Incremental
+
+        Xs, y = _xy(480, 16)
+        kw = dict(loss="log_loss", random_state=0, shuffle=False,
+                  max_iter=2)
+        with config.set(stream_block_rows=96, stream_mesh=1):
+            ref = Incremental(SGDClassifier(**kw),
+                              shuffle_blocks=False).fit(Xs.toarray(), y)
+        with config.set(stream_block_rows=96, stream_mesh=1,
+                        stream_sparse=True):
+            got = Incremental(SGDClassifier(**kw),
+                              shuffle_blocks=False).fit(Xs, y)
+        np.testing.assert_allclose(
+            got.estimator_.coef_, ref.estimator_.coef_, rtol=1e-6,
+            atol=1e-6,
+        )
+        assert getattr(got.estimator_, "_sparse_stream", False)
+
+    def test_zero_compiles_after_pass1_and_dispatches(self):
+        from dask_ml_tpu.models.sgd import SGDClassifier
+
+        Xs, y = _xy(660, 18)
+        kw = dict(loss="log_loss", random_state=0, shuffle=True,
+                  max_iter=1)
+        with config.set(stream_block_rows=96, stream_mesh=1,
+                        stream_sparse=True, superblock_k=3):
+            SGDClassifier(**kw).fit(Xs, y)     # pass 1: warm
+            obs.counters_reset()
+            clf = SGDClassifier(**dict(kw, max_iter=3)).fit(Xs, y)
+            snap = obs.counters_snapshot()
+        assert snap.get("recompiles", 0) == 0
+        st = clf._last_stream_stats
+        assert st["dispatches_per_pass"] == -(-st["n_blocks"] // 3)
+        assert snap.get("superblock_dispatches", 0) > 0
+        assert snap.get("superblock_donations", 0) > 0
+
+
+class TestKMeansParity:
+    @pytest.mark.parametrize("mesh_n", [1, 2])
+    def test_lloyd_parity(self, mesh_n):
+        from dask_ml_tpu.models.kmeans import KMeans
+
+        rng = np.random.RandomState(0)
+        X = _rand_csr(600, 16, density=0.15, seed=0).toarray()
+        X[:200, 0] += 5
+        X[200:400, 1] += 5
+        X[400:, 2] += 5
+        Xs = sp.csr_matrix(X)
+        kw = dict(n_clusters=3, init="k-means||", random_state=0,
+                  max_iter=6)
+        with config.set(stream_block_rows=96, stream_mesh=mesh_n):
+            ref = KMeans(**kw).fit(X.astype(np.float32))
+        with config.set(stream_block_rows=96, stream_mesh=mesh_n,
+                        stream_sparse=True):
+            got = KMeans(**kw).fit(Xs)
+        np.testing.assert_allclose(
+            np.sort(got.cluster_centers_, axis=0),
+            np.sort(ref.cluster_centers_, axis=0),
+            rtol=1e-5, atol=1e-6,
+        )
+
+
+class TestSparseServing:
+    def _fit(self, d=48, n=400, density=0.1):
+        from dask_ml_tpu.models.sgd import SGDClassifier
+
+        Xs, y = _xy(n, d, density=density, seed=11)
+        clf = SGDClassifier(loss="log_loss", random_state=0,
+                            max_iter=3).fit(
+            Xs.toarray().astype(np.float32), y
+        )
+        return clf, Xs
+
+    def test_standalone_agreement(self):
+        from dask_ml_tpu.wrappers import sparse_batch_fn
+
+        clf, Xs = self._fit()
+        q = Xs[:37].tocsr()
+        fn = sparse_batch_fn(clf, "predict")
+        np.testing.assert_array_equal(
+            fn(q), clf.predict(q.toarray().astype(np.float32))
+        )
+        df = sparse_batch_fn(clf, "decision_function")
+        np.testing.assert_allclose(
+            df(q),
+            clf.decision_function(q.toarray().astype(np.float32)),
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_unsupported_returns_none(self):
+        from sklearn.linear_model import LogisticRegression as SkLR
+
+        from dask_ml_tpu.wrappers import sparse_batch_fn
+
+        clf, _ = self._fit()
+        assert sparse_batch_fn(clf, "predict_proba") is None
+        host = SkLR()
+        assert sparse_batch_fn(host, "predict") is None
+
+    def test_warmed_grid_zero_compiles(self):
+        from dask_ml_tpu.serving import ModelServer
+
+        clf, Xs = self._fit()
+        rng = np.random.RandomState(0)
+        with config.set(serving_min_batch=8, serving_max_batch=64,
+                        serving_sparse_nnz_per_row=16):
+            srv = ModelServer(clf, methods=("predict",))
+            srv.warmup()
+            srv.warmup_sparse()
+            subs = [
+                Xs[rng.randint(0, Xs.shape[0],
+                               int(rng.randint(1, 60)))].tocsr()
+                for _ in range(25)
+            ]
+            wants = [
+                clf.predict(s.toarray().astype(np.float32))
+                for s in subs
+            ]
+            with srv:
+                obs.counters_reset()
+                futs = [srv.submit(s, method="predict") for s in subs]
+                for f, w in zip(futs, wants):
+                    np.testing.assert_array_equal(f.result(30), w)
+                snap = obs.counters_snapshot()
+        assert snap.get("recompiles", 0) == 0
+
+    def test_over_nnz_spills_to_dense_rung(self):
+        from dask_ml_tpu.serving import ModelServer
+
+        clf, Xs = self._fit(density=0.1)
+        dense_q = sp.csr_matrix(
+            np.random.RandomState(1).rand(32, 48).astype(np.float32)
+        )   # nnz = 32*48 > top rung (64 * 16)
+        with config.set(serving_min_batch=8, serving_max_batch=64,
+                        serving_sparse_nnz_per_row=16):
+            srv = ModelServer(clf, methods=("predict",))
+            srv.warmup()
+            srv.warmup_sparse()
+            with srv:
+                obs.counters_reset()
+                got = srv.submit(dense_q, method="predict").result(30)
+                snap = obs.counters_snapshot()
+        np.testing.assert_array_equal(
+            got, clf.predict(dense_q.toarray())
+        )
+        assert snap.get("serving_sparse_spills", 0) == 1
+        assert snap.get("recompiles", 0) == 0  # dense rung was warm
+
+    def test_swap_keeps_sparse_lane_current(self):
+        from dask_ml_tpu.models.sgd import SGDClassifier
+        from dask_ml_tpu.serving import ModelServer
+
+        clf, Xs = self._fit()
+        y2 = (np.arange(Xs.shape[0]) % 2).astype(np.float64)
+        clf2 = SGDClassifier(loss="log_loss", random_state=1,
+                             max_iter=2).fit(
+            Xs.toarray().astype(np.float32), y2
+        )
+        with config.set(serving_min_batch=8, serving_max_batch=64,
+                        serving_sparse_nnz_per_row=16):
+            srv = ModelServer(clf, methods=("predict",))
+            srv.warmup()
+            srv.warmup_sparse()
+            with srv:
+                srv.swap_model(clf2)
+                got = srv.submit(Xs[:9].tocsr(),
+                                 method="predict").result(30)
+        np.testing.assert_array_equal(
+            got, clf2.predict(Xs[:9].toarray().astype(np.float32))
+        )
+
+    def test_sparse_submit_refuses_without_entry_point(self):
+        from dask_ml_tpu.models.kmeans import KMeans
+        from dask_ml_tpu.serving import ModelServer
+
+        X = np.random.RandomState(0).rand(200, 8).astype(np.float32)
+        km = KMeans(n_clusters=3, random_state=0, max_iter=5).fit(X)
+        srv = ModelServer(km, methods=("predict",))
+        with srv:
+            with pytest.raises(ValueError, match="sparse entry point"):
+                srv.submit(sp.csr_matrix(X[:5]), method="predict")
+
+
+class TestProducersAndProfile:
+    def test_transform_blocks_and_sparse(self):
+        from dask_ml_tpu.feature_extraction.text import HashingVectorizer
+        from dask_ml_tpu.parallel.streaming import SparseBlocks
+
+        docs = [f"w{i % 40} w{(i * 7) % 40} w{(i * 3) % 40}"
+                for i in range(500)]
+        hv = HashingVectorizer(n_features=2 ** 10)
+        blocks = list(hv.transform_blocks(docs, block_size=128))
+        assert all(sp.isspmatrix_csr(b) for b in blocks)
+        assert sum(b.shape[0] for b in blocks) == 500
+        sb = hv.transform_sparse(docs, block_size=128)
+        assert isinstance(sb, SparseBlocks)
+        np.testing.assert_allclose(
+            sb.tocsr().toarray(), hv.transform(docs).toarray()
+        )
+
+    def test_hashing_to_streamed_fit_device_sparse(self):
+        from dask_ml_tpu.feature_extraction.text import HashingVectorizer
+        from dask_ml_tpu.models.sgd import SGDClassifier
+
+        rng = np.random.RandomState(7)
+        vocab = [f"w{i}" for i in range(300)]
+        docs, labels = [], []
+        for i in range(400):
+            cls = i % 2
+            lo = 0 if cls == 0 else 100
+            docs.append(" ".join(rng.choice(vocab[lo:lo + 200],
+                                            size=12)))
+            labels.append(cls)
+        y = np.asarray(labels, np.float64)
+        hv = HashingVectorizer(n_features=2 ** 12)
+        sb = hv.transform_sparse(docs, block_size=100)
+        with config.set(stream_sparse=True, stream_mesh=1,
+                        stream_block_rows=100):
+            clf = SGDClassifier(loss="log_loss", random_state=0,
+                                max_iter=5, shuffle=False).fit(sb, y)
+            assert clf.solver_info_["sparse_stream"] is True
+            # predict streams on the same mesh the fit committed its
+            # weights to (the general fit-then-predict mesh contract)
+            acc = (clf.predict(sb) == y).mean()
+        assert acc > 0.9
+
+    def test_to_sharded_dense_budget_guard(self):
+        from dask_ml_tpu.feature_extraction.text import (
+            DenseBudgetExceeded, to_sharded_dense)
+
+        wide = _rand_csr(4000, 4096, density=0.001, seed=0)
+        with config.set(to_dense_byte_budget=1 << 20):
+            with pytest.raises(DenseBudgetExceeded,
+                               match="stream_sparse"):
+                to_sharded_dense(wide)
+        # small corpora still densify
+        small = _rand_csr(16, 8, density=0.5, seed=0)
+        assert to_sharded_dense(small).shape == (16, 8)
+
+    def test_profile_lifted_for_narrow_sparse(self):
+        Xs, y = _xy(480, 16)
+        with config.set(stream_sparse=True, stream_mesh=1):
+            s = BlockStream((Xs, y.astype(np.float32)), block_rows=96)
+            for _ in s.superblocks():
+                pass
+            prof = s.profile_snapshot()
+        assert s.profile_reason is None
+        assert prof is not None and prof["rows"] == 480
+
+    def test_profile_wide_sparse_keeps_opt_out(self):
+        wide = _rand_csr(200, 4096, density=0.01, seed=1)
+        with config.set(stream_sparse=True, stream_mesh=1):
+            s = BlockStream((wide,), block_rows=64)
+            for _ in s.superblocks():
+                pass
+        assert s.profile_reason == "sparse-wide(d=4096)"
+        assert s.profile_snapshot() is None
