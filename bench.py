@@ -1305,61 +1305,108 @@ def _bench_int8_serving(jax, on_tpu, n_chips):
 
 
 def _bench_hyperband(jax, on_tpu, n_chips):
-    """BASELINE configs[4]: HyperbandSearchCV wall clock over
-    device-resident SGD trials (vmapped cohort steps: N models advance in
-    one program)."""
+    """BASELINE configs[4]: HyperbandSearchCV wall clock. Since ISSUE
+    14 the search cohort rides the streamed superblock plane (one
+    BlockStream pass per adaptive round, slot-rung scans); the section
+    times BOTH planes over the SAME host data and block partition —
+    ``hyperband_seconds`` records the default (streamed) path,
+    ``hyperband_device_plane_seconds`` the ``search_stream=False``
+    device-resident cohort machinery it replaced, and the ratio is the
+    honest A/B on identical bracket schedules (scores asserted equal).
+    On this repo's 2-core CPU box the ratio is recorded as measured
+    (~1.4-1.7x steady state — the streamed plane removes the device
+    plane's per-round as_sharded+stack copies but shares its XLA step
+    kernels); the >=2x regime is real TPU, where the fused cohort
+    kernels engage and the removed copies are genuine HBM DMA —
+    asserted by tpu_smoke round-13, like every other on-chip claim.
+    ``hyperband_rows_per_sec`` + ``n_candidates`` land in the metrics
+    so bench_sentinel can seed floors for the search plane."""
     import time
 
-    import jax.numpy as jnp
-
+    from dask_ml_tpu import config
     from dask_ml_tpu.model_selection import HyperbandSearchCV
     from dask_ml_tpu.models.sgd import SGDClassifier
-    from dask_ml_tpu.parallel import as_sharded
 
-    n = 200_000 if on_tpu else 30_000
-    d = 64
-    key = jax.random.PRNGKey(4)
+    n = 400_000
+    d = 128
+    rng = np.random.RandomState(4)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X[:, 0] + 0.5 * rng.randn(n) > 0).astype(np.float32)
+    params = {"alpha": [1e-5, 3e-5, 1e-4, 3e-4, 1e-3, 1e-2],
+              "eta0": [0.01, 0.03, 0.05, 0.1, 0.3, 0.5]}
 
-    @jax.jit
-    def gen():
-        kx, ky = jax.random.split(key)
-        X = jax.random.normal(kx, (n, d), jnp.float32)
-        y = (X[:, 0] + 0.5 * jax.random.normal(ky, (n,)) > 0).astype(
-            jnp.float32
-        )
-        return X, y
-
-    X, y = jax.block_until_ready(gen())
-    Xs, ys = as_sharded(X), as_sharded(y)
-    params = {"alpha": [1e-5, 1e-4, 1e-3, 1e-2],
-              "eta0": [0.01, 0.05, 0.1, 0.5]}
-
-    def run_search():
-        search = HyperbandSearchCV(
-            SGDClassifier(tol=1e-3, random_state=0), params,
-            max_iter=9, aggressiveness=3, random_state=0,
-        )
-        search.fit(Xs, ys, classes=[0.0, 1.0])
+    def run_search(streamed):
+        with config.set(search_stream=streamed):
+            search = HyperbandSearchCV(
+                SGDClassifier(tol=1e-3, random_state=0), params,
+                max_iter=27, aggressiveness=3, random_state=0,
+            )
+            search.fit(X, y, classes=[0.0, 1.0])
         return search
 
-    run_search()  # compile warmup: the metric is the warm search
-    t0 = time.perf_counter()
-    search = run_search()
-    elapsed = time.perf_counter() - t0
+    def timed(streamed):
+        run_search(streamed)  # compile warmup: the metric is warm
+        t0 = time.perf_counter()
+        search = run_search(streamed)
+        return search, time.perf_counter() - t0
+
+    search, elapsed = timed(True)
+    dev_search, dev_elapsed = timed(False)
+    assert search.best_params_ == dev_search.best_params_ and \
+        abs(search.best_score_ - dev_search.best_score_) <= 1e-6, (
+        "streamed vs device-plane Hyperband diverged — the ratio "
+        "below would compare different searches"
+    )
     n_trials = len(search.cv_results_["params"])
     total_pf = int(np.sum(search.cv_results_["partial_fit_calls"]))
-    return {
+    meta = search.metadata_["stream"]
+    # a fallback run must never seed streamed-named floors (same rule
+    # as the sparse section): fail the section loudly instead
+    assert meta.get("streamed"), (
+        "hyperband bench did not engage the streamed cohort plane "
+        f"(metadata: {meta}) — refusing to record streamed metrics "
+        "from a device-plane run"
+    )
+    # rows the bracket actually touched: every partial_fit call trains
+    # one block of the shared stream partition
+    rows_touched = total_pf * meta["block_rows"]
+    backend = jax.default_backend()
+    head = {
         "metric": "hyperband_seconds",
         "value": round(elapsed, 3),
         "unit": "s",
-        "backend": jax.default_backend(),
+        "backend": backend,
         "dtype": "float32",
         "n_rows": n,
         "n_features": d,
         "n_trials": n_trials,
+        "n_candidates": n_trials,
         "partial_fit_calls": total_pf,
         "best_score": round(float(search.best_score_), 4),
+        "stream_plane": {k: meta[k] for k in
+                         ("n_blocks", "block_rows", "n_slots",
+                          "dispatches", "shards", "sparse", "fused")},
+        "device_plane_seconds": round(dev_elapsed, 3),
+        "vs_device_plane": round(dev_elapsed / elapsed, 3),
     }
+    rate = {
+        "metric": "hyperband_rows_per_sec",
+        "value": round(rows_touched / elapsed, 1),
+        "unit": "rows/s",
+        "backend": backend,
+        "dtype": "float32",
+        "n_candidates": n_trials,
+        "rows_touched": int(rows_touched),
+    }
+    dev = {
+        "metric": "hyperband_device_plane_seconds",
+        "value": round(dev_elapsed, 3),
+        "unit": "s",
+        "backend": backend,
+        "dtype": "float32",
+        "n_candidates": n_trials,
+    }
+    return [head, rate, dev]
 
 
 def _bench_serving(jax, on_tpu, n_chips):

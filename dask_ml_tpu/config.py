@@ -97,15 +97,30 @@ class Config:
     # bucketed-nnz blocks: values/column-indices/row-ids padded to a
     # geometric nnz-bucket ladder and consumed by sparse superblock
     # scan programs (take/segment_sum — nnz-proportional cost) instead
-    # of densifying every block on host to n x d. Off (the default this
-    # round) keeps today's per-block densify path byte-identical; on, a
-    # sparse source whose density stays under
-    # ``stream_sparse_max_density`` runs GLM val/vg/vgh, streamed SGD
-    # (incl. multiclass and grad-accum) and KMeans assign-stats through
-    # the ``superblock.sparse.*`` programs with the same one-dispatch-
-    # per-super-block / zero-compiles-after-pass-1 / donation contracts
-    # as the dense scan. Dense inputs are untouched either way
-    stream_sparse: bool = False
+    # of densifying every block on host to n x d. ON by default
+    # (ROADMAP 4a — flipped after the PR-13 parity suite held a round
+    # and grew two more shapes): a sparse source whose density stays
+    # under ``stream_sparse_max_density`` runs GLM val/vg/vgh, streamed
+    # SGD (incl. multiclass, grad-accum and the search cohort scans)
+    # and KMeans assign-stats through the ``superblock.sparse.*``
+    # programs with the same one-dispatch-per-super-block /
+    # zero-compiles-after-pass-1 / donation contracts as the dense
+    # scan; over-density sources keep the per-block densify path with
+    # the reason recorded (solver_info_["sparse_stream_reason"]). Off
+    # restores the per-block densify path byte-identically. Dense
+    # inputs are untouched either way
+    stream_sparse: bool = True
+    # streamed adaptive-search cohort rounds (model_selection): a
+    # Hyperband/IncrementalSearchCV round over host-resident X advances
+    # ALL surviving candidates through ONE BlockStream superblock pass
+    # — each super-block is one dispatch whose donated carry holds the
+    # stacked cohort weights (padded to the search's candidate count,
+    # so shrinking brackets reuse one compiled scan), composing with
+    # the stream mesh (shard_map + psum twins), the bucketed-nnz sparse
+    # format and the fused Pallas bodies. Off keeps the SAME block
+    # partition but executes rounds through the device-resident cohort
+    # machinery — the A/B bench.py records
+    search_stream: bool = True
     # automatic densify fallback threshold for the sparse streamed
     # path: a source whose overall nnz density exceeds this fraction
     # stages dense (the bucketed-nnz format stops paying for itself

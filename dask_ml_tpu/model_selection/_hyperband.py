@@ -7,10 +7,15 @@ all brackets are INTERLEAVED through one shared controller fit (VERDICT
 r3 missing #4): every adaptive round advances the union of live
 candidates across brackets, so cohort batching and submesh placement mix
 brackets and an early-stopped bracket frees budget for live ones instead
-of serializing behind them. Under multi-process, whole brackets are
-striped across processes (each an independent SHA sweep on its local
-mesh) — the cross-host unit stays coarse while the intra-process
-execution interleaves.
+of serializing behind them. With the streamed cohort plane (ISSUE 14,
+``config.search_stream``), an interleaved round over host X is ONE
+``BlockStream`` superblock pass: the brackets' heterogeneous
+``n_calls`` fold onto a single block-step timeline with per-model
+activity masks, so one data pass trains the whole bracket union. Under
+multi-process, whole brackets are striped across processes (each an
+independent SHA sweep on its local mesh, itself riding the streamed
+plane on that mesh) — the cross-host unit stays coarse while the
+intra-process execution interleaves.
 """
 
 from __future__ import annotations

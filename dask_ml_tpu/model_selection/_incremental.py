@@ -31,10 +31,14 @@ from ._split import train_test_split
 
 
 def _to_host(a):
+    from ..parallel.streaming import _is_sparse_source
+
+    if _is_sparse_source(a):
+        return a  # sparse stays sparse (np.asarray would mangle it)
     return a.to_numpy() if isinstance(a, ShardedArray) else np.asarray(a)
 
 
-def _blocks_of(X, y, n_blocks):
+def _blocks_of(X, y, n_blocks, block_rows=None):
     """Row blocks = the unit of one partial_fit call.
 
     Device-resident data plane (VERDICT r1 #5): when X is a ShardedArray
@@ -42,13 +46,17 @@ def _blocks_of(X, y, n_blocks):
     gather) and stay there — no full-dataset device→host→device
     round-trip before training, which at BASELINE scale would be a
     TB-size copy. Host inputs keep host blocks (streamed to device per
-    step, as the reference streams blocks to workers)."""
+    step, as the reference streams blocks to workers). ``block_rows``
+    pins the exact block height (the streamed cohort plane passes its
+    stream partition so solo fallbacks train the SAME minibatches the
+    superblock scans do)."""
     if isinstance(X, ShardedArray):
         from ..parallel.sharded import take_rows
 
         ys = y if isinstance(y, ShardedArray) else None
         n = X.n_rows
-        bs = max(int(np.ceil(n / n_blocks)), 1)
+        bs = int(block_rows) if block_rows \
+            else max(int(np.ceil(n / n_blocks)), 1)
         out = []
         for i in range(0, n, bs):
             idx = np.arange(i, min(i + bs, n))
@@ -58,11 +66,15 @@ def _blocks_of(X, y, n_blocks):
                 else np.asarray(y)[idx]
             out.append((take_rows(X, idx), yb))
         return out
+    from ..parallel.streaming import as_row_sliceable
+
     Xh, yh = _to_host(X), _to_host(y)
-    n = len(Xh)
-    bs = max(int(np.ceil(n / n_blocks)), 1)
+    Xh = as_row_sliceable(Xh)  # sparse: CSR slices, no densify
+    n = int(Xh.shape[0])
+    bs = int(block_rows) if block_rows \
+        else max(int(np.ceil(n / n_blocks)), 1)
     return [(Xh[i:i + bs], yh[i:i + bs]) for i in range(0, n, bs)
-            if len(Xh[i:i + bs])]
+            if int(Xh[i:i + bs].shape[0])]
 
 
 def _supports_batch(model) -> bool:
@@ -112,11 +124,139 @@ def disable_process_distribution():
         _dist_state.disabled = prev
 
 
+class _StreamCohortPlane:
+    """The streamed superblock data plane for adaptive-search cohort
+    rounds (ISSUE 14 tentpole): instead of keeping train blocks
+    device-resident and dispatching the search's own cohort scans
+    (HBM-capped, blind to the stream mesh, densifying sparse corpora),
+    a round advances ALL surviving batchable candidates through ONE
+    ``BlockStream`` superblock pass — the same staging ring, mesh
+    sharding, bucketed-nnz sparse format and fused Pallas flavors every
+    streamed fit already rides. The plane owns:
+
+    - the block PARTITION (``fit_block_rows`` — the same formula the
+      streamed SGD/Incremental fits use, so a search trains the same
+      minibatches a plain streamed fit of the winner would);
+    - one lazily-built ``BlockStream`` per cohort batch key (the stream
+      needs the cohort's y encoding), reused across every round so the
+      staging ring and compiled scans stay warm;
+    - one staged validation HOLDOUT per key (dense device slab or
+      packed sparse COO triple), scored in one batched dispatch per
+      round;
+    - ``n_slots`` — the search's total candidate count, the FIXED pad
+      of the stacked cohort carry: bracket halving reuses the one
+      compiled scan via the slot mask instead of recompiling at each
+      surviving N.
+
+    ``config.search_stream=False`` restores the device-resident cohort
+    path on the SAME partition (the honest A/B bench.py records)."""
+
+    def __init__(self, X_train, y_train, X_test, y_test, n_slots):
+        from ..parallel.streaming import BlockStream, fit_block_rows
+
+        self.X, self.y = X_train, y_train
+        self.X_test, self.y_test = X_test, y_test
+        self.n_slots = int(n_slots)
+        n = int(X_train.shape[0])
+        self.block_rows = int(fit_block_rows(X_train))
+        self.n_blocks = max(int(np.ceil(n / self.block_rows)), 1)
+        self._streams = {}
+        self._holdouts = {}
+        self.stats = {"rounds": 0, "dispatches": 0, "shards": 1,
+                      "sparse": False, "fused": False,
+                      "fused_reason": None}
+        # probe: the hot loop must actually superblock this source at
+        # this partition (a sparse corpus that fell back to per-block
+        # densify, or stream_superblock off, keeps the device plane)
+        probe = BlockStream((X_train,), block_rows=self.block_rows,
+                            profile=False)
+        self.engaged = bool(
+            probe.block_rows == self.block_rows
+            and probe.use_superblocks()
+        )
+        self.reason = None if self.engaged else (
+            probe.sparse_reason or "per-block-path"
+        )
+
+    @staticmethod
+    def eligible(estimator, X_train):
+        """The stream PARTITION (and, with ``config.search_stream`` on,
+        the streamed execution plane) serves single-process searches
+        over HOST-resident X with a streamed-cohort-capable estimator;
+        the device-resident plane keeps everything else. A bracket SHA
+        running under ``disable_process_distribution`` (multi-process
+        Hyperband stripes whole brackets across processes) counts as
+        single-process: it fits on its local mesh, and its stream
+        resolves to exactly that mesh — BASELINE config 5's
+        trials-parallel-across-hosts shape with every bracket riding
+        the streamed plane. The knob is deliberately NOT part of this
+        check — with it off the search keeps the stream partition but
+        executes rounds through the device-resident cohort machinery,
+        so the two paths train identical minibatches and their scores
+        are comparable."""
+        from ..parallel import distributed as _dist
+
+        return (hasattr(type(estimator), "_streamed_cohort_round")
+                and not isinstance(X_train, ShardedArray)
+                and (_dist.process_count() == 1 or _dist_is_disabled()))
+
+    def stream_for(self, key, model):
+        """The (cached) training BlockStream for cohort batch key
+        ``key`` — built on first use because the stream stages the
+        ENCODED targets (the key pins the class set)."""
+        stream = self._streams.get(key)
+        if stream is None:
+            from ..parallel.streaming import BlockStream
+
+            y_enc = np.asarray(
+                model._encode_y(np.asarray(self.y)), np.float32
+            )
+            stream = BlockStream((self.X, y_enc),
+                                 block_rows=self.block_rows,
+                                 shuffle=False, profile=False)
+            # finer dispatch granularity than a plain streamed fit
+            # (~4 super-blocks per full pass): a Hyperband round's
+            # timeline mixes wide early steps with narrow survivor
+            # tails, and each dispatch picks its slot RUNG from the
+            # union of active candidates — coarse super-blocks would
+            # drag the whole round onto the widest rung. The byte
+            # budget in resolve_superblock_k still caps K
+            stream._superblock_k_override = max(
+                2, -(-self.n_blocks // 4)
+            )
+            self._streams[key] = stream
+        return stream
+
+    def holdout_for(self, key, cls, model):
+        holdout = self._holdouts.get(key)
+        if holdout is None:
+            holdout = cls._cohort_holdout(self.X_test, self.y_test,
+                                          model)
+            self._holdouts[key] = holdout
+        return holdout
+
+    def note_round(self, info):
+        """Fold one cohort round's engagement record into the plane's
+        stats (surfaced on ``search.metadata_["stream"]`` so smoke
+        suites assert engagement instead of trusting the gates)."""
+        self.stats["rounds"] += 1
+        self.stats["dispatches"] += int(info.get("dispatches", 0))
+        self.stats["shards"] = int(info.get("shards", 1))
+        self.stats["sparse"] = bool(info.get("sparse", False))
+        self.stats["fused"] = bool(info.get("fused", False))
+        self.stats["fused_reason"] = info.get("fused_reason")
+
+    def snapshot(self):
+        return {"streamed": True, "n_blocks": int(self.n_blocks),
+                "block_rows": int(self.block_rows),
+                "n_slots": int(self.n_slots), **self.stats}
+
+
 def fit(model_factory, params_list, train_blocks, X_test, y_test, scorer,
         additional_calls, fit_params=None, patience=False, tol=1e-3,
         max_iter=None, prefix="", verbose=False, checkpoint=None,
         ckpt_token=None, hook_state=None, scoring_is_default=False,
-        trial_tags=None):
+        trial_tags=None, stream_plane=None):
     """Core controller entry: opens the per-fit JSONL sink (closed even on
     error) around the actual controller loop in :func:`_fit`."""
     from ..observability import fit_logger, span
@@ -130,14 +270,14 @@ def fit(model_factory, params_list, train_blocks, X_test, y_test, scorer,
                     prefix=prefix, verbose=verbose, checkpoint=checkpoint,
                     ckpt_token=ckpt_token, hook_state=hook_state,
                     scoring_is_default=scoring_is_default, logger=logger,
-                    trial_tags=trial_tags)
+                    trial_tags=trial_tags, stream_plane=stream_plane)
 
 
 def _fit(model_factory, params_list, train_blocks, X_test, y_test, scorer,
          additional_calls, fit_params=None, patience=False, tol=1e-3,
          max_iter=None, prefix="", verbose=False, checkpoint=None,
          ckpt_token=None, hook_state=None, scoring_is_default=False,
-         logger=None, trial_tags=None):
+         logger=None, trial_tags=None, stream_plane=None):
     """Core controller (ref: _incremental.py::_fit). Returns
     (info, models, history).
 
@@ -315,12 +455,21 @@ def _fit(model_factory, params_list, train_blocks, X_test, y_test, scorer,
                   test=None):
         """``blocks``/``test`` override the shared data plane when a
         trial runs on a submesh with pre-placed copies."""
+        import scipy.sparse as sp
+
         m = meta[mid]
         model = models[mid]
+        device_model = type(model).__module__.startswith("dask_ml_tpu")
         t0 = time.time()
         for i in range(n_calls):
             Xb, yb = (blocks[i] if blocks is not None
                       else train_blocks[m["block_cursor"] % n_blocks])
+            if sp.issparse(Xb) and device_model:
+                # device estimators' per-block partial_fit takes dense
+                # operands; a solo trial that fell out of the streamed
+                # cohort densifies ONE block at a time (host sklearn
+                # estimators consume the CSR natively)
+                Xb = Xb.toarray()
             model.partial_fit(Xb, yb, **fit_params)
             m["block_cursor"] += 1
             m["partial_fit_calls"] += 1
@@ -507,6 +656,53 @@ def _fit(model_factory, params_list, train_blocks, X_test, y_test, scorer,
         record_scores(mids, scores, fit_time / len(mids),
                       score_time / len(mids), executor="vmapped")
 
+    def train_cohort_streamed(key, ent):
+        """Advance every batchable candidate sharing ``key`` through
+        ONE streamed superblock pass (ISSUE 14 tentpole): the round's
+        requests — heterogeneous ``n_calls`` included — compress onto
+        one block-step timeline (two models at the same absolute call
+        index share the step; per-model activity masks pick who
+        advances), so the data is read from host once per round
+        regardless of candidate count, and each model still trains on
+        exactly the blocks its own ``partial_fit`` loop would have."""
+        mids = [mid for mid, _ in ent]
+        cohort = [models[mid] for mid in mids]
+        cls = type(cohort[0])
+        t0 = time.time()
+        stream = stream_plane.stream_for(key, cohort[0])
+        nb = stream_plane.n_blocks
+        starts = {mid: meta[mid]["block_cursor"] for mid in mids}
+        timeline = sorted({starts[mid] + j
+                           for mid, nc in ent for j in range(nc)})
+        step_of = {t: s for s, t in enumerate(timeline)}
+        order = np.asarray([t % nb for t in timeline], np.int64)
+        act = np.zeros((len(timeline), len(mids)), np.float32)
+        for i, (mid, nc) in enumerate(ent):
+            for j in range(nc):
+                act[step_of[starts[mid] + j], i] = 1.0
+        info_round = cls._streamed_cohort_round(
+            cohort, stream, order, act, stream_plane.n_slots,
+            # first streamed round of the search: warm the whole slot
+            # rung ladder so later bracket shrinks stay at zero compiles
+            warm=stream_plane.stats["rounds"] == 0,
+        )
+        for mid, nc in ent:
+            meta[mid]["block_cursor"] += nc
+            meta[mid]["partial_fit_calls"] += nc
+        fit_time = time.time() - t0
+        t0 = time.time()
+        if scoring_is_default and hasattr(cls, "_cohort_holdout_scores"):
+            holdout = stream_plane.holdout_for(key, cls, cohort[0])
+            scores = cls._cohort_holdout_scores(
+                cohort, holdout, stream_plane.n_slots
+            )
+        else:
+            scores = [scorer(m, X_test, y_test) for m in cohort]
+        score_time = time.time() - t0
+        stream_plane.note_round(info_round)
+        record_scores(mids, scores, fit_time / len(mids),
+                      score_time / len(mids), executor="streamed")
+
     def run_requests(requests):
         """Execute {mid: n_calls>0}: cohort-batch everything batchable,
         grouped by (batch key, n_calls, block cursor)."""
@@ -552,6 +748,21 @@ def _fit(model_factory, params_list, train_blocks, X_test, y_test, scorer,
         else:
             for mid, n_calls in host_solo:
                 train_one(mid, n_calls)
+        if stream_plane is not None and groups:
+            # streamed cohort plane (ISSUE 14): merge every batchable
+            # group with the same key — heterogeneous (n_calls, cursor)
+            # combinations ride the SAME pass via per-model step masks,
+            # so a Hyperband round's whole bracket union is one stream
+            by_key = {}
+            for (key, n_calls, _cursor), mids in groups.items():
+                by_key.setdefault(key, []).extend(
+                    (mid, n_calls) for mid in mids
+                )
+            for key, ent in sorted(
+                by_key.items(), key=lambda kv: min(m for m, _ in kv[1])
+            ):
+                train_cohort_streamed(key, sorted(ent))
+            return
         for (key, n_calls, _cursor), mids in sorted(
             groups.items(), key=lambda kv: kv[1][0]
         ):
@@ -708,14 +919,52 @@ class BaseIncrementalSearchCV(BaseEstimator):
         if not est_device:
             X_train, y_train = _to_host(X_train), _to_host(y_train)
             X_test, y_test = _to_host(X_test), _to_host(y_test)
-        from ..parallel.mesh import data_shards, resolve_mesh
-
-        n_blocks = (
-            data_shards(X.mesh) if isinstance(X, ShardedArray)
-            else data_shards(resolve_mesh(None))
-        )
-        blocks = _blocks_of(X_train, y_train, n_blocks)
         params_list = self._sample_params(self._n_initial())
+        from ..config import get_config
+        from ..parallel.mesh import data_shards, resolve_mesh
+        from ..parallel.streaming import _is_sparse_source
+
+        # Streamed cohort plane (ISSUE 14): single-process searches over
+        # host X with a streamed-cohort-capable estimator take the
+        # STREAM partition (fit_block_rows — the same minibatches a
+        # plain streamed fit trains), and by default execute each round
+        # as one BlockStream superblock pass. config.search_stream=False
+        # keeps the partition but runs the device-resident cohort
+        # machinery over it — the honest A/B the bench records.
+        stream_plane = None
+        stream_partition = _StreamCohortPlane.eligible(
+            self.estimator, X_train
+        )
+        if stream_partition:
+            plane = _StreamCohortPlane(X_train, y_train, X_test, y_test,
+                                       n_slots=len(params_list))
+            if plane.engaged and get_config().search_stream:
+                stream_plane = plane
+            n_blocks = plane.n_blocks
+            blocks = _blocks_of(X_train, y_train, n_blocks,
+                                block_rows=plane.block_rows)
+            if _is_sparse_source(X_train) and stream_plane is None:
+                raise ValueError(
+                    "adaptive search over a sparse X needs the streamed "
+                    "cohort plane (the device-resident cohort path would "
+                    "densify the corpus); it did not engage: "
+                    f"{plane.reason if not plane.engaged else 'config.search_stream=False'}. "
+                    "Enable config.stream_sparse/search_stream or "
+                    "densify explicitly within the dense byte budget."
+                )
+        else:
+            if est_device and _is_sparse_source(X_train):
+                raise ValueError(
+                    "adaptive search over a sparse X requires a "
+                    "single-process, host-resident streamed cohort "
+                    "plane (multi-process searches and device-resident "
+                    "inputs keep the dense data plane)"
+                )
+            n_blocks = (
+                data_shards(X.mesh) if isinstance(X, ShardedArray)
+                else data_shards(resolve_mesh(None))
+            )
+            blocks = _blocks_of(X_train, y_train, n_blocks)
 
         def factory(params):
             return clone(self.estimator).set_params(**params)
@@ -770,7 +1019,7 @@ class BaseIncrementalSearchCV(BaseEstimator):
             ckpt_token=ckpt_token,
             hook_state=(self._hook_state, self._set_hook_state),
             scoring_is_default=self.scoring is None,
-            trial_tags=self._trial_tags,
+            trial_tags=self._trial_tags, stream_plane=stream_plane,
         )
 
         self.history_ = history
@@ -809,6 +1058,13 @@ class BaseIncrementalSearchCV(BaseEstimator):
         self.metadata_ = {
             "n_models": n_models,
             "partial_fit_calls": int(calls.sum()),
+            # the streamed-plane engagement record (ISSUE 14): which
+            # execution plane the cohort rounds rode, how many
+            # superblock dispatches the whole search paid, and the
+            # mesh/sparse/fused composition — smoke suites assert on
+            # this instead of trusting the gates
+            "stream": (stream_plane.snapshot() if stream_plane is not None
+                       else {"streamed": False}),
         }
         return self
 

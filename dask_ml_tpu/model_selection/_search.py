@@ -108,6 +108,13 @@ def check_cv(cv=None):
 def _take(a, idx):
     if isinstance(a, ShardedArray):
         return take_rows(a, idx)
+    from ..parallel.streaming import _is_sparse_source, as_row_indexable
+
+    if _is_sparse_source(a):
+        # sparse folds stay sparse (CSR row gather, no densify): the
+        # C-grid fast path budget-guards its own one-shot densify and
+        # the general path's streamed fits consume the CSR directly
+        return as_row_indexable(a)[idx]
     return np.asarray(a)[idx]
 
 

@@ -87,10 +87,17 @@ def train_test_split(*arrays, test_size=None, train_size=None,
     if isinstance(first, PartitionedFrame):
         return _split_frames(arrays, test_size, train_size, rng, shuffle,
                              blockwise)
-    n = first.n_rows if isinstance(first, ShardedArray) else len(first)
+    # scipy sparse raises on len() ("length is ambiguous"); a sparse
+    # corpus splits by row indexing like everything else — the one
+    # row-count rule lives in streaming._n_rows_of
+    from ..parallel.streaming import _n_rows_of
+
+    def _rows(a):
+        return a.n_rows if isinstance(a, ShardedArray) else _n_rows_of(a)
+
+    n = _rows(first)
     for a in arrays:
-        na = a.n_rows if isinstance(a, ShardedArray) else len(a)
-        if na != n:
+        if _rows(a) != n:
             raise ValueError("arrays have inconsistent lengths")
 
     if blockwise and isinstance(first, ShardedArray):
@@ -114,7 +121,11 @@ def train_test_split(*arrays, test_size=None, train_size=None,
         if isinstance(a, ShardedArray):
             out.extend([take_rows(a, train_idx), take_rows(a, test_idx)])
         else:
-            a = np.asarray(a)
+            from ..parallel.streaming import (_is_sparse_source,
+                                              as_row_indexable)
+
+            a = as_row_indexable(a) if _is_sparse_source(a) \
+                else np.asarray(a)
             out.extend([a[train_idx], a[test_idx]])
     return out
 
@@ -193,7 +204,9 @@ class ShuffleSplit:
 
     def split(self, X, y=None, groups=None):
         rng = np.random.RandomState(self.random_state)
-        n = X.n_rows if isinstance(X, ShardedArray) else len(X)
+        from ..parallel.streaming import _n_rows_of
+
+        n = X.n_rows if isinstance(X, ShardedArray) else _n_rows_of(X)
         for _ in range(self.n_splits):
             if self.blockwise and isinstance(X, ShardedArray):
                 yield _blockwise_split_indices(
@@ -219,7 +232,9 @@ class KFold:
         self.random_state = random_state
 
     def split(self, X, y=None, groups=None):
-        n = X.n_rows if isinstance(X, ShardedArray) else len(X)
+        from ..parallel.streaming import _n_rows_of
+
+        n = X.n_rows if isinstance(X, ShardedArray) else _n_rows_of(X)
         if self.n_splits > n:
             raise ValueError(
                 f"n_splits={self.n_splits} > n_samples={n}"

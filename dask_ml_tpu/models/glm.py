@@ -203,9 +203,45 @@ class _GLMBase(BaseEstimator):
             info_i = dict(info)
             if per_cand is not None:
                 info_i["n_iter"] = int(per_cand[i])
+            # a sparse fold the fast path densified under the byte
+            # budget is on record, not silent (ISSUE 14 satellite):
+            # every clone's solver_info_ names the fallback so reports
+            # can tell a direct dense solve from the streamed path
+            reason = getattr(self, "_c_grid_sparse_reason", None)
+            if reason is not None:
+                info_i.setdefault("sparse_stream", False)
+                info_i.setdefault("sparse_stream_reason", reason)
             finish(est, B[i], info_i)
             fitted.append(est)
         return fitted
+
+    def _dense_search_solve(self, X):
+        """One-shot densify of a sparse fold for the stacked C-grid/OvR
+        direct solve, behind the SAME byte budget that guards
+        ``to_sharded_dense`` — an over-budget corpus raises the typed
+        :class:`DenseBudgetExceeded` (the fast path bails and the
+        search keeps streamed per-candidate fits) instead of silently
+        allocating the dense matrix."""
+        from ..config import get_config
+        from ..feature_extraction.text import DenseBudgetExceeded
+
+        n, d = int(X.shape[0]), int(X.shape[1])
+        nbytes = 4 * n * d
+        budget = int(get_config().to_dense_byte_budget)
+        if budget > 0 and nbytes > budget:
+            raise DenseBudgetExceeded(
+                f"the stacked C-grid/OvR search solve would densify a "
+                f"{n} x {d} sparse fold ({nbytes >> 20} MiB > "
+                f"config.to_dense_byte_budget {budget >> 20} MiB); "
+                "falling back to streamed per-candidate fits"
+            )
+        # _csr_dense casts the nnz VALUES to f32 before toarray(), so
+        # the transient is the one budgeted dense block — a f64 source
+        # densified first would peak at ~3x the budget this guard
+        # enforces
+        from ..parallel.streaming import _csr_dense
+
+        return _csr_dense(X.tocsr(), 0, n, np.float32)
 
     def _check_unsupported(self):
         """Honest-raise for accepted-but-unimplemented params (same
@@ -365,8 +401,26 @@ class _GLMBase(BaseEstimator):
         # clean unsupported-param error instead of a fast-path warning
         if (self.solver != "lbfgs" or self.penalty not in ("l2", "none")
                 or self.solver_kwargs or self.warm_start
-                or self.class_weight is not None
-                or stream_plan(X) is not None):
+                or self.class_weight is not None):
+            return None
+        from ..parallel.streaming import _is_sparse_source
+
+        self._c_grid_sparse_reason = None
+        if _is_sparse_source(X):
+            # stacked direct solves need the dense design ONCE; the
+            # densify rides the to_sharded_dense byte budget — typed
+            # refusal (fast path bails, streamed per-candidate fits
+            # carry the search) instead of a silent n x d allocation,
+            # and a within-budget densify is recorded in every clone's
+            # solver_info_ as sparse_stream_reason="search-dense-solve"
+            from ..feature_extraction.text import DenseBudgetExceeded
+
+            try:
+                X = self._dense_search_solve(X)
+            except DenseBudgetExceeded:
+                return None
+            self._c_grid_sparse_reason = "search-dense-solve"
+        elif stream_plan(X) is not None:
             return None
         mesh = resolve_mesh(getattr(X, "mesh", None))
         X, y = check_X_y(X, y, mesh=mesh, dtype=np.float32)

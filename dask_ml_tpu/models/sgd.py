@@ -789,6 +789,403 @@ def _sgd_cohort_scan_pallas(Xr, yr, NV, order, W, LRS, alphas, l2ws,
     return W, losses[-1]
 
 
+# -- streamed cohort superblock scans (ISSUE 14 tentpole) ---------------
+# The adaptive-search cohort as a CLIENT of the streamed superblock
+# plane: one BlockStream pass advances EVERY surviving candidate — each
+# super-block is ONE dispatch whose donated carry holds the stacked
+# (n_slots, d+1) cohort weights, so the round's data is read from
+# host/HBM once regardless of candidate count. Three mechanisms ride
+# the scan:
+#   - ``ACT (K, width)``: per-model STEP activity — heterogeneous
+#     rounds ({model_id: n_calls} with differing counts) run in the
+#     SAME scan, a model advancing only on its own window of block
+#     steps (the per-model ``iflags`` mechanism of the fused kernels
+#     generalized to the XLA scan);
+#   - ``idx (width,)``: the slot-rung gather — each dispatch pulls the
+#     union of its ACTIVE slots out of the full (n_slots, d+1) donated
+#     carry into the smallest compiled rung width (a geometric ladder,
+#     all rungs warmed in round 1), so compute scales with the LIVE
+#     bracket while bracket halving still reuses compiled scans via
+#     padded slots instead of recompiling at each surviving N;
+#   - padding block slots (``counts == 0``, the ragged final
+#     super-block) pass through exactly like the single-model scans,
+#     and padding SLOT columns (``ACT`` all-zero) pass their rows back
+#     unchanged through the ``.at[idx].set`` scatter.
+
+
+def _cohort_rungs(n_slots):
+    """The slot-width ladder a search's cohort dispatches draw from:
+    powers of two below the candidate count, then the full count (a
+    power within 25% of the full count is dropped — warming a
+    near-duplicate rung costs more than its padding ever saves). Every
+    rung compiles during round 1 (the warmup dispatches), so a
+    shrinking bracket later picks its rung at zero new compiles."""
+    n_slots = max(int(n_slots), 1)
+    out, r = [], 1
+    while r < n_slots:
+        out.append(r)
+        r *= 2
+    if out and out[-1] * 4 >= n_slots * 3:
+        out.pop()
+    out.append(n_slots)
+    return out
+
+
+def _cohort_rung_of(n_active, n_slots):
+    for r in _cohort_rungs(n_slots):
+        if r >= n_active:
+            return r
+    return max(int(n_slots), 1)
+
+
+# rung widths already warm-dispatched THIS process, keyed by everything
+# that determines the compiled scan's identity: a second search over
+# the same shapes (the steady-state of a long-running search service —
+# and the warm half of every A/B bench) skips the warmup executions
+# entirely, because the programs they exist to compile are already in
+# the jit caches
+_COHORT_WARMED = set()
+
+
+def _cohort_gather(W, idx):
+    return jnp.take(W, idx, axis=0)
+
+
+def _cohort_scatter(W, idx, Wc):
+    return W.at[idx].set(Wc)
+
+
+@track_program("superblock.sgd_cohort")
+@partial(jax.jit, static_argnames=("loss", "mxu"), donate_argnums=(0,))
+def _sgd_cohort_sb_scan(W, idx, Xs, ys, counts, LRS, ACT, alphas,
+                        l2ws, l1ws, iflags, loss, mxu=None):
+    """K streamed block steps of a search-cohort rung in ONE scan
+    program: ``W (n_slots, d+1)`` donated full carry, ``idx (width,)``
+    the dispatch's slot gather, ``Xs/ys/counts`` the super-block
+    operands of :func:`_sgd_sb_scan`, ``LRS``/``ACT`` ``(K, width)``
+    per-model lr clock values / step-activity masks. Each step runs
+    the SINGLE ``_sgd_update_one`` definition vmapped over the rung —
+    identical updates and lr clocks to the device-resident
+    ``_sgd_cohort_scan`` over the same minibatches — and an inactive
+    (masked or padding) slot passes its weights through untouched."""
+    unrolled = isinstance(Xs, (tuple, list))
+    S = Xs[0].shape[0] if unrolled else Xs.shape[1]
+    r = jnp.arange(S)
+    Wc = _cohort_gather(W, idx)
+
+    def step(Wc, Xb, yb, c, lrs, act):
+        mask = (r < c).astype(jnp.float32)
+        nv = c.astype(jnp.float32)
+
+        def one(w, lr, a, l2w, l1w, ifl):
+            return _sgd_update_one(w, yb, Xb, mask, nv, lr, a, l2w,
+                                   l1w, ifl, loss, mxu=mxu)
+
+        W2, losses = jax.vmap(one, in_axes=(0, 0, 0, 0, 0, 0))(
+            Wc, lrs, alphas, l2ws, l1ws, iflags
+        )
+        keep = (act > 0) & (c > 0)
+        return jnp.where(keep[:, None], W2, Wc), losses
+
+    if unrolled:
+        losses = []
+        for j in range(len(Xs)):
+            Wc, lv = step(Wc, Xs[j], ys[j], counts[j], LRS[j], ACT[j])
+            losses.append(lv)
+        return _cohort_scatter(W, idx, Wc), jnp.stack(losses)
+
+    def scan_step(Wc, inp):
+        Xb, yb, c, lrs, act = inp
+        return step(Wc, Xb, yb, c, lrs, act)
+
+    Wc, losses = jax.lax.scan(scan_step, Wc, (Xs, ys, counts, LRS, ACT))
+    return _cohort_scatter(W, idx, Wc), losses
+
+
+@track_program("pallas.sgd_cohort")
+@partial(jax.jit, static_argnames=("loss", "mxu", "interpret"),
+         donate_argnums=(0,))
+def _sgd_cohort_sb_scan_pallas(W, idx, Xs, ys, counts, LRS, ACT,
+                               alphas, l2ws, l1ws, iflags, loss,
+                               mxu=None, interpret=False):
+    """Fused flavor of :func:`_sgd_cohort_sb_scan`: each block step is
+    ONE ``fused_sgd_many_block_grad`` VMEM pass serving the whole rung
+    — the same kernel the device-resident fused cohort scan uses —
+    followed by the shared ``_sgd_many_update`` epilogue and the
+    step/slot pass-through mask."""
+    from ..ops.pallas_fused import fused_sgd_many_block_grad
+
+    unrolled = isinstance(Xs, (tuple, list))
+    Wc = _cohort_gather(W, idx)
+
+    def step(Wc, Xb, yb, c, lrs, act):
+        nv = jnp.maximum(c.astype(jnp.float32), 1.0)
+        loss_sums, grads = fused_sgd_many_block_grad(
+            Xb, c, yb, Wc, iflags, loss, codes=False, mxu=mxu,
+            interpret=interpret,
+        )
+        W2, losses = _sgd_many_update(Wc, loss_sums, grads, nv, lrs,
+                                      alphas, l2ws, l1ws, iflags)
+        keep = (act > 0) & (c > 0)
+        return jnp.where(keep[:, None], W2, Wc), losses
+
+    if unrolled:
+        losses = []
+        for j in range(len(Xs)):
+            Wc, lv = step(Wc, Xs[j], ys[j], counts[j], LRS[j], ACT[j])
+            losses.append(lv)
+        return _cohort_scatter(W, idx, Wc), jnp.stack(losses)
+
+    def scan_step(Wc, inp):
+        Xb, yb, c, lrs, act = inp
+        return step(Wc, Xb, yb, c, lrs, act)
+
+    Wc, losses = jax.lax.scan(scan_step, Wc, (Xs, ys, counts, LRS, ACT))
+    return _cohort_scatter(W, idx, Wc), losses
+
+
+@_ft_sharded.lru_cache(maxsize=32)
+def _sgd_cohort_sb_scan_sharded(mesh, loss, mxu=None, fused=False,
+                                interpret=False):
+    """Data-parallel flavor of :func:`_sgd_cohort_sb_scan`: the cohort
+    scan runs INSIDE ``shard_map`` over the stream mesh's "data" axis
+    with the slot stack replicated — each block step computes every
+    slot's raw (loss-sum, gradient-sum) from purely local rows and pays
+    exactly ONE ``lax.psum`` over "data" (the stacked analog of the
+    single-model sharded scan's collective shape) before the shared
+    ``_sgd_many_update`` epilogue applies the GLOBAL update. With
+    ``fused=True`` the local raw sums come from the
+    ``fused_sgd_many_block_grad`` Pallas kernel on each device's own
+    slab — the ``.psum`` twin of the fused cohort scan (ISSUE 14 after
+    the PR-12 pattern), tracked as ``pallas.sgd_cohort.psum``."""
+    from jax.sharding import PartitionSpec as P
+
+    from .._compat import shard_map
+    from ..parallel.mesh import DATA_AXIS, data_shard_spec as spec_of
+
+    if fused:
+        from ..ops.pallas_fused import fused_sgd_many_block_grad
+
+    def body(Wc, Xs, ys, shard_counts, counts, LRS, ACT, alphas, l2ws,
+             l1ws, iflags):
+        unrolled = isinstance(Xs, (tuple, list))
+        S = Xs[0].shape[0] if unrolled else Xs.shape[1]
+        r = jnp.arange(S)
+        cts_local = shard_counts[0]
+
+        def step(W, Xb, yb, c_loc, c_glob, lrs, act):
+            mask = (r < c_loc).astype(jnp.float32)
+            nv = jnp.maximum(c_glob.astype(jnp.float32), 1.0)
+            if fused:
+                vs, gs = fused_sgd_many_block_grad(
+                    Xb, c_loc, yb, W, iflags, loss, codes=False,
+                    mxu=mxu, interpret=interpret,
+                )
+            else:
+                def local_sums(w, ifl):
+                    # the raw UNNORMALIZED data term over this shard's
+                    # rows — `_sgd_data_loss`'s eta/loss math with the
+                    # normalizer deferred past the psum
+                    Xd = Xb if mxu is None else Xb.astype(mxu)
+                    eta = jnp.matmul(
+                        Xd, w[:-1].astype(Xd.dtype),
+                        preferred_element_type=jnp.float32,
+                    ) + w[-1] * ifl
+                    if loss == "log_loss":
+                        per = jax.nn.softplus(eta) - yb * eta
+                    elif loss == "hinge":
+                        margins = (2.0 * yb - 1.0) * eta
+                        per = jnp.maximum(0.0, 1.0 - margins)
+                    else:  # squared_error
+                        per = 0.5 * (eta - yb) ** 2
+                    return jnp.sum(per * mask)
+
+                vs, gs = jax.vmap(
+                    lambda w, ifl: jax.value_and_grad(
+                        lambda ww: local_sums(ww, ifl)
+                    )(w)
+                )(W, iflags)
+            vs, gs = jax.lax.psum((vs, gs), DATA_AXIS)
+            W2, losses = _sgd_many_update(W, vs, gs, nv, lrs, alphas,
+                                          l2ws, l1ws, iflags)
+            keep = (act > 0) & (c_glob > 0)
+            return jnp.where(keep[:, None], W2, W), losses
+
+        if unrolled:
+            losses = []
+            for j in range(len(Xs)):
+                Wc, lv = step(Wc, Xs[j], ys[j], cts_local[j],
+                              counts[j], LRS[j], ACT[j])
+                losses.append(lv)
+            return Wc, jnp.stack(losses)
+
+        def scan_step(Wc, inp):
+            Xb, yb, cl, cg, lrs, act = inp
+            return step(Wc, Xb, yb, cl, cg, lrs, act)
+
+        return jax.lax.scan(scan_step, Wc,
+                            (Xs, ys, cts_local, counts, LRS, ACT))
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def run(W, idx, Xs, ys, shard_counts, counts, LRS, ACT, alphas,
+            l2ws, l1ws, iflags):
+        unrolled = isinstance(Xs, (tuple, list))
+        if unrolled:
+            xs_spec = tuple(spec_of(a, 0) for a in Xs)
+            ys_spec = tuple(spec_of(a, 0) for a in ys)
+        else:
+            xs_spec = spec_of(Xs, 1)
+            ys_spec = spec_of(ys, 1)
+        f = shard_map(
+            body, mesh,
+            in_specs=(P(), xs_spec, ys_spec, P(DATA_AXIS, None), P(),
+                      P(), P(), P(), P(), P(), P()),
+            out_specs=(P(), P()),
+            check_vma=False if fused else None,
+        )
+        # the rung gather/scatter runs OUTSIDE the shard_map on the
+        # replicated full carry — the compact stack crosses in as P()
+        Wc, losses = f(_cohort_gather(W, idx), Xs, ys, shard_counts,
+                       counts, LRS, ACT, alphas, l2ws, l1ws, iflags)
+        return _cohort_scatter(W, idx, Wc), losses
+
+    name = "pallas.sgd_cohort.psum" if fused \
+        else "superblock.sgd_cohort.psum"
+    return track_program(name)(run)
+
+
+@_ft_sharded.lru_cache(maxsize=32)
+def _sgd_cohort_sb_scan_sparse(loss, S, mesh=None):
+    """Sparse flavor of :func:`_sgd_cohort_sb_scan` (the search path's
+    densify finally ends — ROADMAP 4b): K cohort block steps over
+    bucketed-nnz COO stacks in ONE donated-carry dispatch, the
+    eta/gradient built from the ``ops/sparse_kernels`` take/segment_sum
+    primitives at nnz cost. Same step/slot masks and padding-slot
+    semantics as the dense cohort scan; ``mesh`` selects the shard_map
+    twin — per-shard raw sums, ONE psum per block step, the shared
+    ``_sgd_many_update`` epilogue — tracked as
+    ``superblock.sparse.sgd_cohort.psum``."""
+    from ..ops.sparse_kernels import sparse_eta
+
+    S = int(S)
+
+    if mesh is None:
+        @partial(jax.jit, donate_argnums=(0,))
+        def run(W, idx, data, cols, rows, ys, counts, LRS, ACT,
+                alphas, l2ws, l1ws, iflags):
+            r = jnp.arange(S)
+
+            def step(Wc, db, cb, rb, yb, c, lrs, act):
+                mask = (r < c).astype(jnp.float32)
+                nv = c.astype(jnp.float32)
+
+                def one(w, lr, a, l2w, l1w, ifl):
+                    return _sgd_update_one_sparse(
+                        w, yb, db, cb, rb, S, mask, nv, lr, a, l2w,
+                        l1w, ifl, loss,
+                    )
+
+                W2, losses = jax.vmap(one, in_axes=(0,) * 6)(
+                    Wc, lrs, alphas, l2ws, l1ws, iflags
+                )
+                keep = (act > 0) & (c > 0)
+                return jnp.where(keep[:, None], W2, Wc), losses
+
+            def scan_step(Wc, inp):
+                db, cb, rb, yb, c, lrs, act = inp
+                return step(Wc, db, cb, rb, yb, c, lrs, act)
+
+            Wc, losses = jax.lax.scan(
+                scan_step, _cohort_gather(W, idx),
+                (data, cols, rows, ys, counts, LRS, ACT),
+            )
+            return _cohort_scatter(W, idx, Wc), losses
+
+        return track_program("superblock.sparse.sgd_cohort")(run)
+
+    from jax.sharding import PartitionSpec as P
+
+    from .._compat import shard_map
+    from ..parallel.mesh import DATA_AXIS
+
+    def body(Wc, data, cols, rows, ys, shard_counts, counts, LRS, ACT,
+             alphas, l2ws, l1ws, iflags):
+        r = jnp.arange(S)               # LOCAL slab height
+        cts_local = shard_counts[0]
+
+        def step(Wc, db, cb, rb, yb, c_loc, c_glob, lrs, act):
+            mask = (r < c_loc).astype(jnp.float32)
+            nv = jnp.maximum(c_glob.astype(jnp.float32), 1.0)
+
+            def local_sums(w, ifl):
+                eta = sparse_eta(db, cb, rb, w[:-1], S) + w[-1] * ifl
+                return jnp.sum(
+                    _sgd_sparse_pointwise(eta, yb, loss) * mask
+                )
+
+            vs, gs = jax.vmap(
+                lambda w, ifl: jax.value_and_grad(
+                    lambda ww: local_sums(ww, ifl)
+                )(w)
+            )(Wc, iflags)
+            vs, gs = jax.lax.psum((vs, gs), DATA_AXIS)
+            W2, losses = _sgd_many_update(Wc, vs, gs, nv, lrs, alphas,
+                                          l2ws, l1ws, iflags)
+            keep = (act > 0) & (c_glob > 0)
+            return jnp.where(keep[:, None], W2, Wc), losses
+
+        def scan_step(Wc, inp):
+            db, cb, rb, yb, cl, cg, lrs, act = inp
+            return step(Wc, db, cb, rb, yb, cl, cg, lrs, act)
+
+        return jax.lax.scan(
+            scan_step, Wc,
+            (data, cols, rows, ys, cts_local, counts, LRS, ACT),
+        )
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def run(W, idx, data, cols, rows, ys, shard_counts, counts, LRS,
+            ACT, alphas, l2ws, l1ws, iflags):
+        f = shard_map(
+            body, mesh,
+            in_specs=(P(), P(None, DATA_AXIS), P(None, DATA_AXIS),
+                      P(None, DATA_AXIS), P(None, DATA_AXIS),
+                      P(DATA_AXIS, None), P(), P(), P(), P(), P(),
+                      P(), P()),
+            out_specs=(P(), P()),
+        )
+        Wc, losses = f(_cohort_gather(W, idx), data, cols, rows, ys,
+                       shard_counts, counts, LRS, ACT, alphas, l2ws,
+                       l1ws, iflags)
+        return _cohort_scatter(W, idx, Wc), losses
+
+    return track_program("superblock.sparse.sgd_cohort.psum")(run)
+
+
+@partial(jax.jit, static_argnames=("n_rows",))
+def _batched_eta_sparse(data, cols, rows, W, n_rows):
+    """(n_rows, N) decision values of N stacked models over ONE packed
+    sparse slab — the streamed-validation scoring dispatch for sparse
+    holdouts (one ``sparse_eta_multi`` pass serves the whole cohort)."""
+    from ..ops.sparse_kernels import sparse_eta_multi
+
+    eta = sparse_eta_multi(data, cols, rows, W[:, :-1], n_rows)
+    return eta + W[:, -1][None, :]
+
+
+def _stack_cohort_weights(models, n_slots):
+    """The cohort's (n_slots, d+1) host weight stack: live models in
+    their slot rows, padding slots zero. Built on HOST so the stack's
+    device shape never depends on the surviving candidate count — the
+    one device_put per dispatch/score is what keeps shrinking brackets
+    at zero recompiles."""
+    d1 = int(np.asarray(models[0]._w).shape[-1])
+    Wh = np.zeros((max(int(n_slots), len(models)), d1), np.float32)
+    for i, m in enumerate(models):
+        Wh[i] = np.asarray(m._w, np.float32)
+    return Wh
+
+
 import functools as _functools
 
 
@@ -1176,6 +1573,261 @@ class _SGDBase(BaseEstimator):
             m._last_loss = losses[i]
             m._t += S
         return models
+
+    # -- streamed-cohort protocol (ISSUE 14 tentpole; consumed by
+    # model_selection._incremental's _StreamCohortPlane) ----------------
+    @classmethod
+    def _cohort_sb_flavor(cls, sb, n_slots, fit_dtype):
+        """(fused, mxu, interpret, reason) for the streamed cohort
+        scan: :meth:`_sb_scan_flavor`'s gate with the multi-weight tile
+        — the fused kernel's (tile, n_slots) MXU matmul must fit VMEM
+        for the PADDED slot stack, since that is what every dispatch
+        actually carries."""
+        from ..config import mxu_dtype
+        from ..ops.pallas_fused import (sgd_many_stream_tile,
+                                        stream_kernel_mode,
+                                        stream_mode_reason,
+                                        stream_tile_reason)
+
+        mxu = mxu_dtype(fit_dtype)
+        reason = stream_mode_reason()
+        if reason is not None:
+            return False, mxu, False, reason
+        _, interp = stream_kernel_mode()
+        Xs = sb.arrays[0]
+        S, d = Xs[0].shape if isinstance(Xs, (tuple, list)) \
+            else Xs.shape[1:]
+        D = sb.shard_counts.shape[0] if sb.shard_counts is not None \
+            else 1
+        S_local = int(S) // max(int(D), 1)
+        tile = sgd_many_stream_tile(S_local, int(d), int(n_slots))
+        reason = stream_tile_reason(S_local, tile)
+        if reason is not None:
+            return False, mxu, False, reason
+        return True, mxu, interp, None
+
+    @classmethod
+    def _streamed_cohort_round(cls, models, stream, order, act,
+                               n_slots, warm=False):
+        """Advance a (possibly heterogeneous) adaptive-search cohort
+        through ONE streamed super-block pass — the ISSUE 14 tentpole.
+
+        ``order`` is the round's block-step timeline (``order[s]`` is
+        the block every active model trains on at step ``s``) and
+        ``act`` the ``(len(order), len(models))`` step-activity matrix:
+        model ``i`` advances exactly on its own window of steps, with
+        the SAME updates and lr clock a per-model ``partial_fit`` loop
+        over those blocks would produce. Each super-block is one
+        dispatch with the stacked carry donated; the data is read once
+        per round regardless of candidate count.
+
+        Slot rungs: the full carry holds ``n_slots`` rows (the
+        search's candidate count), but each dispatch GATHERS the union
+        of its active slots into the smallest rung of the
+        ``_cohort_rungs`` ladder — compute scales with the live
+        bracket, not the padded stack — and scatters the rows back.
+        ``warm=True`` (the search's first streamed round) dispatches
+        every OTHER rung once against the first super-block with an
+        all-zero activity mask (a semantic no-op), so bracket halving
+        later in the search picks any rung at zero new XLA compiles.
+
+        Flavor selection mirrors the single-model ``_sb_step``: sparse
+        slabs take the ``superblock.sparse.sgd_cohort[.psum]``
+        programs, a >1-shard stream mesh the ``.psum`` twins, and the
+        fused Pallas body (``pallas.sgd_cohort[.psum]``) engages under
+        the same tile/mode gates. Returns an engagement/dispatch info
+        dict for the search's telemetry."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..observability import record_superblock_donation
+        from ..parallel.sparse_stream import SparseSlab
+
+        enc = models[0]
+        N = len(models)
+        n_slots = max(int(n_slots), N)
+        d = int(stream.arrays[0].shape[1])
+        for m in models:
+            m._ensure_state(d)
+        order = np.asarray(order, np.int64)
+        act = np.asarray(act, np.float32)
+        S_total = len(order)
+        LRS = np.ones((S_total, n_slots), np.float32)
+        ACT = np.zeros((S_total, n_slots), np.float32)
+        ACT[:, :N] = act
+        for i, m in enumerate(models):
+            steps = np.flatnonzero(act[:, i] > 0)
+            LRS[steps, i] = m._lr_schedule(len(steps))
+        args = np.zeros((n_slots, 4), np.float32)
+        for i, m in enumerate(models):
+            l2w, l1w = m._penalty_weights()
+            args[i] = (m.alpha, l2w, l1w,
+                       1.0 if m.fit_intercept else 0.0)
+        # the carry commits REPLICATED on the stream's mesh once per
+        # round (single-device meshes included — the scan operands live
+        # there), so every dispatch hits one executable and donation
+        # aliases in place
+        rep = NamedSharding(stream.mesh, P())
+        W = jax.device_put(_stack_cohort_weights(models, n_slots), rep)
+        loss_name = enc._loss()
+        sharded = stream.sb_sharded()
+        info = {"streamed": True, "n_steps": int(S_total),
+                "shards": int(stream.sb_data_shards()),
+                "sparse": bool(stream.sb_sparse()),
+                "fused": False, "fused_reason": None,
+                "dispatches": 0, "warm_dispatches": 0}
+        w_bytes = int(n_slots * (d + 1)) * 4
+        state = {"flavor": None}
+
+        def dispatch(W, sb, idx, lr_k, act_k):
+            pars = tuple(jnp.asarray(args[idx, j]) for j in range(4))
+            idx_d = jnp.asarray(idx)
+            lr_d, act_d = jnp.asarray(lr_k), jnp.asarray(act_k)
+            slab = sb.arrays[0]
+            if isinstance(slab, SparseSlab):
+                info["fused_reason"] = "sparse-stream"
+                if sharded:
+                    run = _sgd_cohort_sb_scan_sparse(
+                        loss_name, slab.n_rows, mesh=stream.mesh
+                    )
+                    return run(W, idx_d, slab.data, slab.cols,
+                               slab.rows, sb.arrays[1],
+                               sb.shard_counts, sb.counts, lr_d,
+                               act_d, *pars)
+                run = _sgd_cohort_sb_scan_sparse(loss_name,
+                                                 slab.n_rows)
+                return run(W, idx_d, slab.data, slab.cols, slab.rows,
+                           sb.arrays[1], sb.counts, lr_d, act_d,
+                           *pars)
+            if state["flavor"] is None:
+                # gate once at the TOP rung (max VMEM footprint): if
+                # the fused tile fits the full slot stack it fits
+                # every smaller rung
+                state["flavor"] = cls._cohort_sb_flavor(
+                    sb, n_slots, enc.fit_dtype
+                )
+                info["fused"] = state["flavor"][0]
+                info["fused_reason"] = state["flavor"][3]
+            fused, mxu, interp, _ = state["flavor"]
+            if sharded:
+                run = _sgd_cohort_sb_scan_sharded(
+                    stream.mesh, loss_name, mxu, fused=fused,
+                    interpret=interp,
+                )
+                return run(W, idx_d, sb.arrays[0], sb.arrays[1],
+                           sb.shard_counts, sb.counts, lr_d, act_d,
+                           *pars)
+            if fused:
+                return _sgd_cohort_sb_scan_pallas(
+                    W, idx_d, sb.arrays[0], sb.arrays[1], sb.counts,
+                    lr_d, act_d, *pars, loss_name, mxu=mxu,
+                    interpret=interp,
+                )
+            return _sgd_cohort_sb_scan(
+                W, idx_d, sb.arrays[0], sb.arrays[1], sb.counts,
+                lr_d, act_d, *pars, loss_name, mxu=mxu,
+            )
+
+        all_slots = np.arange(n_slots)
+        pos = 0
+        losses = np.zeros((S_total, N), np.float32)
+        losses_parts = []
+        for sb in stream.superblocks(order=order):
+            K = int(sb.counts.shape[0])
+            take = sb.n_blocks
+            cols = np.flatnonzero(act[pos:pos + take, :].any(axis=0))
+            width = _cohort_rung_of(max(len(cols), 1), n_slots)
+            spare = np.setdiff1d(all_slots, cols)[: width - len(cols)]
+            idx = np.concatenate([cols, spare]).astype(np.int32)
+            if warm and info["dispatches"] == 0:
+                # round-1 rung warmup: every OTHER ladder width runs
+                # once over this super-block with an all-zero activity
+                # mask (weights pass through bit-identically), so the
+                # whole ladder is compiled before bracket shrinks ask
+                # for a narrower rung. Once per PROCESS per shape: a
+                # later search over the same shapes finds the programs
+                # already compiled and skips the executions
+                slab0 = sb.arrays[0]
+                if not isinstance(slab0, SparseSlab) \
+                        and state["flavor"] is None:
+                    state["flavor"] = cls._cohort_sb_flavor(
+                        sb, n_slots, enc.fit_dtype
+                    )
+                    info["fused"] = state["flavor"][0]
+                    info["fused_reason"] = state["flavor"][3]
+                fl = state["flavor"] or (False, None, False, None)
+                wkey = (cls.__name__, loss_name, stream.mesh, sharded,
+                        n_slots, d, K, int(stream.block_rows),
+                        slab0.cap if isinstance(slab0, SparseSlab)
+                        else None, fl[0], str(fl[1]), fl[2])
+                for rw in _cohort_rungs(n_slots):
+                    if rw == width or (wkey, rw) in _COHORT_WARMED:
+                        continue
+                    W, _ = dispatch(
+                        W, sb, np.arange(rw, dtype=np.int32),
+                        np.ones((K, rw), np.float32),
+                        np.zeros((K, rw), np.float32),
+                    )
+                    _COHORT_WARMED.add((wkey, rw))
+                    info["warm_dispatches"] += 1
+                # the REAL dispatch below compiles this round's own
+                # width — register it too, or a later same-shape
+                # search starting at a different width would re-run
+                # its warm no-op for a program that already exists
+                _COHORT_WARMED.add((wkey, width))
+            lr_k = np.ones((K, width), np.float32)
+            act_k = np.zeros((K, width), np.float32)
+            lr_k[:take] = LRS[pos:pos + take][:, idx]
+            act_k[:take] = ACT[pos:pos + take][:, idx]
+            W, lv = dispatch(W, sb, idx, lr_k, act_k)
+            record_superblock_donation(w_bytes)
+            info["dispatches"] += 1
+            # loss pulls DEFER to pass end: a per-dispatch np.asarray
+            # would synchronize the host on every scan, stalling the
+            # staging/compute overlap
+            losses_parts.append((pos, take, idx, lv))
+            pos += take
+        # ONE stable-shape D2H pull per round: weights land back as
+        # host rows (a per-model device slice would mint a fresh tiny
+        # program per surviving N — exactly the recompile leak the
+        # padded stack exists to avoid)
+        rows = np.asarray(W, np.float32)
+        for p, take, idx, lv in losses_parts:
+            lvh = np.asarray(lv, np.float32)[:take]
+            live = idx < N
+            if live.any():
+                losses[p:p + take, idx[live]] = lvh[:, live]
+        for i, m in enumerate(models):
+            steps = np.flatnonzero(act[:, i] > 0)
+            m._w = rows[i].copy()
+            m._t += len(steps)
+            if len(steps):
+                m._last_loss = float(losses[steps[-1], i])
+        cls._batch_publish(models, d)
+        return info
+
+    @classmethod
+    def _cohort_holdout(cls, X_test, y_test, model):
+        """Stage the search's validation split ONCE — every round then
+        scores the whole surviving cohort against it in one batched
+        dispatch. Dense splits stage as device arrays; sparse splits as
+        one packed COO triple (nnz cost, no densify)."""
+        from ..parallel.streaming import (_is_sparse_source,
+                                          as_row_sliceable)
+
+        y_enc = np.asarray(model._encode_y(np.asarray(y_test)),
+                           np.float32)
+        if _is_sparse_source(X_test):
+            from ..parallel.sparse_stream import coo_rows
+
+            src = as_row_sliceable(X_test)
+            n = int(src.shape[0])
+            data, cols, rows = coo_rows(src, 0, n)
+            return {"kind": "sparse", "data": jnp.asarray(data),
+                    "cols": jnp.asarray(cols),
+                    "rows": jnp.asarray(rows), "n": n, "y": y_enc}
+        Xs = as_sharded(np.asarray(X_test), dtype=np.float32)
+        ys = as_sharded(y_enc, mesh=Xs.mesh, dtype=np.float32)
+        return {"kind": "dense", "X": Xs, "y": ys}
 
     def _one_step(self, Xb, yb, mask, n_valid):
         from ..config import mxu_dtype
@@ -1789,10 +2441,15 @@ class _SGDBase(BaseEstimator):
     def _eta_stream(self, X, block_rows):
         """Decision values for out-of-core / sparse X: blocks stream
         through the fitted weights, (n,) or (n, C) host result — same
-        bridge as the GLM predict paths."""
+        bridge as the GLM predict paths. The weights ride as HOST
+        numpy: a cohort-trained ``_w`` may be committed to the full
+        ambient mesh while the predict stream stages on its own
+        (possibly single-device) stream mesh — an uncommitted operand
+        follows the block's placement instead of raising a
+        mixed-devices error."""
         from ..parallel.streaming import streamed_map
 
-        W = self._w
+        W = np.asarray(self._w, np.float32)
         if self._n_out() is not None:
             return streamed_map(
                 X, block_rows, lambda blk: _batched_eta(blk.arrays[0], W)
@@ -1927,6 +2584,30 @@ class SGDClassifier(ClassifierMixin, _SGDBase):
         )
         return np.asarray(acc, np.float64)
 
+    @classmethod
+    def _cohort_holdout_scores(cls, models, holdout, n_slots):
+        """Round scoring as ONE batched dispatch over the staged
+        validation slab (ISSUE 14): the PADDED slot stack keeps the
+        scoring program's shape constant across shrinking brackets —
+        same accuracy math as ``_batched_score_default``."""
+        W = jnp.asarray(_stack_cohort_weights(models, n_slots))
+        N = len(models)
+        if holdout["kind"] == "sparse":
+            eta = np.asarray(_batched_eta_sparse(
+                holdout["data"], holdout["cols"], holdout["rows"], W,
+                n_rows=holdout["n"],
+            ))[:, :N]
+            y01 = holdout["y"]
+            acc = ((eta > 0).astype(np.float32)
+                   == y01[:, None]).mean(axis=0)
+            return np.asarray(acc, np.float64)
+        Xs, ys = holdout["X"], holdout["y"]
+        acc = _batched_accuracy(
+            Xs.data, ys.data, Xs.row_mask(jnp.float32),
+            jnp.float32(Xs.n_rows), W,
+        )
+        return np.asarray(acc, np.float64)[:N]
+
     def decision_function(self, X):
         check_is_fitted(self, "coef_")
         from ..parallel.streaming import stream_plan
@@ -1990,6 +2671,29 @@ class SGDRegressor(RegressorMixin, _SGDBase):
             jnp.float32(Xs.n_rows), W,
         )
         return np.asarray(r2, np.float64)
+
+    @classmethod
+    def _cohort_holdout_scores(cls, models, holdout, n_slots):
+        """R^2 twin of the classifier's one-dispatch round scoring —
+        padded slot stack, stable program shape across bracket
+        shrinks."""
+        W = jnp.asarray(_stack_cohort_weights(models, n_slots))
+        N = len(models)
+        if holdout["kind"] == "sparse":
+            eta = np.asarray(_batched_eta_sparse(
+                holdout["data"], holdout["cols"], holdout["rows"], W,
+                n_rows=holdout["n"],
+            ))[:, :N]
+            y = np.asarray(holdout["y"], np.float64)
+            ss_tot = float(np.sum((y - y.mean()) ** 2))
+            ss_res = np.sum((eta - y[:, None]) ** 2, axis=0)
+            return 1.0 - ss_res / max(ss_tot, 1e-12)
+        Xs, ys = holdout["X"], holdout["y"]
+        r2 = _batched_r2(
+            Xs.data, ys.data, Xs.row_mask(jnp.float32),
+            jnp.float32(Xs.n_rows), W,
+        )
+        return np.asarray(r2, np.float64)[:N]
 
     def predict(self, X):
         check_is_fitted(self, "coef_")

@@ -530,8 +530,10 @@ def glm_multi_stream_tile(S, d, n_classes, itemsize=4):
 
 def sgd_many_stream_tile(S, d, n_models, itemsize=4):
     """Tile for the multi-weight streamed SGD kernel (multiclass OvR
-    rows or a batched-trial cohort): same footprint shape as the
-    multi-target GLM reducer."""
+    rows, a batched-trial cohort, or a search cohort's slot stack —
+    the streamed cohort scans gate at the FULL padded slot count, so a
+    tile that fits the top rung fits every narrower one): same
+    footprint shape as the multi-target GLM reducer."""
     return glm_multi_stream_tile(S, d, n_models, itemsize)
 
 

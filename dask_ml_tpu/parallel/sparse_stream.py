@@ -15,7 +15,11 @@ Sharding: on a D-shard stream mesh each block's rows split into D
 contiguous slabs (exactly the dense path's partition); entries land in
 their shard's ``cap``-wide segment of the ``(D * cap,)`` staging row
 with SHARD-LOCAL row ids, so the shard_map consumers read purely local
-nonzeros and keep their one-psum-per-super-block contract.
+nonzeros and keep their one-psum-per-super-block contract. Consumers:
+the GLM/SGD/KMeans streamed reducers (PR 13) and, since ISSUE 14, the
+adaptive-search cohort scans (``superblock.sparse.sgd_cohort[.psum]``)
+— a Hyperband bracket over a hashed-text corpus streams bucketed-nnz
+slabs with no densify anywhere in the search.
 
 Fallbacks are decided at PLAN time (one pass over ``indptr``, no data
 touched): a corpus — or any single block — denser than

@@ -101,6 +101,17 @@ def as_row_sliceable(a):
     return a.tocsr() if sp.issparse(a) and not sp.isspmatrix_csr(a) else a
 
 
+def as_row_indexable(a):
+    """Normalize a sparse source to a form supporting fancy ROW
+    indexing (``a[idx_array]``): scipy sparse → CSR; the
+    ``SparseBlocks`` view (which only supports contiguous-range
+    densify) materializes as one CSR. The single normalization point
+    behind the search/split fold-extraction paths — sparse folds stay
+    sparse, never densified."""
+    a = as_row_sliceable(a)
+    return a.tocsr() if isinstance(a, SparseBlocks) else a
+
+
 def _slice_dense(a, lo, hi, dtype):
     """One host block of ``a`` as a dense array — the single densify
     point for sparse sources (O(block) host memory, never the corpus).
@@ -459,7 +470,11 @@ class BlockStream:
         self._shard_counts_sharding = NamedSharding(
             self.mesh, P(DATA_AXIS, None)
         )
-        self._superblock_k_override = None  # set by the K autotuner
+        # set by the K autotuner — and by the adaptive-search cohort
+        # plane (ISSUE 14), which wants finer dispatch granularity than
+        # a plain fit so each dispatch's slot RUNG can track the live
+        # bracket instead of the round's widest moment
+        self._superblock_k_override = None
         # device-resident sparse staging (ISSUE 13): when opted in
         # (config.stream_sparse) and the source stays under the density
         # threshold, a sparse X streams as bucketed-nnz COO triples
@@ -1206,8 +1221,11 @@ class BlockStream:
         ``order`` (default: all blocks once, shuffled when the stream
         shuffles) is the sequence of block indices the consumer's scan
         steps through — block j of super-block i is ``order[i*K + j]``.
-        The final super-block pads missing slots with zero counts so
-        every dispatch has the identical [K, block_rows, d] shape."""
+        An explicit ``order`` may be any length and revisit blocks (the
+        adaptive-search cohort plane streams each round's block-step
+        TIMELINE through here, ISSUE 14). The final super-block pads
+        missing slots with zero counts so every dispatch has the
+        identical [K, block_rows, d] shape."""
         if self.sparse_plan is not None:
             # device-resident sparse staging (ISSUE 13): bucketed-nnz
             # COO triples instead of densified slabs, same dispatch /

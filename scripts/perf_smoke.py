@@ -274,6 +274,86 @@ def main():
         failures.append("sparse_blocks_staged counter never moved — "
                         "blocks did not stage as bucketed-nnz slabs")
 
+    # -- search section (ISSUE 14): the adaptive-search cohort rides
+    # the streamed superblock plane — every round must be exactly
+    # ceil(steps / K) dispatches (one per super-block, the round-1
+    # {mid: 1} round exactly one), and after round 1 (which warms the
+    # slot RUNG ladder) the whole search — INCLUDING shrinking
+    # candidate sets, 8 -> 4 -> 2 -> 1 under decay — must pay zero new
+    # XLA compiles: bracket halving reuses compiled scans via padded
+    # slot masks, never a recompile per surviving N.
+    from dask_ml_tpu.model_selection import IncrementalSearchCV
+
+    ns, ds = 16_384, 16
+    Xq = rng.randn(ns, ds).astype(np.float32)
+    yq = (Xq[:, 0] > 0).astype(np.float64)
+    params_q = {"alpha": list(np.logspace(-4, -1, 8))}
+    marks = []
+
+    class _Probe(IncrementalSearchCV):
+        def _additional_calls(self, info):
+            marks.append(obs.counters_snapshot().get("recompiles", 0))
+            return super()._additional_calls(info)
+
+    with config.set(stream_block_rows=2048, stream_autotune=False,
+                    stream_mesh=1):
+        sq = _Probe(SGDClassifier(learning_rate="constant"), params_q,
+                    n_initial_parameters=8, decay_rate=1.0,
+                    max_iter=48, fits_per_score=8, random_state=0)
+        obs.counters_reset()
+        sq.fit(Xq, yq, classes=[0.0, 1.0])
+    sm = sq.metadata_["stream"]
+    if not sm.get("streamed"):
+        failures.append("search section: streamed cohort plane did "
+                        f"not engage ({sm})")
+    else:
+        n_rounds = sm["rounds"]
+        k_search = max(2, math.ceil(sm["n_blocks"] / 4))
+        expect = 1 + (n_rounds - 1) * math.ceil(8 / k_search)
+        if sm["dispatches"] != expect:
+            failures.append(
+                f"search dispatches={sm['dispatches']} != {expect} "
+                f"(1 for round 1 + ceil(8/{k_search}) per later "
+                f"round x {n_rounds - 1}) — one dispatch per "
+                "super-block per round"
+            )
+        if n_rounds < 4:
+            failures.append(
+                f"search ran only {n_rounds} rounds — the shrinking-"
+                "bracket contract needs several"
+            )
+    if len(marks) >= 2 and marks[-1] != marks[0]:
+        failures.append(
+            f"{marks[-1] - marks[0]} new XLA compiles AFTER round 1 "
+            f"across shrinking candidate sets (marks={marks}) — "
+            "bracket halving must reuse the compiled scan via the "
+            "padded-N slot mask, not recompile at each N"
+        )
+    # sharded search flavor: the cohort scans run under shard_map on
+    # the 8-virtual-device mesh with the same zero-compile contract
+    sh_search = None
+    if len(jax.devices()) >= 8:
+        marks.clear()
+        with config.set(stream_block_rows=2048, stream_autotune=False,
+                        stream_mesh=0):
+            sq8 = _Probe(SGDClassifier(learning_rate="constant"),
+                         params_q, n_initial_parameters=8,
+                         decay_rate=1.0, max_iter=24, fits_per_score=8,
+                         random_state=0)
+            obs.counters_reset()
+            sq8.fit(Xq, yq, classes=[0.0, 1.0])
+        sh_search = sq8.metadata_["stream"]
+        if sh_search.get("shards") != 8:
+            failures.append(
+                f"sharded search ran at shards={sh_search.get('shards')}"
+                ", wanted 8 — the cohort psum flavor did not engage"
+            )
+        if len(marks) >= 2 and marks[-1] != marks[0]:
+            failures.append(
+                f"{marks[-1] - marks[0]} new XLA compiles after round "
+                "1 on the SHARDED search path"
+            )
+
     print(f"perf smoke: n_blocks={n_blocks} K={k} "
           f"dispatches_per_pass={dpp} (budget {budget}) "
           f"recompiles_after_pass1={recompiles} | sharded: "
@@ -283,7 +363,9 @@ def main():
           f"recompiles_after_pass1={fu_recompiles} | sparse: "
           f"dispatches_per_pass={sp_dpp} "
           f"recompiles_after_pass1={sp_recompiles} "
-          f"ladder_rungs={sp_rungs}")
+          f"ladder_rungs={sp_rungs} | search: "
+          f"rounds={sm.get('rounds')} dispatches={sm.get('dispatches')} "
+          f"shards8={None if sh_search is None else sh_search.get('shards')}")
     if failures:
         for f in failures:
             print(f"PERF SMOKE FAIL: {f}", file=sys.stderr)

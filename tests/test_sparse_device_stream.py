@@ -74,9 +74,21 @@ class TestPlanAndLadder:
         assert not plan.engaged
         assert "spill" in plan.reason
 
-    def test_default_off_keeps_todays_path(self):
+    def test_default_on_engages(self):
+        # ROADMAP 4a (ISSUE 14 satellite): after the PR-13 parity suite
+        # held a round, stream_sparse ships DEFAULT ON — a plain config
+        # builds the staging plan with no knobs set
         Xs, y = _xy()
+        assert config.get_config().stream_sparse is True
         with config.set(stream_mesh=1, stream_block_rows=96):
+            s = BlockStream((Xs, y.astype(np.float32)), block_rows=96)
+            assert s.sparse_plan is not None
+            assert s.resolve_superblock_k() > 1
+
+    def test_opt_out_keeps_densify_path(self):
+        Xs, y = _xy()
+        with config.set(stream_mesh=1, stream_block_rows=96,
+                        stream_sparse=False):
             s = BlockStream((Xs, y.astype(np.float32)), block_rows=96)
             assert s.sparse_plan is None
             assert s.sparse_reason == "stream-sparse-off"
@@ -215,7 +227,8 @@ class TestGLMParity:
 
         Xs, y = _xy(600, 14)
         # knob off: sparse_stream False, reason names the knob
-        with config.set(stream_block_rows=96, stream_mesh=1):
+        with config.set(stream_block_rows=96, stream_mesh=1,
+                        stream_sparse=False):
             off = LogisticRegression(solver="lbfgs", max_iter=3).fit(
                 Xs, y
             )
@@ -254,7 +267,8 @@ class TestSGDParity:
         Xs, y = _xy(660, 18)
         kw = dict(loss="log_loss", random_state=0, shuffle=False,
                   max_iter=2)
-        with config.set(stream_block_rows=96, stream_mesh=mesh_n):
+        with config.set(stream_block_rows=96, stream_mesh=mesh_n,
+                        stream_sparse=False):
             ref = SGDClassifier(**kw).fit(
                 Xs.toarray().astype(np.float32), y
             )
@@ -262,6 +276,53 @@ class TestSGDParity:
                         stream_sparse=True):
             got = SGDClassifier(**kw).fit(Xs, y)
         np.testing.assert_allclose(got.coef_, ref.coef_, rtol=1e-6,
+                                   atol=1e-6)
+        assert got.solver_info_["sparse_stream"] is True
+
+    # the default-flip soak shapes (ISSUE 14 satellite, ROADMAP 4a):
+    # a NARROW-d wide-ish corpus at d=2**10 (the profile-fold boundary)
+    # and a density right under the 0.25 fallback edge — the parity
+    # suite must hold on them before stream_sparse ships default-ON
+    @pytest.mark.parametrize("n,d,density", [
+        (520, 2 ** 10, 0.05),
+        (660, 24, 0.20),
+    ])
+    def test_fit_parity_flip_shapes(self, n, d, density):
+        from dask_ml_tpu.models.sgd import SGDClassifier
+
+        Xs, y = _xy(n, d, density=density, seed=11)
+        kw = dict(loss="log_loss", random_state=0, shuffle=False,
+                  max_iter=2)
+        with config.set(stream_block_rows=96, stream_mesh=1,
+                        stream_sparse=False):
+            ref = SGDClassifier(**kw).fit(
+                Xs.toarray().astype(np.float32), y
+            )
+        with config.set(stream_block_rows=96, stream_mesh=1):
+            got = SGDClassifier(**kw).fit(Xs, y)  # default-ON path
+        np.testing.assert_allclose(got.coef_, ref.coef_, rtol=1e-6,
+                                   atol=1e-6)
+        assert got.solver_info_["sparse_stream"] is True
+        assert got.solver_info_["sparse_stream_reason"] is None
+
+    @pytest.mark.parametrize("n,d,density", [
+        (520, 2 ** 10, 0.05),
+        (660, 24, 0.20),
+    ])
+    def test_glm_parity_flip_shapes(self, n, d, density):
+        from dask_ml_tpu.linear_model import LogisticRegression
+
+        Xs, y = _xy(n, d, density=density, seed=12)
+        with config.set(stream_block_rows=96, stream_mesh=1,
+                        stream_sparse=False):
+            ref = LogisticRegression(solver="gradient_descent",
+                                     max_iter=6).fit(
+                Xs.toarray().astype(np.float32), y
+            )
+        with config.set(stream_block_rows=96, stream_mesh=1):
+            got = LogisticRegression(solver="gradient_descent",
+                                     max_iter=6).fit(Xs, y)
+        np.testing.assert_allclose(got.coef_, ref.coef_, rtol=1e-5,
                                    atol=1e-6)
         assert got.solver_info_["sparse_stream"] is True
 
