@@ -331,6 +331,7 @@ def run():
         _try(_bench_int8_serving, jax, on_tpu, n_chips)
         _try(_bench_fleet, jax, on_tpu, n_chips)
         _try(_bench_drift, jax, on_tpu, n_chips)
+        _try(_bench_plan_warm_start, jax, on_tpu, n_chips)
     result["extra_metrics"] = extras
     # every successful metric also APPENDS to BENCH_floors.jsonl (run
     # marker + one kind="bench_metric" record each; the file is never
@@ -1007,6 +1008,97 @@ def _sharded_child_main():
         out["error"] = f"{type(exc).__name__}: {exc}"
         out["metric"] = "streamed_sgd_sharded_child"
     print(json.dumps(out), flush=True)
+
+
+def _plan_warm_child_main():
+    """Grandchild body for `_bench_plan_warm_start`: ONE process's
+    fit+serve startup — a streamed SGD fit plus a full serving-grid
+    warmup — through ``config.compile_cache_dir`` (the plan layer arms
+    it on every ProgramPlan build). One JSON line out; the parent runs
+    it twice against one cache dir to measure cold vs warm."""
+    out = {"error": None, "metric": "plan_warm_start_child"}
+    try:
+        cache = os.environ["BENCH_PLAN_WARM_CHILD"]
+        import numpy as np
+
+        from dask_ml_tpu import config as _cfg
+        from dask_ml_tpu.models.sgd import SGDClassifier
+        from dask_ml_tpu.serving import BucketLadder, ModelServer
+
+        rng = np.random.RandomState(11)
+        # small data on purpose: startup is the COMPILE bill (streamed
+        # scan + the serving grid), not the training compute — that is
+        # what the persistent cache amortizes
+        n, d = 16_384, 32
+        X = rng.randn(n, d).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        with _cfg.set(compile_cache_dir=cache, stream_block_rows=2048,
+                      stream_autotune=False, stream_mesh=1):
+            t0 = time.perf_counter()
+            clf = SGDClassifier(max_iter=2, random_state=0,
+                                shuffle=False)
+            clf.fit(X, y)
+            ModelServer(clf, methods=("predict",),
+                        ladder=BucketLadder(8, 256, 2.0)).warmup()
+            out["startup_s"] = time.perf_counter() - t0
+    except Exception as exc:  # one JSON line no matter what
+        out["error"] = f"{type(exc).__name__}: {exc}"
+    print(json.dumps(out), flush=True)
+
+
+def _bench_plan_warm_start(jax, on_tpu, n_chips):
+    """Plan warm-start section (ISSUE 15 satellite): cold-process vs
+    warm-process fit+serve startup through ``compile_cache_dir``. Two
+    identical grandchildren share one fresh cache directory: the first
+    (cold) pays every XLA compile and seeds the persistent cache, the
+    second (warm) replays them from disk. Records the warm startup
+    seconds and the cold/warm speedup ratio (>= 1 when the cache
+    works)."""
+    import tempfile
+
+    cache = tempfile.mkdtemp(prefix="bench_plan_warm_")
+    env = dict(os.environ, BENCH_PLAN_WARM_CHILD=cache)
+    env.pop("BENCH_CHILD", None)
+    # the ambient env cache (set at bench import for the DRIVER's
+    # compiles) would make "cold" warm — the child must see only the
+    # fresh per-section directory, via config.compile_cache_dir.
+    # Set "" rather than pop: the child re-imports bench.py, whose
+    # import-time setdefault would silently restore the shared
+    # .jax_cache for a missing var (an empty value is kept and
+    # disables jax's env-armed cache)
+    env["JAX_COMPILATION_CACHE_DIR"] = ""
+
+    def one():
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, timeout=300, capture_output=True, text=True,
+        )
+        obj = _last_json_line(r.stdout)
+        if not obj or obj.get("error") or obj.get("startup_s") is None:
+            raise RuntimeError(
+                "plan-warm child failed: "
+                f"{obj.get('error') if obj else 'no JSON line'} "
+                f"{(r.stderr or '')[-500:]}"
+            )
+        return float(obj["startup_s"])
+
+    cold = one()
+    warm = one()
+    backend = jax.default_backend()
+    common = {"unit": None, "backend": backend, "dtype": "float32",
+              "n_chips": n_chips}
+    return [
+        {**common, "metric": "plan_warm_start_seconds",
+         "value": round(warm, 3), "unit": "s",
+         "cold_start_seconds": round(cold, 3),
+         "baseline": {
+             "what": "identical child process against an empty "
+                     "compile cache (cold start)",
+             "seconds": round(cold, 3),
+         }},
+        {**common, "metric": "plan_warm_start_ratio",
+         "value": round(cold / max(warm, 1e-9), 3), "unit": "ratio"},
+    ]
 
 
 def _bench_fused_sharded_stream(jax, on_tpu, n_chips):
@@ -1933,6 +2025,9 @@ def main():
     surface), and a parent watchdog emits the error line at the deadline
     if everything else failed — the 'never exit without a JSON line'
     contract holds at the advertised bound."""
+    if os.environ.get("BENCH_PLAN_WARM_CHILD"):
+        _plan_warm_child_main()
+        return
     if os.environ.get("BENCH_SHARDED_CHILD"):
         _sharded_child_main()
         return

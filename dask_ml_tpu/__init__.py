@@ -10,6 +10,9 @@ Infrastructure layers:
 - ``io/``       — native (C++) block loaders
 - ``observability/`` — JSONL metrics, span tracing, runtime counters,
   run-report CLI (``python -m dask_ml_tpu.observability.report``)
+- ``plans/``    — the one execution plane for compiled programs: shape
+  ladders, ProgramPlan build path (cache/track/donate/compile-cache),
+  process-wide warmup registry
 - ``serving/``  — online inference: ModelServer micro-batching over a
   shape-bucket ladder with admission control and warmup
 - ``utils/``    — validation, checkpointing, testing
@@ -27,6 +30,6 @@ __all__ = [
     "cluster", "compose", "config", "datasets", "decomposition",
     "ensemble", "feature_extraction", "impute", "linear_model", "metrics",
     "model_selection", "naive_bayes", "observability", "ops", "parallel",
-    "preprocessing", "serving", "utils", "wrappers", "xgboost",
+    "plans", "preprocessing", "serving", "utils", "wrappers", "xgboost",
     "__version__",
 ]

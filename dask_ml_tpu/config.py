@@ -199,8 +199,22 @@ class Config:
     # persistent XLA compilation cache directory ("" = off): repeated
     # runs skip warm-up compiles for programs whose shapes/backends
     # match a cached entry (applies process-wide on first streamed fit
-    # or serving warmup after the knob is set)
+    # or serving warmup after the knob is set; every plans.ProgramPlan
+    # build arms it too)
     compile_cache_dir: str = ""
+    # -- execution plans (dask_ml_tpu/plans/) -----------------------------
+    # process-wide plan build cache: two ProgramPlan builds with an
+    # identical spec (name, cache key, donation, static axes) return
+    # the SAME tracked jitted entry point, so the second client's
+    # warmup hits warm jit caches instead of re-tracing/re-compiling
+    # (plan_cache_hits counts). Off = every build constructs a fresh
+    # jit (the pre-ISSUE-15 behavior)
+    plan_cache: bool = True
+    # force the process-wide WarmupRegistry to re-execute every warm
+    # request even for keys already registered warm (the executions are
+    # semantic no-ops; debugging aid for compile-cache investigations).
+    # Off (default) keeps warming idempotent per process
+    plan_rewarm: bool = False
     # JSONL metrics path ("" = disabled)
     metrics_path: str = ""
     # span-trace directory: spans append to <trace_dir>/trace.jsonl even

@@ -41,6 +41,7 @@ from ..utils.validation import check_array, check_is_fitted
 # -- jitted kernels ---------------------------------------------------------
 
 from ..observability import emit_jit_step, span, track_program
+from ..plans import tracked as plan_tracked
 
 
 @track_program("kmeans.lloyd")
@@ -200,7 +201,7 @@ def _block_moments(X, mask):
         jnp.tensordot(mask, X * X, axes=(0, 0))
 
 
-@track_program("superblock.kmeans_assign")
+@plan_tracked("superblock.kmeans_assign")
 @partial(jax.jit, static_argnames=("mxu_dtype",), donate_argnums=(0,))
 def _sb_assign_stats(acc, Xs, counts, centers, mxu_dtype=None):
     """Super-block Lloyd pass (ISSUE 3): scan the (K, S, d) stack
@@ -306,7 +307,7 @@ def _sb_assign_stats_sharded(mesh, mxu_dtype=None, fused=False,
 
     name = ("pallas.kmeans_stream.psum" if fused
             else "superblock.kmeans_assign.psum")
-    return track_program(name)(run)
+    return plan_tracked(name, run)
 
 
 def _sparse_block_assign_stats(db, cb, rb, c, centers, S):
@@ -359,7 +360,7 @@ def _sb_assign_stats_sparse(S, mesh=None):
                                   (data, cols, rows, counts))
             return acc
 
-        return track_program("superblock.sparse.kmeans_assign")(run)
+        return plan_tracked("superblock.sparse.kmeans_assign", run)
 
     from jax.sharding import PartitionSpec as P
 
@@ -392,10 +393,10 @@ def _sb_assign_stats_sparse(S, mesh=None):
         )
         return f(acc, data, cols, rows, counts, centers)
 
-    return track_program("superblock.sparse.kmeans_assign.psum")(run)
+    return plan_tracked("superblock.sparse.kmeans_assign.psum", run)
 
 
-@track_program("pallas.kmeans_stream")
+@plan_tracked("pallas.kmeans_stream")
 @partial(jax.jit, static_argnames=("mxu_dtype", "interpret"),
         donate_argnums=(0,))
 def _sb_assign_stats_pallas(acc, Xs, counts, centers, mxu_dtype=None,

@@ -33,6 +33,7 @@ import numpy as np
 from ..base import BaseEstimator, ClassifierMixin, RegressorMixin, to_host
 from ..metrics import accuracy_score, r2_score
 from ..observability import track_program
+from ..plans import tracked as plan_tracked, warmups as plan_warmups
 from ..parallel.sharded import ShardedArray, as_sharded
 from ..utils.validation import check_is_fitted
 
@@ -210,7 +211,7 @@ def _sgd_accum_apply(W, grad, lr, alpha, l2w, l1w):
     return W2.at[..., :-1].set(coef)
 
 
-@track_program("superblock.sgd_scan")
+@plan_tracked("superblock.sgd_scan")
 @partial(jax.jit, static_argnames=("loss", "n_out", "mxu"),
          donate_argnums=(0,))
 def _sgd_sb_scan(W, Xs, ys, counts, lrs, alpha, l2w, l1w, iflag, loss,
@@ -264,7 +265,7 @@ def _sgd_sb_scan(W, Xs, ys, counts, lrs, alpha, l2w, l1w, iflag, loss,
     return jax.lax.scan(scan_step, W, (Xs, ys, counts, lrs))
 
 
-@track_program("pallas.sgd_step")
+@plan_tracked("pallas.sgd_step")
 @partial(jax.jit, static_argnames=("loss", "n_out", "mxu", "interpret"),
          donate_argnums=(0,))
 def _sgd_sb_scan_pallas(W, Xs, ys, counts, lrs, alpha, l2w, l1w, iflag,
@@ -413,7 +414,7 @@ def _sgd_sb_scan_sparse(loss, n_out, S, mesh=None):
             return jax.lax.scan(scan_step, W,
                                 (data, cols, rows, ys, counts, lrs))
 
-        return track_program("superblock.sparse.sgd_scan")(run)
+        return plan_tracked("superblock.sparse.sgd_scan", run)
 
     from jax.sharding import PartitionSpec as P
 
@@ -485,10 +486,10 @@ def _sgd_sb_scan_sparse(loss, n_out, S, mesh=None):
         return f(W, data, cols, rows, ys, shard_counts, counts, lrs,
                  alpha, l2w, l1w, iflag)
 
-    return track_program("superblock.sparse.sgd_scan.psum")(run)
+    return plan_tracked("superblock.sparse.sgd_scan.psum", run)
 
 
-@track_program("superblock.sparse.grad_accum_micro")
+@plan_tracked("superblock.sparse.grad_accum_micro")
 @partial(jax.jit, static_argnames=("loss", "n_out", "S"))
 def _sgd_accum_micro_sparse(W, data, cols, rows, yb, mask, nv_group,
                             iflag, loss, n_out, S):
@@ -666,10 +667,10 @@ def _sgd_sb_scan_sharded(mesh, loss, n_out, mxu=None, fused=False,
                  l1w, iflag)
 
     name = "pallas.sgd_step.psum" if fused else "superblock.sgd_scan.psum"
-    return track_program(name)(run)
+    return plan_tracked(name, run)
 
 
-@track_program("sgd.fused_epoch")
+@plan_tracked("sgd.fused_epoch")
 @partial(jax.jit, static_argnames=("loss", "schedule", "n_out"))
 def _sgd_epoch(Xr, yr, order, W, t0, eta0, power_t, alpha, l2w, l1w,
                iflag, n_rows, loss, schedule, n_out):
@@ -720,7 +721,7 @@ def _sgd_epoch(Xr, yr, order, W, t0, eta0, power_t, alpha, l2w, l1w,
     return W, t
 
 
-@track_program("sgd.cohort_scan")
+@plan_tracked("sgd.cohort_scan", ladder="cohort-slots")
 @partial(jax.jit, static_argnames=("loss", "mxu"))
 def _sgd_cohort_scan(Xr, yr, NV, order, W, LRS, alphas, l2ws, l1ws,
                      iflags, loss, mxu=None):
@@ -757,7 +758,7 @@ def _sgd_cohort_scan(Xr, yr, NV, order, W, LRS, alphas, l2ws, l1ws,
     return W, losses[-1]
 
 
-@track_program("pallas.sgd_cohort")
+@plan_tracked("pallas.sgd_cohort", ladder="cohort-slots")
 @partial(jax.jit, static_argnames=("loss", "mxu", "interpret"))
 def _sgd_cohort_scan_pallas(Xr, yr, NV, order, W, LRS, alphas, l2ws,
                             l1ws, iflags, loss, mxu=None,
@@ -813,38 +814,25 @@ def _sgd_cohort_scan_pallas(Xr, yr, NV, order, W, LRS, alphas, l2ws,
 #     unchanged through the ``.at[idx].set`` scatter.
 
 
+# the slot-width ladder a search's cohort dispatches draw from: the
+# plans subsystem's SlotRungLadder (ISSUE 15 — powers of two below the
+# candidate count, then the full count, near-duplicate top power
+# dropped). Every rung compiles during round 1 (warmup dispatches
+# recorded in the process-wide plans WarmupRegistry, which replaced the
+# old module-level _COHORT_WARMED set), so a shrinking bracket later
+# picks its rung at zero new compiles — and a second search over the
+# same shapes skips the warmup executions entirely.
+from ..plans.ladders import SlotRungLadder as _SlotRungLadder  # noqa: E402
+
+_COHORT_LADDER = _SlotRungLadder()
+
+
 def _cohort_rungs(n_slots):
-    """The slot-width ladder a search's cohort dispatches draw from:
-    powers of two below the candidate count, then the full count (a
-    power within 25% of the full count is dropped — warming a
-    near-duplicate rung costs more than its padding ever saves). Every
-    rung compiles during round 1 (the warmup dispatches), so a
-    shrinking bracket later picks its rung at zero new compiles."""
-    n_slots = max(int(n_slots), 1)
-    out, r = [], 1
-    while r < n_slots:
-        out.append(r)
-        r *= 2
-    if out and out[-1] * 4 >= n_slots * 3:
-        out.pop()
-    out.append(n_slots)
-    return out
+    return _COHORT_LADDER.rungs_for(n_slots)
 
 
 def _cohort_rung_of(n_active, n_slots):
-    for r in _cohort_rungs(n_slots):
-        if r >= n_active:
-            return r
-    return max(int(n_slots), 1)
-
-
-# rung widths already warm-dispatched THIS process, keyed by everything
-# that determines the compiled scan's identity: a second search over
-# the same shapes (the steady-state of a long-running search service —
-# and the warm half of every A/B bench) skips the warmup executions
-# entirely, because the programs they exist to compile are already in
-# the jit caches
-_COHORT_WARMED = set()
+    return _COHORT_LADDER.rung_for(n_active, n_slots)
 
 
 def _cohort_gather(W, idx):
@@ -855,7 +843,7 @@ def _cohort_scatter(W, idx, Wc):
     return W.at[idx].set(Wc)
 
 
-@track_program("superblock.sgd_cohort")
+@plan_tracked("superblock.sgd_cohort", ladder="cohort-slots")
 @partial(jax.jit, static_argnames=("loss", "mxu"), donate_argnums=(0,))
 def _sgd_cohort_sb_scan(W, idx, Xs, ys, counts, LRS, ACT, alphas,
                         l2ws, l1ws, iflags, loss, mxu=None):
@@ -902,7 +890,7 @@ def _sgd_cohort_sb_scan(W, idx, Xs, ys, counts, LRS, ACT, alphas,
     return _cohort_scatter(W, idx, Wc), losses
 
 
-@track_program("pallas.sgd_cohort")
+@plan_tracked("pallas.sgd_cohort", ladder="cohort-slots")
 @partial(jax.jit, static_argnames=("loss", "mxu", "interpret"),
          donate_argnums=(0,))
 def _sgd_cohort_sb_scan_pallas(W, idx, Xs, ys, counts, LRS, ACT,
@@ -1051,7 +1039,7 @@ def _sgd_cohort_sb_scan_sharded(mesh, loss, mxu=None, fused=False,
 
     name = "pallas.sgd_cohort.psum" if fused \
         else "superblock.sgd_cohort.psum"
-    return track_program(name)(run)
+    return plan_tracked(name, run, ladder="cohort-slots")
 
 
 @_ft_sharded.lru_cache(maxsize=32)
@@ -1101,7 +1089,8 @@ def _sgd_cohort_sb_scan_sparse(loss, S, mesh=None):
             )
             return _cohort_scatter(W, idx, Wc), losses
 
-        return track_program("superblock.sparse.sgd_cohort")(run)
+        return plan_tracked("superblock.sparse.sgd_cohort", run,
+                            ladder="cohort-slots")
 
     from jax.sharding import PartitionSpec as P
 
@@ -1159,7 +1148,8 @@ def _sgd_cohort_sb_scan_sparse(loss, S, mesh=None):
                        l1ws, iflags)
         return _cohort_scatter(W, idx, Wc), losses
 
-    return track_program("superblock.sparse.sgd_cohort.psum")(run)
+    return plan_tracked("superblock.sparse.sgd_cohort.psum", run,
+                        ladder="cohort-slots")
 
 
 @partial(jax.jit, static_argnames=("n_rows",))
@@ -1743,9 +1733,11 @@ class _SGDBase(BaseEstimator):
                 # once over this super-block with an all-zero activity
                 # mask (weights pass through bit-identically), so the
                 # whole ladder is compiled before bracket shrinks ask
-                # for a narrower rung. Once per PROCESS per shape: a
-                # later search over the same shapes finds the programs
-                # already compiled and skips the executions
+                # for a narrower rung. Once per PROCESS per shape via
+                # the plans WarmupRegistry (ISSUE 15): a later search
+                # over the same shapes finds the programs already
+                # compiled and skips the executions — and the plans
+                # table names the rungs that minted them
                 slab0 = sb.arrays[0]
                 if not isinstance(slab0, SparseSlab) \
                         and state["flavor"] is None:
@@ -1759,21 +1751,39 @@ class _SGDBase(BaseEstimator):
                         n_slots, d, K, int(stream.block_rows),
                         slab0.cap if isinstance(slab0, SparseSlab)
                         else None, fl[0], str(fl[1]), fl[2])
+                # attribute warm rungs to the flavor that actually
+                # dispatches (sparse / fused / psum variants have their
+                # own program rows) — a surprise recompile must name
+                # the program that minted it, not a sibling
+                if isinstance(slab0, SparseSlab):
+                    cohort_prog = "superblock.sparse.sgd_cohort"
+                elif fl[0]:
+                    cohort_prog = "pallas.sgd_cohort"
+                else:
+                    cohort_prog = "superblock.sgd_cohort"
+                if sharded:
+                    cohort_prog += ".psum"
                 for rw in _cohort_rungs(n_slots):
-                    if rw == width or (wkey, rw) in _COHORT_WARMED:
+                    if rw == width \
+                            or plan_warmups.warmed(("cohort", wkey, rw)):
                         continue
                     W, _ = dispatch(
                         W, sb, np.arange(rw, dtype=np.int32),
                         np.ones((K, rw), np.float32),
                         np.zeros((K, rw), np.float32),
                     )
-                    _COHORT_WARMED.add((wkey, rw))
+                    plan_warmups.note(("cohort", wkey, rw),
+                                      program=cohort_prog,
+                                      ladder="cohort-slots", rung=rw,
+                                      ran=True)
                     info["warm_dispatches"] += 1
                 # the REAL dispatch below compiles this round's own
                 # width — register it too, or a later same-shape
                 # search starting at a different width would re-run
                 # its warm no-op for a program that already exists
-                _COHORT_WARMED.add((wkey, width))
+                plan_warmups.note(("cohort", wkey, width),
+                                  program=cohort_prog,
+                                  ladder="cohort-slots", rung=width)
             lr_k = np.ones((K, width), np.float32)
             act_k = np.zeros((K, width), np.float32)
             lr_k[:take] = LRS[pos:pos + take][:, idx]

@@ -32,6 +32,7 @@ from jax.sharding import PartitionSpec as P
 
 from ...parallel.mesh import DATA_AXIS
 from ...observability import emit_jit_step, track_program
+from ...plans import ProgramPlan
 from ..solvers import regularizers
 from ..solvers.families import get_family
 from ...ops.linalg import shard_map
@@ -902,10 +903,8 @@ def solve_multi(solver, X, Y, mask, n_rows, B0, family, reg, lam, pmask,
                              "n_iter_per_class": [int(i) for i in iters]}
 
 
-@track_program("glm.lbfgs_multi")
-@partial(jax.jit, static_argnames=("family", "reg", "C", "memory"))
-def _multi_stacked_chunk(X, Y, mask, n_rows, carry, lam, pmask, l1_ratio,
-                         stop_it, tol, family, reg, C, memory=10):
+def _multi_stacked_body(X, Y, mask, n_rows, carry, lam, pmask, l1_ratio,
+                        stop_it, tol, family, reg, C, memory=10):
     """Joint L-BFGS over the FLAT (C*d,) multi-target vector with an XLA
     data term: one (n,d)x(d,C) matmul serves every target's forward pass
     and one (d,n)x(n,C) their gradients. ``Y`` is (C, n) targets sharing
@@ -931,10 +930,8 @@ def _multi_stacked_chunk(X, Y, mask, n_rows, carry, lam, pmask, l1_ratio,
                        n_blocks=C)
 
 
-@track_program("glm.lbfgs_lam_grid")
-@partial(jax.jit, static_argnames=("family", "reg", "k", "memory"))
-def _lam_grid_chunk(X, y, mask, n_rows, carry, lams, pmask, stop_it, tol,
-                    family, reg, k, memory=10):
+def _lam_grid_body(X, y, mask, n_rows, carry, lams, pmask, stop_it, tol,
+                   family, reg, k, memory=10):
     """Joint L-BFGS over the FLAT (k*d,) stacked-lam vector: the k
     forward matvecs batch into ONE (n,d)x(d,k) matmul (and the gradient
     into one (d,n)x(n,k)) — real MXU contractions, unlike vmapping the
@@ -963,10 +960,8 @@ def _lam_grid_chunk(X, y, mask, n_rows, carry, lams, pmask, stop_it, tol,
                        n_blocks=k)
 
 
-@track_program("glm.lbfgs_lam_grid_multi")
-@partial(jax.jit, static_argnames=("family", "reg", "k", "C", "memory"))
-def _lam_grid_multi_chunk(X, Y, mask, n_rows, carry, lams, pmask, stop_it,
-                          tol, family, reg, k, C, memory=10):
+def _lam_grid_multi_body(X, Y, mask, n_rows, carry, lams, pmask, stop_it,
+                         tol, family, reg, k, C, memory=10):
     """C-grid x one-vs-rest: k candidates x C classes as ONE stacked
     (k*C*d,) joint solve. ``Y`` is (C, n) one-hot targets shared by all
     candidates; block j = i*C + c solves class c at lam_i. One
@@ -990,6 +985,32 @@ def _lam_grid_multi_chunk(X, Y, mask, n_rows, carry, lams, pmask, stop_it,
 
     return _lbfgs_loop(loss, carry, stop_it, tol, memory, False,
                        n_blocks=k * C)
+
+
+# The stacked C-grid / OvR direct-solve programs build through the plan
+# layer (ISSUE 15): identical jit flags and bodies (jaxprs byte-
+# identical to the decorator-built programs — asserted in
+# tests/test_plans.py), with cache keying / track_program registration /
+# compile_cache_dir arming owned by plans.ProgramPlan instead of this
+# call site. Module-level builds, so XLA's compile cache is shared
+# across estimator instances exactly as before.
+_multi_stacked_chunk = ProgramPlan(
+    name="glm.lbfgs_multi", body=_multi_stacked_body,
+    static_argnames=("family", "reg", "C", "memory"),
+    group="stacked-solve",
+).build()
+
+_lam_grid_chunk = ProgramPlan(
+    name="glm.lbfgs_lam_grid", body=_lam_grid_body,
+    static_argnames=("family", "reg", "k", "memory"),
+    group="stacked-solve",
+).build()
+
+_lam_grid_multi_chunk = ProgramPlan(
+    name="glm.lbfgs_lam_grid_multi", body=_lam_grid_multi_body,
+    static_argnames=("family", "reg", "k", "C", "memory"),
+    group="stacked-solve",
+).build()
 
 
 def solve_lam_grid_multi(X, Y, mask, n_rows, lams, pmask, family, reg,

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...observability import track_program
+from ...plans import tracked as plan_tracked
 from . import regularizers
 from .families import get_family
 
@@ -363,7 +364,7 @@ def _sb_reducer_sharded(kind, family, intercept, n_classes, mesh,
     suffix = "_multi" if n_classes else ""
     name = (f"pallas.glm_{kind}{suffix}.psum" if fused
             else f"superblock.glm.{kind}{suffix}.psum")
-    return track_program(name)(run)
+    return plan_tracked(name, run)
 
 
 @_ft.lru_cache(maxsize=64)
@@ -432,7 +433,7 @@ def _sb_reducer(kind, family, intercept, n_classes, mxu=None,
             return acc
 
         suffix = "_multi" if n_classes else ""
-        return track_program(f"pallas.glm_{kind}{suffix}")(run_fused)
+        return plan_tracked(f"pallas.glm_{kind}{suffix}", run_fused)
     fn, extra = _reducer_blocks(kind, n_classes)
 
     @partial(jax.jit, donate_argnums=(0,))
@@ -458,7 +459,7 @@ def _sb_reducer(kind, family, intercept, n_classes, mxu=None,
         return acc
 
     suffix = "_multi" if n_classes else ""
-    return track_program(f"superblock.glm.{kind}{suffix}")(run)
+    return plan_tracked(f"superblock.glm.{kind}{suffix}", run)
 
 
 # -- device-resident sparse reducers (ISSUE 13 tentpole) --------------------
@@ -560,7 +561,8 @@ def _sb_reducer_sparse(kind, family, intercept, n_classes, n_rows,
                                   (data, cols, rows, ys, counts))
             return acc
 
-        return track_program(f"superblock.sparse.glm.{kind}{suffix}")(run)
+        return plan_tracked(f"superblock.sparse.glm.{kind}{suffix}",
+                            run)
 
     from jax.sharding import PartitionSpec as P
 
@@ -599,9 +601,9 @@ def _sb_reducer_sparse(kind, family, intercept, n_classes, n_rows,
         )
         return f(acc, beta, data, cols, rows, ys, counts)
 
-    return track_program(
-        f"superblock.sparse.glm.{kind}{suffix}.psum"
-    )(run)
+    return plan_tracked(
+        f"superblock.sparse.glm.{kind}{suffix}.psum", run
+    )
 
 
 @_ft.lru_cache(maxsize=32)
@@ -653,7 +655,8 @@ def _sb_admm_local(local_iter, family, intercept, n_classes,
 
     suffix = "_multi" if n_classes else ""
     tail = ".gspmd" if gspmd else ""
-    return track_program(f"superblock.glm.admm_local{suffix}{tail}")(run)
+    return plan_tracked(f"superblock.glm.admm_local{suffix}{tail}",
+                        run)
 
 
 # ---------------------------------------------------------------------------

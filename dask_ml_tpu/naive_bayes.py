@@ -16,9 +16,58 @@ import numpy as np
 from .base import BaseEstimator, ClassifierMixin, to_host
 from .metrics import accuracy_score
 from .parallel.sharded import ShardedArray
+from .plans import GeometricLadder, ProgramPlan, warmups
 from .utils.validation import check_X_y, check_array, check_is_fitted
 
 __all__ = ["GaussianNB"]
+
+# -- execution-plan declarations (ISSUE 15) ---------------------------------
+# GaussianNB is the "any new estimator gets streaming + serving for
+# free" proof: ONE ProgramPlan (the donated-carry per-block class-stats
+# reducer below) + one shape ladder is the whole streamed-fit story —
+# `Incremental(GaussianNB())` then streams blocks through it with zero
+# steady-state compiles, and `wrappers._nb_extract` serves the fitted
+# model through the same plan-built zero-recompile serving entry points
+# as the linear family (warmable via ModelServer.warmup()).
+
+# block heights pad up this ladder so a whole streamed fit touches at
+# most two compiled rungs (full blocks + the ragged tail)
+_STREAM_LADDER = GeometricLadder(min_rows=256, max_rows=1 << 22,
+                                 growth=2.0)
+
+
+def _nb_partial_stats_body(carry, Xp, codes, mask, k):
+    """One padded block folded into the running per-class
+    (count, sum, sum-of-squares) stats — Gaussian NB's whole sufficient
+    statistic, so the streamed fit is one masked matmul pair per
+    block. ``codes`` are class indices (host-encoded, so label dtype
+    never enters the trace); ``k`` is static."""
+    counts, sums, sqs = carry
+    cm = (codes[None, :] == jnp.arange(k, dtype=Xp.dtype)[:, None]) \
+        .astype(Xp.dtype) * mask[None, :]
+    counts = counts + jnp.sum(cm, axis=1)
+    sums = sums + cm @ Xp                                # (k, d) on MXU
+    sqs = sqs + cm @ (Xp * Xp)
+    return counts, sums, sqs
+
+
+# the fitted attributes the streamed path publishes lazily from the
+# device-resident running stats (see GaussianNB.__getattr__)
+_NB_STAT_ATTRS = ("theta_", "var_", "class_prior_", "class_count_")
+
+_NB_STATS_PLAN = ProgramPlan(
+    name="plans.nb.partial_stats", body=_nb_partial_stats_body,
+    donate=(0,), static_argnames=("k",), ladder="nb-rows",
+    group="naive-bayes",
+)
+_NB_STATS = None
+
+
+def _nb_stats():
+    global _NB_STATS
+    if _NB_STATS is None:
+        _NB_STATS = _NB_STATS_PLAN.build()
+    return _NB_STATS
 
 
 @jax.jit
@@ -33,8 +82,11 @@ def _class_stats(X, y, mask, classes):
     return counts, means, jnp.maximum(var, 0.0)
 
 
-@jax.jit
-def _joint_log_likelihood(X, theta, var, log_prior):
+def _jll_math(X, theta, var, log_prior):
+    """The one joint-log-likelihood definition — the in-core predict
+    below AND the plan-built serving core (wrappers._nb_core) both
+    trace THIS function, so a numerical change can never diverge the
+    served predictions from GaussianNB.predict."""
     # -0.5 * sum((x-mu)^2/var) - 0.5*sum(log 2 pi var) + log prior
     prec = 1.0 / var                                     # (k, d)
     x2 = (X * X) @ prec.T                                # (n, k)
@@ -43,6 +95,9 @@ def _joint_log_likelihood(X, theta, var, log_prior):
     quad = x2 - 2.0 * xm + m2[None, :]
     logdet = jnp.sum(jnp.log(2.0 * jnp.pi * var), axis=1)
     return -0.5 * (quad + logdet[None, :]) + log_prior[None, :]
+
+
+_joint_log_likelihood = jax.jit(_jll_math)
 
 
 class GaussianNB(ClassifierMixin, BaseEstimator):
@@ -74,6 +129,120 @@ class GaussianNB(ClassifierMixin, BaseEstimator):
             self.class_prior_ = self.class_count_ / self.class_count_.sum()
         self.n_features_in_ = X.shape[1]
         return self
+
+    # -- streamed out-of-core fit (ISSUE 15) ------------------------------
+    def partial_fit(self, X, y, classes=None):
+        """Fold one block of rows into the running per-class stats via
+        the plan-built donated-carry reducer — the streamed fit
+        ``Incremental(GaussianNB())`` drives block by block. Blocks pad
+        up the plans GeometricLadder (mask co-located with the rung
+        choice), so a whole multi-pass fit touches a bounded compiled
+        set and pays zero XLA compiles after pass 1."""
+        import scipy.sparse as sp
+
+        if isinstance(X, ShardedArray):
+            Xh = X.to_numpy()
+        elif sp.issparse(X):
+            Xh = X.toarray()
+        else:
+            Xh = X
+        Xh = np.asarray(Xh, np.float32)
+        if Xh.ndim == 1:
+            Xh = Xh[None, :]
+        yh = np.asarray(y.to_numpy() if isinstance(y, ShardedArray)
+                        else y).ravel()
+        if getattr(self, "_stats_", None) is None:
+            if classes is None:
+                raise ValueError(
+                    "classes= is required on the first partial_fit"
+                )
+            self.classes_ = np.unique(np.asarray(classes))
+            k, d = len(self.classes_), int(Xh.shape[1])
+            self._stats_ = (jnp.zeros((k,), jnp.float32),
+                            jnp.zeros((k, d), jnp.float32),
+                            jnp.zeros((k, d), jnp.float32))
+            self.n_features_in_ = d
+        if Xh.shape[1] != self.n_features_in_:
+            raise ValueError(
+                f"block has {Xh.shape[1]} features; this fit started "
+                f"with {self.n_features_in_}"
+            )
+        k = len(self.classes_)
+        idx = np.searchsorted(self.classes_, yh)
+        ok = (idx < k) & (self.classes_[np.minimum(idx, k - 1)] == yh)
+        if not np.all(ok):
+            raise ValueError(
+                f"y contains labels outside classes= "
+                f"({np.asarray(yh)[~ok][:3]!r} ...)"
+            )
+        codes = idx.astype(np.float32)
+        # fold in top-rung chunks: a block taller than the ladder's top
+        # is the caller's batch, not a reason to refuse a fit
+        top = _STREAM_LADDER.max_rows
+        for lo in range(0, Xh.shape[0], top):
+            xb, cb = Xh[lo:lo + top], codes[lo:lo + top]
+            n = xb.shape[0]
+            rung = _STREAM_LADDER.rung_for(n)
+            Xp = _STREAM_LADDER.pad_rows(xb, rung)
+            cp = _STREAM_LADDER.pad_rows(cb, rung)
+            mask = _STREAM_LADDER.row_mask(n, rung)
+            self._stats_ = _nb_stats()(self._stats_, Xp, cp, mask, k=k)
+            # attribution: the real dispatch minted (or reused) this
+            # rung's specialization — the plans table names it
+            warmups.note(("nb-stats", k, self.n_features_in_, rung),
+                         program="plans.nb.partial_stats",
+                         ladder="nb-rows", rung=rung)
+        # publishing is LAZY (see __getattr__): pulling the stats to
+        # host here would synchronize every streamed block's device
+        # computation with the host loop; dropping the published attrs
+        # instead keeps the fitted-attribute contract (any read
+        # publishes first) without the per-block sync
+        for a in _NB_STAT_ATTRS:
+            self.__dict__.pop(a, None)
+        return self
+
+    def __getattr__(self, name):
+        # fitted-stat attributes materialize on first read after a
+        # partial_fit (the streamed path defers the device->host pull)
+        if name in _NB_STAT_ATTRS \
+                and self.__dict__.get("_stats_") is not None:
+            self._publish_from_stats()
+            return self.__dict__[name]
+        raise AttributeError(
+            f"{type(self).__name__!r} object has no attribute {name!r}"
+        )
+
+    def __getstate__(self):
+        # pickle the PUBLISHED view (host numpy stats): a restored
+        # estimator predicts immediately and can keep partial_fitting —
+        # jnp re-adopts numpy carries on the next block
+        if self.__dict__.get("_stats_") is not None:
+            self._publish_from_stats()
+        state = dict(self.__dict__)
+        st = state.get("_stats_")
+        if st is not None:
+            state["_stats_"] = tuple(np.asarray(a) for a in st)
+        return state
+
+    def _publish_from_stats(self):
+        counts, sums, sqs = (np.asarray(a, np.float64)
+                             for a in self._stats_)
+        tot = max(float(counts.sum()), 1.0)
+        means = sums / np.maximum(counts[:, None], 1.0)
+        var = np.maximum(
+            sqs / np.maximum(counts[:, None], 1.0) - means ** 2, 0.0
+        )
+        gmean = sums.sum(axis=0) / tot
+        gvar = np.maximum(sqs.sum(axis=0) / tot - gmean ** 2, 0.0)
+        eps = self.var_smoothing * float(np.max(gvar)) \
+            if gvar.size else 0.0
+        self.class_count_ = counts
+        self.theta_ = means
+        self.var_ = var + eps
+        if self.priors is not None:
+            self.class_prior_ = np.asarray(self.priors, np.float64)
+        else:
+            self.class_prior_ = counts / tot
 
     def _jll(self, X):
         X = check_array(X, dtype=np.float32)

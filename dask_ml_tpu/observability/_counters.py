@@ -68,6 +68,22 @@ def record_donation(nbytes: int) -> None:
         counter_add("donated_buffers_reused", 1)
 
 
+def record_plan_build(cached: bool = False) -> None:
+    """One ProgramPlan build: ``plan_builds`` for a fresh tracked jit,
+    ``plan_cache_hits`` when the process-wide build cache returned an
+    existing entry point (the second client's free warmup)."""
+    if counters_enabled():
+        counter_add("plan_cache_hits" if cached else "plan_builds", 1)
+
+
+def record_plan_warmup(hit: bool = False) -> None:
+    """One WarmupRegistry event: ``plan_warmups`` for an executed warm
+    call, ``plan_cache_hits`` for a skip (the key — and therefore the
+    compile it would have minted — was already warm)."""
+    if counters_enabled():
+        counter_add("plan_cache_hits" if hit else "plan_warmups", 1)
+
+
 def record_superblock(n_blocks: int) -> None:
     """One super-block dispatch covering ``n_blocks`` real streamed
     blocks — superblock_blocks / superblock_dispatches is the measured

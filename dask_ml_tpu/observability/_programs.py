@@ -88,10 +88,19 @@ def _entry(name: str) -> dict:
 
 
 def programs_snapshot() -> list[dict]:
-    """Registry rows (copies), most FLOPs-total first."""
+    """Registry rows (copies), most FLOPs-total first. Rows of
+    plan-built programs carry their ``plan`` (owning plan group) and
+    ``ladder_rung`` attribution (ISSUE 15) so a surprise recompile
+    names the ladder that minted it."""
     with _lock:
         rows = [{k: v for k, v in e.items() if not k.startswith("_")}
                 for e in _programs.values()]
+    try:
+        from ..plans.plan import annotate_programs
+
+        annotate_programs(rows)
+    except Exception:  # pragma: no cover - attribution never breaks it
+        pass
     rows.sort(key=lambda e: -(e["flops_total"] or 0.0))
     return rows
 
@@ -297,6 +306,16 @@ def log_programs(logger, peak=True, **extra) -> list[dict]:
     if logger is None:
         return snap
     rec = {"programs": snap}
+    # the plans table rides the same record (ISSUE 15): which plan /
+    # ladder rung minted each warmed specialization
+    try:
+        from ..plans import plans_snapshot
+
+        plrows = plans_snapshot()
+    except Exception:
+        plrows = None
+    if plrows:
+        rec["plans"] = plrows
     if peak:
         try:
             import jax

@@ -442,6 +442,16 @@ def status_data() -> dict:
     progs = programs_snapshot()
     if progs:
         records.append({"programs": progs})
+    # the plans table (ISSUE 15): which plan/ladder rung minted each
+    # warmed specialization — rides the same report aggregator
+    try:
+        from ..plans import plans_snapshot
+
+        plrows = plans_snapshot()
+    except Exception:
+        plrows = None
+    if plrows:
+        records.append({"plans": plrows})
     hists = {}
     for (name, labels), h in histograms_snapshot().items():
         key = f"{name}{_labels_str(labels)}"

@@ -361,6 +361,17 @@ def final_programs(records):
     return []
 
 
+def final_plans(records):
+    """The LAST execution-plan snapshot (rides ``log_programs`` records
+    since ISSUE 15), or []: one row per planned program — plan group,
+    shape ladder, the rungs that minted specializations, warmup /
+    cache-hit counts."""
+    for r in reversed(records):
+        if isinstance(r.get("plans"), list):
+            return r["plans"]
+    return []
+
+
 def resolved_peak(records):
     """The peak-FLOPs fields riding the last programs record (None when
     the run never recorded them — MFU columns are then skipped)."""
@@ -426,6 +437,7 @@ def report_data(records):
         "counters": final_counters(records),
         "reliability": reliability_summary(records),
         "programs": final_programs(records),
+        "plans": final_plans(records),
         "peak": peak,
         "watchdog_stalls": [
             {"span": s, "thread": t, "age_s": a, "threads_dumped": n}
@@ -502,6 +514,10 @@ def build_report(records, path="<records>"):
         # measured number everywhere
         sync_exec = bool(peak and "cpu" in
                          str(peak.get("device_kind") or "").lower())
+        # plan/ladder:rung attribution column (ISSUE 15) — only when
+        # any row carries it, so pre-plans records render unchanged
+        has_plan = any(p.get("plan") or p.get("ladder_rung")
+                       for p in progs)
         rows = []
         for p in progs:
             flops = p.get("flops_per_call")
@@ -515,24 +531,36 @@ def build_report(records, path="<records>"):
             mfu = (_fmt_mfu(ftot / exec_s / total_peak)
                    if sync_exec and total_peak and exec_s > 0 and ftot
                    else "-")
-            rows.append((
+            row = (
                 p.get("program"), p.get("compiles", 0),
                 _fmt_seconds(p.get("compile_s") or 0.0),
                 p.get("calls", 0),
                 _fmt_flops(flops) if flops else "-",
                 _fmt_bytes(hbm) if hbm else "-",
                 mfu,
-            ))
+            )
+            if has_plan:
+                row += (p.get("ladder_rung") or p.get("plan") or "-",)
+            rows.append(row)
         title = "programs (XLA cost/memory per compiled entry point)"
         if peak:
             title += (f"  [peak {peak['flop_per_s_per_chip']:.3g} "
                       f"FLOP/s/chip x{peak['n_chips']}, "
                       f"{peak['source']}]")
+        headers = ("program", "compiles", "compile_s", "calls",
+                   "flops/call", "hbm_peak", "mfu")
+        if has_plan:
+            headers += ("plan",)
+        lines += _table(title, headers, rows)
+    plans = data.get("plans") or []
+    if plans:
         lines += _table(
-            title,
-            ("program", "compiles", "compile_s", "calls", "flops/call",
-             "hbm_peak", "mfu"),
-            rows,
+            "plans (execution plans: ladder rungs / warmups)",
+            ("program", "plan", "ladder", "rungs", "warmups",
+             "warm_hits"),
+            [(p.get("program"), p.get("plan"), p.get("ladder"),
+              p.get("rungs"), p.get("warmups"), p.get("warm_hits"))
+             for p in plans],
         )
     stalls = data["watchdog_stalls"]
     if stalls:

@@ -37,9 +37,16 @@ __all__ = ["SparseSlab", "SparseStreamPlan", "plan_sparse_stream",
 
 # nnz-bucket ladder policy: rungs grow geometrically from _NNZ_MIN so
 # tiny blocks don't mint per-nnz shapes; growth 2.0 bounds padded-nnz
-# waste below 50% of any staged block
+# waste below 50% of any staged block. The policy itself is the plans
+# subsystem's NnzLadder (ISSUE 15) — the never-clamp semantics
+# documented on _nnz_rung live there now, shared with the serving nnz
+# grid's attribution
 _NNZ_MIN = 128
 _NNZ_GROWTH = 2.0
+
+from ..plans.ladders import NnzLadder as _NnzLadder  # noqa: E402
+
+_NNZ_LADDER = _NnzLadder(min_nnz=_NNZ_MIN, growth=_NNZ_GROWTH)
 
 
 class SparseSlab:
@@ -116,16 +123,15 @@ def coo_rows(a, lo, hi):
 def _nnz_rung(nnz: int, top: int) -> int:
     """Smallest ladder rung >= nnz: geometric from _NNZ_MIN, clipped to
     ``top`` (the max any block needs). Deliberately NOT serving's
-    BucketLadder even though the min/growth policy matches: the ladder
-    there CLAMPS its last rung to ``max_rows`` exactly (padding waste
-    matters per request), while the staging capacity must stay a pure
-    geometric rung — clamping cap to the observed max nnz would key the
-    compiled scan shape to the corpus's exact nnz instead of its
-    bucket, minting a fresh specialization per corpus."""
-    r = _NNZ_MIN
-    while r < nnz:
-        r = int(np.ceil(r * _NNZ_GROWTH))
-    return min(r, max(top, 1)) if top else r
+    clamped GeometricLadder even though the min/growth policy matches:
+    the ladder there CLAMPS its last rung to ``max_rows`` exactly
+    (padding waste matters per request), while the staging capacity
+    must stay a pure geometric rung — clamping cap to the observed max
+    nnz would key the compiled scan shape to the corpus's exact nnz
+    instead of its bucket, minting a fresh specialization per corpus.
+    Delegates to the plans subsystem's NnzLadder, which encodes exactly
+    that never-clamp policy."""
+    return _NNZ_LADDER.rung_for(int(nnz), top=int(top))
 
 
 class SparseStreamPlan:

@@ -1,0 +1,142 @@
+"""WarmupRegistry: process-wide, idempotent, attributable warmup.
+
+Warming — executing a compiled entry point once per shape rung so
+steady-state traffic pays zero XLA compiles — used to be three
+unrelated mechanisms: ``ModelServer.warmup()``/``warmup_sparse()``
+walking the serving grids, the search plane's module-level
+``_COHORT_WARMED`` set, and ``rebuild_model``'s off-path rewarm. This
+registry subsumes them:
+
+- **idempotent**: a warm key covers everything that determines the
+  compiled program's identity (the plan token of the entry point, the
+  rung, the operand geometry). A second client asking to warm an
+  already-warm key skips the execution — with the plan build cache on,
+  a second server over the same-shaped model warms for free;
+- **attributable**: every warm records (program, ladder, rung), so the
+  ``plans`` table on ``/status`` and in the report CLI shows which
+  ladder rung minted each specialization, and the
+  ``plan_warmups`` / ``plan_cache_hits`` counters make warming cheap
+  to assert in smokes;
+- **overridable**: ``config.plan_rewarm`` forces every warm to
+  re-execute (debugging aid; the executions are semantic no-ops).
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["WarmupRegistry", "warmups"]
+
+
+class WarmupRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._warmed: dict = {}
+
+    def warmed(self, key) -> bool:
+        """True when ``key`` is already warm (always False under
+        ``config.plan_rewarm``)."""
+        from ..config import get_config
+
+        if get_config().plan_rewarm:
+            return False
+        with self._lock:
+            return key in self._warmed
+
+    def note(self, key, program=None, ladder=None, rung=None,
+             ran=False) -> None:
+        """Register ``key`` as warm WITHOUT executing anything — for
+        call sites whose real dispatch just compiled the program (the
+        cohort scan's own round-width dispatch). ``ran=True`` marks a
+        warm execution this registry is accounting for."""
+        from ..observability._counters import record_plan_warmup
+        from .plan import note_rung
+
+        with self._lock:
+            rec = self._warmed.get(key)
+            if rec is None:
+                rec = self._warmed[key] = {
+                    "program": program, "ladder": ladder, "rung": rung,
+                    "ran": bool(ran), "hits": 0,
+                }
+                fresh = True
+            else:
+                fresh = False
+        if fresh:
+            note_rung(program, rung)
+            if ran:
+                record_plan_warmup()
+
+    def warm(self, key, thunk, program=None, ladder=None,
+             rung=None) -> bool:
+        """Execute ``thunk`` once per key: returns True when it ran,
+        False when the key was already warm (counted as a
+        ``plan_cache_hits`` — the compile it would have minted already
+        exists)."""
+        if self.warmed(key):
+            from ..observability._counters import record_plan_warmup
+
+            record_plan_warmup(hit=True)
+            with self._lock:
+                rec = self._warmed.get(key)
+                if rec is not None:
+                    rec["hits"] += 1
+            return False
+        thunk()
+        self.note(key, program=program, ladder=ladder, rung=rung,
+                  ran=True)
+        return True
+
+    def stats_by_program(self) -> dict:
+        """{program: {"warmups": executed, "hits": skipped}} — the
+        plans-table numbers."""
+        out: dict = {}
+        with self._lock:
+            for rec in self._warmed.values():
+                p = rec.get("program")
+                if p is None:
+                    continue
+                e = out.setdefault(p, {"warmups": 0, "hits": 0})
+                if rec.get("ran"):
+                    e["warmups"] += 1
+                e["hits"] += int(rec.get("hits", 0))
+        return out
+
+    def snapshot(self) -> list:
+        """One row per warmed key family, aggregated by
+        (program, ladder): the rungs warmed and the execution/skip
+        counts."""
+        groups: dict = {}
+        with self._lock:
+            for rec in self._warmed.values():
+                gkey = (rec.get("program"), rec.get("ladder"))
+                g = groups.setdefault(gkey, {"rungs": set(),
+                                             "warmups": 0, "hits": 0})
+                if rec.get("rung") is not None:
+                    g["rungs"].add(rec["rung"])
+                if rec.get("ran"):
+                    g["warmups"] += 1
+                g["hits"] += int(rec.get("hits", 0))
+        rows = []
+        for (program, ladder) in sorted(
+                groups, key=lambda k: (str(k[0]), str(k[1]))):
+            g = groups[(program, ladder)]
+            rows.append({
+                "program": program or "-",
+                "ladder": ladder or "-",
+                "rungs": ",".join(str(r) for r in sorted(g["rungs"]))
+                         or "-",
+                "warmups": g["warmups"],
+                "warm_hits": g["hits"],
+            })
+        return rows
+
+    def reset(self) -> None:
+        with self._lock:
+            self._warmed.clear()
+
+
+# THE process-wide registry (like the program/counter registries in
+# observability): warming is a property of the process's jit caches, so
+# its bookkeeping must be too
+warmups = WarmupRegistry()

@@ -9,41 +9,30 @@ steady-state serving touches at most ``len(ladder)`` compiled programs
 per method, all of which ``ModelServer.warmup()`` can compile before the
 first request arrives.
 
-Geometric (not linear) spacing is the padding/compile trade: with growth
-``g`` the padded rows waste less than ``(g-1)/g`` of any batch while the
-rung count stays logarithmic in ``max/min``.
+Since ISSUE 15 the policy itself lives in the plans subsystem
+(:class:`dask_ml_tpu.plans.GeometricLadder` — the same rung math also
+feeds the sparse serving nnz grid and the plans warmup registry);
+``BucketLadder`` is the serving-configured instance. Geometric (not
+linear) spacing is the padding/compile trade: with growth ``g`` the
+padded rows waste less than ``(g-1)/g`` of any batch while the rung
+count stays logarithmic in ``max/min``.
 """
 
 from __future__ import annotations
 
-import math
+from ..plans.ladders import GeometricLadder
 
 __all__ = ["BucketLadder"]
 
 
-class BucketLadder:
+class BucketLadder(GeometricLadder):
     """The geometric sequence of padded batch heights.
 
     ``bucket_for(n)`` returns the smallest rung >= n; callers chunk
     requests taller than the top rung (``max_rows``) before asking.
     """
 
-    __slots__ = ("buckets",)
-
-    def __init__(self, min_rows=8, max_rows=1024, growth=2.0):
-        if min_rows < 1:
-            raise ValueError(f"min_rows must be >= 1, got {min_rows}")
-        if max_rows < min_rows:
-            raise ValueError(
-                f"max_rows={max_rows} < min_rows={min_rows}"
-            )
-        if growth <= 1.0:
-            raise ValueError(f"growth must be > 1, got {growth}")
-        rungs = [int(min_rows)]
-        while rungs[-1] < max_rows:
-            nxt = max(int(math.ceil(rungs[-1] * growth)), rungs[-1] + 1)
-            rungs.append(min(nxt, int(max_rows)))
-        self.buckets = tuple(rungs)
+    __slots__ = ()
 
     @classmethod
     def from_config(cls):
@@ -56,33 +45,5 @@ class BucketLadder:
             growth=cfg.serving_bucket_growth,
         )
 
-    @property
-    def max_rows(self) -> int:
-        return self.buckets[-1]
-
-    def __len__(self):
-        return len(self.buckets)
-
-    def __iter__(self):
-        return iter(self.buckets)
-
     def __repr__(self):
         return f"BucketLadder{self.buckets}"
-
-    def bucket_for(self, n_rows: int) -> int:
-        """Smallest rung >= n_rows. Raises for batches taller than the
-        top rung — the batcher must chunk those, padding DOWN would drop
-        rows and padding up past max would mint a novel shape."""
-        if n_rows > self.buckets[-1]:
-            raise ValueError(
-                f"batch of {n_rows} rows exceeds the top bucket "
-                f"{self.buckets[-1]}; chunk before bucketing"
-            )
-        for b in self.buckets:
-            if b >= n_rows:
-                return b
-        raise AssertionError("unreachable")  # pragma: no cover
-
-    def padding_for(self, n_rows: int) -> int:
-        """Rows of padding the ladder charges a batch of ``n_rows``."""
-        return self.bucket_for(n_rows) - n_rows

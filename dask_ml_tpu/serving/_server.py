@@ -511,6 +511,14 @@ class ModelServer:
         this, a workload whose batches stay on the ladder triggers zero
         new XLA compiles.
 
+        Warming routes through the process-wide plans WarmupRegistry
+        (ISSUE 15): each (entry point, rung) warms at most once per
+        process — a second server over the same-shaped model (whose
+        plan-cached build shares the first's compiled entry points)
+        skips the redundant executions (``plan_cache_hits`` counts),
+        and the plans table on ``/status`` / in the report CLI shows
+        which ladder rung minted each specialization.
+
         With ``config.compile_cache_dir`` set, these compiles also land
         in jax's persistent compilation cache: warmup still walks the
         full (method, bucket) grid, but a later process serving the same
@@ -526,7 +534,35 @@ class ModelServer:
         self._warmed = True
         return self
 
+    @staticmethod
+    def _plan_token(fn):
+        """The warm-dedup identity of a compiled entry point: the plan
+        token of its (innermost, for pipelines) tracked jit. Plan-cached
+        builds share tokens exactly when they share executables, so the
+        registry skips precisely the warms whose compiles already
+        exist; a host fallback (or a jit built outside the plan layer)
+        gets a per-object token."""
+        inner = fn
+        while getattr(inner, "_inner", None) is not None:
+            inner = inner._inner
+        tgt = getattr(inner, "_fn", None)
+        tok = getattr(tgt, "plan_token", None)
+        return tok if tok is not None else ("obj", id(fn))
+
+    @staticmethod
+    def _plan_prog(fn):
+        """The program name warmups attribute to — the innermost
+        tracked jit's (a pipeline's own ``_fn`` is None; its compiled
+        program is the final step's leaf)."""
+        inner = fn
+        while getattr(inner, "_inner", None) is not None:
+            inner = inner._inner
+        return getattr(getattr(inner, "_fn", None), "program_name",
+                       None)
+
     def _warm_fns(self, fns):
+        from ..plans import warmups
+
         for method, fn in fns.items():
             if not fn.jitted:
                 continue   # host fallback: nothing to compile
@@ -536,8 +572,19 @@ class ModelServer:
                     "cannot infer n_features for warmup; estimator "
                     "exposes neither fitted params nor n_features_in_"
                 )
+            token = self._plan_token(fn)
+            prog = self._plan_prog(fn)
+            # the key carries the replica's device: XLA specializes per
+            # param placement, so two replicas sharing one plan-cached
+            # entry point still each warm their own device's programs
             for bucket in self.ladder:
-                fn(np.zeros((bucket, d), np.float32))
+                warmups.warm(
+                    ("serving", token, self.device, int(bucket),
+                     int(d)),
+                    lambda b=bucket: fn(np.zeros((b, d), np.float32)),
+                    program=prog, ladder="serving-rows",
+                    rung=int(bucket),
+                )
 
     def _probe_width(self):
         est = self.estimator
@@ -549,21 +596,31 @@ class ModelServer:
         """Compile the sparse entry points' (rows, nnz-bucket) grid —
         every row rung x every nnz rung (bounded above by
         ``max_nnz``'s rung when given, so a deployment that knows its
-        traffic density doesn't compile the whole ladder). After this,
-        sparse traffic whose batches stay on the grid mints zero new
-        XLA compiles; over-top-nnz batches spill to the (dense-warmed)
-        densify path."""
+        traffic density doesn't compile the whole ladder). Routed
+        through the plans WarmupRegistry like the dense grid. After
+        this, sparse traffic whose batches stay on the grid mints zero
+        new XLA compiles; over-top-nnz batches spill to the
+        (dense-warmed) densify path."""
         from ..config import ensure_compile_cache
+        from ..plans import warmups
 
         ensure_compile_cache()
         for fn in self._sparse_fns.values():
             top = fn.nnz_ladder.max_rows if max_nnz is None \
                 else fn.nnz_bucket(min(max_nnz, fn.nnz_ladder.max_rows))
+            token = self._plan_token(fn)
+            prog = self._plan_prog(fn)
             for rb in self.ladder:
                 for nb in fn.nnz_ladder:
                     if nb > top:
                         break
-                    fn.warm(rb, nb)
+                    warmups.warm(
+                        ("serving-sparse", token, self.device,
+                         int(rb), int(nb)),
+                        lambda rb=rb, nb=nb: fn.warm(rb, nb),
+                        program=prog, ladder="serving-nnz",
+                        rung=int(nb),
+                    )
         return self
 
     # -- request plane ----------------------------------------------------

@@ -456,20 +456,27 @@ def _donate_spec():
     return (1,) if jax.default_backend() in ("tpu", "gpu") else ()
 
 
-def _tracked_jit(est, method, core, donate, flavor=None):
-    """Jit a serving core and register it in the compiled-program
-    registry as ``serving.<Estimator>.<method>[.<flavor>]`` — a
-    recorded serving run attributes per-batch FLOPs/HBM exactly like a
-    fit does, and the quantized flavor ranks separately in the report
-    CLI's programs table."""
-    import jax
-
-    from .observability import track_program
+def _tracked_jit(est, method, core, donate, flavor=None, sig=None):
+    """Build a serving core's tracked jitted entry point through the
+    plan layer (``plans.ProgramPlan`` — ISSUE 15): cache keying,
+    ``track_program`` registration as
+    ``serving.<Estimator>.<method>[.<flavor>]``, donation wiring and
+    ``compile_cache_dir`` arming all happen there. ``sig`` (the swap
+    contract's structural signature) is the plan cache key: two builds
+    over same-shaped fitted params return the SAME entry point, so a
+    second server's warmup hits warm jit caches instead of re-tracing
+    — and the quantized flavor ranks separately in the report CLI's
+    programs table."""
+    from .plans import ProgramPlan
 
     name = f"serving.{type(est).__name__}.{method}"
     if flavor:
         name += f".{flavor}"
-    return track_program(name)(jax.jit(core, donate_argnums=donate))
+    return ProgramPlan(
+        name=name, body=core, donate=tuple(donate),
+        key=("serving", sig) if sig is not None else None,
+        ladder="serving-rows", group="serving",
+    ).build()
 
 
 def _put_params(params, device):
@@ -647,7 +654,8 @@ def _jit_linear(est, method, device=None, quantize=None):
             donate = _donate_spec()
             core = _linear_core_int8(sig[1], sig[2])
             return CompiledBatchFn(
-                _tracked_jit(est, method, core, donate, flavor="int8"),
+                _tracked_jit(est, method, core, donate, flavor="int8",
+                             sig=sig),
                 method, True, params["Wq"].shape[1],
                 donates=bool(donate),
                 params=_put_params(params, device), post=post,
@@ -665,7 +673,7 @@ def _jit_linear(est, method, device=None, quantize=None):
     donate = _donate_spec()
     core = _linear_core(sig[1], sig[2])
     return CompiledBatchFn(
-        _tracked_jit(est, method, core, donate), method, True,
+        _tracked_jit(est, method, core, donate, sig=sig), method, True,
         params["W"].shape[1], donates=bool(donate),
         params=_put_params(params, device), post=post,
         extract=lambda e: _linear_extract(e, method), sig=sig,
@@ -801,8 +809,6 @@ def sparse_batch_fn(estimator, method="predict", device=None):
     if built is None:
         return None
     params, post, sig = built
-    import jax
-
     from .config import get_config
     from .serving._buckets import BucketLadder
 
@@ -815,10 +821,14 @@ def sparse_batch_fn(estimator, method="predict", device=None):
         growth=cfg.serving_bucket_growth,
     )
     core = _sparse_linear_core(sig[1], sig[2])
-    from .observability import track_program
+    from .plans import ProgramPlan
 
     name = f"serving.{type(est).__name__}.{method}.sparse"
-    fn = track_program(name)(jax.jit(core, static_argnums=(4,)))
+    fn = ProgramPlan(
+        name=name, body=core, static_argnums=(4,),
+        key=("serving-sparse", sig), ladder="serving-nnz",
+        group="serving",
+    ).build()
     return SparseBatchFn(
         fn, method, params["W"].shape[1],
         params=_put_params(params, device), post=post,
@@ -859,7 +869,8 @@ def _jit_kmeans(est, method, device=None):
     params, post, sig = built
     donate = _donate_spec()
     return CompiledBatchFn(
-        _tracked_jit(est, method, _kmeans_core(method), donate), method,
+        _tracked_jit(est, method, _kmeans_core(method), donate,
+                     sig=sig), method,
         True, int(params["centers"].shape[1]), donates=bool(donate),
         params=_put_params(params, device), post=post,
         extract=lambda e: _kmeans_extract(e, method), sig=sig,
@@ -900,10 +911,66 @@ def _jit_pca(est, method, device=None):
     donate = _donate_spec()
     core = _pca_core("mean" in params, "scale" in params)
     return CompiledBatchFn(
-        _tracked_jit(est, method, core, donate), method, True,
+        _tracked_jit(est, method, core, donate, sig=sig), method, True,
         int(params["components"].shape[1]), donates=bool(donate),
         params=_put_params(params, device), post=post,
         extract=lambda e: _pca_extract(e, method), sig=sig,
+        device=device,
+    )
+
+
+def _nb_extract(est, method):
+    """(host params, post, signature) for a fitted GaussianNB — the
+    ISSUE 15 onboarding: the joint-log-likelihood predict is one
+    matmul-shaped program over a swappable {theta, var, log_prior}
+    pytree, so naive_bayes serves through the same plan-built
+    zero-recompile entry points (and hot-swap contract) as the linear
+    family."""
+    if method not in ("predict", "predict_proba"):
+        return None
+    theta = np.asarray(est.theta_, np.float32)
+    var = np.asarray(est.var_, np.float32)
+    prior = np.asarray(est.class_prior_, np.float64)
+    params = {"theta": theta, "var": var,
+              "log_prior": np.log(prior).astype(np.float32)}
+    kind = "classify" if method == "predict" else "proba"
+    post = None
+    if kind == "classify":
+        cls = np.asarray(est.classes_)
+        post = lambda idx: cls[np.asarray(idx)]  # noqa: E731
+    return params, post, ("nb", kind, _shapes(params))
+
+
+def _nb_core(kind):
+    import jax
+    import jax.numpy as jnp
+
+    from .naive_bayes import _jll_math
+
+    def jll(p, X):
+        # the ONE jll definition (naive_bayes._jll_math) over the
+        # swappable param pytree — served and in-core predictions can
+        # never numerically diverge
+        return _jll_math(X, p["theta"], p["var"], p["log_prior"])
+
+    if kind == "classify":
+        return lambda p, X: jnp.argmax(jll(p, X), axis=1).astype(
+            jnp.int32
+        )
+    return lambda p, X: jax.nn.softmax(jll(p, X), axis=1)
+
+
+def _jit_nb(est, method, device=None):
+    built = _nb_extract(est, method)
+    if built is None:
+        return None
+    params, post, sig = built
+    donate = _donate_spec()
+    return CompiledBatchFn(
+        _tracked_jit(est, method, _nb_core(sig[1]), donate, sig=sig),
+        method, True, int(params["theta"].shape[1]),
+        donates=bool(donate), params=_put_params(params, device),
+        post=post, extract=lambda e: _nb_extract(e, method), sig=sig,
         device=device,
     )
 
@@ -951,6 +1018,8 @@ def compiled_batch_fn(estimator, method="predict", device=None,
             built = _jit_kmeans(est, method, device=device)
         elif hasattr(est, "components_"):
             built = _jit_pca(est, method, device=device)
+        elif hasattr(est, "theta_"):
+            built = _jit_nb(est, method, device=device)
         if built is not None:
             return built
     target = getattr(est, method, None)

@@ -354,6 +354,77 @@ def main():
                 "1 on the SHARDED search path"
             )
 
+    # -- plans section (ISSUE 15): the CROSS-CLIENT zero-recompile gate.
+    # One process warms all three compiled-program machineries through
+    # the plan layer — serving's (method, bucket) grid, the stacked
+    # C-grid direct solves, and the streamed superblock scan — then
+    # runs ragged serving traffic + a second C-grid search + a second
+    # streamed fit and asserts ZERO new XLA compiles across ALL of
+    # them. Before the plans subsystem each machinery was gated
+    # separately; a client whose warmup missed a shape the others
+    # relied on could only be caught by its own gate.
+    from dask_ml_tpu.linear_model import LogisticRegression
+    from dask_ml_tpu.model_selection import GridSearchCV
+    from dask_ml_tpu.serving import BucketLadder, ModelServer
+
+    npl, dpl = 8_192, 16
+    Xpl = rng.randn(npl, dpl).astype(np.float32)
+    ypl = (Xpl[:, 0] > 0).astype(np.float64)
+    grid_c = {"C": [0.1, 1.0, 10.0]}
+
+    def run_search():
+        GridSearchCV(
+            LogisticRegression(solver="lbfgs", max_iter=5, tol=0.0),
+            grid_c, cv=2, refit=False, scheduler="synchronous",
+        ).fit(Xpl, ypl)
+
+    pl_recompiles = None
+    with config.set(stream_block_rows=1024, stream_autotune=False,
+                    stream_mesh=1):
+        # max_iter=2: at this scale a pass is ONE superblock dispatch,
+        # so the carry-from-previous-output program variant only
+        # appears at pass 2 — the warm fit must cover it
+        clf_pl = SGDClassifier(max_iter=2, random_state=0,
+                               shuffle=False)
+        clf_pl.fit(Xpl, ypl)       # warms the streamed scan programs
+        run_search()               # warms the stacked C-grid solves
+        srv_pl = ModelServer(clf_pl, methods=("predict",),
+                             ladder=BucketLadder(8, 128, 2.0),
+                             batch_window_ms=1.0, timeout_ms=0)
+        srv_pl.warmup()            # warms the serving grid (plan layer)
+        obs.counters_reset()
+        with srv_pl:
+            SGDClassifier(max_iter=2, random_state=0,
+                          shuffle=False).fit(Xpl, ypl)
+            run_search()
+            rngs = np.random.RandomState(7)
+            for _ in range(20):
+                nreq = rngs.randint(1, 128)
+                i = rngs.randint(0, npl - nreq)
+                srv_pl.predict(Xpl[i:i + nreq])
+            pl_recompiles = obs.counters_snapshot().get("recompiles", 0)
+    if pl_recompiles:
+        failures.append(
+            f"{pl_recompiles} new XLA compiles across the warmed "
+            "serving + C-grid search + streamed fit trio — the plan "
+            "layer's cross-client zero-recompile contract broke"
+        )
+    # the plans table must name what warmed: serving rungs + any
+    # plan-built program attribution
+    from dask_ml_tpu import plans as _plans
+
+    pl_rows = {r["program"]: r for r in _plans.plans_snapshot()}
+    srv_row = pl_rows.get("serving.SGDClassifier.predict")
+    if not srv_row or srv_row["warmups"] < 1 \
+            or "128" not in srv_row["rungs"]:
+        failures.append(
+            f"plans table missing the warmed serving grid: {srv_row}"
+        )
+    if "glm.lbfgs_lam_grid" not in pl_rows:
+        failures.append(
+            "plans table missing the stacked C-grid solve program"
+        )
+
     print(f"perf smoke: n_blocks={n_blocks} K={k} "
           f"dispatches_per_pass={dpp} (budget {budget}) "
           f"recompiles_after_pass1={recompiles} | sharded: "
@@ -365,7 +436,8 @@ def main():
           f"recompiles_after_pass1={sp_recompiles} "
           f"ladder_rungs={sp_rungs} | search: "
           f"rounds={sm.get('rounds')} dispatches={sm.get('dispatches')} "
-          f"shards8={None if sh_search is None else sh_search.get('shards')}")
+          f"shards8={None if sh_search is None else sh_search.get('shards')}"
+          f" | plans: cross-client recompiles={pl_recompiles}")
     if failures:
         for f in failures:
             print(f"PERF SMOKE FAIL: {f}", file=sys.stderr)

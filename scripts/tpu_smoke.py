@@ -810,6 +810,71 @@ def main():
               f"({t_d / t_s:.2f}x), fused={meta['fused']}, "
               f"sparse cohort OK")
 
+    def plans_round14():
+        """ISSUE 15 surfaces: the plans subsystem on real chips — a
+        plan-built serving grid, a C-grid search and a streamed fit all
+        warmed in ONE process pay zero XLA compiles afterward (the
+        cross-client contract perf_smoke gates on CPU), donation is
+        honored on the serving path (TPU donates the batch operand),
+        and the plans table renders with ladder:rung attribution.
+        Degrades to a 1-chip attach like rounds 8-13."""
+        from dask_ml_tpu import config, plans
+        from dask_ml_tpu import observability as obs
+        from dask_ml_tpu.linear_model import LogisticRegression
+        from dask_ml_tpu.model_selection import GridSearchCV
+        from dask_ml_tpu.models.sgd import SGDClassifier
+        from dask_ml_tpu.serving import BucketLadder, ModelServer
+
+        on_tpu = jax.default_backend() == "tpu"
+        n_dev = len(jax.devices())
+        rng = np.random.RandomState(15)
+        n, d = 65_536, 64
+        X = rng.randn(n, d).astype(np.float32)
+        yh = (X[:, 0] > 0).astype(np.float64)
+
+        def run_search():
+            GridSearchCV(
+                LogisticRegression(solver="lbfgs", max_iter=5,
+                                   tol=0.0),
+                {"C": [0.1, 1.0, 10.0]}, cv=2, refit=False,
+                scheduler="synchronous",
+            ).fit(X, yh)
+
+        with config.set(stream_block_rows=4096, stream_autotune=False,
+                        dtype="float32", stream_mesh=0):
+            clf = SGDClassifier(max_iter=2, random_state=0,
+                                shuffle=False)
+            clf.fit(X, yh)             # warms the streamed scans
+            run_search()               # warms the stacked solves
+            srv = ModelServer(clf, methods=("predict",),
+                              ladder=BucketLadder(8, 256, 2.0),
+                              batch_window_ms=1.0, timeout_ms=0)
+            srv.warmup()               # warms the serving grid
+            obs.counters_reset()
+            with srv:
+                SGDClassifier(max_iter=2, random_state=0,
+                              shuffle=False).fit(X, yh)
+                run_search()
+                r2 = np.random.RandomState(7)
+                for _ in range(30):
+                    k = r2.randint(1, 256)
+                    i = r2.randint(0, n - k)
+                    srv.predict(X[i:i + k])
+            snap = obs.counters_snapshot()
+        assert snap.get("recompiles", 0) == 0, snap.get("recompiles")
+        if on_tpu:
+            # the plan layer wired batch donation (TPU/GPU only)
+            assert snap.get("donated_buffers_reused", 0) > 0, snap
+        rows = {r["program"]: r for r in plans.plans_snapshot()}
+        srow = rows.get("serving.SGDClassifier.predict")
+        assert srow and srow["warmups"] >= 1 \
+            and srow["ladder"] == "serving-rows" \
+            and "256" in srow["rungs"], srow
+        assert "glm.lbfgs_lam_grid" in rows, sorted(rows)
+        print(f"    round-14: {n_dev} chips, cross-client "
+              f"recompiles=0, plans table rows={len(rows)}, "
+              f"serving rungs {srow['rungs']}")
+
     passed = _load_state()
     for name, fn in [
         ("glm solvers x3 families", glms),
@@ -832,6 +897,7 @@ def main():
         ("round-12 device-resident sparse streaming",
          sparse_stream_round12),
         ("round-13 streamed-cohort adaptive search", search_round13),
+        ("round-14 execution plans (plans/)", plans_round14),
     ]:
         results.append(run(name, fn, passed))
 
