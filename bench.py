@@ -332,6 +332,7 @@ def run():
         _try(_bench_fleet, jax, on_tpu, n_chips)
         _try(_bench_drift, jax, on_tpu, n_chips)
         _try(_bench_plan_warm_start, jax, on_tpu, n_chips)
+        _try(_bench_request_trace, jax, on_tpu, n_chips)
     result["extra_metrics"] = extras
     # every successful metric also APPENDS to BENCH_floors.jsonl (run
     # marker + one kind="bench_metric" record each; the file is never
@@ -1725,6 +1726,110 @@ def _bench_drift(jax, on_tpu, n_chips):
         for e in entries:
             _lg.log(kind="bench_drift", **e)
     return entries
+
+
+def _bench_request_trace(jax, on_tpu, n_chips):
+    """Request-trace overhead section (ISSUE 16): the trace plane's
+    cost, measured. The SAME warmed closed-loop ragged mix served with
+    ``obs_trace_sample=0`` (the default — no trace object ever
+    allocated, the zero-overhead contract the jaxpr-identity test
+    pins) vs ``1.0`` (every request stage-stamped, tail-sampled,
+    histogram-folded). Tracing is host-side Python (~20us per request
+    after the cadence fix in ``_slow_threshold``); against ms-scale
+    accelerator steps that amortizes below 3% (criterion >= 0.97 on
+    TPU), but this CPU bench's sub-ms batches are an adversarial
+    denominator — there the criterion is >= 0.70 and the floor
+    sentinel guards the recorded ratio against regression."""
+    import threading as _threading
+    import time
+
+    from dask_ml_tpu.datasets import make_classification
+    from dask_ml_tpu.linear_model import LogisticRegression
+    from dask_ml_tpu.observability import traces_reset
+    from dask_ml_tpu.serving import BucketLadder, ModelServer
+
+    d = 32
+    n = 20_000
+    X, y = make_classification(n_samples=n, n_features=d,
+                               n_informative=d // 4, random_state=0)
+    clf = LogisticRegression(solver="lbfgs", max_iter=20).fit(X, y)
+    Xh = X.to_numpy().astype(np.float32)
+
+    rng = np.random.RandomState(13)
+    n_requests = 400
+    sizes = np.maximum(np.exp(
+        rng.uniform(0, np.log(256), size=n_requests)
+    ).astype(int), 1)
+    offs = [int(rng.randint(0, n - s)) for s in sizes]
+    requests = [Xh[i:i + int(s)] for s, i in zip(sizes, offs)]
+    total_rows = int(sizes.sum())
+    n_clients = 8
+    shares = [requests[c::n_clients] for c in range(n_clients)]
+
+    def drive(srv):
+        def client(c):
+            for r in shares[c]:
+                srv.predict(r)
+
+        threads = [_threading.Thread(target=client, args=(c,))
+                   for c in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0
+
+    def build(sample):
+        from dask_ml_tpu import config
+
+        # a small keep bound: the steady-state cost under test is the
+        # stamps + sampler decision + histogram folds, not an unbounded
+        # retention deque
+        with config.set(obs_trace_sample=sample, obs_trace_keep=64,
+                        obs_drift=False):
+            return ModelServer(
+                clf, methods=("predict",),
+                ladder=BucketLadder(8, 512, 2.0),
+                batch_window_ms=1.0, timeout_ms=0,
+            ).warmup()
+
+    # interleaved passes, each mode's best — same confound control as
+    # the drift section (shared-box load drifts on pass timescales)
+    srv_off, srv_on = build(0.0), build(1.0)
+    t_offs, t_ons = [], []
+    with srv_off, srv_on:
+        drive(srv_off)                     # warm passes
+        drive(srv_on)
+        for _ in range(4):
+            t_offs.append(drive(srv_off))
+            t_ons.append(drive(srv_on))
+    off_s, on_s = min(t_offs), min(t_ons)
+    traces_reset()                         # bench must not leak sampler state
+    ratio = off_s / on_s                   # >= 1.0 means no overhead
+    thresh = 0.97 if on_tpu else 0.70
+    entry = {
+        "metric": "request_trace_overhead_ratio",
+        "value": round(ratio, 4),
+        "unit": "ratio",
+        "backend": jax.default_backend(),
+        "dtype": "float32",
+        "criterion": f">= {thresh} (host-side tracing vs this backend's "
+                     "step time; <= 3% on accelerator-scale steps)",
+        "criterion_met": bool(ratio >= thresh),
+        "n_requests": n_requests,
+        "total_rows": total_rows,
+        "rows_per_sec_untraced": round(total_rows / off_s, 1),
+        "rows_per_sec_traced": round(total_rows / on_s, 1),
+    }
+    from dask_ml_tpu.observability import MetricsLogger
+
+    metrics_file = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_metrics.jsonl"
+    )
+    with MetricsLogger(metrics_file) as _lg:
+        _lg.log(kind="bench_trace", **entry)
+    return entry
 
 
 def _bench_fleet(jax, on_tpu, n_chips):

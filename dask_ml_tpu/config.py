@@ -264,6 +264,17 @@ class Config:
     # cap new series are dropped and counted
     # (telemetry_series_dropped_total)
     obs_max_series: int = 512
+    # per-request trace plane (observability/_requests.py): the rolling
+    # slowest fraction of ordinary completions tail-sampled with a full
+    # stage breakdown (errors, timeouts, sheds, SLO violations,
+    # reroutes, and fault-injected requests are ALWAYS kept; every
+    # completion folds into the per-stage exemplar histograms either
+    # way). 0 = the plane is off: no trace object is ever allocated on
+    # the serving hot path and the serving jaxprs are byte-identical
+    obs_trace_sample: float = 0.0
+    # sampled traces retained in memory for /traces, /status and the
+    # report CLI (a bounded deque; oldest sampled traces fall off)
+    obs_trace_keep: int = 256
     # slow-span watchdog (observability/_watchdog.py): any span open past
     # this many seconds dumps all-thread tracebacks + device memory
     # gauges + the open-span stack to the trace sink, without touching

@@ -40,6 +40,12 @@ equivalent is this package (grown from the flat per-step logger in
 - ``drift``     — train-serve/window/version drift scoring (PSI/KS),
   hot-swap shadow canaries, the drift-alert counter, the background
   drift monitor (``config.obs_drift``);
+- ``_requests`` — the per-REQUEST trace plane
+  (``config.obs_trace_sample``): stage-stamped lifecycle traces through
+  the serving queue/pack/execute/demux pipeline, tail sampling of
+  interesting traces, per-stage exemplar histograms, the ``/traces``
+  surface, and the admitted-traffic capture/replay substrate (ROADMAP
+  4(c));
 - ``live``      — the LIVE telemetry plane (``config.obs_http_port``):
   a process-wide gauge/histogram registry over the counter registry,
   fit-progress publication via span-close observers, and a background
@@ -115,6 +121,13 @@ from ._spans import (
     remove_span_observer,
     span,
 )
+from ._requests import (
+    load_capture,
+    replay,
+    tracing_enabled,
+    traces_data,
+    traces_reset,
+)
 from ._watchdog import Watchdog, watchdog, watchdog_active
 from .live import (
     TelemetryServer,
@@ -164,8 +177,13 @@ __all__ = [
     "fit_logger",
     "install_recompile_tracking",
     "jit_callbacks_supported",
+    "load_capture",
     "log_counters",
     "log_programs",
+    "replay",
+    "traces_data",
+    "traces_reset",
+    "tracing_enabled",
     "open_spans_snapshot",
     "profile_trace",
     "programs_enabled",

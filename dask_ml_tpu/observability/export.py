@@ -16,7 +16,12 @@ format, viewable in ``ui.perfetto.dev`` (or ``chrome://tracing``):
 - per-step solver records contribute ``<component>.<metric>`` counter
   tracks (loss / inertia / residual trajectories on the timeline);
 - watchdog stall records become instant ("i") events so a stall dump is
-  visible at the moment it fired.
+  visible at the moment it fired;
+- sampled request traces (``req_trace`` records) become per-stage "X"
+  slices — queue wait on the admission thread's lane, pack/execute/
+  demux on the worker's — linked by flow events ("s"/"f") sharing the
+  pid-prefixed trace id, so a request is drawn hopping threads from
+  admission to completion.
 
 Timestamps: span records carry absolute ``t_unix``; step records only
 carry the sink-relative ``time``. The exporter estimates each sink's
@@ -38,6 +43,19 @@ _STEP_KEYS = ("loss", "inertia", "center_shift2", "primal_residual",
 # span attributes that are structural, not user payload
 _SPAN_META = {"span", "span_id", "parent_id", "depth", "time", "t_unix",
               "wall_s", "sync_s", "thread"}
+
+# request-trace stage order (mirrors observability/_requests.STAGES)
+# and the names of the consecutive stage-pair slices
+_REQ_STAGES = ("admit", "queue_pop", "pack", "dispatch", "execute_done",
+               "demux", "complete")
+_REQ_DUR = {
+    ("admit", "queue_pop"): "queue_wait",
+    ("queue_pop", "pack"): "pack",
+    ("pack", "dispatch"): "dispatch",
+    ("dispatch", "execute_done"): "execute",
+    ("execute_done", "demux"): "demux",
+    ("demux", "complete"): "resolve",
+}
 
 
 def _origins(records):
@@ -105,6 +123,8 @@ def to_chrome_trace(records) -> dict:
     # thread name
     span_pids = {r["span_id"] >> 24 for r in records
                  if isinstance(r.get("span_id"), int)}
+    span_pids |= {int(r["pid"]) & 0xFFFFFF for r in records
+                  if r.get("req_trace") and isinstance(r.get("pid"), int)}
     multi_proc = len(span_pids) > 1
 
     def lane_of(r):
@@ -168,6 +188,51 @@ def to_chrome_trace(records) -> dict:
                 "args": {"age_s": r.get("age_s"),
                          "timeout_s": r.get("timeout_s")},
             })
+            continue
+        if r.get("req_trace"):
+            # one request's lifecycle: per-stage "X" slices (queue wait
+            # on the ADMISSION thread's lane, everything from queue_pop
+            # on the worker's) linked by a flow arrow sharing the
+            # pid-prefixed trace id — ui.perfetto.dev draws the request
+            # hopping threads
+            st = r.get("stages") or {}
+            if "admit" not in st:
+                continue
+            threads = r.get("threads") or {}
+            adm = threads.get("admit", "main")
+            wrk = threads.get("worker", adm)
+            if multi_proc:
+                p = int(r.get("pid", 0)) & 0xFFFFFF
+                adm = f"pid{p}.{adm}"
+                wrk = f"pid{p}.{wrk}"
+            rid = r.get("trace_id")
+            label = f"req {r.get('method')}#{rid}"
+            args = {k: v for k, v in r.items()
+                    if k not in ("req_trace", "stages", "durations",
+                                 "threads", "time", "t_unix")
+                    and isinstance(v, (int, float, str, bool))}
+            order = [s for s in _REQ_STAGES if s in st]
+            for a, b in zip(order, order[1:]):
+                d_us = (float(st[b]) - float(st[a])) * 1e6
+                lane = adm if a == "admit" else wrk
+                events.append({
+                    "name": f"{label}:{_REQ_DUR.get((a, b), f'{a}>{b}')}",
+                    "ph": "X", "pid": 1, "tid": tid_of(lane),
+                    "ts": round(t + float(st[a]) * 1e6, 3),
+                    "dur": round(max(d_us, 0.0), 3),
+                    "cat": "request", "args": args,
+                })
+            if isinstance(rid, int) and len(order) > 1:
+                events.append({
+                    "name": label, "ph": "s", "id": rid,
+                    "cat": "request", "pid": 1, "tid": tid_of(adm),
+                    "ts": round(t, 3),
+                })
+                events.append({
+                    "name": label, "ph": "f", "bp": "e", "id": rid,
+                    "cat": "request", "pid": 1, "tid": tid_of(wrk),
+                    "ts": round(t + float(st[order[-1]]) * 1e6, 3),
+                })
             continue
         if "span" in r:
             dur = float(r.get("wall_s", 0.0)) * 1e6

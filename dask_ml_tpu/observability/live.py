@@ -553,6 +553,19 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                     200, render_prometheus().encode(),
                     "text/plain; version=0.0.4; charset=utf-8",
                 )
+            elif path == "/traces":
+                # the request trace plane's JSON surface: sampler
+                # counters, retained sampled traces, per-stage exemplar
+                # histograms. Exemplars live HERE, not in /metrics —
+                # the Prometheus text exposition stays grammar-clean
+                from ._requests import traces_data
+
+                self._reply(
+                    200,
+                    (json.dumps(traces_data(), default=_json_default)
+                     + "\n").encode(),
+                    "application/json",
+                )
             elif path == "/status":
                 # default=: span attrs can carry numpy scalars (a fit's
                 # n_iter etc.) — degrade them to floats/strings instead
@@ -567,7 +580,7 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 self._reply(
                     200,
                     b"dask_ml_tpu live telemetry: "
-                    b"/metrics /status /healthz\n",
+                    b"/metrics /status /traces /healthz\n",
                     "text/plain; charset=utf-8",
                 )
             else:

@@ -7,11 +7,14 @@ Usage::
     python -m dask_ml_tpu.observability.report metrics.jsonl --json
     python -m dask_ml_tpu.observability.report trace.jsonl --perfetto out.json
     python -m dask_ml_tpu.observability.report --merge a.jsonl b.jsonl ...
+    python -m dask_ml_tpu.observability.report trace.jsonl --slowest 20
 
 Reads the records the subsystem emits — span records (``span`` field),
 per-step solver/search records (``component`` field), stream-pass
 overlap records (``stream_pass``), counter snapshots (``counters``),
-program-registry snapshots (``programs``, from ``log_programs``), and
+program-registry snapshots (``programs``, from ``log_programs``),
+sampled request traces (``req_trace``) + admitted-traffic captures
+(``req_capture``, both from ``observability/_requests.py``), and
 watchdog stall dumps (``watchdog``) — and prints: time per span (wall +
 device-sync + measured MFU where program FLOPs were recorded),
 samples/s where a span recorded its row count, each component's
@@ -324,6 +327,45 @@ def summarize_drift(records):
     return {"scores": scores, "canaries": canaries}
 
 
+_TRACE_TAGS = ("replica", "version", "flavor", "rerouted_from",
+               "slo_violation", "slo_shed", "fault_injected",
+               "canary_scored")
+
+
+def summarize_traces(records):
+    """The request-trace slice of a recorded run: every sampled
+    ``req_trace`` record (slowest first) plus the admitted-traffic
+    capture summary (``req_capture`` records — the replay substrate).
+    Trace records carry absolute ``t_unix``, so a ``--merge`` of several
+    processes' files lands them on the shared wall-clock timeline and
+    the pid-prefixed trace ids never collide."""
+    traces = [r for r in records if r.get("req_trace")]
+    traces.sort(key=lambda r: -float(r.get("e2e_s", 0.0)))
+    by_outcome = {}
+    for r in traces:
+        o = r.get("outcome", "?")
+        by_outcome[o] = by_outcome.get(o, 0) + 1
+    caps = [r for r in records if r.get("req_capture")]
+    capture = None
+    if caps:
+        by_method = {}
+        rows = 0
+        for c in caps:
+            by_method[c.get("method", "?")] = \
+                by_method.get(c.get("method", "?"), 0) + 1
+            rows += int(c.get("n_rows", 0))
+        ts = sorted(float(c["t_unix"]) for c in caps if "t_unix" in c)
+        dur = (ts[-1] - ts[0]) if len(ts) > 1 else 0.0
+        capture = {
+            "requests": len(caps), "rows": rows,
+            "duration_s": round(dur, 6),
+            "rate_rps": round(len(caps) / dur, 3) if dur > 0 else None,
+            "by_method": by_method,
+        }
+    return {"sampled": len(traces), "by_outcome": by_outcome,
+            "traces": traces, "capture": capture}
+
+
 def _numeric(v):
     return isinstance(v, (int, float)) and not isinstance(v, bool)
 
@@ -434,6 +476,7 @@ def report_data(records):
         "components": comps,
         "streaming": summarize_stream(records),
         "drift": summarize_drift(records),
+        "traces": summarize_traces(records),
         "counters": final_counters(records),
         "reliability": reliability_summary(records),
         "programs": final_programs(records),
@@ -446,9 +489,32 @@ def report_data(records):
     }
 
 
-def build_report(records, path="<records>"):
+def _fmt_ms(s):
+    if s is None:
+        return "-"
+    return f"{float(s) * 1e3:.2f}ms"
+
+
+def _trace_flags(t):
+    """Compact tag column for the traces table."""
+    flags = []
+    if t.get("rerouted_from") is not None:
+        flags.append(f"rerouted_from={t['rerouted_from']}")
+    for k in ("slo_violation", "slo_shed", "fault_injected",
+              "canary_scored"):
+        if t.get(k):
+            flags.append(k)
+    if t.get("replica") is not None:
+        flags.append(f"r{t['replica']}")
+    if t.get("version") is not None:
+        flags.append(f"v{t['version']}")
+    return ",".join(flags) or "-"
+
+
+def build_report(records, path="<records>", slowest=10):
     """The full report as one string (the CLI prints it; tests assert on
-    it)."""
+    it). ``slowest`` caps the traces table at the N slowest sampled
+    traces (``report ... --slowest N``)."""
     data = report_data(records)
     lines = [f"run report: {path}  ({len(records)} records)", ""]
     span_rows = []
@@ -500,6 +566,40 @@ def build_report(records, path="<records>"):
               c["disagreement"], c["max_quantile_shift"],
               "ALERT" if c["alert"] else "-")
              for c in dr["canaries"]],
+        )
+    tr = data.get("traces") or {}
+    if tr.get("sampled"):
+        n_show = max(int(slowest), 1)
+        shown = tr["traces"][:n_show]
+        rows = []
+        for t in shown:
+            d = t.get("durations") or {}
+            rows.append((
+                t.get("trace_id"), t.get("method"), t.get("n_rows"),
+                t.get("outcome"), _fmt_ms(t.get("e2e_s")),
+                _fmt_ms(d.get("queue_wait")), _fmt_ms(d.get("pack")),
+                _fmt_ms(d.get("execute")), _fmt_ms(d.get("demux")),
+                _trace_flags(t),
+            ))
+        outcomes = ", ".join(f"{k}={v}" for k, v in
+                             sorted(tr["by_outcome"].items()))
+        lines += _table(
+            f"traces ({len(shown)} slowest of {tr['sampled']} sampled; "
+            f"outcomes: {outcomes})",
+            ("trace", "method", "rows", "outcome", "e2e", "queue",
+             "pack", "exec", "demux", "tags"),
+            rows,
+        )
+    cap = tr.get("capture")
+    if cap:
+        lines += _table(
+            "traffic capture (admitted request mix — replay substrate)",
+            ("requests", "rows", "duration", "rate", "by_method"),
+            [(cap["requests"], cap["rows"],
+              _fmt_seconds(cap["duration_s"]),
+              f"{cap['rate_rps']:.1f}/s" if cap["rate_rps"] else "-",
+              ", ".join(f"{k}:{v}" for k, v in
+                        sorted(cap["by_method"].items())))],
         )
     progs = data["programs"]
     if progs:
@@ -589,7 +689,7 @@ def build_report(records, path="<records>"):
         lines += _table("counters", ("counter", "total"), rows)
     if not span_rows and not comp_rows and not st and not ctr \
             and not progs and not stalls and not dr["scores"] \
-            and not dr["canaries"]:
+            and not dr["canaries"] and not tr.get("sampled") and not cap:
         lines.append("no observability records found "
                      "(set config.metrics_path or config.trace_dir)")
     return "\n".join(lines).rstrip() + "\n"
@@ -603,6 +703,7 @@ def main(argv=None):
     as_json = False
     merge = False
     perfetto_out = None
+    slowest = 10
     paths = []
     i = 0
     while i < len(argv):
@@ -618,6 +719,17 @@ def main(argv=None):
                 return 2
             i += 1
             perfetto_out = argv[i]
+        elif a == "--slowest":
+            if i + 1 >= len(argv):
+                print("error: --slowest needs a count", file=sys.stderr)
+                return 2
+            i += 1
+            try:
+                slowest = int(argv[i])
+            except ValueError:
+                print(f"error: --slowest needs an integer, got "
+                      f"{argv[i]!r}", file=sys.stderr)
+                return 2
         else:
             paths.append(a)
         i += 1
@@ -665,7 +777,8 @@ def main(argv=None):
             data["merged_files"] = len(lists)
             sys.stdout.write(json.dumps(data) + "\n")
         elif perfetto_out is None:
-            sys.stdout.write(build_report(merged, path=label))
+            sys.stdout.write(build_report(merged, path=label,
+                                          slowest=slowest))
         return rc
     for path in paths:
         try:
@@ -694,7 +807,8 @@ def main(argv=None):
             data["path"] = path
             sys.stdout.write(json.dumps(data) + "\n")
         elif perfetto_out is None:
-            sys.stdout.write(build_report(records, path=path))
+            sys.stdout.write(build_report(records, path=path,
+                                          slowest=slowest))
     return rc
 
 

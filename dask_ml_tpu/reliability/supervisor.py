@@ -175,6 +175,12 @@ class ReplicaSupervisor:
             return
         if not reqs:
             return
+        for r in reqs:
+            # requeued traces record the corpse they drained off of —
+            # the same rerouted_from tag the fleet's failover loop sets
+            if r.trace is not None:
+                r.trace.tag(rerouted_from=dead.replica_id,
+                            replica=fresh.replica_id)
         verdict = fresh._queue.put_many(reqs)
         if verdict == "ok":
             for _ in reqs:
@@ -185,7 +191,7 @@ class ReplicaSupervisor:
         fail_requests(reqs, ServerClosed(
             "replica died and its replacement could not absorb the "
             "backlog"
-        ))
+        ), outcome="closed")
 
     def _permanent_failure(self, idx, dead):
         """Budget exhausted: the slot degrades to permanent failover —
@@ -202,7 +208,7 @@ class ReplicaSupervisor:
             fail_requests(dead._queue.drain_all(), ServerClosed(
                 f"replica {dead.replica_id} exceeded its restart budget "
                 f"({self.budget}); permanently failed over"
-            ))
+            ), outcome="closed")
         except Exception:
             pass
         record_replica_failure()

@@ -59,7 +59,7 @@ class Request:
     the Future its caller is waiting on."""
 
     __slots__ = ("X", "n_rows", "method", "future", "t_enqueue",
-                 "deadline", "seq")
+                 "deadline", "seq", "trace")
 
     def __init__(self, X, method, timeout_s=0.0, future=None):
         self.X = X
@@ -67,6 +67,7 @@ class Request:
         self.method = method
         self.future = future if future is not None else Future()
         self.seq = 0              # stamped by BoundedQueue at admission
+        self.trace = None         # RequestTrace when the plane is on
         self.t_enqueue = time.perf_counter()
         self.deadline = (self.t_enqueue + timeout_s) if timeout_s > 0 \
             else None
@@ -149,23 +150,38 @@ def demux_outputs(out, segments):
     caller-visible result."""
     for req, start in segments:
         piece = out[start:start + req.n_rows]
+        tr = req.trace
+        if tr is not None:
+            tr.stamp("demux")
         # copy: the slice views the ping-pong output only until the next
         # batch of this bucket lands; the caller's array must be its own
         if not req.future.set_running_or_notify_cancel():
+            if tr is not None:
+                tr.finish("cancelled")
             continue  # caller cancelled while we computed
         req.future.set_result(np.array(piece))
+        if tr is not None:
+            # finalize AFTER set_result: the sampler/histogram folds
+            # never sit between the compute and the caller's wakeup
+            tr.stamp("complete")
+            tr.finish("ok")
 
 
-def fail_requests(requests, exc):
+def fail_requests(requests, exc, outcome="error"):
     """Resolve every request's future with ``exc`` (batch-level failure
     or shed); futures already cancelled — or already resolved by a
-    partial demux before the failure — are skipped, never raised on."""
+    partial demux before the failure — are skipped, never raised on.
+    ``outcome`` labels the traced requests' terminal state ("timeout" /
+    "shed" / "error" — finish is idempotent, so a request a partial
+    demux already completed keeps its first outcome)."""
     for r in requests:
         try:
             if r.future.set_running_or_notify_cancel():
                 r.future.set_exception(exc)
         except Exception:
             pass  # future already in a terminal state
+        if r.trace is not None:
+            r.trace.finish(outcome)
 
 
 class BoundedQueue:

@@ -135,6 +135,14 @@ class ModelServer:
         # read per batch
         self.model_name = str(name) if name else type(estimator).__name__
         self._drift_on = bool(cfg.obs_drift)
+        # request trace plane (observability/_requests.py): the gate is
+        # captured ONCE, like _drift_on — with obs_trace_sample=0 the
+        # hot path never allocates a trace (one bool check per admit /
+        # batch), and nothing the plane does ever enters a jaxpr
+        self._trace_on = float(cfg.obs_trace_sample) > 0.0
+        # versions whose publish ran the shadow canary — traces served
+        # by such a version carry the canary_scored tag
+        self._canary_versions = set()
         self._shadow_frac = float(cfg.obs_shadow_fraction)
         self._shadow = {}               # method -> drift.ShadowBuffer
         self._pend = {}                 # method -> pending fold sample
@@ -268,7 +276,8 @@ class ModelServer:
             for r in reqs:
                 self._execute([r])
         else:
-            fail_requests(reqs, ServerClosed("server stopped"))
+            fail_requests(reqs, ServerClosed("server stopped"),
+                          outcome="closed")
 
     def __enter__(self):
         return self.start()
@@ -378,6 +387,9 @@ class ModelServer:
         else:
             self.model_version += 1
         if old_outs:
+            # traces served by this version carry canary_scored: the
+            # publish was shadow-scored against recent traffic
+            self._canary_versions.add(self.model_version)
             # canary phase 2: the SAME shadow rows through the
             # just-committed parameters; the per-method prediction
             # deltas (disagreement + max quantile shift) publish as
@@ -719,11 +731,28 @@ class ModelServer:
         return _gather_futures([r.future for r in reqs])
 
     def _admit(self, reqs):
+        if self._trace_on:
+            # traces exist BEFORE the queue decides: a shed/closed
+            # request still produces a (tail-sampled) trace — the
+            # contract that 100% of refused requests are attributable
+            from ..observability import _requests as rtrace
+
+            for r in reqs:
+                r.trace = rtrace.new_trace(r.method, r.n_rows,
+                                           t_admit=r.t_enqueue)
+                if self.replica_id is not None:
+                    r.trace.tag(replica=self.replica_id)
         verdict = self._queue.put_many(reqs)
         if verdict == "closed":
+            for r in reqs:
+                if r.trace is not None:
+                    r.trace.finish("closed")
             raise ServerClosed("server is not accepting requests")
         if verdict != "ok":
             smetrics.record_drop("shed")
+            for r in reqs:
+                if r.trace is not None:
+                    r.trace.finish("shed")
             raise ServerOverloaded(
                 f"queue at bound ({self.max_queue} requests); request "
                 "shed"
@@ -885,7 +914,7 @@ class ModelServer:
             smetrics.record_drop("error")
             fail_requests([first], ServingError(
                 f"serving worker error: {type(exc).__name__}: {exc}"
-            ))
+            ), outcome="error")
 
     def _serve_one(self, first):
         if first.expired():
@@ -893,7 +922,7 @@ class ModelServer:
             fail_requests([first], RequestTimeout(
                 f"request waited past its {self.timeout_s * 1e3:.0f}ms "
                 "deadline"
-            ))
+            ), outcome="timeout")
             return
         batch = [first]
         rows = first.n_rows
@@ -907,6 +936,8 @@ class ModelServer:
         # keep coalescing past the fixed window while the budget is
         # ample (_batching.release_deadline)
         dequeue_t = time.perf_counter()
+        if first.trace is not None:
+            first.trace.stamp("queue_pop", dequeue_t)
         # exec predictions change once per ExecStats WINDOW (seconds),
         # not per coalescing wake (<=10ms) — cache per candidate bucket
         # for this assembly so the loop doesn't pay a locked histogram
@@ -915,11 +946,13 @@ class ModelServer:
         while rows < top and not self._stop.is_set():
             got = self._queue.drain_method(first.method, top - rows)
             for r in got:
+                if r.trace is not None:
+                    r.trace.stamp("queue_pop")
                 if r.expired():
                     smetrics.record_drop("timeout")
                     fail_requests([r], RequestTimeout(
                         "request waited past its deadline"
-                    ))
+                    ), outcome="timeout")
                 else:
                     batch.append(r)
                     rows += r.n_rows
@@ -1036,6 +1069,20 @@ class ModelServer:
         drift.fold_serving(self.model_name, pend["version"], method, X,
                            outs, max_rows=X.shape[0])
 
+    @staticmethod
+    def _tag_fault(batch, exc):
+        """Mark every traced request in a failed batch whose failure
+        was a chaos-plane injection (``fault_plan`` at the
+        serving_execute site) — the tag makes injected faults
+        distinguishable from organic batch failures on /traces."""
+        from ..reliability.faults import FaultInjected
+
+        if not isinstance(exc, FaultInjected):
+            return
+        for r in batch:
+            if r.trace is not None:
+                r.trace.tag(fault_injected=True)
+
     def _execute(self, batch):
         if batch[0].method.endswith("#sparse"):
             return self._execute_sparse(batch)
@@ -1057,6 +1104,18 @@ class ModelServer:
             buf, segments, bucket, rows = pack_batch(
                 batch, self.ladder, self._staging
             )
+            if self._trace_on:
+                t_pack = time.perf_counter()
+                canary = self.model_version in self._canary_versions
+                for r in batch:
+                    tr = r.trace
+                    if tr is not None:
+                        tr.stamp("pack", t_pack)
+                        tr.tag(bucket=int(bucket),
+                               flavor=self._active_flavor,
+                               version=self.model_version)
+                        if canary:
+                            tr.tag(canary_scored=True)
             smetrics.set_queue_gauges(self._queue.depth, rows,
                                       replica=self.replica_id)
             t_exec = time.perf_counter()
@@ -1079,6 +1138,12 @@ class ModelServer:
                 # so a capacity review sees which rung is slow, and the
                 # SLO counter when config.serving_slo_ms is set
                 smetrics.observe_request_latency(method, bucket, lat)
+                tr = r.trace
+                if tr is not None:
+                    tr.stamp("dispatch", t_exec)
+                    tr.stamp("execute_done", done)
+                    if self._slo_s > 0 and lat > self._slo_s:
+                        tr.tag(slo_violation=True)
             demux_outputs(out, segments)
             if self._drift_on:
                 # quality sketches AFTER demux (callers already have
@@ -1103,9 +1168,10 @@ class ModelServer:
         except Exception as exc:
             for _ in batch:   # per REQUEST, matching the timeout path
                 smetrics.record_drop("error")
+            self._tag_fault(batch, exc)
             fail_requests(batch, ServingError(
                 f"batch execution failed: {type(exc).__name__}: {exc}"
-            ))
+            ), outcome="error")
         finally:
             # inflight back to 0 on the failure path too — a failed
             # batch must not leave /metrics showing phantom inflight rows
@@ -1134,6 +1200,18 @@ class ModelServer:
                 else sp_.vstack([r.X for r in batch]).tocsr()
             rows = int(X.shape[0])
             bucket = self.ladder.bucket_for(rows)
+            if self._trace_on:
+                t_pack = time.perf_counter()
+                canary = self.model_version in self._canary_versions
+                for r in batch:
+                    tr = r.trace
+                    if tr is not None:
+                        tr.stamp("pack", t_pack)
+                        tr.tag(bucket=int(bucket),
+                               flavor=self._active_flavor,
+                               version=self.model_version)
+                        if canary:
+                            tr.tag(canary_scored=True)
             smetrics.set_queue_gauges(self._queue.depth, rows,
                                       replica=self.replica_id)
             t_exec = time.perf_counter()
@@ -1163,20 +1241,35 @@ class ModelServer:
                 lat = done - r.t_enqueue
                 self._latency.observe(lat)
                 smetrics.observe_request_latency(lane, bucket, lat)
+                tr = r.trace
+                if tr is not None:
+                    tr.stamp("dispatch", t_exec)
+                    tr.stamp("execute_done", done)
+                    if self._slo_s > 0 and lat > self._slo_s:
+                        tr.tag(slo_violation=True)
             out = np.asarray(out)
             lo = 0
             for r in batch:
                 f = r.future
+                tr = r.trace
+                if tr is not None:
+                    tr.stamp("demux")
                 if f.set_running_or_notify_cancel():
                     f.set_result(out[lo:lo + r.n_rows])
+                    if tr is not None:
+                        tr.stamp("complete")
+                        tr.finish("ok")
+                elif tr is not None:
+                    tr.finish("cancelled")
                 lo += r.n_rows
         except Exception as exc:
             for _ in batch:
                 smetrics.record_drop("error")
+            self._tag_fault(batch, exc)
             fail_requests(batch, ServingError(
                 f"sparse batch execution failed: "
                 f"{type(exc).__name__}: {exc}"
-            ))
+            ), outcome="error")
         finally:
             smetrics.set_queue_gauges(self._queue.depth, 0,
                                       replica=self.replica_id)

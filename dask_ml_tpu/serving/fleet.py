@@ -286,6 +286,8 @@ class FleetServer:
         """Admit one request: SLO admission at the door, then
         least-loaded placement over healthy replicas with failover.
         Returns the chosen replica's Future."""
+        from ..observability import _requests as rtrace
+
         X = np.asarray(X, np.float32)
         n_rows = 1 if X.ndim == 1 else int(X.shape[0])
         ranked = sorted(self._healthy(),
@@ -318,6 +320,13 @@ class FleetServer:
                     break
             if admit_at is None:
                 smetrics.record_drop("slo_shed")
+                if rtrace.tracing_enabled():
+                    # a shed request never reaches a replica's _admit,
+                    # so its trace is born AND finished at the door —
+                    # the tail sampler always keeps slo_shed traces
+                    tr = rtrace.new_trace(method, n_rows)
+                    tr.tag(slo_shed=True)
+                    tr.finish("slo_shed")
                 raise SloShed(
                     f"predicted completion {best_predicted * 1e3:.1f}ms "
                     f"on the best of {len(ranked)} healthy replica(s) "
@@ -327,8 +336,15 @@ class FleetServer:
             if admit_at:
                 ranked = ranked[admit_at:] + ranked[:admit_at]
         last_exc = None
+        rerouted_from = None
         for i, r in enumerate(ranked):
             try:
+                if rerouted_from is not None:
+                    # the surviving replica's trace records where the
+                    # request was rerouted from (thread-local pending
+                    # tag, picked up by _admit's new_trace)
+                    with rtrace.tagging(rerouted_from=rerouted_from):
+                        return r.submit(X, method=method)
                 return r.submit(X, method=method)
             except ServerClosed as exc:
                 # replica died between the health check and the put —
@@ -340,10 +356,12 @@ class FleetServer:
                 last_exc = exc
                 smetrics.record_reroute()
                 smetrics.drop_replica_gauges(r.replica_id)
+                rerouted_from = r.replica_id
             except ServerOverloaded as exc:
                 last_exc = exc
                 if i + 1 < len(ranked):
                     smetrics.record_reroute()
+                    rerouted_from = r.replica_id
         if isinstance(last_exc, ServerClosed):
             raise NoHealthyReplicas(
                 f"every replica refused this request; last: {last_exc}"

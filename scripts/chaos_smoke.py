@@ -18,6 +18,11 @@ Three gates, each exercising one leg of the reliability plane:
    the replica must be rebuilt+rewarmed off the serving path and rejoin
    routing, ZERO requests may be lost, and traffic after the rebuild's
    warmup must mint ZERO new XLA compiles.
+4. **injected serving fault is trace-visible** (ISSUE 16) — a traced
+   server under ``fault_plan=serving_execute:crash@0`` fails the first
+   batch typed; every request in that batch must surface on the request
+   trace plane tail-sampled with ``fault_injected`` tagged and outcome
+   ``error``, while later healthy traffic traces clean.
 
 Prints one JSON line per gate; exit 0 = all gates hold.
 Run: ``python scripts/chaos_smoke.py``.
@@ -183,6 +188,53 @@ print("RESULT " + json.dumps(out), flush=True)
 """
 
 
+CHILD_FAULT_TRACE = r"""
+import json
+import numpy as np
+from dask_ml_tpu import config, observability as obs
+from dask_ml_tpu.models.sgd import SGDClassifier
+from dask_ml_tpu.serving import BucketLadder, ModelServer, ServingError
+
+rng = np.random.RandomState(3)
+X = rng.randn(4000, 12).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.float32)
+with config.set(stream_block_rows=0):
+    clf = SGDClassifier(max_iter=2, random_state=0).fit(X, y)
+
+out = {"ok": False}
+with config.set(obs_trace_sample=1.0,
+                fault_plan="serving_execute:crash@0"):
+    with ModelServer(clf, ladder=BucketLadder(8, 64, 2.0)) as srv:
+        srv.warmup()
+        f = srv.submit(X[:4])
+        try:
+            f.result(30)
+            out["error"] = "faulted batch did not fail"
+        except ServingError:
+            pass
+        # the plan fired once (@0): later traffic is healthy
+        for i in range(4):
+            srv.submit(X[: 2 + i]).result(30)
+d = obs.traces_data()
+errors = [t for t in d["traces"] if t["outcome"] == "error"]
+clean = [t for t in d["traces"] if t["outcome"] == "ok"]
+out["errors"] = len(errors)
+out["clean"] = len(clean)
+out["fault_tagged"] = sum(1 for t in errors if t.get("fault_injected"))
+out["injected_counter"] = obs.counters_snapshot().get(
+    "faults_injected_serving_execute", 0)
+out.setdefault("ok", False)
+out["ok"] = (
+    len(errors) >= 1
+    and out["fault_tagged"] == len(errors)
+    and len(clean) == 4
+    and not any(t.get("fault_injected") for t in clean)
+    and out["injected_counter"] >= 1
+)
+print("RESULT " + json.dumps(out), flush=True)
+"""
+
+
 def _free_port():
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -323,6 +375,16 @@ def gate_replica_restart():
     return {"gate": "replica_restart", **result}
 
 
+def gate_fault_trace():
+    """Gate 4: an injected serving_execute fault's batch is tagged
+    fault_injected on the request trace plane; healthy traffic after
+    the one-shot arm traces clean."""
+    result = _run_child(CHILD_FAULT_TRACE, timeout=240)
+    if not result.get("ok"):
+        raise RuntimeError(f"fault-trace gate failed: {result}")
+    return {"gate": "fault_trace", **result}
+
+
 def main():
     import tempfile
 
@@ -333,6 +395,7 @@ def main():
         print(json.dumps(g1))
         print(json.dumps(gate_io_retry(control)))
         print(json.dumps(gate_replica_restart()))
+        print(json.dumps(gate_fault_trace()))
     except Exception as exc:
         print(json.dumps({"ok": False,
                           "error": f"{type(exc).__name__}: {exc}"}))

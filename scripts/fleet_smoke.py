@@ -7,7 +7,12 @@ ragged traffic with one hot-swap mid-run must
 - lose NO request across the swap (every submitted request resolves,
   and every answer matches one of the two published versions exactly);
 - expose per-replica stats on ``/status`` (the fleet aggregate carries a
-  ``replicas`` list; each replica labels its queue gauges).
+  ``replicas`` list; each replica labels its queue gauges);
+- (ISSUE 16) after a supervised replica's worker dies with traced
+  requests still queued, the supervisor's drain-and-requeue must tag
+  every drained request's trace with the corpse's id
+  (``rerouted_from``) — the requests complete on the rebuilt replica
+  and their sampled traces prove where they came from.
 
 The parent picks a free port, launches the child with
 ``DASK_ML_TPU_OBS_HTTP_PORT`` pointing at it, scrapes ``/status`` while
@@ -90,6 +95,48 @@ with fleet:
         t.join()
     recompiles = obs.counters_snapshot().get("recompiles", 0) - before
     stats = fleet.stats()
+
+    # phase 2 (ISSUE 16): a TRACED supervised fleet loses a worker with
+    # requests still queued — the supervisor's requeue must tag every
+    # drained request's trace with the corpse's replica id
+    from dask_ml_tpu import config
+    from dask_ml_tpu.serving import ServerClosed
+    from dask_ml_tpu.serving._batching import fail_requests
+
+    rerouted_ok = []
+    with config.set(obs_trace_sample=1.0, serving_supervise=True,
+                    serving_supervise_interval_s=0.05):
+        fleet2 = FleetServer(a, name="clf2", replicas=2,
+                             ladder=BucketLadder(8, 128, 2.0),
+                             batch_window_ms=1.0, timeout_ms=0).warmup()
+        with fleet2:
+            doomed = fleet2.replicas[0]
+            doomed.pause()
+            futs = [doomed.submit(Xh[:16]) for _ in range(6)]
+
+            def boom(first):
+                # the in-hand request fails typed (the batch guard's
+                # contract), then the worker thread dies mid-loop with
+                # the remaining five still queued
+                fail_requests([first],
+                              ServerClosed("injected worker death"),
+                              outcome="closed")
+                raise RuntimeError("injected worker death")
+
+            doomed._serve_guarded = boom
+            doomed.resume()
+            sacrificed = 0
+            for f in futs:
+                try:
+                    got = f.result(120)
+                    assert got.shape == (16,)
+                except ServerClosed:
+                    sacrificed += 1
+        d = obs.traces_data()
+        rerouted_ok = [t for t in d["traces"]
+                       if t.get("rerouted_from") == 0
+                       and t["outcome"] == "ok"]
+
     try:
         assert not errs, errs[:3]
         n_sent, n_done = sum(sent), sum(done)
@@ -99,9 +146,16 @@ with fleet:
         assert swapped_to == 2 and stats["version"] == 2
         assert stats["swaps"] >= 1
         assert [p["version"] for p in stats["replicas"]] == [2, 2]
+        assert sacrificed == 1, f"{sacrificed} sacrificed (wanted 1)"
+        assert len(rerouted_ok) == 5, \
+            f"{len(rerouted_ok)} drained requests traced rerouted_from=0"
+        assert all(set(t["stages"]) >= {"admit", "queue_pop", "pack",
+                                        "complete"}
+                   for t in rerouted_ok)
         verdict.update(ok=True, requests=n_done,
                        recompiles=recompiles, swapped_to=swapped_to,
-                       batches=stats["batches"])
+                       batches=stats["batches"],
+                       rerouted_traced=len(rerouted_ok))
     except AssertionError as exc:
         verdict["error"] = str(exc)
     print("FLEET_DONE " + json.dumps(verdict), flush=True)

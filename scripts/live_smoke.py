@@ -8,9 +8,15 @@ briefly so a slow scraper still sees the final state), and asserts:
 - ``/healthz`` answers 200;
 - ``/metrics`` parses as Prometheus text and contains >= 1 histogram
   series and >= 1 fit progress gauge (``fit_pass``);
-- ``/status`` is valid JSON naming this child's pid.
+- ``/status`` is valid JSON naming this child's pid;
+- (ISSUE 16) after the child turns the fit into a TRACED serving phase
+  under an artificially tight SLO, ``/traces`` shows the violating
+  requests tail-sampled with a COMPLETE stage breakdown (every
+  lifecycle stage stamped, slo_violation tagged) while the process is
+  still up.
 
-Prints one JSON line: {"ok": true, "fit_pass": ..., "histograms": ...}.
+Prints one JSON line: {"ok": true, "fit_pass": ..., "histograms": ...,
+"slo_traces": ...}.
 Run: ``python scripts/live_smoke.py`` (exit 0 = gate holds).
 """
 
@@ -37,8 +43,27 @@ y = (X[:, 0] > 0).astype(np.float32)
 with config.set(stream_block_rows=4096):
     SGDClassifier(max_iter=8, random_state=0).fit(X, y)
 print("FIT_DONE", flush=True)
-# keep the exporter up so the parent's final scrape can't race the exit
-time.sleep(float(os.environ.get("LIVE_SMOKE_LINGER", "20")))
+# serving phase under the same exporter: tracing ON, SLO artificially
+# tight (1us) so every executed request violates it — the tail sampler
+# must keep ALL of them with a complete stage breakdown on /traces
+from dask_ml_tpu.datasets import make_classification
+from dask_ml_tpu.linear_model import LogisticRegression
+from dask_ml_tpu.serving import BucketLadder, ModelServer
+
+Xs, ys = make_classification(
+    n_samples=300, n_features=6, n_informative=4, random_state=0
+)
+clf = LogisticRegression(solver="lbfgs", max_iter=20).fit(Xs, ys)
+Xh = Xs.to_numpy().astype(np.float32)
+with config.set(obs_trace_sample=1.0, serving_slo_ms=0.001):
+    with ModelServer(clf, ladder=BucketLadder(8, 64, 2.0)) as srv:
+        srv.warmup()
+        for i in range(6):
+            srv.submit(Xh[: 4 + i]).result(30)
+        print("SERVE_DONE", flush=True)
+        # keep the exporter (and sampler state) up so the parent's
+        # final scrape can't race the exit
+        time.sleep(float(os.environ.get("LIVE_SMOKE_LINGER", "20")))
 """
 
 
@@ -123,8 +148,40 @@ def main():
         status_doc = json.loads(body)
         assert status_doc["pid"] == child.pid, (status_doc["pid"],
                                                 child.pid)
+        # 4) the serving phase's SLO-violating requests are on /traces,
+        #    tail-sampled with a COMPLETE stage breakdown
+        full_stages = {"admit", "queue_pop", "pack", "dispatch",
+                       "execute_done", "demux", "complete"}
+        slo_traces = 0
+        while time.time() < deadline:
+            _, body = _get(base + "/traces")
+            doc = json.loads(body)
+            slo = [t for t in doc.get("traces", [])
+                   if t.get("slo_violation")
+                   and set(t.get("stages", {})) == full_stages
+                   and t.get("outcome") == "ok"]
+            if len(slo) >= 6:
+                slo_traces = len(slo)
+                break
+            if child.poll() is not None:
+                raise RuntimeError(
+                    "child exited before /traces sampled the "
+                    "SLO-violating requests"
+                )
+            time.sleep(0.05)
+        if not slo_traces:
+            raise RuntimeError(
+                "deadline: /traces never showed the SLO-violating "
+                "requests with complete breakdowns"
+            )
+        # the trace-fed queue-wait family reached /metrics too
+        _, text = _get(base + "/metrics")
+        assert re.search(
+            r"^dask_ml_tpu_serving_queue_wait_seconds_bucket\{", text,
+            re.MULTILINE,
+        ), "serving_queue_wait_seconds missing from /metrics"
         out.update(ok=True, fit_pass=fit_pass, histograms=n_hist,
-                   port=port)
+                   slo_traces=slo_traces, port=port)
     except Exception as exc:
         out["error"] = f"{type(exc).__name__}: {exc}"
     finally:

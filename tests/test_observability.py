@@ -393,6 +393,63 @@ def test_drift_plane_adds_nothing_when_disabled():
     drift.reset()
 
 
+def test_trace_plane_adds_nothing_when_disabled():
+    """ISSUE 16 extension of the zero-overhead contract: the request
+    trace plane is pure host bookkeeping — a full traced server
+    lifecycle (sample=1.0) and an untraced one (the 0 default) leave
+    the serving entry point's jaxpr byte-identical, and with the plane
+    off no trace is ever allocated and no sampler state moves."""
+    import jax
+    import jax.numpy as jnp
+
+    from dask_ml_tpu.observability import _requests as rtrace
+    from dask_ml_tpu.serving import BucketLadder, ModelServer
+    from dask_ml_tpu.wrappers import _linear_core
+
+    def serve_jaxpr():
+        core = _linear_core("classify", multi=False)
+        p = {"W": jnp.zeros((1, 6)), "b": jnp.zeros(1)}
+        return str(jax.make_jaxpr(core)(p, jnp.zeros((8, 6))))
+
+    from dask_ml_tpu.datasets import make_classification
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    X, y = make_classification(
+        n_samples=300, n_features=6, n_informative=4, random_state=0
+    )
+    clf = LogisticRegression(solver="lbfgs", max_iter=20).fit(X, y)
+    Xh = X.to_numpy().astype(np.float32)
+
+    rtrace.traces_reset()
+    assert not rtrace.tracing_enabled()
+    baseline = serve_jaxpr()
+    ladder = BucketLadder(8, 64, 2.0)
+    # traced lifecycle: the plane records on the host, the program
+    # can't see it
+    with config.set(obs_trace_sample=1.0):
+        assert rtrace.tracing_enabled()
+        with ModelServer(clf, ladder=ladder) as srv:
+            srv.warmup()
+            srv.submit(Xh[:4]).result(10)
+            assert serve_jaxpr() == baseline
+    assert rtrace.traces_data()["counts"]["completed"] == 1
+    rtrace.traces_reset()
+    # untraced lifecycle: nothing allocated, nothing counted, same
+    # program
+    with ModelServer(clf, ladder=ladder) as srv:
+        assert srv._trace_on is False
+        srv.warmup()
+        f = srv.submit(Xh[:4])
+        # the queue entry never grew a trace
+        f.result(10)
+        assert serve_jaxpr() == baseline
+    d = rtrace.traces_data()
+    assert d["counts"] == {"started": 0, "completed": 0, "sampled": 0,
+                           "captured": 0}
+    assert d["traces"] == [] and d["stage_histograms"] == {}
+    assert serve_jaxpr() == baseline
+
+
 def test_jit_callbacks_probe_resettable(monkeypatch):
     from dask_ml_tpu.observability import _metrics
 

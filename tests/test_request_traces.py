@@ -1,0 +1,561 @@
+"""Request trace plane (ISSUE 16): per-request lifecycle tracing
+through the serving pipeline, tail sampling, exemplar histograms, the
+/traces surface, reroute/shed/fault tagging, and the traffic
+capture/replay round-trip.
+
+The load-bearing assertions: stage stamps telescope exactly (the sum of
+stage-pair durations IS complete - admit), a hammered traced server
+pays ZERO post-warmup XLA compiles across a mid-run hot-swap, every
+non-ok outcome is tail-sampled regardless of the slowest-p fraction,
+and with ``obs_trace_sample=0`` no trace object is ever allocated
+(the jaxpr-identity half of the contract lives in
+``test_observability.py``)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu import config, observability as obs
+from dask_ml_tpu.observability import _requests as rtrace
+from dask_ml_tpu.serving import (
+    BucketLadder,
+    FleetServer,
+    ModelServer,
+    RequestTimeout,
+    ServerClosed,
+    ServerOverloaded,
+    SloShed,
+)
+
+
+@pytest.fixture(scope="module")
+def logreg():
+    """Two same-shape fitted models (the hot-swap pair) + host data."""
+    from dask_ml_tpu.datasets import make_classification
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    X, y = make_classification(
+        n_samples=600, n_features=12, n_informative=6, random_state=0
+    )
+    X2, y2 = make_classification(
+        n_samples=600, n_features=12, n_informative=6, random_state=7
+    )
+    a = LogisticRegression(solver="lbfgs", max_iter=30).fit(X, y)
+    b = LogisticRegression(solver="lbfgs", max_iter=30).fit(X2, y2)
+    return a, b, X.to_numpy().astype(np.float32)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    rtrace.traces_reset()
+    yield
+    rtrace.traces_reset()
+
+
+def _ladder():
+    return BucketLadder(8, 128, 2.0)
+
+
+def _stage_order(trace):
+    st = trace["stages"]
+    return [st[s] for s in rtrace.STAGES if s in st]
+
+
+# -- zero overhead when off --------------------------------------------------
+
+def test_trace_plane_off_by_default(logreg):
+    """obs_trace_sample=0 (the default): no trace object is ever
+    allocated — the queue entries keep trace=None end to end and the
+    plane's counters never move."""
+    clf, _, Xh = logreg
+    seen = []
+    orig = rtrace.new_trace
+
+    with ModelServer(clf, ladder=_ladder()) as srv:
+        assert srv._trace_on is False
+        srv.warmup()
+        futs = [srv.submit(Xh[: 1 + i]) for i in range(4)]
+        for f in futs:
+            f.result(10)
+    assert seen == [] and orig is rtrace.new_trace
+    d = obs.traces_data()
+    assert d["counts"] == {"started": 0, "completed": 0, "sampled": 0,
+                           "captured": 0}
+    assert d["traces"] == [] and d["stage_histograms"] == {}
+
+
+# -- stage stamps ------------------------------------------------------------
+
+def test_stages_telescope_and_tags(logreg):
+    clf, _, Xh = logreg
+    with config.set(obs_trace_sample=1.0):
+        with ModelServer(clf, ladder=_ladder(),
+                         methods=("predict", "predict_proba")) as srv:
+            assert srv._trace_on is True
+            srv.warmup()
+            futs = [srv.submit(Xh[: 1 + (3 * i) % 40]) for i in range(8)]
+            futs += [srv.submit(Xh[:5], method="predict_proba")
+                     for _ in range(2)]
+            for f in futs:
+                f.result(10)
+    d = obs.traces_data()
+    assert d["counts"]["started"] == 10
+    assert d["counts"]["completed"] == 10
+    assert d["counts"]["sampled"] == 10        # p=1.0 keeps everything
+    assert len(d["traces"]) == 10
+    for t in d["traces"]:
+        # every lifecycle stage stamped, in order
+        assert set(t["stages"]) == set(rtrace.STAGES)
+        order = _stage_order(t)
+        assert order == sorted(order)
+        # telescoping: stage-pair durations sum to the e2e exactly
+        assert sum(t["durations"].values()) == pytest.approx(
+            t["e2e_s"], abs=5e-5)
+        assert t["outcome"] == "ok"
+        # bucket is the COALESCED batch's ladder slot
+        assert t["bucket"] in (8, 16, 32, 64, 128)
+        assert t["version"] == 0
+        assert t["method"] in ("predict", "predict_proba")
+        assert t["trace_id"] >> 24 > 0         # pid-prefixed
+    # per-stage exemplar histograms saw every completion
+    hists = d["stage_histograms"]
+    for name in ("queue_wait", "pack", "execute", "demux"):
+        assert hists[name]["count"] == 10
+        ex = [e for e in hists[name]["exemplars"] if e is not None]
+        assert ex and all(isinstance(e, int) for e in ex)
+        ids = {t["trace_id"] for t in d["traces"]}
+        assert set(ex) <= ids
+
+
+# -- the hammer: ragged concurrent traffic + mid-run hot-swap ---------------
+
+def test_hammer_traced_hotswap_zero_compiles(logreg):
+    """Concurrent ragged traffic with tracing ON, a hot-swap mid-run:
+    every completed request's stages stay monotonic and sum to within
+    5% (plus a small absolute floor) of its client-measured e2e, and
+    the warmed server pays ZERO new XLA compiles."""
+    clf, clf2, Xh = logreg
+    rng = np.random.RandomState(3)
+    sizes = [int(rng.randint(1, 100)) for _ in range(120)]
+    measured = {}        # trace snapshot can't see client e2e: key by
+    #                      (method, n_rows, order) is ambiguous — match
+    #                      by trace_id via a submit-side registry
+    lock = threading.Lock()
+    errs = []
+
+    with config.set(obs_trace_sample=1.0, obs_trace_keep=512):
+        with ModelServer(clf, ladder=_ladder(), batch_window_ms=1.0) \
+                as srv:
+            srv.warmup()
+            before = obs.counters_snapshot().get("recompiles", 0)
+
+            def client(my_sizes):
+                try:
+                    for n in my_sizes:
+                        t0 = time.perf_counter()
+                        f = srv.submit(Xh[:n])
+                        f.result(30)
+                        e2e = time.perf_counter() - t0
+                        with lock:
+                            measured[len(measured)] = e2e
+                except Exception as exc:   # pragma: no cover
+                    errs.append(exc)
+
+            threads = [threading.Thread(target=client,
+                                        args=(sizes[c::4],))
+                       for c in range(4)]
+            for th in threads:
+                th.start()
+            # mid-run zero-recompile hot-swap (same shapes): wait for
+            # real completions under v0, swap, let the rest drain
+            deadline = time.monotonic() + 30
+            while (obs.traces_data()["counts"]["completed"] < 10
+                   and time.monotonic() < deadline):
+                time.sleep(0.002)
+            srv.swap_model(clf2)
+            for th in threads:
+                th.join(60)
+            # a few post-swap requests pin v1 traffic deterministically
+            for _ in range(3):
+                srv.submit(Xh[:16]).result(30)
+            after = obs.counters_snapshot().get("recompiles", 0)
+    assert errs == []
+    assert after - before == 0, \
+        f"traced hammer paid {after - before} recompiles"
+    d = obs.traces_data()
+    assert d["counts"]["completed"] == len(sizes) + 3
+    assert d["counts"]["sampled"] == len(sizes) + 3    # p=1.0
+    client_e2e = sorted(measured.values())
+    for t in d["traces"]:
+        order = _stage_order(t)
+        assert order == sorted(order), t
+        dsum = sum(t["durations"].values())
+        assert dsum == pytest.approx(t["e2e_s"], abs=1e-4)
+        # the trace e2e is bounded by SOME client measurement: admit is
+        # stamped at Request construction inside submit, complete right
+        # after set_result — the client adds only call overhead, so the
+        # slowest client e2e bounds every trace e2e (5% + 5ms slack)
+        assert t["e2e_s"] <= client_e2e[-1] * 1.05 + 5e-3
+    # both model versions served under tracing
+    versions = {t["version"] for t in d["traces"]}
+    assert versions == {0, 1}
+
+
+# -- tail sampler ------------------------------------------------------------
+
+def test_non_ok_outcomes_always_sampled(logreg):
+    """Sheds, timeouts and (injected) errors are kept by the tail
+    sampler at ANY sample fraction — here a tiny p that would almost
+    never keep an ordinary completion."""
+    clf, _, Xh = logreg
+    with config.set(obs_trace_sample=0.01):
+        # shed: a paused 2-deep queue overflows on the third submit
+        with ModelServer(clf, ladder=_ladder(), max_queue=2) as srv:
+            srv.warmup()
+            srv.pause()
+            held = [srv.submit(Xh[:4]) for _ in range(2)]
+            with pytest.raises(ServerOverloaded):
+                srv.submit(Xh[:4])
+            srv.resume()
+            for f in held:
+                f.result(10)
+        # timeout: requests expire while the worker is parked
+        with ModelServer(clf, ladder=_ladder(), timeout_ms=30) as srv:
+            srv.warmup()
+            srv.pause()
+            f = srv.submit(Xh[:4])
+            time.sleep(0.1)
+            srv.resume()
+            with pytest.raises(RequestTimeout):
+                f.result(10)
+        # error: the chaos plane fails one batch inside _execute (the
+        # worker re-applies the creator's config, so the plan armed
+        # here is live on the worker thread)
+        from dask_ml_tpu.reliability import faults
+        from dask_ml_tpu.serving import ServingError
+
+        faults.reset_plans()
+        with config.set(fault_plan="serving_execute:crash@0"):
+            with ModelServer(clf, ladder=_ladder()) as srv:
+                srv.warmup()
+                f = srv.submit(Xh[:4])
+                with pytest.raises(ServingError):
+                    f.result(10)
+        faults.reset_plans()
+    d = obs.traces_data()
+    by_outcome = {}
+    for t in d["traces"]:
+        by_outcome.setdefault(t["outcome"], []).append(t)
+    assert by_outcome.get("shed"), d["counts"]
+    assert by_outcome.get("timeout"), d["counts"]
+    assert by_outcome.get("error"), d["counts"]
+    # the injected fault's batch is tagged
+    assert all(t.get("fault_injected") for t in by_outcome["error"])
+    # a shed trace never reached the worker: no queue_pop stamp
+    assert "queue_pop" not in by_outcome["shed"][0]["stages"]
+
+
+def test_tail_sampler_keeps_slowest_fraction(logreg):
+    """At a small p most ordinary completions fold into the histograms
+    WITHOUT being kept; the sampled set is the slow tail."""
+    clf, _, Xh = logreg
+    n = 150
+    with config.set(obs_trace_sample=0.05):
+        with ModelServer(clf, ladder=_ladder()) as srv:
+            srv.warmup()
+            # sequential round-trips: burst submits would queue behind
+            # each other, every completion a new e2e max → all kept
+            for _ in range(n):
+                srv.submit(Xh[:4]).result(10)
+    d = obs.traces_data()
+    assert d["counts"]["completed"] == n
+    # every completion folded into the per-stage histograms...
+    assert d["stage_histograms"]["queue_wait"]["count"] == n
+    # ...but only a fraction was kept with a full breakdown
+    assert d["counts"]["sampled"] < n // 2
+
+
+def test_trace_keep_bounds_retention(logreg):
+    clf, _, Xh = logreg
+    with config.set(obs_trace_sample=1.0, obs_trace_keep=5):
+        with ModelServer(clf, ladder=_ladder()) as srv:
+            srv.warmup()
+            futs = [srv.submit(Xh[:4]) for _ in range(20)]
+            for f in futs:
+                f.result(10)
+    d = obs.traces_data()
+    assert d["counts"]["sampled"] == 20
+    assert len(d["traces"]) == 5          # deque bound: newest kept
+
+
+# -- fleet: reroute + SLO shed tagging --------------------------------------
+
+def test_reroute_tags_surviving_replica_trace(logreg):
+    """A replica dying between the health check and the put reroutes
+    the request; the survivor's trace records the corpse's id."""
+    clf, _, Xh = logreg
+    with config.set(obs_trace_sample=1.0):
+        fleet = FleetServer(clf, name="clf", replicas=2,
+                            ladder=_ladder()).warmup()
+        with fleet:
+            # replica 0 refuses with the typed death error while still
+            # ranking healthy (the race fleet.submit's failover covers)
+            def _dead(X, method="predict"):
+                raise ServerClosed("replica 0 died")
+
+            fleet.replicas[0].submit = _dead
+            y = fleet.predict(Xh[:6])
+            assert y.shape == (6,)
+    d = obs.traces_data()
+    done = [t for t in d["traces"] if t["outcome"] == "ok"]
+    assert done
+    t = done[-1]
+    assert t["rerouted_from"] == 0
+    assert t["replica"] == 1
+    assert set(t["stages"]) == set(rtrace.STAGES)
+
+
+def test_slo_shed_trace_kept_and_tagged(logreg):
+    clf, _, Xh = logreg
+    with config.set(obs_trace_sample=1.0, serving_slo_ms=30.0):
+        fleet = FleetServer(clf, name="clf", replicas=1,
+                            ladder=_ladder(), batch_window_ms=1.0,
+                            timeout_ms=0).warmup()
+        with fleet:
+            for _ in range(10):
+                fleet.predict(Xh[:64])
+            from dask_ml_tpu.serving._batching import Request
+
+            for r in fleet.replicas:
+                r.pause()
+                for _ in range(13):
+                    r._exec.observe("predict", 128, 0.5)
+                for _ in range(8):
+                    r._queue.put(Request(Xh[:100], "predict"))
+            with pytest.raises(SloShed):
+                fleet.submit(Xh[:100])
+            for r in fleet.replicas:
+                r._queue.drain_all()
+                r.resume()
+    d = obs.traces_data()
+    shed = [t for t in d["traces"] if t["outcome"] == "slo_shed"]
+    assert len(shed) == 1
+    assert shed[0]["slo_shed"] is True
+    assert shed[0]["n_rows"] == 100
+
+
+# -- /traces endpoint --------------------------------------------------------
+
+def test_traces_endpoint_serves_sampler_state(logreg):
+    from dask_ml_tpu.observability import live
+
+    clf, _, Xh = logreg
+    live.stop_telemetry()
+    with config.set(obs_trace_sample=1.0):
+        with obs.TelemetryServer(port=0) as tsrv:
+            with ModelServer(clf, ladder=_ladder()) as srv:
+                srv.warmup()
+                futs = [srv.submit(Xh[:4]) for _ in range(3)]
+                for f in futs:
+                    f.result(10)
+            with urllib.request.urlopen(
+                    f"{tsrv.url}/traces", timeout=5.0) as resp:
+                assert resp.status == 200
+                assert "json" in resp.headers["Content-Type"]
+                body = json.loads(resp.read())
+    assert body["counts"]["completed"] == 3
+    assert len(body["traces"]) == 3
+    assert body["stage_histograms"]["queue_wait"]["count"] == 3
+    assert "exemplars" in body["stage_histograms"]["queue_wait"]
+    live.metrics_reset()
+
+
+def test_queue_wait_histogram_mirrors_to_live_registry(logreg):
+    """The satellite family: serving_queue_wait_seconds{method,bucket}
+    lands in the live registry (scraped on /metrics) while a telemetry
+    server is up — fed from the trace timestamps."""
+    from dask_ml_tpu.observability import live
+
+    clf, _, Xh = logreg
+    live.stop_telemetry()
+    live.metrics_reset()
+    with config.set(obs_trace_sample=1.0):
+        with obs.TelemetryServer(port=0):
+            with ModelServer(clf, ladder=_ladder()) as srv:
+                srv.warmup()
+                futs = [srv.submit(Xh[:4]) for _ in range(3)]
+                for f in futs:
+                    f.result(10)
+            fams = {name for (name, labels) in
+                    live.histograms_snapshot()}
+            assert "serving_queue_wait_seconds" in fams
+            assert "serving_pack_seconds" in fams
+            assert "serving_demux_seconds" in fams
+            key = [(name, labels) for (name, labels)
+                   in live.histograms_snapshot()
+                   if name == "serving_queue_wait_seconds"][0]
+            assert dict(key[1])["method"] == "predict"
+            text = live.render_prometheus()
+            assert "serving_queue_wait_seconds_bucket" in text
+            # exemplars stay OFF the text exposition (grammar-clean)
+            assert "# {" not in text and "trace_id" not in text
+    live.metrics_reset()
+
+
+# -- capture / replay round-trip --------------------------------------------
+
+def test_capture_roundtrip_replay(tmp_path, logreg):
+    clf, _, Xh = logreg
+    trace_dir = str(tmp_path / "t")
+    with config.set(obs_trace_sample=1.0, trace_dir=trace_dir):
+        with ModelServer(clf, ladder=_ladder(),
+                         methods=("predict", "predict_proba")) as srv:
+            srv.warmup()
+            futs = [srv.submit(Xh[: 1 + i % 9]) for i in range(10)]
+            futs += [srv.submit(Xh[:3], method="predict_proba")
+                     for _ in range(4)]
+            for f in futs:
+                f.result(10)
+    path = tmp_path / "t" / "trace.jsonl"
+    records = obs.load_capture(str(path))
+    assert len(records) == 14
+    assert obs.traces_data()["counts"]["captured"] == 14
+    # replay reproduces the recorded (method, rows) mix in order
+    replayed = []
+    out = obs.replay(records, lambda m, n: replayed.append((m, n)),
+                     speed=1000.0)
+    assert replayed == [(r["method"], r["n_rows"]) for r in records]
+    assert out["requests"] == 14
+    assert out["rows"] == sum(r["n_rows"] for r in records)
+    assert out["by_method"] == {"predict": 10, "predict_proba": 4}
+    assert out["rate_rps"] > 0
+    # the sampled req_trace records rode the same file
+    sampled = [json.loads(line) for line in open(path)
+               if '"req_trace"' in line]
+    assert len(sampled) == 14              # p=1.0
+    assert all(s["stages"]["admit"] == 0.0 for s in sampled)
+
+
+def test_replay_empty_and_corrupt_lines(tmp_path):
+    p = tmp_path / "cap.jsonl"
+    p.write_text('{"req_capture": true, "trace_id": 1, "method": "m", '
+                 '"n_rows": 2, "t_unix": 5.0}\n'
+                 'not json\n'
+                 '{"other": true}\n')
+    records = obs.load_capture(str(p))
+    assert len(records) == 1
+    out = obs.replay(records, lambda m, n: None)
+    assert out["requests"] == 1 and out["rows"] == 2
+    assert obs.replay([], lambda m, n: None)["requests"] == 0
+
+
+# -- report CLI --------------------------------------------------------------
+
+def _fake_trace(tid, pid, e2e, t_unix, method="predict", **tags):
+    stages = {"admit": 0.0, "queue_pop": e2e * 0.4, "pack": e2e * 0.5,
+              "dispatch": e2e * 0.55, "execute_done": e2e * 0.8,
+              "demux": e2e * 0.9, "complete": e2e}
+    durs = {"queue_wait": e2e * 0.4, "pack": e2e * 0.1,
+            "dispatch": e2e * 0.05, "execute": e2e * 0.25,
+            "demux": e2e * 0.1, "resolve": e2e * 0.1}
+    return {"req_trace": True, "trace_id": tid, "pid": pid,
+            "method": method, "n_rows": 4, "t_unix": t_unix,
+            "e2e_s": e2e, "outcome": tags.pop("outcome", "ok"),
+            "stages": stages, "durations": durs,
+            "threads": {"admit": "MainThread", "worker": "w"}, **tags}
+
+
+def test_report_slowest_table_and_merge():
+    from dask_ml_tpu.observability.report import (
+        build_report, merge_records, report_data, summarize_traces,
+    )
+
+    pid_a, pid_b = 11, 22
+    a = [{"req_capture": True, "trace_id": (pid_a << 24) | i,
+          "pid": pid_a, "method": "predict", "n_rows": 4,
+          "t_unix": 100.0 + i} for i in range(3)]
+    a += [_fake_trace((pid_a << 24) | 1, pid_a, 0.050, 100.0),
+          _fake_trace((pid_a << 24) | 2, pid_a, 0.010, 101.0)]
+    b = [_fake_trace((pid_b << 24) | 1, pid_b, 0.030, 100.5,
+                     rerouted_from=0, replica=1)]
+    merged = merge_records([a, b])
+    tr = summarize_traces(merged)
+    assert tr["sampled"] == 3
+    # slowest first, across both processes' files
+    assert [t["e2e_s"] for t in tr["traces"]] == [0.050, 0.030, 0.010]
+    assert tr["capture"]["requests"] == 3
+    assert tr["capture"]["by_method"] == {"predict": 3}
+    data = report_data(merged)
+    assert data["traces"]["sampled"] == 3
+    json.dumps(data)                      # --json stays serializable
+    text = build_report(merged, slowest=2)
+    assert "traces (2 slowest of 3 sampled" in text
+    assert "rerouted_from=0" in text
+    assert "traffic capture" in text
+    # --slowest 1 trims the table
+    assert "traces (1 slowest of 3 sampled" in build_report(
+        merged, slowest=1)
+
+
+def test_report_cli_slowest_flag(tmp_path, capsys):
+    from dask_ml_tpu.observability.report import main
+
+    p = tmp_path / "tr.jsonl"
+    with open(p, "w") as fh:
+        for i in range(4):
+            fh.write(json.dumps(_fake_trace(
+                (9 << 24) | i, 9, 0.01 * (i + 1), 100.0 + i)) + "\n")
+    assert main([str(p), "--slowest", "2"]) == 0
+    out = capsys.readouterr().out
+    assert "traces (2 slowest of 4 sampled" in out
+    assert main([str(p), "--json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["traces"]["sampled"] == 4
+    assert main([str(p), "--slowest"]) == 2        # missing count
+    assert main([str(p), "--slowest", "x"]) == 2   # non-integer
+
+
+def test_perfetto_flow_events_cross_threads(tmp_path):
+    from dask_ml_tpu.observability.export import to_chrome_trace
+
+    recs = [_fake_trace((11 << 24) | 1, 11, 0.040, 100.0),
+            _fake_trace((22 << 24) | 1, 22, 0.020, 100.5)]
+    trace = to_chrome_trace(recs)
+    ev = trace["traceEvents"]
+    slices = [e for e in ev if e.get("cat") == "request"
+              and e["ph"] == "X"]
+    flows = [e for e in ev if e.get("ph") in ("s", "f")]
+    # 6 stage-pair slices per trace; one s + one f flow pair each
+    assert len(slices) == 12
+    assert len(flows) == 4
+    starts = [e for e in flows if e["ph"] == "s"]
+    ends = [e for e in flows if e["ph"] == "f"]
+    assert {e["id"] for e in starts} == {(11 << 24) | 1, (22 << 24) | 1}
+    assert {e["id"] for e in ends} == {e["id"] for e in starts}
+    # the flow hops lanes: start on the admit thread, finish on worker
+    for s in starts:
+        f = [e for e in ends if e["id"] == s["id"]][0]
+        assert s["tid"] != f["tid"]
+    # two processes' MainThreads land on distinct lanes
+    lanes = {e["args"]["name"] for e in ev if e["ph"] == "M"}
+    assert "pid11.MainThread" in lanes and "pid22.MainThread" in lanes
+    # queue_wait slice lanes on the admission thread
+    qw = [e for e in slices if e["name"].endswith("queue_wait")]
+    assert qw and all(e["dur"] > 0 for e in qw)
+
+
+def test_traces_reset_isolates(logreg):
+    clf, _, Xh = logreg
+    with config.set(obs_trace_sample=1.0):
+        with ModelServer(clf, ladder=_ladder()) as srv:
+            srv.warmup()
+            srv.submit(Xh[:4]).result(10)
+    assert obs.traces_data()["counts"]["completed"] == 1
+    obs.traces_reset()
+    d = obs.traces_data()
+    assert d["counts"]["completed"] == 0
+    assert d["traces"] == [] and d["stage_histograms"] == {}
