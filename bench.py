@@ -333,6 +333,7 @@ def run():
         _try(_bench_drift, jax, on_tpu, n_chips)
         _try(_bench_plan_warm_start, jax, on_tpu, n_chips)
         _try(_bench_request_trace, jax, on_tpu, n_chips)
+        _try(_bench_federation, jax, on_tpu, n_chips)
     result["extra_metrics"] = extras
     # every successful metric also APPENDS to BENCH_floors.jsonl (run
     # marker + one kind="bench_metric" record each; the file is never
@@ -1995,6 +1996,180 @@ def _fleet_entries(jax, n_chips, n_requests, total_rows, n_clients,
             "final_version": stats["version"],
         },
     ]
+
+
+def _bench_federation(jax, on_tpu, n_chips):
+    """Federation section (ISSUE 17): the same ragged closed-loop mix
+    served through a :class:`FederatedFleet` router over two fleet
+    processes (LocalEndpoints — the virtual-process transport, so the
+    number measures ROUTING, not urllib), then a failover pass where
+    one process dies mid-run: every admitted request must still
+    resolve (``fleet_failover_lost_requests`` is recorded but, being
+    0 by contract, never seeds a sentinel floor — the federation smoke
+    gates it), plus the plans-warm autoscale spin-up latency
+    (``ReplicaAutoscaler.scale_up`` returns it) against the same
+    process's COLD first warmup."""
+    import threading as _threading
+    import time
+
+    from dask_ml_tpu import observability as obs
+    from dask_ml_tpu.datasets import make_classification
+    from dask_ml_tpu.linear_model import LogisticRegression
+    from dask_ml_tpu.serving import (
+        BucketLadder,
+        FederatedFleet,
+        FleetServer,
+        LocalEndpoint,
+        ReplicaAutoscaler,
+        ServingError,
+    )
+
+    n = 100_000 if on_tpu else 20_000
+    d = 128 if on_tpu else 32
+    X, y = make_classification(n_samples=n, n_features=d,
+                               n_informative=max(d // 4, 2),
+                               random_state=0)
+    a = LogisticRegression(solver="lbfgs", max_iter=20).fit(X, y)
+    Xh = X.to_numpy().astype(np.float32)
+
+    rng = np.random.RandomState(17)
+    n_requests = 400
+    sizes = np.maximum(np.exp(
+        rng.uniform(0, np.log(256), size=n_requests)
+    ).astype(int), 1)
+    offs = [int(rng.randint(0, n - s)) for s in sizes]
+    requests = [Xh[i:i + int(s)] for s, i in zip(sizes, offs)]
+    total_rows = int(sizes.sum())
+    n_clients = 8
+    shares = [list(range(c, n_requests, n_clients))
+              for c in range(n_clients)]
+    ladder = BucketLadder(8, 512, 2.0)
+
+    def drive(server):
+        """One closed-loop pass; returns (seconds, lost-count)."""
+        lost = [0] * n_clients
+
+        def client(c):
+            for i in shares[c]:
+                try:
+                    server.predict(requests[i])
+                except ServingError:
+                    lost[c] += 1
+
+        threads = [_threading.Thread(target=client, args=(c,))
+                   for c in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0, sum(lost)
+
+    t0 = time.perf_counter()
+    f0 = FleetServer(a, name="fed0", replicas=1, ladder=ladder,
+                     batch_window_ms=1.0, timeout_ms=0).warmup()
+    cold_warmup_s = time.perf_counter() - t0
+    f1 = FleetServer(a, name="fed1", replicas=1, ladder=ladder,
+                     batch_window_ms=1.0, timeout_ms=0).warmup()
+    f0.start()
+    f1.start()
+    fed = FederatedFleet(
+        [LocalEndpoint(f0, "p0"), LocalEndpoint(f1, "p1")],
+        name="fed0", ladder=ladder, poll_s=0.1,
+    ).start()
+    try:
+        drive(fed)                       # warm pass
+        fed_s, _ = drive(fed)
+        # failover pass: the ranked-first process dies mid-run; the
+        # whole-request re-issue must lose nothing
+        c0 = obs.counters_snapshot()
+        victim = {"p0": f0, "p1": f1}[
+            fed._ranked("predict", 64)[0].endpoint.process_id]
+        killer = _threading.Timer(max(fed_s / 2, 0.05),
+                                  lambda: victim.stop(drain=False))
+        killer.start()
+        failover_s, n_lost = drive(fed)
+        killer.cancel()
+        reroutes = obs.counters_snapshot() \
+            .get("serving_process_reroutes", 0) \
+            - c0.get("serving_process_reroutes", 0)
+    finally:
+        fed.stop()
+        for f in (f0, f1):
+            try:
+                f.stop(drain=False)
+            except Exception:
+                pass
+
+    # plans-warm spin-up: the same process has already compiled the
+    # ladder, so scale_up's off-path warmup replays cached programs —
+    # min over a few cycles (ms-scale timing, keep the floor stable)
+    f2 = FleetServer(a, name="fed-scale", replicas=1, ladder=ladder,
+                     batch_window_ms=1.0, timeout_ms=0).warmup().start()
+    try:
+        scaler = ReplicaAutoscaler(f2, min_replicas=1, max_replicas=4,
+                                   interval_s=3600.0, patience=1,
+                                   cooldown_s=0.0)
+        spinups = []
+        for _ in range(3):
+            spinups.append(scaler.scale_up())
+        warm_spinup_s = min(spinups)
+    finally:
+        f2.stop(drain=False)
+
+    common = {
+        "backend": jax.default_backend(),
+        "dtype": "float32",
+        "n_chips": n_chips,
+        "processes": 2,
+        "n_requests": n_requests,
+        "total_rows": total_rows,
+        "n_clients": n_clients,
+    }
+    entries = [
+        {
+            **common,
+            "metric": "fleet_federated_rows_per_sec",
+            "value": round(total_rows / fed_s, 1),
+            "unit": "rows/s",
+            "federated_seconds": round(fed_s, 3),
+        },
+        {
+            **common,
+            "metric": "fleet_failover_lost_requests",
+            "value": int(n_lost),
+            "unit": "requests",
+            "criterion": "== 0 (whole-request re-issue on ProcessDown)",
+            "criterion_met": n_lost == 0,
+            "process_reroutes": int(reroutes),
+            "failover_pass_seconds": round(failover_s, 3),
+        },
+        {
+            **common,
+            "metric": "autoscale_spinup_seconds",
+            "value": round(warm_spinup_s, 4),
+            "unit": "s",
+            # plan-warm vs cold: the scale-up replays this process's
+            # already-minted programs; the cold number is the same
+            # ladder's first-ever warmup
+            "vs_baseline": round(warm_spinup_s
+                                 / max(cold_warmup_s, 1e-9), 4),
+            "baseline": {
+                "what": "cold 1-replica fleet warmup, same ladder",
+                "seconds": round(cold_warmup_s, 3),
+            },
+            "spinups_s": [round(s, 4) for s in spinups],
+        },
+    ]
+    from dask_ml_tpu.observability import MetricsLogger
+
+    metrics_file = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_metrics.jsonl"
+    )
+    with MetricsLogger(metrics_file) as _lg:
+        for e in entries:
+            _lg.log(kind="bench_federation", **e)
+    return entries
 
 
 _emit_lock = threading.Lock()

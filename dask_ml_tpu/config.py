@@ -350,6 +350,47 @@ class Config:
     # flavors swap via rebuild_model (fresh compiles off the serving
     # path) instead
     serving_warm_flavors: str = ""
+    # -- serving federation (dask_ml_tpu/serving/federation.py) -----------
+    # how long a FederatedFleet router trusts a cached process /status
+    # snapshot before re-polling it (seconds) — routing reads the cache;
+    # only a stale cache pays the poll
+    serving_federation_poll_s: float = 0.5
+    # per-call deadline for one cross-process operation (a /status poll,
+    # one routed submit, one publish fan-out push); a process that
+    # cannot answer inside it is treated as down and failed over
+    serving_federation_timeout_s: float = 10.0
+    # how long a process marked down stays out of routing before the
+    # router probes it again (seconds) — a rebooted process rejoins on
+    # the first successful probe and is re-converged to the control
+    # plane's current version
+    serving_federation_retry_s: float = 2.0
+    # -- serving autoscale (dask_ml_tpu/serving/autoscale.py) -------------
+    # FleetServer.start arms a ReplicaAutoscaler: the SLO admission
+    # signal (queued rows x windowed exec quantiles) ADDS replicas under
+    # sustained predicted pressure and RETIRES them (graceful drain)
+    # when it subsides, instead of only shedding. Off by default:
+    # elasticity is an operational policy, fixed fleets keep today's
+    # behavior
+    serving_autoscale: bool = False
+    # replica-count bounds the autoscaler never crosses (min also floors
+    # scale-down; the fleet's construction-time count seeds the pool)
+    serving_autoscale_min: int = 1
+    serving_autoscale_max: int = 4
+    # autoscaler sweep cadence (seconds)
+    serving_autoscale_interval_s: float = 0.25
+    # hysteresis bands on the predicted completion signal
+    # (milliseconds): scale UP when the best replica's predicted
+    # completion for a top-bucket request stays above the up band,
+    # DOWN when it stays below the down band. 0 = derive from
+    # serving_slo_ms (80% / 20% of the SLO)
+    serving_autoscale_up_ms: float = 0.0
+    serving_autoscale_down_ms: float = 0.0
+    # consecutive over/under-band sweeps required before a scale action
+    # fires (debounce: one bursty tick must not mint a replica)
+    serving_autoscale_patience: int = 2
+    # seconds after any scale action during which no further action
+    # fires (the new pool must see traffic before being judged)
+    serving_autoscale_cooldown_s: float = 2.0
 
 
 _ENV_PREFIX = "DASK_ML_TPU_"

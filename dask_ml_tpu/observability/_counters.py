@@ -288,6 +288,46 @@ def record_replica_failure() -> None:
         counter_add("serving_replica_failures", 1)
 
 
+def record_scale_up() -> None:
+    """The autoscaler ADDED a replica (SLO headroom predicted a miss
+    under the up-band for the configured patience) — live /metrics:
+    ``dask_ml_tpu_serving_scale_ups_total`` beside the
+    ``serving_replicas`` gauge."""
+    if counters_enabled():
+        counter_add("serving_scale_ups", 1)
+
+
+def record_scale_down() -> None:
+    """The autoscaler RETIRED a replica (sustained headroom under the
+    down-band); the victim drained gracefully and its gauge series were
+    dropped."""
+    if counters_enabled():
+        counter_add("serving_scale_downs", 1)
+
+
+def record_process_reroute() -> None:
+    """The federation router re-issued a request on a different fleet
+    PROCESS after its first choice died/refused mid-flight — the
+    cross-process twin of ``serving_reroutes``."""
+    if counters_enabled():
+        counter_add("serving_process_reroutes", 1)
+
+
+def record_process_failover() -> None:
+    """The federation router marked a whole fleet process DOWN
+    (connection refused / status poll dead) and stopped routing to it
+    until it answers again."""
+    if counters_enabled():
+        counter_add("serving_process_failovers", 1)
+
+
+def record_federation_publish() -> None:
+    """One registry publish fanned out across the federation boundary
+    (origin registry -> every remote fleet process)."""
+    if counters_enabled():
+        counter_add("federation_publishes", 1)
+
+
 def record_serving_slo_violation() -> None:
     """A served request's end-to-end latency exceeded the configured
     ``serving_slo_ms`` — the request still SUCCEEDED (unlike the drop

@@ -87,8 +87,8 @@ _LIVE_HIST = {
 
 # tags that make a trace unconditionally interesting to the tail
 # sampler (beyond a non-"ok" outcome)
-_ALWAYS_KEEP_TAGS = ("rerouted_from", "fault_injected", "slo_violation",
-                     "slo_shed")
+_ALWAYS_KEEP_TAGS = ("rerouted_from", "rerouted_from_process",
+                     "fault_injected", "slo_violation", "slo_shed")
 
 _lock = threading.Lock()
 _kept: deque | None = None          # sampled trace records, newest last

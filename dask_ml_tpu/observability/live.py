@@ -536,12 +536,40 @@ class _Handler(http.server.BaseHTTPRequestHandler):
     def log_message(self, *args):  # silent: stderr belongs to the fit
         pass
 
-    def _reply(self, code, body: bytes, ctype: str):
+    def _reply(self, code, body: bytes, ctype: str, headers=()):
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in dict(headers).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
+
+    def do_POST(self):
+        # the federation request/publish surface (serving/federation):
+        # POST /fleet/<name>/<op> routes to the live-registered
+        # FleetServer carrying <name> in this process. Kept out of
+        # do_GET so scrapers stay read-only.
+        path = self.path.split("?", 1)[0].rstrip("/")
+        try:
+            if path.startswith("/fleet/"):
+                from ..serving import federation
+
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                body = self.rfile.read(n) if n > 0 else b""
+                code, out, ctype, extra = federation.handle_http(
+                    path, dict(self.headers.items()), body
+                )
+                self._reply(code, out, ctype, extra)
+            else:
+                self._reply(404, b"not found\n",
+                            "text/plain; charset=utf-8")
+        except Exception as exc:  # never take the server thread down
+            try:
+                self._reply(500, f"error: {exc}\n".encode(),
+                            "text/plain; charset=utf-8")
+            except Exception:
+                pass
 
     def do_GET(self):
         path = self.path.split("?", 1)[0].rstrip("/") or "/"

@@ -23,7 +23,15 @@ or a host→device parameter transfer per call.
   placement), least-loaded routing, SLO-aware admission, rolling
   hot-swap, failover, and :func:`serve_while_training`;
 - ``metrics``   — per-batch spans + serving counters through
-  ``dask_ml_tpu/observability``, and the latency-quantile window.
+  ``dask_ml_tpu/observability``, and the latency-quantile window;
+- ``federation`` — :class:`FederatedFleet`: predicted-completion
+  routing over N fleet PROCESSES, zero-lost failover with
+  ``rerouted_from_process`` trace tags, cross-process publish fan-out
+  with pinned version convergence;
+- ``autoscale`` — :class:`ReplicaAutoscaler`: the SLO admission signal
+  ADDS/RETIRES replicas under hysteresis bands (plans-warm spin-up);
+- ``loadtest``  — :func:`replay_load_test`: recorded-traffic replay
+  with a pass/fail SLO verdict (chaos- and canary-aware).
 
 Quick start::
 
@@ -48,7 +56,17 @@ from ._server import (
     ServingError,
     SloShed,
 )
+from .autoscale import ReplicaAutoscaler
+from .federation import (
+    FederatedFleet,
+    FleetEndpoint,
+    HttpEndpoint,
+    LocalEndpoint,
+    NoLiveProcesses,
+    ProcessDown,
+)
 from .fleet import FleetServer, NoHealthyReplicas, serve_while_training
+from .loadtest import replay_load_test, synthesize_records
 from .registry import (
     ModelRegistry,
     ModelVersion,
@@ -58,17 +76,26 @@ from .registry import (
 
 __all__ = [
     "BucketLadder",
+    "FederatedFleet",
+    "FleetEndpoint",
     "FleetServer",
+    "HttpEndpoint",
+    "LocalEndpoint",
     "ModelRegistry",
     "ModelServer",
     "ModelVersion",
     "NoHealthyReplicas",
+    "NoLiveProcesses",
+    "ProcessDown",
     "RegistryError",
+    "ReplicaAutoscaler",
     "RequestTimeout",
     "ServerClosed",
     "ServerOverloaded",
     "ServingError",
     "SloShed",
     "UnknownModelError",
+    "replay_load_test",
     "serve_while_training",
+    "synthesize_records",
 ]

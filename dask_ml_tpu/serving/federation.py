@@ -1,0 +1,695 @@
+"""Federation plane: route one request stream over N fleet PROCESSES.
+
+The FleetServer (PR 6) scales a model across replicas inside one
+process — one GIL, one failure domain, one host's devices. This module
+adds the layer above it, the production shape ROADMAP item 4 names:
+
+- **endpoints** — a :class:`FleetEndpoint` per fleet process.
+  :class:`LocalEndpoint` wraps an in-process :class:`FleetServer`
+  (tests, the virtual-rank harness); :class:`HttpEndpoint` talks to a
+  REMOTE process's live telemetry server (PR 5), whose new ``POST
+  /fleet/<name>/<op>`` surface this module also implements
+  (:func:`handle_http` — the live ``_Handler`` delegates to it);
+- **predicted-completion routing** — a background poller caches every
+  process's ``/status`` fleet block (queued rows, windowed exec
+  quantiles, replica health); :meth:`FederatedFleet.submit` ranks live
+  processes by :func:`~.policy.predict_completion_s` fed from
+  :func:`~.policy.exec_from_snapshot` (the remote twin of the local
+  predictor) and places the request on the fastest predicted finisher;
+- **failover with zero lost admitted requests** — inference is
+  idempotent, so a request in flight to a process that dies (SIGKILL,
+  connection reset) is RE-ISSUED whole on the next-ranked process; the
+  survivor's trace carries ``rerouted_from_process`` (the cross-process
+  generalization of the fleet's ``rerouted_from`` tag, propagated over
+  HTTP in the ``X-Fed-Reroute`` header) and the hop counts as
+  ``serving_process_reroutes``. A dead process's gauge series are
+  dropped (never latched) and it counts one
+  ``serving_process_failovers``;
+- **cross-process publish fan-out** — :meth:`FederatedFleet.publish`
+  writes the router's CONTROL registry, then pushes the snapshot to
+  every live process tagged with the control registry's version id and
+  a monotonically increasing fan-out ``seq``. Each receiving fleet
+  applies it through :func:`apply_publish`: stale seqs are dropped
+  (last-writer-wins — back-to-back publishes converge every process to
+  the control registry's CURRENT version no matter the arrival order)
+  and the version id is PINNED into the local registry
+  (``ModelRegistry.publish(version=...)``), so version NUMBERS agree
+  fleet-wide and each process's ``_on_publish`` rolls its usual
+  zero-recompile hot-swap.
+
+Trust boundary: the publish op ships a pickled estimator — the same
+trust level as the process boundary it crosses. The telemetry server
+binds 127.0.0.1 by default; point HttpEndpoints only at processes you
+already trust with code execution (a pickle IS code).
+"""
+
+from __future__ import annotations
+
+import http.client
+import io
+import json
+import pickle
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from . import metrics as smetrics
+from ._buckets import BucketLadder
+from ._server import (
+    RequestTimeout,
+    ServerClosed,
+    ServerOverloaded,
+    ServingError,
+    SloShed,
+)
+from .fleet import NoHealthyReplicas
+from .policy import exec_from_snapshot, predict_completion_s
+from .registry import ModelRegistry
+
+__all__ = ["FederatedFleet", "FleetEndpoint", "LocalEndpoint",
+           "HttpEndpoint", "ProcessDown", "NoLiveProcesses",
+           "apply_publish", "handle_http"]
+
+
+class ProcessDown(ServingError):
+    """A fleet process stopped answering (connection refused/reset,
+    status poll dead). The router fails the request over; the process
+    rejoins routing when its status poll answers again."""
+
+
+class NoLiveProcesses(ServingError):
+    """Every federated process is down or refused this request — the
+    federation twin of :class:`~.fleet.NoHealthyReplicas`."""
+
+
+# -- wire helpers ------------------------------------------------------------
+
+def _npy_bytes(arr) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(arr), allow_pickle=False)
+    return buf.getvalue()
+
+
+def _npy_load(body: bytes):
+    return np.load(io.BytesIO(body), allow_pickle=False)
+
+
+# -- endpoints ---------------------------------------------------------------
+
+class FleetEndpoint:
+    """One fleet process as the router sees it: a process id, a status
+    probe, a blocking submit, and a publish-apply hook. Subclasses wrap
+    an in-process FleetServer (:class:`LocalEndpoint`) or a remote
+    process's HTTP surface (:class:`HttpEndpoint`)."""
+
+    process_id: str = "?"
+
+    def status(self) -> dict:
+        """The process's fleet stats block (queue_rows, exec_s windows,
+        replica health). Raises :class:`ProcessDown` when unreachable."""
+        raise NotImplementedError
+
+    def submit(self, X, method="predict", rerouted_from=None):
+        """BLOCKING: place one request and return its result array.
+        ``rerouted_from`` names the process this request failed over
+        from — the receiving fleet tags the survivor's trace with it."""
+        raise NotImplementedError
+
+    def apply_publish(self, estimator, version, seq, tag=None,
+                      quantize=None) -> bool:
+        """Install one fanned-out publish (seq-guarded, version-pinned).
+        Returns False when the seq was stale (already superseded)."""
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.process_id!r})"
+
+
+class LocalEndpoint(FleetEndpoint):
+    """An in-process :class:`FleetServer` as a federation endpoint —
+    the virtual-rank test harness's transport (and the degenerate
+    single-process federation)."""
+
+    def __init__(self, fleet, process_id=None):
+        self.fleet = fleet
+        self.process_id = str(
+            process_id if process_id is not None else f"local:{id(fleet)}"
+        )
+
+    def status(self) -> dict:
+        try:
+            if not self.fleet._started:
+                raise ProcessDown(f"{self.process_id}: fleet stopped")
+            return self.fleet.stats()
+        except ProcessDown:
+            raise
+        except Exception as exc:
+            raise ProcessDown(f"{self.process_id}: {exc}") from exc
+
+    def submit(self, X, method="predict", rerouted_from=None):
+        import concurrent.futures as cf
+
+        from ..config import get_config
+        from ..observability import _requests as rtrace
+
+        timeout_s = float(get_config().serving_federation_timeout_s)
+        try:
+            if rerouted_from is not None:
+                with rtrace.tagging(rerouted_from_process=rerouted_from):
+                    fut = self.fleet.submit(X, method=method)
+            else:
+                fut = self.fleet.submit(X, method=method)
+            return fut.result(timeout_s if timeout_s > 0 else None)
+        except (ServerClosed, NoHealthyReplicas) as exc:
+            raise ProcessDown(f"{self.process_id}: {exc}") from exc
+        except cf.TimeoutError:
+            raise RequestTimeout(
+                f"{self.process_id}: no result within "
+                f"{timeout_s:.1f}s federation budget"
+            ) from None
+
+    def apply_publish(self, estimator, version, seq, tag=None,
+                      quantize=None) -> bool:
+        return apply_publish(self.fleet, estimator, version, seq,
+                             tag=tag, quantize=quantize)
+
+
+class HttpEndpoint(FleetEndpoint):
+    """A REMOTE fleet process behind its live telemetry server: GETs
+    ``/status`` for the poll plane and POSTs ``/fleet/<name>/<op>``
+    (npy request/response bodies; pickle for publish — see the module
+    trust note) for the request/publish planes."""
+
+    def __init__(self, base_url, name="model", process_id=None,
+                 timeout_s=None):
+        from ..config import get_config
+
+        self.base_url = str(base_url).rstrip("/")
+        self.name = str(name)
+        self.process_id = str(process_id if process_id is not None
+                              else self.base_url)
+        self.timeout_s = float(
+            get_config().serving_federation_timeout_s
+            if timeout_s is None else timeout_s
+        )
+
+    def _post(self, op, body, headers):
+        req = urllib.request.Request(
+            f"{self.base_url}/fleet/{self.name}/{op}", data=body,
+            headers=headers, method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout_s) as resp:
+                return resp.status, resp.read(), dict(resp.headers)
+        except urllib.error.HTTPError as exc:
+            # typed serving errors ride HTTP status + X-Fed-Error; read
+            # the body so the connection is reusable
+            body = exc.read()
+            return exc.code, body, dict(exc.headers or {})
+        except (urllib.error.URLError, http.client.HTTPException,
+                ConnectionError, OSError, TimeoutError) as exc:
+            # IncompleteRead / RemoteDisconnected and friends are the
+            # process dying mid-response — same failover as a refused
+            # connection (inference is idempotent, re-issue is safe)
+            raise ProcessDown(f"{self.process_id}: {exc}") from exc
+
+    def status(self) -> dict:
+        try:
+            with urllib.request.urlopen(f"{self.base_url}/status",
+                                        timeout=self.timeout_s) as resp:
+                data = json.loads(resp.read().decode())
+        except (urllib.error.URLError, http.client.HTTPException,
+                ConnectionError, OSError, TimeoutError,
+                ValueError) as exc:
+            raise ProcessDown(f"{self.process_id}: {exc}") from exc
+        for entry in data.get("serving", ()):
+            if entry.get("fleet") == self.name:
+                return entry
+        raise ProcessDown(
+            f"{self.process_id}: no fleet {self.name!r} on /status"
+        )
+
+    def submit(self, X, method="predict", rerouted_from=None):
+        headers = {"Content-Type": "application/x-npy"}
+        if rerouted_from is not None:
+            headers["X-Fed-Reroute"] = str(rerouted_from)
+        code, body, rhead = self._post(method, _npy_bytes(X), headers)
+        if code == 200:
+            return _npy_load(body)
+        kind = rhead.get("X-Fed-Error", "")
+        msg = body.decode(errors="replace").strip() or f"HTTP {code}"
+        if kind == "slo_shed":
+            raise SloShed(f"{self.process_id}: {msg}")
+        if kind == "overloaded":
+            raise ServerOverloaded(f"{self.process_id}: {msg}")
+        if kind == "timeout":
+            raise RequestTimeout(f"{self.process_id}: {msg}")
+        # closed / unknown fleet / anything else: this process cannot
+        # take the request — fail over
+        raise ProcessDown(f"{self.process_id}: {msg}")
+
+    def apply_publish(self, estimator, version, seq, tag=None,
+                      quantize=None) -> bool:
+        headers = {
+            "Content-Type": "application/x-pickle",
+            "X-Fed-Version": str(int(version)),
+            "X-Fed-Seq": str(int(seq)),
+        }
+        if tag is not None:
+            headers["X-Fed-Tag"] = str(tag)
+        if quantize is not None:
+            headers["X-Fed-Quantize"] = str(quantize)
+        code, body, _ = self._post("publish", pickle.dumps(estimator),
+                                   headers)
+        if code != 200:
+            raise ProcessDown(
+                f"{self.process_id}: publish failed: "
+                f"{body.decode(errors='replace').strip()}"
+            )
+        return bool(json.loads(body.decode()).get("applied", False))
+
+
+# -- receiving side ----------------------------------------------------------
+
+# serializes fan-in applies per process: two fan-outs landing
+# concurrently must check-and-advance the seq AND publish in one
+# critical section, or the registry's current could regress to the
+# stale one
+_apply_lock = threading.Lock()
+
+
+def apply_publish(fleet, estimator, version, seq, tag=None,
+                  quantize=None) -> bool:
+    """Install one fanned-out publish on a receiving fleet: drop stale
+    seqs (last-writer-wins — the fan-out generalization of the fleet's
+    ``_on_publish`` converge-to-current contract), pin the origin
+    version id into the local registry, and let the fleet's own
+    subscriber roll the zero-recompile hot-swap."""
+    seq = int(seq)
+    with _apply_lock:
+        if seq <= getattr(fleet, "_fed_seq", 0):
+            return False
+        fleet._fed_seq = seq
+        fleet.registry.publish(fleet.name, estimator, tag=tag,
+                               quantize=quantize, version=int(version))
+    return True
+
+
+def _find_fleet(name):
+    """The live-registered FleetServer carrying ``name`` in THIS
+    process (fleet.start() registers it for /status; the federation
+    POST surface reuses that same registration)."""
+    from ..observability.live import _server_set
+
+    for srv in list(_server_set()):
+        if getattr(srv, "name", None) == name \
+                and hasattr(srv, "replicas"):
+            return srv
+    return None
+
+
+def handle_http(path, headers, body):
+    """The ``POST /fleet/<name>/<op>`` handler the live telemetry
+    server delegates to. Returns ``(code, body_bytes, content_type,
+    extra_headers)``. Ops: a served method name (npy in, npy out) or
+    ``publish`` (pickle in — module trust note applies). Typed serving
+    errors map to status codes the :class:`HttpEndpoint` reverses:
+    429 + ``X-Fed-Error: slo_shed|overloaded``, 503 closed/unknown,
+    504 timeout."""
+    from ..observability import _requests as rtrace
+
+    parts = [p for p in path.split("/") if p]
+    if len(parts) != 3 or parts[0] != "fleet":
+        return (404, b"not found\n", "text/plain; charset=utf-8", {})
+    _, name, op = parts
+    fleet = _find_fleet(name)
+    if fleet is None:
+        return (503, f"no live fleet {name!r} in this process\n"
+                .encode(), "text/plain; charset=utf-8",
+                {"X-Fed-Error": "unknown"})
+    if op == "publish":
+        try:
+            est = pickle.loads(body)
+            version = int(headers.get("X-Fed-Version", 0))
+            seq = int(headers.get("X-Fed-Seq", 0))
+        except Exception as exc:
+            return (400, f"bad publish body: {exc}\n".encode(),
+                    "text/plain; charset=utf-8", {})
+        applied = apply_publish(
+            fleet, est, version, seq,
+            tag=headers.get("X-Fed-Tag"),
+            quantize=headers.get("X-Fed-Quantize"),
+        )
+        out = json.dumps({"applied": applied,
+                          "version": fleet.version}).encode() + b"\n"
+        return (200, out, "application/json", {})
+    try:
+        X = _npy_load(body)
+    except Exception as exc:
+        return (400, f"bad npy body: {exc}\n".encode(),
+                "text/plain; charset=utf-8", {})
+    rerouted = headers.get("X-Fed-Reroute")
+    try:
+        if rerouted:
+            # the survivor's trace records the process this request
+            # failed over FROM (thread-local pending tag, picked up by
+            # the replica's _admit)
+            with rtrace.tagging(rerouted_from_process=rerouted):
+                fut = fleet.submit(X, method=op)
+        else:
+            fut = fleet.submit(X, method=op)
+        from ..config import get_config
+
+        timeout_s = float(get_config().serving_federation_timeout_s)
+        result = fut.result(timeout_s if timeout_s > 0 else None)
+    except SloShed as exc:
+        return (429, f"{exc}\n".encode(), "text/plain; charset=utf-8",
+                {"X-Fed-Error": "slo_shed"})
+    except ServerOverloaded as exc:
+        return (429, f"{exc}\n".encode(), "text/plain; charset=utf-8",
+                {"X-Fed-Error": "overloaded"})
+    except (ServerClosed, NoHealthyReplicas) as exc:
+        return (503, f"{exc}\n".encode(), "text/plain; charset=utf-8",
+                {"X-Fed-Error": "closed"})
+    except RequestTimeout as exc:
+        return (504, f"{exc}\n".encode(), "text/plain; charset=utf-8",
+                {"X-Fed-Error": "timeout"})
+    except AttributeError:
+        return (400, f"unknown method {op!r}\n".encode(),
+                "text/plain; charset=utf-8", {})
+    except Exception as exc:  # ServingError etc.
+        return (500, f"{exc}\n".encode(), "text/plain; charset=utf-8",
+                {"X-Fed-Error": "error"})
+    return (200, _npy_bytes(result), "application/x-npy", {})
+
+
+# -- the router --------------------------------------------------------------
+
+class _ProcessState:
+    __slots__ = ("endpoint", "alive", "stats", "t_status", "t_dead")
+
+    def __init__(self, endpoint):
+        self.endpoint = endpoint
+        self.alive = True       # optimistic: first poll corrects it
+        self.stats = None
+        self.t_status = 0.0
+        self.t_dead = 0.0
+
+
+class FederatedFleet:
+    """Client-side router over N fleet processes.
+
+    Parameters
+    ----------
+    endpoints : sequence of FleetEndpoint (or (url, process_id) strs)
+        The fleet processes. Strings build :class:`HttpEndpoint`\\ s.
+    name : str, the registry/fleet name every process serves
+    ladder : BucketLadder, default from config — sizes the completion
+        predictor's top bucket (must match the processes' ladders)
+    poll_s / timeout_s / retry_s : floats, default
+        ``config.serving_federation_*`` — status-poll period, per-call
+        HTTP budget, dead-process re-probe period.
+
+    Use as a context manager::
+
+        with FederatedFleet([url0, url1], name="model") as fed:
+            y = fed.predict(x)          # routed + failed over
+            fed.publish(new_clf)        # fans out, converges versions
+    """
+
+    def __init__(self, endpoints, name="model", ladder=None,
+                 registry=None, poll_s=None, timeout_s=None,
+                 retry_s=None):
+        from ..config import get_config
+
+        cfg = get_config()
+        self.name = str(name)
+        eps = []
+        for ep in endpoints:
+            if isinstance(ep, FleetEndpoint):
+                eps.append(ep)
+            else:
+                eps.append(HttpEndpoint(ep, name=self.name,
+                                        timeout_s=timeout_s))
+        if not eps:
+            raise ValueError("FederatedFleet needs >= 1 endpoint")
+        self._procs = [_ProcessState(ep) for ep in eps]
+        self.ladder = ladder if ladder is not None \
+            else BucketLadder.from_config()
+        # the CONTROL registry: the fan-out's source of truth for
+        # version ids (pinned into every process's local registry)
+        self.registry = registry if registry is not None \
+            else ModelRegistry()
+        self._poll_s = float(cfg.serving_federation_poll_s
+                             if poll_s is None else poll_s)
+        self._retry_s = float(cfg.serving_federation_retry_s
+                              if retry_s is None else retry_s)
+        self._pub_seq = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._poller = None
+        self._pool = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        import concurrent.futures as cf
+
+        if self._pool is None:
+            self._pool = cf.ThreadPoolExecutor(
+                max_workers=max(4, 2 * len(self._procs)),
+                thread_name_prefix="fed-submit",
+            )
+        self._stop.clear()
+        self._poll_once()
+        if self._poller is None:
+            self._poller = threading.Thread(
+                target=self._poll_loop, name="fed-poller", daemon=True,
+            )
+            self._poller.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._poller is not None:
+            self._poller.join(5.0)
+            self._poller = None
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+        for p in self._procs:
+            try:
+                p.endpoint.close()
+            except Exception:
+                pass
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb):
+        self.stop()
+        return False
+
+    # -- poll plane --------------------------------------------------------
+    def _poll_loop(self):
+        while not self._stop.wait(self._poll_s):
+            try:
+                self._poll_once()
+            except Exception:
+                pass
+
+    def _poll_once(self):
+        now = time.monotonic()
+        for p in self._procs:
+            if not p.alive and now - p.t_dead < self._retry_s:
+                continue  # back off re-probing a known-dead process
+            try:
+                stats = p.endpoint.status()
+            except ProcessDown:
+                self._mark_dead(p)
+                continue
+            with self._lock:
+                back = not p.alive
+                p.alive = True
+                p.stats = stats
+                p.t_status = time.monotonic()
+            pid = p.endpoint.process_id
+            smetrics.set_process_gauges(
+                pid, healthy=True,
+                replicas=stats.get("healthy_replicas"),
+            )
+            if back:
+                # a recovered process rejoins routing; its registry
+                # re-converges on the next publish fan-out
+                pass
+
+    def _mark_dead(self, p):
+        with self._lock:
+            was_alive = p.alive
+            p.alive = False
+            p.t_dead = time.monotonic()
+            p.stats = None
+        if was_alive:
+            smetrics.record_process_failover()
+            # never latch a dead process's gauge series on /metrics
+            smetrics.drop_process_gauges(p.endpoint.process_id)
+
+    # -- request plane -----------------------------------------------------
+    def _ranked(self, method, n_rows):
+        """Live processes ordered by predicted completion (unknown
+        predictions — cold windows — rank AFTER known-fast ones but
+        still receive traffic via queue_rows tiebreak)."""
+        with self._lock:
+            live = [p for p in self._procs if p.alive]
+        scored = []
+        for p in live:
+            stats = p.stats or {}
+            queue_rows = int(stats.get("queue_rows", 0) or 0)
+            exec_s = None
+            for rep in stats.get("replicas", ()):
+                v = exec_from_snapshot(rep.get("exec_s"), method,
+                                       self.ladder.max_rows)
+                if v is not None and (exec_s is None or v < exec_s):
+                    exec_s = v
+            predicted = predict_completion_s(
+                queue_rows, n_rows, self.ladder.max_rows, exec_s)
+            scored.append((predicted if predicted is not None
+                           else float("inf"), queue_rows, p))
+        scored.sort(key=lambda t: (t[0], t[1],
+                                   t[2].endpoint.process_id))
+        return [p for _, _, p in scored]
+
+    def _run_request(self, X, method):
+        X = np.asarray(X, np.float32)
+        n_rows = 1 if X.ndim == 1 else int(X.shape[0])
+        ranked = self._ranked(method, n_rows)
+        if not ranked:
+            raise NoLiveProcesses(
+                f"0/{len(self._procs)} federated processes live"
+            )
+        last_exc = None
+        rerouted_from = None
+        for p in ranked:
+            try:
+                return p.endpoint.submit(X, method=method,
+                                         rerouted_from=rerouted_from)
+            except ProcessDown as exc:
+                # the process died under this request (or refused it as
+                # closed): inference is idempotent, so the WHOLE request
+                # re-issues on the next-ranked survivor — this retry is
+                # the zero-lost-admitted-requests mechanism
+                last_exc = exc
+                self._mark_dead(p)
+                smetrics.record_process_reroute()
+                rerouted_from = p.endpoint.process_id
+            except ServerOverloaded as exc:
+                last_exc = exc
+                smetrics.record_process_reroute()
+                rerouted_from = p.endpoint.process_id
+            # SloShed / RequestTimeout propagate: admission refused the
+            # request deliberately (re-issuing would double-spend its
+            # budget), and a timeout already burned it
+        if isinstance(last_exc, ProcessDown):
+            raise NoLiveProcesses(
+                f"every federated process refused this request; "
+                f"last: {last_exc}"
+            ) from last_exc
+        raise last_exc
+
+    def submit(self, X, method="predict"):
+        """Admit one request to the federation: returns a Future
+        resolving to the result array (routing, failover and reroute
+        tagging happen on the router's worker thread)."""
+        if self._pool is None:
+            raise ServerClosed("FederatedFleet is not started")
+        return self._pool.submit(self._run_request, X, method)
+
+    def _call(self, X, method):
+        return self.submit(X, method=method).result()
+
+    def predict(self, X):
+        return self._call(X, "predict")
+
+    def predict_proba(self, X):
+        return self._call(X, "predict_proba")
+
+    def decision_function(self, X):
+        return self._call(X, "decision_function")
+
+    def transform(self, X):
+        return self._call(X, "transform")
+
+    # -- publish plane -----------------------------------------------------
+    def publish(self, estimator, tag=None, quantize=None) -> int:
+        """Publish to the control registry and fan the snapshot out to
+        every live process (version-pinned + seq-guarded — see
+        :func:`apply_publish`). Returns the control version id. Dead
+        processes are skipped; they re-converge on their next publish
+        after recovery."""
+        version = self.registry.publish(self.name, estimator, tag=tag,
+                                        quantize=quantize)
+        self._fan_out()
+        return version
+
+    def _fan_out(self):
+        """Push the control registry's CURRENT version to every live
+        process. Re-reading current under the seq lock (instead of
+        shipping the version a caller just published) is what makes
+        back-to-back publishes converge: a slow fan-out thread pushes
+        the NEWEST version with the NEWEST seq, never resurrects its
+        own stale one."""
+        with self._lock:
+            try:
+                mv = self.registry.get(self.name)
+            except KeyError:
+                return
+            self._pub_seq += 1
+            seq = self._pub_seq
+            live = [p for p in self._procs if p.alive]
+        smetrics.record_federation_publish()
+        for p in live:
+            try:
+                p.endpoint.apply_publish(
+                    mv.estimator, mv.version, seq, tag=mv.tag,
+                    quantize=getattr(mv, "quantize", None),
+                )
+            except ProcessDown:
+                self._mark_dead(p)
+
+    def rollback(self, version=None) -> int:
+        """Roll the control registry back and fan the re-pointed
+        version out (a rollback IS a publish on the wire: the archived
+        snapshot ships with its ORIGINAL pinned version id under a
+        fresh seq)."""
+        v = self.registry.rollback(self.name, version=version)
+        self._fan_out()
+        return v
+
+    # -- stats -------------------------------------------------------------
+    def stats(self) -> dict:
+        """The router's live view: per-process alive/queue/staleness —
+        the /status ``federation`` shape scripts assert on."""
+        with self._lock:
+            procs = [{
+                "process": p.endpoint.process_id,
+                "alive": p.alive,
+                "status_age_s": round(time.monotonic() - p.t_status, 3)
+                if p.t_status else None,
+                "queue_rows": int((p.stats or {}).get("queue_rows", 0)
+                                  or 0),
+                "version": (p.stats or {}).get("version"),
+                "healthy_replicas": (p.stats or {})
+                .get("healthy_replicas"),
+            } for p in self._procs]
+        return {
+            "federation": self.name,
+            "n_processes": len(procs),
+            "live_processes": sum(1 for p in procs if p["alive"]),
+            "processes": procs,
+        }

@@ -101,7 +101,7 @@ class FleetServer:
     def __init__(self, model=None, registry=None, name="model",
                  methods=("predict",), replicas=None, ladder=None,
                  max_queue=None, batch_window_ms=None, timeout_ms=None,
-                 supervise=None):
+                 supervise=None, autoscale=None):
         import jax
 
         from ..config import get_config
@@ -144,6 +144,13 @@ class FleetServer:
             cfg.serving_supervise if supervise is None else supervise
         )
         self._supervisor = None
+        # SLO-driven replica autoscaling (serving/autoscale.py): the
+        # admission predictor ADDS/RETIRES replicas under hysteresis
+        # bands (config.serving_autoscale; default off)
+        self._autoscale = bool(
+            cfg.serving_autoscale if autoscale is None else autoscale
+        )
+        self._autoscaler = None
         # follow the name: every publish/rollback becomes a rolling
         # swap (the immediate initial callback is version-matched away)
         self._sub = self.registry.subscribe(self.name, self._on_publish)
@@ -192,12 +199,22 @@ class FleetServer:
             from ..reliability.supervisor import ReplicaSupervisor
 
             self._supervisor = ReplicaSupervisor(self).start()
+        if self._autoscale and self._autoscaler is None:
+            from .autoscale import ReplicaAutoscaler
+
+            self._autoscaler = ReplicaAutoscaler(self).start()
+        smetrics.set_replica_count_gauge(self.name, len(self.replicas))
         return self
 
     def stop(self, drain=True, timeout=None):
         from ..observability.live import unregister_server
 
         unregister_server(self)
+        if self._autoscaler is not None:
+            # the scaler stands down BEFORE replicas stop — a shutdown
+            # emptying the queues must not read as a scale-down signal
+            self._autoscaler.stop()
+            self._autoscaler = None
         if self._supervisor is not None:
             # the supervisor must stand down BEFORE replicas stop, or
             # it would read the deliberate shutdown as a fleet-wide

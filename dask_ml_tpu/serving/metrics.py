@@ -34,7 +34,12 @@ from __future__ import annotations
 
 from ..observability import span
 from ..observability._counters import (
+    record_federation_publish,
+    record_process_failover,
+    record_process_reroute,
     record_registry_publish,
+    record_scale_down,
+    record_scale_up,
     record_serving_batch,
     record_serving_drop,
     record_serving_request,
@@ -50,10 +55,13 @@ from ..observability._hist import (
 from ..observability.live import gauge_set, histogram, live_publishing
 
 __all__ = ["LatencyWindow", "batch_span", "drop_replica_gauges",
-           "record_batch", "record_request", "record_drop",
-           "observe_request_latency", "set_queue_gauges",
-           "set_replica_gauges", "record_swap", "record_reroute",
-           "record_publish"]
+           "drop_process_gauges", "record_batch", "record_request",
+           "record_drop", "observe_request_latency", "set_queue_gauges",
+           "set_replica_gauges", "set_process_gauges",
+           "set_replica_count_gauge", "record_swap", "record_reroute",
+           "record_publish", "record_scale_up", "record_scale_down",
+           "record_process_reroute", "record_process_failover",
+           "record_federation_publish"]
 
 # counter recording lives in observability/_counters.py (the shared
 # registry the report CLI and span deltas read); these are the serving
@@ -132,6 +140,40 @@ def drop_replica_gauges(replica) -> None:
     for family in ("serving_replica", "serving_queue_depth",
                    "serving_inflight_rows"):
         drop_labeled_series(family, labels)
+
+
+def set_replica_count_gauge(fleet, n: int) -> None:
+    """The autoscaler's headline gauge: how many replicas ``fleet`` is
+    running RIGHT NOW (``dask_ml_tpu_serving_replicas{fleet=...}``) —
+    scale-ups/downs move it, the ``serving_scale_ups/downs_total``
+    counters say how often."""
+    if not live_publishing():
+        return
+    gauge_set("serving_replicas", int(n), (("fleet", str(fleet)),))
+
+
+def set_process_gauges(process, healthy=None, replicas=None) -> None:
+    """Per-PROCESS federation gauges: the router's live view of each
+    fleet process (``serving_process_healthy`` flips to 0 on failover,
+    ``serving_process_replicas`` mirrors the remote /status replica
+    count)."""
+    if not live_publishing():
+        return
+    labels = (("process", str(process)),)
+    if healthy is not None:
+        gauge_set("serving_process_healthy", 1 if healthy else 0,
+                  labels)
+    if replicas is not None:
+        gauge_set("serving_process_replicas", int(replicas), labels)
+
+
+def drop_process_gauges(process) -> None:
+    """Remove a dead fleet PROCESS's labeled gauge series from the live
+    registry — the federation twin of :func:`drop_replica_gauges`, so
+    /metrics never latches phantom processes after a failover."""
+    from ..observability.live import drop_labeled_series
+
+    drop_labeled_series("serving_process", (("process", str(process)),))
 
 
 def set_replica_gauges(replica, version=None, healthy=None) -> None:

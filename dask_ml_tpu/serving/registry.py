@@ -107,7 +107,7 @@ class ModelRegistry:
 
     # -- write plane -------------------------------------------------------
     def publish(self, name, estimator, tag=None, snapshot=True,
-                publisher=None, quantize=None) -> int:
+                publisher=None, quantize=None, version=None) -> int:
         """Store ``estimator`` as the next version of ``name``, make it
         current, notify subscribers. Returns the new version id.
         ``publisher`` labels the version on /status (defaults to the
@@ -117,14 +117,30 @@ class ModelRegistry:
         (per-channel scales are computed at swap time from this
         snapshot's weights).
 
+        ``version`` PINS the version id instead of minting the next one
+        — the federation plane's cross-process convergence hook: a
+        publish fanned out from another process carries the ORIGIN
+        registry's id, and pinning it here makes version numbers agree
+        fleet-wide (re-publishing an id this registry already holds
+        overwrites that slot — replays of the same fan-out are
+        idempotent, not version-inflating). The local counter advances
+        past any pinned id so local publishes never collide with it.
+
         ``snapshot=True`` (default) deep-copies the estimator so later
         in-place training (``partial_fit``) cannot mutate the archive;
         pass False only for estimators the caller promises never to
         touch again."""
         est = copy.deepcopy(estimator) if snapshot else estimator
         with self._lock:
-            version = self._next.get(name, 1)
-            self._next[name] = version + 1
+            if version is None:
+                version = self._next.get(name, 1)
+            else:
+                version = int(version)
+                if version < 1:
+                    raise ValueError(
+                        f"pinned version must be >= 1, got {version}")
+            self._next[name] = max(self._next.get(name, 1),
+                                   version + 1)
             mv = ModelVersion(name, version, est, tag=tag,
                               publisher=publisher, quantize=quantize)
             versions = self._models.setdefault(name, {})

@@ -1,0 +1,131 @@
+"""Federation: one router over N fleet processes, scaled to the SLO.
+
+`examples/10_fleet.py` scaled a model across replicas INSIDE one
+process. The federation plane is the layer above — the last hop of the
+"serves heavy traffic from millions of users" north star:
+
+- ``FederatedFleet``    — predicted-completion routing over fleet
+  PROCESSES (each process's ``/status`` snapshot rebuilds the local
+  admission predictor remotely via ``policy.exec_from_snapshot``);
+- **failover**          — a process dying mid-request loses NOTHING:
+  the whole request re-issues on the next-ranked survivor, whose
+  trace carries ``rerouted_from_process``;
+- **publish fan-out**   — one ``fed.publish()`` pins the control
+  registry's version id into every process (stale fan-outs drop, so
+  back-to-back publishes converge no matter the arrival order);
+- ``ReplicaAutoscaler`` — the SLO admission signal ADDS/RETIRES
+  replicas under hysteresis bands, spin-up warmed off the serving
+  path;
+- ``replay_load_test``  — recorded traffic in, pass/fail SLO verdict
+  out.
+
+This example federates two in-process fleets through
+``LocalEndpoint``s (the virtual-process transport — swap in
+``"http://host:port"`` strings against real processes running a
+``TelemetryServer``), kills one mid-traffic, publishes a retrained
+version to the survivor, scales under a synthetic burst, and verdicts
+a replayed load test. ``scripts/federation_smoke.py`` proves the same
+story with real subprocesses and a SIGKILL.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from dask_ml_tpu import config
+from dask_ml_tpu import observability as obs
+from dask_ml_tpu.datasets import make_classification
+from dask_ml_tpu.linear_model import LogisticRegression
+from dask_ml_tpu.serving import (
+    BucketLadder,
+    FederatedFleet,
+    FleetServer,
+    LocalEndpoint,
+    ReplicaAutoscaler,
+    replay_load_test,
+    synthesize_records,
+)
+
+n = int(os.environ.get("DASK_ML_TPU_EXAMPLE_N", 20_000))
+X, y = make_classification(n_samples=n, n_features=16, n_informative=8,
+                           random_state=0)
+X2, y2 = make_classification(n_samples=n, n_features=16, n_informative=8,
+                             random_state=7)
+a = LogisticRegression(solver="lbfgs", max_iter=30).fit(X, y)
+b = LogisticRegression(solver="lbfgs", max_iter=30).fit(X2, y2)
+Xh = X.to_numpy().astype(np.float32)
+
+ladder = BucketLadder(8, 256, 2.0)
+
+# -- two "processes": each fleet owns its registry, workers, devices.
+#    Against real remote processes these would be HttpEndpoint URLs.
+f0 = FleetServer(a, name="clf", replicas=1, ladder=ladder,
+                 batch_window_ms=1.0, timeout_ms=0).warmup().start()
+f1 = FleetServer(a, name="clf", replicas=1, ladder=ladder,
+                 batch_window_ms=1.0, timeout_ms=0).warmup().start()
+
+with FederatedFleet([LocalEndpoint(f0, "p0"), LocalEndpoint(f1, "p1")],
+                    name="clf", ladder=ladder, poll_s=0.2) as fed:
+    # align version numbering fleet-wide: control v1 pins over each
+    # process's construction-time version
+    v1 = fed.publish(a)
+    print(f"published v{v1} to {fed.stats()['live_processes']} processes")
+
+    # -- routed traffic ----------------------------------------------------
+    got = fed.predict(Xh[:32])
+    assert np.array_equal(got, np.asarray(a.predict(Xh[:32])))
+    print(f"routed predict ok; router view: {fed.stats()['processes']}")
+
+    # -- failover: p0 dies mid-stream, nothing is lost ---------------------
+    c0 = obs.counters_snapshot()
+    f0.stop(drain=False)                 # the "SIGKILL"
+    for i in range(6):                   # every request still resolves
+        got = fed.predict(Xh[i * 8:(i + 1) * 8])
+        assert np.array_equal(got, np.asarray(a.predict(Xh[i * 8:(i + 1) * 8])))
+    c1 = obs.counters_snapshot()
+    print(f"p0 killed: {fed.stats()['live_processes']}/2 live, "
+          f"reroutes +{c1.get('serving_process_reroutes', 0) - c0.get('serving_process_reroutes', 0)}, "
+          f"failovers +{c1.get('serving_process_failovers', 0) - c0.get('serving_process_failovers', 0)}, "
+          "0 requests lost")
+
+    # -- publish fan-out converges the survivor ----------------------------
+    v2 = fed.publish(b)
+    assert f1.version == v2 == f1.registry.current_version("clf")
+    got = fed.predict(Xh[:32])
+    assert np.array_equal(got, np.asarray(b.predict(Xh[:32])))
+    print(f"published v{v2}: survivor registry pinned to control version")
+
+with config.set(serving_slo_ms=5000.0):
+    # -- autoscale: the admission signal grows the fleet -------------------
+    fleet = FleetServer(a, name="clf-as", replicas=1, ladder=ladder,
+                        batch_window_ms=1.0, timeout_ms=0).warmup()
+    with fleet:
+        # pretend yesterday's window showed the top bucket at 90% of the
+        # SLO — above the 80% up band, below the shedding door
+        for _ in range(50):
+            fleet.replicas[0]._exec.observe("predict", ladder.max_rows, 4.5)
+        scaler = ReplicaAutoscaler(fleet, min_replicas=1, max_replicas=2,
+                                   interval_s=0.05, patience=2,
+                                   cooldown_s=5.0)
+        scaler.start()
+
+        # -- replayed load test against the scaling fleet ------------------
+        report = replay_load_test(
+            fleet, Xh,
+            records=synthesize_records(150, rows=(1, 64), rate_rps=300.0),
+            slo_ms=5000.0, quantile=99.0,
+        )
+        scaler.stop()
+        ups = [e for e in scaler.events if e[0] == "up"]
+        print(f"autoscale: {len(fleet.replicas)} replicas "
+              f"(spin-up {ups[0][2] * 1e3:.1f} ms, warmed off-path)")
+        print(f"load test: {report['ok']}/{report['requests']} ok, "
+              f"p99 {report['latency_ms']['p99']:.1f} ms "
+              f"<= SLO {report['slo_ms']:.0f} ms, "
+              f"passed={report['passed']}")
+        assert ups and report["passed"]
+
+print("federation example done")

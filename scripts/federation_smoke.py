@@ -1,0 +1,352 @@
+"""Federation verify gate (ISSUE 17): TWO subprocess fleet processes
+behind one :class:`FederatedFleet` router must survive a SIGKILL of the
+currently-preferred process mid-traffic with
+
+- ZERO lost admitted requests: every submitted request resolves, and
+  every answer matches one of the published versions exactly — the
+  whole-request re-issue on :class:`ProcessDown` is the mechanism;
+- the survivor's sampled traces carrying ``rerouted_from_process``
+  (the ``X-Fed-Reroute`` header crossed the process boundary);
+- registry RE-CONVERGENCE on the next publish: the survivor's local
+  registry pins the control registry's CURRENT version id;
+- ZERO post-warmup XLA compiles in the survivor across the whole run
+  (routing, failover and the fanned-out hot-swap are all shape-stable);
+
+and, in-parent, a replayed synthetic burst against a 1-replica fleet
+whose top-bucket window predicts SLO pressure must fire a plans-warm
+autoscale scale-up while the replay itself holds the SLO verdict.
+
+The parent picks two free ports, launches each child with
+``DASK_ML_TPU_OBS_HTTP_PORT`` pointing at its own telemetry server,
+federates over :class:`HttpEndpoint`\\ s, and asserts on the router's
+own counters plus the survivor's ``/status`` and ``/traces``.
+
+Prints one JSON line: {"ok": true, "requests": ..., "recompiles": 0,
+"published": 2, ...}. Run: ``python scripts/federation_smoke.py``
+(exit 0 = gate holds).
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CHILD = r"""
+import os, time
+
+from dask_ml_tpu import config
+from dask_ml_tpu.datasets import make_classification
+from dask_ml_tpu.linear_model import LogisticRegression
+from dask_ml_tpu.serving import BucketLadder, FleetServer
+
+X, y = make_classification(n_samples=600, n_features=12,
+                           n_informative=6, random_state=0)
+a = LogisticRegression(solver="lbfgs", max_iter=30).fit(X, y)
+
+# trace plane ON at a production-like sample rate: reroute-tagged
+# traces are ALWAYS kept (the tail sampler's contract), while ordinary
+# completions mostly are not — so the parent's reroute-audit trace
+# cannot be evicted from the bounded keep ring by the traffic behind it
+with config.set(obs_trace_sample=0.01):
+    fleet = FleetServer(a, name="fedclf", replicas=2,
+                        ladder=BucketLadder(8, 128, 2.0),
+                        batch_window_ms=1.0, timeout_ms=0).warmup()
+    with fleet:
+        print("FED_READY", flush=True)
+        # serve until the parent terminates (or SIGKILLs) this process
+        time.sleep(float(os.environ.get("FED_SMOKE_LINGER", "180")))
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get_json(url, timeout=2.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _wait_fleet(base, child, deadline):
+    """Block until ``base``'s /status shows the 2-replica fleet."""
+    while time.time() < deadline:
+        if child.poll() is not None:
+            raise RuntimeError(
+                "child exited before its fleet came up: "
+                + child.stderr.read()[-2000:]
+            )
+        try:
+            doc = _get_json(base + "/status")
+        except Exception:
+            time.sleep(0.05)
+            continue
+        for s in doc.get("serving", ()):
+            if isinstance(s, dict) and s.get("fleet") == "fedclf" \
+                    and s.get("healthy_replicas") == 2:
+                return
+        time.sleep(0.05)
+    raise RuntimeError(f"deadline: {base}/status never showed the fleet")
+
+
+def _federation_section(out):
+    import numpy as np
+
+    from dask_ml_tpu import observability as obs
+    from dask_ml_tpu.datasets import make_classification
+    from dask_ml_tpu.linear_model import LogisticRegression
+    from dask_ml_tpu.serving import (
+        BucketLadder,
+        FederatedFleet,
+        HttpEndpoint,
+        ServingError,
+    )
+
+    # the parent's twin of the children's deterministic fit: expected
+    # answers for BOTH versions (exact-match is the lost-request test)
+    X, y = make_classification(n_samples=600, n_features=12,
+                               n_informative=6, random_state=0)
+    X2, y2 = make_classification(n_samples=600, n_features=12,
+                                 n_informative=6, random_state=7)
+    a = LogisticRegression(solver="lbfgs", max_iter=30).fit(X, y)
+    b = LogisticRegression(solver="lbfgs", max_iter=30).fit(X2, y2)
+    Xh = X.to_numpy().astype(np.float32)
+    preds = {1: np.asarray(a.predict(Xh)), 2: np.asarray(b.predict(Xh))}
+
+    ports = [_free_port(), _free_port()]
+    bases = [f"http://127.0.0.1:{p}" for p in ports]
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    children = [
+        subprocess.Popen(
+            [sys.executable, "-c", CHILD],
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "DASK_ML_TPU_OBS_HTTP_PORT": str(p)},
+            cwd=here, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        )
+        for p in ports
+    ]
+    deadline = time.time() + 180
+    try:
+        for base, child in zip(bases, children):
+            _wait_fleet(base, child, deadline)
+
+        eps = [HttpEndpoint(bases[i], name="fedclf", process_id=f"p{i}",
+                            timeout_s=30.0) for i in (0, 1)]
+        c0 = obs.counters_snapshot()
+        with FederatedFleet(eps, name="fedclf",
+                            ladder=BucketLadder(8, 128, 2.0),
+                            poll_s=0.25, retry_s=60.0) as fed:
+            # warm probes through BOTH processes, then align version
+            # numbering: control v1 pins over each child's
+            # construction-time v1 (idempotent overwrite)
+            for ep in eps:
+                got = ep.submit(Xh[:64])
+                assert np.array_equal(got, preds[1][:64]), \
+                    "cross-process fit is not deterministic"
+            v1 = fed.publish(a)
+            assert v1 == 1, v1
+            time.sleep(0.3)
+            base_rec = [
+                _get_json(base + "/status")["counters"]
+                .get("recompiles", 0)
+                for base in bases
+            ]
+
+            N_CLIENTS = 3
+            # per-thread slots, summed after join (no racy +=)
+            sent = [0] * N_CLIENTS
+            done = [0] * N_CLIENTS
+            errs = []
+            stop = threading.Event()
+
+            def client(seed):
+                rng = np.random.RandomState(seed)
+                while not stop.is_set():
+                    n = int(rng.randint(1, 100))
+                    i = int(rng.randint(0, Xh.shape[0] - n))
+                    sent[seed] += 1
+                    try:
+                        got = fed.submit(Xh[i:i + n]).result(60)
+                    except ServingError as exc:
+                        errs.append(repr(exc))   # a shed/timeout IS a
+                        continue                 # lost request here
+                    except Exception as exc:
+                        errs.append(repr(exc))
+                        continue
+                    if not any(np.array_equal(got, preds[v][i:i + n])
+                               for v in (1, 2)):
+                        errs.append(f"mismatch at n={n} i={i}")
+                        continue
+                    done[seed] += 1
+
+            threads = [threading.Thread(target=client, args=(s,))
+                       for s in range(N_CLIENTS)]
+            for t in threads:
+                t.start()
+            time.sleep(0.8)
+
+            # SIGKILL the process the router currently PREFERS — the
+            # next requests provably route at the corpse and must fail
+            # over whole
+            victim = int(fed._ranked("predict", 64)[0]
+                         .endpoint.process_id[1])
+            survivor = 1 - victim
+            os.kill(children[victim].pid, signal.SIGKILL)
+            children[victim].wait(10)
+            # a few foreground requests right through the failover
+            # window (the clients race it too)
+            for _ in range(3):
+                got = fed.predict(Xh[:64])
+                assert np.array_equal(got, preds[1][:64])
+            time.sleep(0.8)
+
+            # the NEXT publish re-converges the survivor to the
+            # control registry's current version
+            v2 = fed.publish(b)
+            assert v2 == 2, v2
+            time.sleep(0.5)
+            stop.set()
+            for t in threads:
+                t.join()
+
+            n_sent, n_done = sum(sent), sum(done)
+            assert not errs, errs[:3]
+            assert n_done == n_sent, (n_done, n_sent)
+            assert n_sent >= 50, f"only {n_sent} requests — no real load"
+
+            fstats = fed.stats()
+            assert fstats["live_processes"] == 1, fstats
+            dead = [p for p in fstats["processes"]
+                    if p["process"] == f"p{victim}"]
+            assert dead and not dead[0]["alive"], fstats
+
+            c1 = obs.counters_snapshot()
+            reroutes = c1.get("serving_process_reroutes", 0) \
+                - c0.get("serving_process_reroutes", 0)
+            failovers = c1.get("serving_process_failovers", 0) \
+                - c0.get("serving_process_failovers", 0)
+            assert reroutes >= 1, f"{reroutes} process reroutes"
+            assert failovers >= 1, f"{failovers} process failovers"
+
+            sdoc = _get_json(bases[survivor] + "/status")
+            recompiles = sdoc["counters"].get("recompiles", 0) \
+                - base_rec[survivor]
+            assert recompiles == 0, \
+                f"{recompiles} post-warmup compiles in survivor"
+            entry = [s for s in sdoc["serving"]
+                     if s.get("fleet") == "fedclf"][0]
+            assert entry["version"] == v2, entry
+            reg = sdoc.get("registry", {}).get("fedclf", {})
+            assert reg.get("current") == v2, reg
+
+            tdoc = _get_json(bases[survivor] + "/traces")
+            tagged = [t for t in tdoc.get("traces", ())
+                      if t.get("rerouted_from_process") == f"p{victim}"
+                      and t.get("outcome") == "ok"]
+            assert tagged, "no survivor trace carries the reroute tag"
+
+            out.update(
+                requests=n_done, reroutes=reroutes,
+                failovers=failovers, recompiles=recompiles,
+                published=v2, survivor=f"p{survivor}",
+                rerouted_traced=len(tagged),
+            )
+    finally:
+        for child in children:
+            if child.poll() is None:
+                child.terminate()
+                try:
+                    child.wait(10)
+                except Exception:
+                    child.kill()
+
+
+def _autoscale_section(out):
+    """A replayed burst whose top-bucket window predicts SLO pressure
+    must ADD a replica (plans-warm, off the serving path) while the
+    replay itself passes its SLO verdict."""
+    from dask_ml_tpu import config
+    from dask_ml_tpu.datasets import make_classification
+    from dask_ml_tpu.linear_model import LogisticRegression
+    from dask_ml_tpu.serving import (
+        BucketLadder,
+        FleetServer,
+        ReplicaAutoscaler,
+        replay_load_test,
+        synthesize_records,
+    )
+
+    X, y = make_classification(n_samples=600, n_features=12,
+                               n_informative=6, random_state=0)
+    a = LogisticRegression(solver="lbfgs", max_iter=30).fit(X, y)
+    Xh = X.to_numpy().astype("float32")
+
+    with config.set(serving_slo_ms=5000.0):
+        fleet = FleetServer(a, name="fed-as", replicas=1,
+                            ladder=BucketLadder(8, 128, 2.0),
+                            batch_window_ms=1.0, timeout_ms=0).warmup()
+        with fleet:
+            # the recorded burst's story: yesterday's window showed the
+            # top bucket running at 90% of the SLO — above the 80% up
+            # band (scale), below the door (no shedding)
+            r0 = fleet.replicas[0]
+            for _ in range(50):
+                r0._exec.observe("predict", fleet.ladder.max_rows, 4.5)
+            scaler = ReplicaAutoscaler(fleet, min_replicas=1,
+                                       max_replicas=2, interval_s=0.05,
+                                       patience=2, cooldown_s=5.0)
+            scaler.start()
+            try:
+                report = replay_load_test(
+                    fleet, Xh,
+                    records=synthesize_records(150, rows=(1, 64),
+                                               rate_rps=300.0, seed=1),
+                    slo_ms=5000.0, quantile=99.0,
+                )
+                deadline = time.time() + 20
+                while not scaler.events and time.time() < deadline:
+                    time.sleep(0.05)
+            finally:
+                scaler.stop()
+            ups = [e for e in scaler.events if e[0] == "up"]
+            assert ups, f"no scale-up fired: {scaler.events}"
+            assert len(fleet.replicas) == 2, len(fleet.replicas)
+            assert report["passed"], report
+            assert report["error"] == 0 and report["timeout"] == 0, \
+                report
+            out.update(
+                autoscale_replicas=len(fleet.replicas),
+                scaleup_spinup_s=ups[0][2],
+                loadtest={k: report[k] for k in
+                          ("requests", "ok", "shed", "passed")},
+                loadtest_p99_ms=report["latency_ms"]["p99"],
+            )
+
+
+def main():
+    out = {"ok": False}
+    try:
+        _federation_section(out)
+        _autoscale_section(out)
+        out["ok"] = True
+    except Exception as exc:
+        out["ok"] = False
+        out["error"] = f"{type(exc).__name__}: {exc}"
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

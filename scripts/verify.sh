@@ -116,6 +116,17 @@ if ! timeout -k 10 500 python scripts/chaos_smoke.py; then
     exit 1
 fi
 
+# -- federation gate (ISSUE 17): TWO subprocess fleet processes behind
+# one router; SIGKILL the currently-preferred process mid-traffic — zero
+# lost admitted requests (survivor traces carry rerouted_from_process),
+# the next publish re-converges the survivor to the control registry's
+# version with zero post-warmup compiles; a replayed burst must fire a
+# plans-warm autoscale scale-up while holding its SLO verdict.
+if ! timeout -k 10 500 python scripts/federation_smoke.py; then
+    echo "VERIFY FAIL: federation gate (routing / failover / autoscale)"
+    exit 1
+fi
+
 # -- serving suite (fast, targeted): the online-inference subsystem gates
 # the same as lint — a broken server should fail verify in ~1min, before
 # the full tier-1 wait. timeout-wrapped like tier-1: a hung serving

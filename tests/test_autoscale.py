@@ -1,0 +1,365 @@
+"""SLO-driven autoscaling (dask_ml_tpu/serving/autoscale.py) and the
+replay load-test harness (serving/loadtest.py).
+
+The load-bearing assertions: queue pressure above the up-band GROWS the
+fleet (new replica warmed off-path, installed under the lock, counted
+and gauged), sustained headroom below the down-band RETIRES the
+least-loaded replica with a graceful drain and DROPS its gauge series,
+bounds/cooldown hold, and the replay harness turns a recorded mix into
+a pass/fail SLO verdict (canary flip restored, outcome accounting
+exact).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu import config, observability as obs
+from dask_ml_tpu.serving import (
+    BucketLadder,
+    FleetServer,
+    ReplicaAutoscaler,
+    replay_load_test,
+    synthesize_records,
+)
+from dask_ml_tpu.serving.autoscale import ReplicaAutoscaler as _RA
+
+
+@pytest.fixture(scope="module")
+def two_logregs():
+    from dask_ml_tpu.datasets import make_classification
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    X, y = make_classification(
+        n_samples=600, n_features=12, n_informative=6, random_state=0
+    )
+    X2, y2 = make_classification(
+        n_samples=600, n_features=12, n_informative=6, random_state=7
+    )
+    a = LogisticRegression(solver="lbfgs", max_iter=30).fit(X, y)
+    b = LogisticRegression(solver="lbfgs", max_iter=30).fit(X2, y2)
+    return a, b, X.to_numpy().astype(np.float32)
+
+
+def _ladder():
+    return BucketLadder(8, 64, 2.0)
+
+
+def _seed_slow(fleet, exec_s=0.5):
+    """Fake a warm, SLOW execution window on every replica so the
+    predictor returns a confident big number."""
+    for r in fleet.replicas:
+        r._exec.observe("predict", fleet.ladder.max_rows, exec_s)
+
+
+def _seed_fast(fleet, exec_s=1e-4):
+    for r in fleet.replicas:
+        r._exec.observe("predict", fleet.ladder.max_rows, exec_s)
+
+
+# -- signal ------------------------------------------------------------------
+
+def test_signal_none_on_cold_fleet(two_logregs):
+    """A cold fleet (no execution history) neither grows nor shrinks."""
+    a, _, _ = two_logregs
+    fleet = FleetServer(a, name="clf", replicas=1, ladder=_ladder())
+    with fleet:
+        sc = ReplicaAutoscaler(fleet, min_replicas=1, max_replicas=3,
+                               up_ms=10.0, down_ms=1.0, patience=1,
+                               cooldown_s=0.0)
+        assert sc.signal_ms() is None
+        sc.tick()
+        assert len(fleet.replicas) == 1
+        assert sc.events == []
+
+
+def test_band_defaults_derive_from_slo(two_logregs):
+    a, _, _ = two_logregs
+    with config.set(serving_slo_ms=200.0):
+        fleet = FleetServer(a, name="clf", replicas=1, ladder=_ladder())
+        sc = ReplicaAutoscaler(fleet)
+        assert sc.up_ms == pytest.approx(160.0)
+        assert sc.down_ms == pytest.approx(40.0)
+        fleet.stop(drain=False)
+
+
+# -- scale up ----------------------------------------------------------------
+
+def test_scale_up_on_queue_pressure(two_logregs):
+    """Predicted completion above the up-band for `patience` ticks adds
+    a replica at the registry's current version — warmed, gauged,
+    counted — and the hysteresis counters reset after the action."""
+    a, _, Xh = two_logregs
+    fleet = FleetServer(a, name="clf", replicas=1, ladder=_ladder(),
+                        batch_window_ms=1.0, timeout_ms=0)
+    with fleet.warmup():
+        r0 = fleet.replicas[0]
+        r0.pause()
+        _seed_slow(fleet, 0.5)            # 500ms per batch
+        futs = [fleet.submit(Xh[:32]) for _ in range(4)]   # queue rows
+        sc = ReplicaAutoscaler(fleet, min_replicas=1, max_replicas=3,
+                               up_ms=100.0, down_ms=1.0, patience=2,
+                               cooldown_s=0.0)
+        assert sc.signal_ms() > 100.0
+        before = obs.counters_snapshot().get("serving_scale_ups", 0)
+        sc.tick()
+        assert len(fleet.replicas) == 1    # patience not yet met
+        sc.tick()
+        assert len(fleet.replicas) == 2
+        assert sc.events[-1][0] == "up" and sc.events[-1][1] == 2
+        after = obs.counters_snapshot().get("serving_scale_ups", 0)
+        assert after - before == 1
+        assert sc._above == 0
+        fresh = fleet.replicas[-1]
+        assert fresh.replica_id == 1
+        assert fresh.model_version == fleet.version
+        assert fresh.healthy
+        # the fresh replica actually serves
+        r0.resume()
+        got = fleet.predict(Xh[:5])
+        assert got.shape == (5,)
+        for f in futs:
+            f.result(30)
+
+
+def test_scale_up_respects_max_and_cooldown(two_logregs):
+    a, _, Xh = two_logregs
+    fleet = FleetServer(a, name="clf", replicas=1, ladder=_ladder(),
+                        batch_window_ms=1.0, timeout_ms=0)
+    with fleet.warmup():
+        fleet.replicas[0].pause()
+        _seed_slow(fleet, 0.5)
+        futs = [fleet.submit(Xh[:32]) for _ in range(4)]
+        sc = ReplicaAutoscaler(fleet, min_replicas=1, max_replicas=2,
+                               up_ms=50.0, down_ms=1.0, patience=1,
+                               cooldown_s=60.0)
+        sc.tick()
+        assert len(fleet.replicas) == 2
+        # above the band again, but inside the cooldown AND at max
+        _seed_slow(fleet, 0.5)
+        sc.tick()
+        sc.tick()
+        assert len(fleet.replicas) == 2
+        for r in fleet.replicas:
+            r.resume()
+        for f in futs:
+            f.result(30)
+
+
+# -- scale down --------------------------------------------------------------
+
+def test_scale_down_drains_and_drops_gauges(two_logregs):
+    """Sustained headroom retires the least-loaded replica: removed
+    from routing FIRST, drained gracefully, its serving_replica_* and
+    queue gauge series dropped from the live registry."""
+    from dask_ml_tpu.observability.live import (
+        TelemetryServer,
+        gauges_snapshot,
+    )
+
+    a, _, Xh = two_logregs
+    with TelemetryServer(port=0):
+        fleet = FleetServer(a, name="clf", replicas=2, ladder=_ladder(),
+                            batch_window_ms=1.0)
+        with fleet.warmup():
+            _seed_fast(fleet)
+            # traffic latches per-replica gauge series
+            for _ in range(3):
+                fleet.predict(Xh[:8])
+            import dask_ml_tpu.serving.metrics as smetrics
+
+            for r in fleet.replicas:
+                smetrics.set_queue_gauges(0, 0, replica=r.replica_id)
+            have = {(n, dict(ls).get("replica"))
+                    for (n, ls) in gauges_snapshot()}
+            assert ("serving_replica_healthy", "0") in have
+            assert ("serving_queue_depth", "1") in have
+            sc = ReplicaAutoscaler(fleet, min_replicas=1,
+                                   max_replicas=2, up_ms=1e6,
+                                   down_ms=1e5, patience=2,
+                                   cooldown_s=0.0)
+            before = obs.counters_snapshot().get("serving_scale_downs",
+                                                 0)
+            sc.tick()
+            assert len(fleet.replicas) == 2
+            sc.tick()
+            assert len(fleet.replicas) == 1
+            after = obs.counters_snapshot().get("serving_scale_downs",
+                                                0)
+            assert after - before == 1
+            assert sc.events[-1][0] == "down"
+            gone = "1" if fleet.replicas[0].replica_id == 0 else "0"
+            have = {(n, dict(ls).get("replica"))
+                    for (n, ls) in gauges_snapshot()}
+            assert ("serving_replica_healthy", gone) not in have
+            assert ("serving_queue_depth", gone) not in have
+            # the survivor still serves and keeps its series
+            assert fleet.predict(Xh[:4]).shape == (4,)
+            sc.tick()   # at min: no further shrink
+            assert len(fleet.replicas) == 1
+
+
+def test_scale_down_never_below_min(two_logregs):
+    a, _, _ = two_logregs
+    fleet = FleetServer(a, name="clf", replicas=1, ladder=_ladder())
+    with fleet:
+        _seed_fast(fleet)
+        sc = ReplicaAutoscaler(fleet, min_replicas=1, max_replicas=3,
+                               up_ms=1e6, down_ms=1e5, patience=1,
+                               cooldown_s=0.0)
+        sc.tick()
+        sc.tick()
+        assert len(fleet.replicas) == 1
+        assert sc.events == []
+
+
+# -- arming from config ------------------------------------------------------
+
+def test_autoscaler_armed_from_config(two_logregs):
+    a, _, _ = two_logregs
+    with config.set(serving_autoscale=True,
+                    serving_autoscale_interval_s=0.05,
+                    serving_slo_ms=100.0):
+        fleet = FleetServer(a, name="clf", replicas=1,
+                            ladder=_ladder())
+        fleet.start()
+        try:
+            assert fleet._autoscaler is not None
+            assert fleet._autoscaler._thread is not None
+        finally:
+            fleet.stop()
+        assert fleet._autoscaler is None
+    # default off
+    fleet = FleetServer(a, name="clf", replicas=1, ladder=_ladder())
+    with fleet:
+        assert fleet._autoscaler is None
+
+
+def test_scale_events_visible_in_loop(two_logregs):
+    """The armed thread really scales: under faked pressure the loop
+    adds a replica within a few intervals."""
+    a, _, Xh = two_logregs
+    fleet = FleetServer(a, name="clf", replicas=1, ladder=_ladder(),
+                        batch_window_ms=1.0, timeout_ms=0,
+                        autoscale=False)
+    with fleet.warmup():
+        fleet.replicas[0].pause()
+        _seed_slow(fleet, 0.5)
+        futs = [fleet.submit(Xh[:32]) for _ in range(4)]
+        sc = ReplicaAutoscaler(fleet, min_replicas=1, max_replicas=2,
+                               interval_s=0.05, up_ms=50.0,
+                               down_ms=1.0, patience=1,
+                               cooldown_s=10.0).start()
+        try:
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline \
+                    and len(fleet.replicas) < 2:
+                time.sleep(0.05)
+            assert len(fleet.replicas) == 2
+        finally:
+            sc.stop()
+        for r in fleet.replicas:
+            r.resume()
+        for f in futs:
+            f.result(30)
+
+
+# -- replay load test --------------------------------------------------------
+
+def test_synthesize_records_deterministic():
+    r1 = synthesize_records(50, methods=("predict", "predict_proba"),
+                            rows=(1, 32), rate_rps=100.0, seed=3)
+    r2 = synthesize_records(50, methods=("predict", "predict_proba"),
+                            rows=(1, 32), rate_rps=100.0, seed=3)
+    assert r1 == r2
+    assert len(r1) == 50
+    assert all(rec["req_capture"] for rec in r1)
+    assert all(1 <= rec["n_rows"] <= 32 for rec in r1)
+    assert {rec["method"] for rec in r1} \
+        == {"predict", "predict_proba"}
+    ts = [rec["t_unix"] for rec in r1]
+    assert ts == sorted(ts)
+
+
+def test_replay_load_test_verdict_and_accounting(two_logregs):
+    """Every record resolves into exactly one outcome bucket; a healthy
+    fleet under a generous SLO passes."""
+    a, _, Xh = two_logregs
+    fleet = FleetServer(a, name="clf", replicas=1, ladder=_ladder(),
+                        batch_window_ms=1.0)
+    with fleet.warmup():
+        recs = synthesize_records(30, rows=(1, 32), rate_rps=500.0,
+                                  seed=1)
+        rep = replay_load_test(fleet, Xh, records=recs, speed=5.0,
+                               slo_ms=30_000.0, quantile=99.0)
+    assert rep["requests"] == 30
+    assert rep["ok"] + rep["shed"] + rep["timeout"] + rep["error"] \
+        == 30
+    assert rep["ok"] == rep["admitted"] == 30
+    assert rep["passed"] is True
+    assert rep["latency_ms"]["p99"] is not None
+    assert rep["latency_ms"]["p99"] <= 30_000.0
+
+
+def test_replay_load_test_slo_miss_fails(two_logregs):
+    """An absurd SLO budget fails the verdict (latency quantile above
+    it) even with zero errors."""
+    a, _, Xh = two_logregs
+    fleet = FleetServer(a, name="clf", replicas=1, ladder=_ladder(),
+                        batch_window_ms=1.0)
+    with fleet.warmup():
+        recs = synthesize_records(10, rows=(1, 16), rate_rps=500.0)
+        rep = replay_load_test(fleet, Xh, records=recs, speed=10.0,
+                               slo_ms=1e-4, quantile=99.0)
+    assert rep["error"] == 0
+    assert rep["passed"] is False
+
+
+def test_replay_load_test_canary_flip_restores(two_logregs):
+    """canary_version= runs the mix against an ARCHIVED version (a
+    zero-recompile hot-swap) and flips back after — shadow canary."""
+    a, b, Xh = two_logregs
+    fleet = FleetServer(a, name="clf", replicas=1, ladder=_ladder(),
+                        batch_window_ms=1.0)
+    with fleet.warmup():
+        v2 = fleet.publish(b)
+        assert fleet.version == v2
+        before = obs.counters_snapshot().get("recompiles", 0)
+        recs = synthesize_records(10, rows=(1, 16), rate_rps=500.0)
+        rep = replay_load_test(fleet, Xh, records=recs, speed=10.0,
+                               slo_ms=30_000.0, canary_version=1)
+        after = obs.counters_snapshot().get("recompiles", 0)
+        assert rep["canary_version"] == 1
+        assert rep["restored_version"] == v2
+        assert rep["passed"] is True
+        assert fleet.version == v2
+        assert fleet.registry.current_version("clf") == v2
+        assert after - before == 0
+    assert _RA is ReplicaAutoscaler  # both export paths are one class
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_replay_load_test_factory_under_fault_plan(two_logregs):
+    """A factory target is CONSTRUCTED inside the armed fault_plan
+    scope (workers capture config at construction) and stopped by the
+    harness; the chaos run's outcome accounting stays exact."""
+    a, _, Xh = two_logregs
+
+    def factory():
+        return FleetServer(a, name="clf", replicas=2,
+                           ladder=_ladder(), batch_window_ms=1.0,
+                           timeout_ms=0,   # deadline-free: requeued
+                           supervise=True).warmup().start()
+
+    recs = synthesize_records(20, rows=(1, 16), rate_rps=300.0, seed=5)
+    with config.set(serving_supervise_interval_s=0.1):
+        rep = replay_load_test(factory, Xh, records=recs, speed=5.0,
+                               slo_ms=30_000.0,
+                               fault_plan="replica_worker:crash@3")
+    assert rep["requests"] == 20
+    assert rep["ok"] + rep["shed"] + rep["timeout"] + rep["error"] \
+        == 20
+    # the supervised fleet absorbs the worker crash: zero lost admits
+    assert rep["error"] == 0 and rep["timeout"] == 0

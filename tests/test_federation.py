@@ -1,0 +1,460 @@
+"""Federation plane (dask_ml_tpu/serving/federation.py): predicted-
+completion routing over N fleet processes, whole-request failover with
+``rerouted_from_process`` tagging, seq-guarded + version-pinned
+cross-process publish fan-out, the ``POST /fleet`` HTTP surface, and
+the policy predictor's admit-friendly edge cases the router ranks by.
+
+The load-bearing assertions: a process death loses ZERO admitted
+requests (the survivor's trace names the corpse process), back-to-back
+fan-outs converge EVERY process to the control registry's CURRENT
+version (stale seqs dropped, version ids pinned equal), a dead
+process's gauge series are dropped from the live registry, and warmed
+federated traffic across a publish fan-out mints zero XLA compiles.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu import config, observability as obs
+from dask_ml_tpu.observability import _requests as rtrace
+from dask_ml_tpu.serving import (
+    BucketLadder,
+    FederatedFleet,
+    FleetServer,
+    HttpEndpoint,
+    LocalEndpoint,
+    ModelRegistry,
+    NoLiveProcesses,
+    ProcessDown,
+)
+from dask_ml_tpu.serving.federation import apply_publish
+from dask_ml_tpu.serving.policy import (
+    ExecStats,
+    admission_verdict,
+    exec_from_snapshot,
+    predict_completion_s,
+)
+
+
+@pytest.fixture(scope="module")
+def two_logregs():
+    """Two same-shape fitted models (the swap pair) + host data."""
+    from dask_ml_tpu.datasets import make_classification
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    X, y = make_classification(
+        n_samples=600, n_features=12, n_informative=6, random_state=0
+    )
+    X2, y2 = make_classification(
+        n_samples=600, n_features=12, n_informative=6, random_state=7
+    )
+    a = LogisticRegression(solver="lbfgs", max_iter=30).fit(X, y)
+    b = LogisticRegression(solver="lbfgs", max_iter=30).fit(X2, y2)
+    return a, b, X.to_numpy().astype(np.float32)
+
+
+@pytest.fixture(autouse=True)
+def _trace_isolation():
+    rtrace.traces_reset()
+    yield
+    rtrace.traces_reset()
+
+
+def _ladder():
+    return BucketLadder(8, 64, 2.0)
+
+
+def _pair(a, name="clf"):
+    """Two started in-process fleets (own registries — separate
+    'processes') + their endpoints + the router."""
+    f1 = FleetServer(a, name=name, replicas=1, ladder=_ladder(),
+                     batch_window_ms=1.0).warmup().start()
+    f2 = FleetServer(a, name=name, replicas=1, ladder=_ladder(),
+                     batch_window_ms=1.0).warmup().start()
+    fed = FederatedFleet(
+        [LocalEndpoint(f1, "p0"), LocalEndpoint(f2, "p1")],
+        name=name, ladder=_ladder(),
+    ).start()
+    return f1, f2, fed
+
+
+# -- policy edge cases (the router's prediction substrate) -------------------
+
+def test_predict_s_empty_window_admits():
+    """A never-observed predictor yields None, and None ADMITS — an
+    empty window must not shed (or admit) with false confidence."""
+    ex = ExecStats()
+    assert ex.predict_s("predict", 64) is None
+    pred = predict_completion_s(1000, 8, 64,
+                                ex.predict_s("predict", 64))
+    assert pred is None
+    assert admission_verdict(pred, 0.001) is True
+
+
+def test_predict_s_single_sample_stays_usable():
+    """One observation is a usable (positive, finite) estimate — the
+    deadline-release and admission paths rely on early predictions."""
+    ex = ExecStats()
+    ex.observe("predict", 64, 0.25)
+    v = ex.predict_s("predict", 64)
+    assert v is not None and math.isfinite(v) and v > 0
+    # an unmeasured sibling bucket borrows it
+    assert ex.predict_s("predict", 8) == pytest.approx(v)
+
+
+def test_predict_s_degenerate_mass_collapses_to_none():
+    """All-zero observations (a sub-resolution clock) collapse to None
+    instead of a 0.0 that admission would read as 'instant'."""
+    ex = ExecStats()
+    for _ in range(20):
+        ex.observe("predict", 64, 0.0)
+    assert ex.predict_s("predict", 64) is None
+    assert ex.predict_s("predict", 8) is None   # sibling equally bad
+
+
+def test_completion_and_verdict_guards():
+    assert predict_completion_s(100, 8, 64, None) is None
+    assert predict_completion_s(100, 8, 64, 0.0) is None
+    assert predict_completion_s(100, 8, 64, float("nan")) is None
+    assert predict_completion_s(100, 8, 64, -1.0) is None
+    assert predict_completion_s(0, 8, 64, 0.5) == pytest.approx(0.5)
+    assert admission_verdict(None, 1.0) is True
+    assert admission_verdict(float("nan"), 1.0) is True
+    assert admission_verdict(2.0, 1.0) is False
+    assert admission_verdict(0.5, 1.0) is True
+    assert admission_verdict(99.0, 0.0) is True   # no SLO, no shed
+
+
+def test_exec_from_snapshot_heterogeneous_windows():
+    """The remote-twin predictor over heterogeneous replica windows:
+    thin windows skipped, degenerate quantiles skipped, other methods
+    ignored, nearest bucket by log-distance wins."""
+    snap = {
+        "predict:64": {"count": 30, "p50_s": 0.01, "p90_s": 0.02},
+        "predict:8": {"count": 2, "p50_s": 5.0, "p90_s": 5.0},
+        "predict:16": {"count": 30, "p50_s": 0.0, "p90_s": 0.0},
+        "transform:64": {"count": 30, "p50_s": 9.0, "p90_s": 9.0},
+    }
+    assert exec_from_snapshot(snap, "predict", 64) == 0.02
+    # 8 and 16 are closer by log-distance but thin/degenerate: the
+    # warm 64 window answers for them too
+    assert exec_from_snapshot(snap, "predict", 8) == 0.02
+    assert exec_from_snapshot(snap, "transform", 8) == 9.0
+    assert exec_from_snapshot(snap, "decision_function", 64) is None
+    assert exec_from_snapshot({}, "predict", 64) is None
+    assert exec_from_snapshot(None, "predict", 64) is None
+
+
+# -- registry version pinning ------------------------------------------------
+
+def test_registry_pinned_publish(two_logregs):
+    """publish(version=) stores at the exact id, points current at it,
+    advances the local counter past it, and overwrites idempotently —
+    the fan-out's version-convergence substrate."""
+    a, b, _ = two_logregs
+    reg = ModelRegistry(keep=8)
+    assert reg.publish("m", a) == 1
+    assert reg.publish("m", b, version=5) == 5
+    assert reg.current_version("m") == 5
+    assert reg.publish("m", a) == 6          # never collides with pin
+    assert reg.publish("m", b, version=5) == 5   # replayed fan-out
+    assert reg.current_version("m") == 5
+    assert reg.versions("m") == (1, 5, 6)
+    with pytest.raises(ValueError):
+        reg.publish("m", a, version=0)
+
+
+def test_apply_publish_stale_seq_dropped(two_logregs):
+    """Out-of-order fan-out delivery: the newer seq wins no matter the
+    arrival order (last-writer-wins, the cross-process generalization
+    of the fleet's converge-to-current contract)."""
+    a, b, _ = two_logregs
+    fleet = FleetServer(a, name="clf", ladder=_ladder(), replicas=1)
+    try:
+        assert apply_publish(fleet, b, version=7, seq=5) is True
+        assert fleet.version == 7
+        # seq 4 arrives late: dropped, version stays
+        assert apply_publish(fleet, a, version=6, seq=4) is False
+        assert fleet.version == 7
+        assert fleet.registry.current_version("clf") == 7
+        # local publishes mint ids past the pin
+        assert fleet.registry.publish("clf", a) == 8
+    finally:
+        fleet.stop(drain=False)
+
+
+# -- routing -----------------------------------------------------------------
+
+def test_ranked_prefers_predicted_fast(two_logregs):
+    """The router orders processes by predicted completion out of the
+    cached /status windows; cold (no-prediction) processes rank after
+    warm-fast ones but stay routable."""
+    a, _, _ = two_logregs
+    fed = FederatedFleet(
+        [HttpEndpoint("http://127.0.0.1:1", name="clf",
+                      process_id=p) for p in ("p0", "p1", "p2")],
+        name="clf", ladder=_ladder(),
+    )
+    warm = {"count": 30, "p50_s": 0.01, "p90_s": 0.01}
+    slow = {"count": 30, "p50_s": 1.0, "p90_s": 1.0}
+    fed._procs[0].stats = {"queue_rows": 640,
+                           "replicas": [{"exec_s": {"predict:64": slow}}]}
+    fed._procs[1].stats = {"queue_rows": 0,
+                           "replicas": [{"exec_s": {"predict:64": warm}}]}
+    fed._procs[2].stats = {"queue_rows": 0, "replicas": [{"exec_s": {}}]}
+    order = [p.endpoint.process_id for p in fed._ranked("predict", 8)]
+    assert order == ["p1", "p0", "p2"]
+
+
+def test_federated_failover_zero_lost_with_process_tag(two_logregs):
+    """Kill one process mid-traffic: every admitted request still
+    resolves (whole-request re-issue on the survivor), the survivor's
+    trace names the corpse process, and the hop/failover counters
+    move."""
+    a, _, Xh = two_logregs
+    with config.set(obs_trace_sample=1.0):
+        f1, f2, fed = _pair(a)
+        try:
+            want = np.asarray(a.predict(Xh[:6]))
+            before = obs.counters_snapshot()
+            np.testing.assert_array_equal(fed.predict(Xh[:6]), want)
+            # p0 dies (no drain — a SIGKILL stand-in); the router finds
+            # out mid-request and re-issues on p1
+            f1.stop(drain=False)
+            futs = [fed.submit(Xh[i:i + 4]) for i in range(0, 24, 4)]
+            for i, fut in enumerate(futs):
+                got = fut.result(30)
+                np.testing.assert_array_equal(
+                    got, np.asarray(a.predict(Xh[4 * i:4 * i + 4])))
+            after = obs.counters_snapshot()
+            assert after.get("serving_process_reroutes", 0) \
+                > before.get("serving_process_reroutes", 0)
+            assert after.get("serving_process_failovers", 0) \
+                > before.get("serving_process_failovers", 0)
+            st = fed.stats()
+            assert st["live_processes"] == 1
+        finally:
+            fed.stop()
+            f1.stop(drain=False)
+            f2.stop()
+    d = obs.traces_data()
+    tagged = [t for t in d["traces"]
+              if t.get("rerouted_from_process") == "p0"
+              and t["outcome"] == "ok"]
+    assert tagged, "no survivor trace carried rerouted_from_process"
+
+
+def test_all_processes_down_is_typed(two_logregs):
+    a, _, Xh = two_logregs
+    f1, f2, fed = _pair(a)
+    try:
+        f1.stop(drain=False)
+        f2.stop(drain=False)
+        with pytest.raises(NoLiveProcesses):
+            fed.submit(Xh[:4]).result(30)
+    finally:
+        fed.stop()
+
+
+# -- publish fan-out ---------------------------------------------------------
+
+def test_fanout_back_to_back_converges_and_zero_compiles(two_logregs):
+    """Back-to-back cross-process publishes: every process lands on the
+    control registry's CURRENT version with EQUAL version ids, and the
+    whole sequence (same-shape swaps) mints zero XLA compiles on the
+    warmed fleets."""
+    a, b, Xh = two_logregs
+    f1, f2, fed = _pair(a)
+    try:
+        before = obs.counters_snapshot().get("recompiles", 0)
+        for est in (b, a, b, a):
+            v = fed.publish(est)
+        assert fed.registry.current_version("clf") == v
+        assert f1.version == v and f2.version == v
+        assert f1.registry.current_version("clf") == v
+        assert f2.registry.current_version("clf") == v
+        # the converged fleets actually serve the last-published model
+        want = np.asarray(a.predict(Xh[:8]))
+        np.testing.assert_array_equal(fed.predict(Xh[:8]), want)
+        after = obs.counters_snapshot().get("recompiles", 0)
+        assert after - before == 0, (
+            f"{after - before} recompiles across 4 fan-outs"
+        )
+    finally:
+        fed.stop()
+        f1.stop()
+        f2.stop()
+
+
+def test_fanout_skips_dead_and_reconverges_on_next_publish(two_logregs):
+    """A publish while a process is down skips it; after it returns,
+    the NEXT publish re-converges its registry (the smoke's
+    re-convergence contract in miniature)."""
+    a, b, _ = two_logregs
+    f1, f2, fed = _pair(a)
+    try:
+        fed._poll_once()
+        v0 = fed.publish(a)       # everyone on control v1
+        f2.stop(drain=False)
+        v1 = fed.publish(b)       # p1 dead: only p0 converges
+        assert v1 > v0
+        assert f1.version == v1
+        assert f2.version == v0   # stale: missed the fan-out
+        # p1 comes back (fresh fleet on the same endpoint object)
+        f2b = FleetServer(a, name="clf", replicas=1, ladder=_ladder(),
+                          batch_window_ms=1.0).warmup().start()
+        fed._procs[1].endpoint.fleet = f2b
+        fed._procs[1].alive = True
+        v2 = fed.publish(a)
+        assert f1.version == v2 and f2b.version == v2
+        assert f2b.registry.current_version("clf") == v2
+        f2b.stop()
+    finally:
+        fed.stop()
+        f1.stop(drain=False)
+        f2.stop(drain=False)
+
+
+# -- live-gauge hygiene ------------------------------------------------------
+
+def test_process_failover_drops_process_gauges(two_logregs):
+    """A process marked dead must not leave serving_process_* series
+    latched on /metrics (the federation twin of the replica-gauge
+    drop)."""
+    from dask_ml_tpu.observability.live import (
+        TelemetryServer,
+        gauges_snapshot,
+    )
+
+    a, _, _ = two_logregs
+    with TelemetryServer(port=0):
+        f1, f2, fed = _pair(a)
+        try:
+            fed._poll_once()
+            have = {(n, dict(ls).get("process"))
+                    for (n, ls) in gauges_snapshot()}
+            assert ("serving_process_healthy", "p0") in have
+            assert ("serving_process_healthy", "p1") in have
+            f1.stop(drain=False)
+            fed._poll_once()
+            have = {(n, dict(ls).get("process"))
+                    for (n, ls) in gauges_snapshot()}
+            assert ("serving_process_healthy", "p0") not in have
+            assert ("serving_process_healthy", "p1") in have
+        finally:
+            fed.stop()
+            f1.stop(drain=False)
+            f2.stop()
+
+
+# -- HTTP surface ------------------------------------------------------------
+
+def test_http_endpoint_roundtrip_publish_and_errors(two_logregs):
+    """The POST /fleet surface end-to-end against a real telemetry
+    server: status, npy submit round-trip, version-pinned publish,
+    typed unknown-fleet refusal, and dead-server ProcessDown."""
+    from dask_ml_tpu.observability.live import TelemetryServer
+
+    a, b, Xh = two_logregs
+    ts = TelemetryServer(port=0).start()
+    fleet = FleetServer(a, name="clf", replicas=1, ladder=_ladder(),
+                        batch_window_ms=1.0).warmup().start()
+    try:
+        ep = HttpEndpoint(ts.url, name="clf", process_id="h0",
+                          timeout_s=30.0)
+        assert ep.status()["fleet"] == "clf"
+        got = ep.submit(Xh[:7])
+        np.testing.assert_array_equal(got,
+                                      np.asarray(a.predict(Xh[:7])))
+        assert ep.apply_publish(b, version=9, seq=1) is True
+        assert fleet.version == 9
+        assert fleet.registry.current_version("clf") == 9
+        assert ep.apply_publish(a, version=8, seq=1) is False  # stale
+        assert fleet.version == 9
+        with pytest.raises(ProcessDown):
+            HttpEndpoint(ts.url, name="ghost").submit(Xh[:2])
+    finally:
+        fleet.stop()
+        ts.stop()
+    with pytest.raises(ProcessDown):
+        ep.status()
+
+
+def test_http_truncated_response_is_process_down(two_logregs, monkeypatch):
+    """A SIGKILL landing mid-RESPONSE surfaces as IncompleteRead — an
+    http.client.HTTPException, NOT an OSError — and must still map to
+    ProcessDown so the router re-issues the request whole (the zero-
+    lost contract covers deaths at any point in the round-trip)."""
+    import http.client
+    import urllib.request as _ur
+
+    _, _, Xh = two_logregs
+
+    def boom(*args, **kwargs):
+        raise http.client.IncompleteRead(b"", 464)
+
+    monkeypatch.setattr(_ur, "urlopen", boom)
+    ep = HttpEndpoint("http://127.0.0.1:1", name="clf",
+                      process_id="h0", timeout_s=1.0)
+    with pytest.raises(ProcessDown):
+        ep.submit(Xh[:2])
+    with pytest.raises(ProcessDown):
+        ep.status()
+
+
+def test_http_reroute_header_tags_survivor_trace(two_logregs):
+    """X-Fed-Reroute propagates the corpse process's id into the
+    SURVIVOR process's trace — the cross-process reroute audit trail."""
+    from dask_ml_tpu.observability.live import TelemetryServer
+
+    a, _, Xh = two_logregs
+    with config.set(obs_trace_sample=1.0):
+        with TelemetryServer(port=0) as ts:
+            fleet = FleetServer(a, name="clf", replicas=1,
+                                ladder=_ladder(),
+                                batch_window_ms=1.0).warmup().start()
+            try:
+                ep = HttpEndpoint(ts.url, name="clf", timeout_s=30.0)
+                got = ep.submit(Xh[:3], rerouted_from="proc-dead")
+                assert got.shape == (3,)
+            finally:
+                fleet.stop()
+    d = obs.traces_data()
+    tagged = [t for t in d["traces"]
+              if t.get("rerouted_from_process") == "proc-dead"]
+    assert tagged and tagged[-1]["outcome"] == "ok"
+
+
+# -- virtual-rank harness ----------------------------------------------------
+
+def test_virtual_rank_federation_roundtrip(two_logregs):
+    """Multi-process federation logic without real fabric: each
+    virtual rank builds its own fleet (own registry — the process
+    stand-in), the router federates the ranks' endpoints, and a
+    publish converges every rank's registry to the pinned version."""
+    from dask_ml_tpu.parallel.distributed import run_virtual_processes
+
+    a, b, Xh = two_logregs
+
+    def build(rank):
+        fleet = FleetServer(
+            a, name="clf", replicas=1, ladder=_ladder(),
+            batch_window_ms=1.0,
+        ).warmup().start()
+        return LocalEndpoint(fleet, f"rank{rank}")
+
+    eps = run_virtual_processes(build, world=2)
+    fed = FederatedFleet(eps, name="clf", ladder=_ladder()).start()
+    try:
+        want = np.asarray(a.predict(Xh[:10]))
+        np.testing.assert_array_equal(fed.predict(Xh[:10]), want)
+        v = fed.publish(b)
+        assert all(ep.fleet.version == v for ep in eps)
+        assert all(ep.fleet.registry.current_version("clf") == v
+                   for ep in eps)
+    finally:
+        fed.stop()
+        for ep in eps:
+            ep.fleet.stop()
