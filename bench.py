@@ -325,6 +325,7 @@ def run():
         _try(_bench_sharded_streaming, jax, on_tpu, n_chips)
         _try(_bench_fused_sharded_stream, jax, on_tpu, n_chips)
         _try(_bench_sparse_stream, jax, on_tpu, n_chips)
+        _try(_bench_feature_sharded, jax, on_tpu, n_chips)
         _try(_bench_hyperband, jax, on_tpu, n_chips)
         _try(_bench_c_grid_search, jax, on_tpu, n_chips)
         _try(_bench_serving, jax, on_tpu, n_chips)
@@ -1010,6 +1011,142 @@ def _sharded_child_main():
         out["error"] = f"{type(exc).__name__}: {exc}"
         out["metric"] = "streamed_sgd_sharded_child"
     print(json.dumps(out), flush=True)
+
+
+def _mesh2d_measure(shape):
+    """One feature-sharded measurement (ISSUE 18), shared by the TPU
+    in-process path and the CPU grandchild: assert the 1-D stage
+    REFUSES the wide-d fit under the simulated per-device byte budget
+    (typed StreamBudgetExceeded), then time the same fit — and a
+    streamed randomized PCA — on the 2-D ``shape`` mesh, where the X
+    slabs stage as (rows/D, d/M) per-device tiles under the SAME
+    budget."""
+    import time
+
+    import numpy as np
+
+    from dask_ml_tpu import config as _cfg
+    from dask_ml_tpu import observability as obs
+    from dask_ml_tpu.linear_model import LogisticRegression
+    from dask_ml_tpu.models.pca import PCA
+    from dask_ml_tpu.parallel.streaming import (BlockStream,
+                                                StreamBudgetExceeded)
+
+    n, d, block_rows = 65_536, 512, 2048
+    # single-device staging needs K x 2048 x 512 x 4 = ~33.5MB; the 2x4
+    # tiles need ~4.3MB — the budget sits between, so the SAME fit is a
+    # typed refusal on 1-D and a measurement on the hybrid mesh
+    budget = 8_000_000
+    rng = np.random.RandomState(18)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+
+    refused = False
+    try:
+        with _cfg.set(stream_block_rows=block_rows,
+                      stream_autotune=False, stream_mesh=1,
+                      stream_device_byte_budget=budget):
+            LogisticRegression(solver="lbfgs", max_iter=2).fit(X, y)
+    except StreamBudgetExceeded:
+        refused = True
+    if not refused:
+        raise RuntimeError(
+            "1-D stage did not refuse the wide-d fit under "
+            f"stream_device_byte_budget={budget}"
+        )
+
+    with _cfg.set(stream_block_rows=block_rows, stream_autotune=False,
+                  stream_mesh=0, mesh_shape=shape,
+                  stream_device_byte_budget=budget):
+        st = BlockStream((X, y.astype(np.float32)),
+                         block_rows=block_rows)
+        D, M = st.sb_data_shards(), st.sb_model_shards()
+        if M <= 1:
+            raise RuntimeError(
+                "model axis did not engage "
+                f"(reason={st.model_tile_reason})"
+            )
+        LogisticRegression(solver="lbfgs", max_iter=2).fit(X, y)  # warm
+        obs.counters_reset()
+        t0 = time.perf_counter()
+        LogisticRegression(solver="lbfgs", max_iter=8).fit(X, y)
+        glm_s = time.perf_counter() - t0
+        # rows actually streamed through the superblock plane (lbfgs
+        # pass count is line-search dependent; the counter is exact)
+        glm_rows = obs.counters_snapshot().get(
+            "superblock_blocks", 0) * block_rows
+        if glm_rows <= 0:
+            raise RuntimeError("feature-sharded GLM fit did not stream")
+
+        PCA(n_components=8, svd_solver="randomized",
+            random_state=0).fit(X)                      # warm compiles
+        t0 = time.perf_counter()
+        PCA(n_components=8, svd_solver="randomized",
+            random_state=0).fit(X)
+        pca_s = time.perf_counter() - t0
+    return {
+        "mesh": f"{D}x{M}", "n_rows": n, "d": d,
+        "glm_rows_per_sec": glm_rows / glm_s,
+        # the streamed rSVD pass plan is FIXED: 1 moments + 3 range
+        "pca_rows_per_sec": 4 * n / pca_s,
+    }
+
+
+def _mesh2d_child_main():
+    """Grandchild body for `_bench_feature_sharded` on CPU: the whole
+    measurement at a forced 8-virtual-device pool (mesh 2x4). One JSON
+    line out."""
+    out = {"error": None, "metric": "feature_sharded_child"}
+    try:
+        from dask_ml_tpu._platform import force_cpu_platform
+
+        force_cpu_platform(
+            n_devices=int(os.environ["BENCH_MESH2D_CHILD"])
+        )
+        out.update(_mesh2d_measure("2x4"))
+    except Exception as exc:  # one JSON line no matter what
+        out["error"] = f"{type(exc).__name__}: {exc}"
+    print(json.dumps(out), flush=True)
+
+
+def _bench_feature_sharded(jax, on_tpu, n_chips):
+    """Feature-sharded streaming (ISSUE 18): a (rows, d) GLM fit the
+    1-D path REFUSES under the simulated per-device byte budget
+    (typed StreamBudgetExceeded) completes — and is timed — on the 2-D
+    hybrid mesh, plus the streamed randomized PCA at the same width.
+    On CPU the measurement runs in a grandchild so the 8-virtual-device
+    pool can't leak into other sections; on TPU it runs in-process over
+    the real chips with an inferred "-1x2" model axis."""
+    if on_tpu:
+        if n_chips < 2 or n_chips % 2:
+            raise RuntimeError(
+                f"needs an even multi-chip attach for a model axis, "
+                f"have {n_chips}"
+            )
+        res = _mesh2d_measure("-1x2")
+    else:
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   BENCH_MESH2D_CHILD="8")
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            timeout=300, capture_output=True, text=True,
+        )
+        res = _last_json_line(r.stdout)
+        if res is None or res.get("error"):
+            raise RuntimeError(
+                f"mesh2d child failed: {(res or {}).get('error')} "
+                f"{(r.stderr or '')[-500:]}"
+            )
+    backend = jax.default_backend()
+    common = {"backend": backend, "mesh": res["mesh"],
+              "n_rows": res["n_rows"], "d": res["d"],
+              "refused_1d": True}
+    return [
+        dict(common, metric="glm_feature_sharded_rows_per_sec",
+             value=round(res["glm_rows_per_sec"], 1), unit="rows/s"),
+        dict(common, metric="pca_streamed_rows_per_sec",
+             value=round(res["pca_rows_per_sec"], 1), unit="rows/s"),
+    ]
 
 
 def _plan_warm_child_main():
@@ -2310,6 +2447,9 @@ def main():
         return
     if os.environ.get("BENCH_SHARDED_CHILD"):
         _sharded_child_main()
+        return
+    if os.environ.get("BENCH_MESH2D_CHILD"):
+        _mesh2d_child_main()
         return
     if os.environ.get("BENCH_CHILD") == "1":
         _child_main()

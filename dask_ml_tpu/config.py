@@ -66,6 +66,27 @@ class Config:
     # streamed jaxprs are byte-identical to the pre-mesh programs);
     # N > 1 = shard over the first N local devices
     stream_mesh: int = 0
+    # 2-D ("data", "model") mesh shape for the streamed/sharded plane
+    # (parallel/mesh.py): "auto" = the 1-D data mesh over the resolved
+    # device set (today's behavior — nothing changes); "DxM" = a 2-D
+    # hybrid mesh with D data shards and M feature (model) shards, where
+    # either factor may be -1 (inferred from the device count); a bare
+    # "D" or "Dx1" collapses to the plain 1-D mesh so the 1-D programs
+    # stay jaxpr-byte-identical. With M > 1 streamed X slabs stage as
+    # (rows/D, d/M) per-device tiles and the GLM reducers / streamed
+    # PCA run their feature-sharded flavors (psum over "model" exactly
+    # where the math contracts over features) — per-chip HBM then stays
+    # flat in d. Composes with stream_mesh: that knob first restricts
+    # the device pool, this one shapes it
+    mesh_shape: str = "auto"
+    # simulated per-device staging byte budget for streamed fits: > 0
+    # makes BlockStream refuse (typed StreamBudgetExceeded) any fit
+    # whose per-device staged super-block bytes (K x block_rows/D x
+    # ceil(d/M) x itemsize) exceed it, pointing at mesh_shape — the
+    # CPU-verifiable stand-in for real per-chip HBM limits (bench.py
+    # drives the 1-D-refuses / 2-D-completes point through this).
+    # 0 = off (no budget enforced)
+    stream_device_byte_budget: int = 0
     # zero-copy CPU staging: on a single-device XLA:CPU mesh, full
     # dense 64-byte-aligned blocks import into the runtime as ALIASES
     # of the host memory (dlpack) instead of device_put copies — the

@@ -361,15 +361,280 @@ def _sb_reducer_sharded(kind, family, intercept, n_classes, mesh,
         )
         return f(acc, beta, Xs, ys, counts)
 
+    from ...parallel.mesh import mesh_str
+
     suffix = "_multi" if n_classes else ""
     name = (f"pallas.glm_{kind}{suffix}.psum" if fused
             else f"superblock.glm.{kind}{suffix}.psum")
-    return plan_tracked(name, run)
+    return plan_tracked(name, run, mesh=mesh_str(mesh))
+
+
+def _sb_reducer_feature_sharded(kind, family, intercept, n_classes,
+                                mesh, model_shards):
+    """Feature-sharded super-block reducer (ISSUE 18 tentpole): the 2-D
+    ("data", "model") flavor of :func:`_sb_reducer_sharded`. Each device
+    scans its OWN (K, S/D, d/M) tile of every block — per-chip HBM for
+    the streamed X slabs is flat in d — and the replicated (d,)-sized
+    carries/operands (beta in, loss/grad[/Hessian] sums out) are the
+    only full-width device arrays, so the interface to ``_sb_pass`` /
+    ``_merge`` / the host solvers is unchanged (L-BFGS S/Y memory lives
+    in host RAM as before — per-chip HBM never sees it).
+
+    Collective structure: the dispatch keeps exactly ONE ``lax.psum``
+    over "data" per super-block (the K-step local sums merge once, as
+    in the 1-D flavor) and adds "model" collectives exactly where the
+    math contracts over features — a per-block psum for
+    ``eta = Σ_m X_m @ w_m`` (the feature-dot), and one per-super-block
+    ``all_gather`` reassembling the per-feature gradient (and Hessian
+    row-tile) slices. The trivial M == 1 case never reaches here:
+    ``_sb_pass`` only selects this flavor when the stream actually
+    tiled (``sb_model_shards() > 1``), so the 1-D programs stay
+    jaxpr-byte-identical."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..._compat import shard_map
+    from ...parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+    fam = get_family(family)
+
+    def _x_spec(a, lead):
+        # X tiles: rows over "data", features (last axis) over "model"
+        return P(*((None,) * lead + (DATA_AXIS,)
+                   + (None,) * (a.ndim - lead - 2) + (MODEL_AXIS,)))
+
+    def _y_spec(a, lead):
+        return P(*((None,) * lead + (DATA_AXIS,)
+                   + (None,) * (a.ndim - lead - 1)))
+
+    def _w_local(bd, dm):
+        # this shard's (dm,)/(C, dm) feature slice of the replicated
+        # weights (intercept column already stripped by the caller)
+        mi = jax.lax.axis_index(MODEL_AXIS)
+        if bd.ndim == 1:
+            return jax.lax.dynamic_slice(bd, (mi * dm,), (dm,))
+        return jax.lax.dynamic_slice(
+            bd, (0, mi * dm), (bd.shape[0], dm)
+        )
+
+    def _gather_feat(t, axis):
+        # per-feature slices -> the full-width array, replicated over
+        # "model": scatter this shard's tile into a zero full-width
+        # buffer and psum (adding zeros — exact), which the replication
+        # checker can statically infer (an all_gather it cannot)
+        mi = jax.lax.axis_index(MODEL_AXIS)
+        dm = t.shape[axis]
+        full = t.shape[:axis] + (dm * model_shards,) + t.shape[axis + 1:]
+        start = (0,) * axis + (mi * dm,) + (0,) * (t.ndim - axis - 1)
+        z = jax.lax.dynamic_update_slice(
+            jnp.zeros(full, t.dtype), t, start
+        )
+        return jax.lax.psum(z, MODEL_AXIS)
+
+    def block_sums(beta, Xb, yb, mask):
+        """Local (val, grad-slice[, hess-tile]) sums for ONE block's
+        (S/D, d/M) tile. ``eta`` pays the per-block feature psum; val
+        and the intercept pieces come out model-REPLICATED, the
+        per-feature pieces model-VARYING (gathered once per
+        super-block, after the data psum)."""
+        dm = Xb.shape[-1]
+        bd = beta.astype(Xb.dtype)
+        if n_classes:
+            B = bd[:, :-1] if intercept else bd
+            B_loc = _w_local(B, dm)
+            eta = jax.lax.psum(Xb @ B_loc.T, MODEL_AXIS)  # (S/D, C)
+            if intercept:
+                eta = eta + bd[:, -1]
+            Y = _codes_onehot(yb, mask, n_classes)
+
+            def per_eta(e):
+                per_class = jax.vmap(
+                    lambda ec, yc: jnp.sum(fam.pointwise(ec, yc) * mask),
+                    in_axes=(1, 0),
+                )(e, Y)
+                return jnp.sum(per_class)
+
+            val, r = jax.value_and_grad(per_eta)(eta)
+            g_loc = r.T @ Xb  # (C, d/M) — this shard's grad slice
+            if kind == "val":
+                return (val,)
+            if kind == "vg":
+                out = (val, g_loc)
+                if intercept:
+                    out += (jnp.sum(r, axis=0),)  # (C,), replicated
+                return out
+            # multiclass vgh: the (C, p, p) Hessian stack needs the
+            # full-width rows — gather the block's tile (transient,
+            # one block at a time) and reuse the 1-D per-class math;
+            # every model shard computes the identical stack, so it
+            # rides the data psum replicated
+            Xf = _gather_feat(Xb, axis=1)  # (S/D, d)
+            W = jax.vmap(lambda e, yc: fam.hess_weight(e, yc) * mask,
+                         in_axes=(1, 0))(eta, Y)  # (C, S/D)
+            XW = Xf[None, :, :] * W[:, :, None]
+            H = jnp.einsum("cni,nj->cij", XW, Xf,
+                           preferred_element_type=jnp.float32)
+            if intercept:
+                col = jnp.sum(XW, axis=1)  # (C, d)
+                wsum = jnp.sum(W, axis=1)  # (C,)
+                H = jnp.concatenate([
+                    jnp.concatenate([H, col[:, :, None]], axis=2),
+                    jnp.concatenate(
+                        [col[:, None, :], wsum[:, None, None]], axis=2
+                    ),
+                ], axis=1)
+            g_full = _gather_feat(g_loc, axis=1)
+            if intercept:
+                g_full = jnp.concatenate(
+                    [g_full, jnp.sum(r, axis=0)[:, None]], axis=1
+                )
+            return (val, g_full, H)
+        w = bd[:-1] if intercept else bd
+        w_loc = _w_local(w, dm)
+        eta = jax.lax.psum(Xb @ w_loc, MODEL_AXIS)  # the feature-dot
+        if intercept:
+            eta = eta + bd[-1]
+        val, r = jax.value_and_grad(
+            lambda e: jnp.sum(fam.pointwise(e, yb) * mask)
+        )(eta)
+        if kind == "val":
+            return (val,)
+        g_loc = Xb.T @ r  # (d/M,) — this shard's grad slice
+        if kind == "vg":
+            out = (val, g_loc)
+            if intercept:
+                out += (jnp.sum(r),)
+            return out
+        # vgh: Hessian row-tile H_m = (X_m W)^T X — (d/M, d); the full
+        # rows come from a transient per-block gather (the Hessian is
+        # inherently (d, d); the streamed wide-d path is lbfgs/vg)
+        wgt = fam.hess_weight(eta, yb) * mask
+        Xw = Xb * wgt[:, None]
+        Xf = _gather_feat(Xb, axis=1)  # (S/D, d)
+        H_loc = jnp.einsum("ni,nj->ij", Xw, Xf,
+                           preferred_element_type=jnp.float32)
+        out = (val, g_loc, H_loc)
+        if intercept:
+            out += (jnp.sum(r), jnp.sum(Xw, axis=0), jnp.sum(wgt))
+        return out
+
+    def _assemble(parts):
+        """Replicated full-width sums from the data-psummed local
+        tuple: gather the per-feature slices over "model" (their ONE
+        per-super-block collective), rebuild the 1-D reducer's
+        (val[, grad[, hess]]) carry layout."""
+        if kind == "val" or (n_classes and kind == "vgh"):
+            return parts  # already full-width / assembled per block
+        if n_classes:  # multiclass vg
+            if intercept:
+                val, g_loc, g_b = parts
+                g = jnp.concatenate(
+                    [_gather_feat(g_loc, axis=1), g_b[:, None]], axis=1
+                )
+            else:
+                val, g_loc = parts
+                g = _gather_feat(g_loc, axis=1)
+            return (val, g)
+        if kind == "vg":
+            if intercept:
+                val, g_loc, g_b = parts
+                g = jnp.concatenate([_gather_feat(g_loc, axis=0),
+                                     g_b[None]])
+            else:
+                val, g_loc = parts
+                g = _gather_feat(g_loc, axis=0)
+            return (val, g)
+        # binary vgh: grad slices + Hessian row-tiles -> full (p,) /
+        # (p, p), intercept row/col appended exactly like the 1-D
+        # kernel's jnp.block assembly
+        val, g_loc, H_loc = parts[:3]
+        g = _gather_feat(g_loc, axis=0)
+        H = _gather_feat(H_loc, axis=0)  # (d, d)
+        if intercept:
+            g_b, col_loc, wsum = parts[3:]
+            g = jnp.concatenate([g, g_b[None]])
+            col = _gather_feat(col_loc, axis=0)
+            H = jnp.block([
+                [H, col[:, None]],
+                [col[None, :], wsum[None, None]],
+            ])
+        return (val, g, H)
+
+    def body(acc, beta, Xs, ys, counts):
+        unrolled = isinstance(Xs, (tuple, list))
+        r = jnp.arange(Xs[0].shape[0] if unrolled else Xs.shape[1])
+        cts = counts[0]
+        p = acc[1].shape[-1] if len(acc) > 1 else 0
+
+        def zeros_local():
+            # local accumulators mirror block_sums' output layout
+            # (per-feature slices stay sliced until after the data
+            # psum), not the replicated carry's
+            dm = (Xs[0].shape[-1] if unrolled else Xs.shape[-1])
+
+            def z(*s):
+                return jnp.zeros(s, jnp.float32)
+
+            if kind == "val":
+                return (z(),)
+            if n_classes:
+                if kind == "vg":
+                    out = (z(), z(n_classes, dm))
+                    return out + ((z(n_classes),) if intercept else ())
+                return (z(), z(n_classes, p), z(n_classes, p, p))
+            if kind == "vg":
+                out = (z(), z(dm))
+                return out + ((z(),) if intercept else ())
+            d_full = dm * model_shards
+            out = (z(), z(dm), z(dm, d_full))
+            return out + ((z(), z(dm), z()) if intercept else ())
+
+        def step(lacc, Xb, yb, c):
+            mask = (r < c).astype(Xb.dtype)
+            out = block_sums(beta, Xb, yb, mask)
+            return tuple(l + o for l, o in zip(lacc, out))
+
+        local = zeros_local()
+        if unrolled:
+            for j in range(len(Xs)):
+                local = step(local, Xs[j], ys[j], cts[j])
+        else:
+            def scan_step(lacc, inp):
+                return step(lacc, *inp), jnp.float32(0.0)
+
+            local, _ = jax.lax.scan(scan_step, local, (Xs, ys, cts))
+        # the super-block's ONE data collective, as in the 1-D flavor
+        local = jax.lax.psum(local, DATA_AXIS)
+        # ... then the per-super-block feature reassembly
+        full = _assemble(local)
+        return tuple(a + f for a, f in zip(acc, full))
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def run(acc, beta, Xs, ys, counts):
+        unrolled = isinstance(Xs, (tuple, list))
+        if unrolled:
+            xs_spec = tuple(_x_spec(a, 0) for a in Xs)
+            ys_spec = tuple(_y_spec(a, 0) for a in ys)
+        else:
+            xs_spec = _x_spec(Xs, 1)
+            ys_spec = _y_spec(ys, 1)
+        f = shard_map(
+            body, mesh,
+            in_specs=(P(), P(), xs_spec, ys_spec, P(DATA_AXIS, None)),
+            out_specs=P(),
+        )
+        return f(acc, beta, Xs, ys, counts)
+
+    from ...parallel.mesh import mesh_str
+
+    suffix = "_multi" if n_classes else ""
+    return plan_tracked(f"superblock.glm.{kind}{suffix}.model_psum",
+                        run, mesh=mesh_str(mesh))
 
 
 @_ft.lru_cache(maxsize=64)
 def _sb_reducer(kind, family, intercept, n_classes, mxu=None,
-                fused=False, interpret=False, mesh=None):
+                fused=False, interpret=False, mesh=None,
+                model_shards=1):
     """The donated-carry super-block program for one objective flavor:
     ``kind`` in {"val", "vg", "vgh"} lifts the matching per-block kernel
     into a scan over the (K, S, ...) stacks, accumulating its sum tuple.
@@ -391,7 +656,19 @@ def _sb_reducer(kind, family, intercept, n_classes, mxu=None,
     (config.dtype="auto" on TPU). ``fused`` composes with ``mesh``
     (ISSUE 12): the fused body then runs inside the shard_map program
     on each device's own slab. With ``fused=False`` and ``mxu`` unset
-    this function is byte-for-byte the pre-feature program."""
+    this function is byte-for-byte the pre-feature program.
+
+    ``model_shards`` > 1 (ISSUE 18: the stream's X tiles actually
+    sharded over a 2-D mesh's "model" axis) selects the
+    feature-sharded flavor — per-device (K, S/D, d/M) tiles, the
+    feature-contracting psums over "model", program names
+    ``superblock.glm.*.model_psum``. Callers leave it at the default
+    whenever the stream didn't tile, so the M == 1 cache keys (and the
+    1-D jaxprs) are untouched."""
+    if mesh is not None and model_shards > 1:
+        return _sb_reducer_feature_sharded(
+            kind, family, intercept, n_classes, mesh, model_shards
+        )
     if mesh is not None:
         return _sb_reducer_sharded(kind, family, intercept, n_classes,
                                    mesh, mxu=mxu, fused=fused,
@@ -730,6 +1007,12 @@ class StreamedObjective:
             # body would hold C Hessian accumulators in VMEM at once,
             # and multiclass newton is not a streamed hot path
             return None, False, False, "multiclass-hessian-xla"
+        M = int(getattr(self.stream, "sb_model_shards", lambda: 1)())
+        if M > 1:
+            # feature-sharded tiles (2-D mesh, ISSUE 18) stay XLA: the
+            # fused Pallas bodies have no per-feature-slice story (the
+            # model-axis psum sits mid-objective)
+            return None, False, False, f"feature-sharded(M={M})"
         reason = stream_mode_reason()
         if reason is not None:
             return None, False, False, reason
@@ -800,9 +1083,16 @@ class StreamedObjective:
             # place
             from jax.sharding import NamedSharding, PartitionSpec as P
 
+            # the feature-sharded flavor engages ONLY when the stream's
+            # X actually tiled over "model" (sb_model_shards > 1); the
+            # kwarg is omitted otherwise so the M == 1 reducer cache
+            # keys — and with them the 1-D jaxprs — stay byte-identical
+            m_shards = int(getattr(s, "sb_model_shards",
+                                   lambda: 1)())
+            kw = {"model_shards": m_shards} if m_shards > 1 else {}
             run = _sb_reducer(kind, self.family, self.intercept,
                               self.n_classes or 0, mxu=mxu, fused=fused,
-                              interpret=interp, mesh=s.mesh)
+                              interpret=interp, mesh=s.mesh, **kw)
             init = jax.device_put(init, NamedSharding(s.mesh, P()))
         else:
             run = _sb_reducer(kind, self.family, self.intercept,
@@ -1482,6 +1772,15 @@ def _fused_stream_info(obj, stream, solver, fit_dtype):
     out["stream_shards"] = int(
         getattr(stream, "sb_data_shards", lambda: 1)()
     ) if use_sb else 1
+    # 2-D mesh audit trail (ISSUE 18): the model-axis width the X tiles
+    # actually sharded over (1 on 1-D meshes and wherever tiling was
+    # refused), and WHY a 2-D mesh didn't tile (None when it did or
+    # when there was no model axis to tile over)
+    out["stream_model_shards"] = int(
+        getattr(stream, "sb_model_shards", lambda: 1)()
+    ) if use_sb else 1
+    out["model_tile_reason"] = getattr(stream, "model_tile_reason",
+                                       None)
     # the device-resident sparse flavor's audit trail (ISSUE 13),
     # mirroring fused_stream_reason: None iff the bucketed-nnz scan
     # carried the pass, else why it fell back — "stream-sparse-off",

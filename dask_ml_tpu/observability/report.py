@@ -267,7 +267,22 @@ def summarize_stream(records):
     # 1 = single-device streaming, D = shard_map/psum scans over D chips
     sh = [int(p["sb_shards"]) for p in passes if p.get("sb_shards")]
     tot["sb_shards"] = max(sh) if sh else 1
+    # 2-D mesh shape (ISSUE 18): feature-sharded passes tag "DxM"; the
+    # widest mesh of the run wins (passes usually share one)
+    mm = [int(p.get("sb_model_shards", 1)) for p in passes]
+    tot["sb_model_shards"] = max(mm) if mm else 1
+    msh = [str(p["mesh"]) for p in passes if p.get("mesh")]
+    tot["mesh"] = (max(msh, key=_mesh_size) if msh
+                   else f"{tot['sb_shards']}x{tot['sb_model_shards']}")
     return tot
+
+
+def _mesh_size(s):
+    try:
+        d, m = str(s).split("x")
+        return int(d) * int(m)
+    except Exception:
+        return 0
 
 
 def summarize_drift(records):
@@ -539,10 +554,11 @@ def build_report(records, path="<records>", slowest=10):
     if st:
         lines += _table(
             "streaming overlap",
-            ("passes", "blocks", "dispatches", "sb_k", "shards",
+            ("passes", "blocks", "dispatches", "sb_k", "mesh",
              "host", "put", "wait", "consume"),
             [(st["n_passes"], st["n_blocks"], st["dispatches"],
-              st["superblock_k"], st.get("sb_shards", 1),
+              st["superblock_k"],
+              st.get("mesh", f"{st.get('sb_shards', 1)}x1"),
               _fmt_seconds(st["host_s"]),
               _fmt_seconds(st["put_s"]), _fmt_seconds(st["wait_s"]),
               _fmt_seconds(st["consume_s"]))],
@@ -618,6 +634,9 @@ def build_report(records, path="<records>", slowest=10):
         # any row carries it, so pre-plans records render unchanged
         has_plan = any(p.get("plan") or p.get("ladder_rung")
                        for p in progs)
+        # mesh column (ISSUE 18): sharded super-block programs render
+        # the "DxM" shape they were built over
+        has_mesh = any(p.get("mesh") for p in progs)
         rows = []
         for p in progs:
             flops = p.get("flops_per_call")
@@ -641,6 +660,8 @@ def build_report(records, path="<records>", slowest=10):
             )
             if has_plan:
                 row += (p.get("ladder_rung") or p.get("plan") or "-",)
+            if has_mesh:
+                row += (p.get("mesh") or "-",)
             rows.append(row)
         title = "programs (XLA cost/memory per compiled entry point)"
         if peak:
@@ -651,6 +672,8 @@ def build_report(records, path="<records>", slowest=10):
                    "flops/call", "hbm_peak", "mfu")
         if has_plan:
             headers += ("plan",)
+        if has_mesh:
+            headers += ("mesh",)
         lines += _table(title, headers, rows)
     plans = data.get("plans") or []
     if plans:

@@ -128,7 +128,8 @@ class PartitionedFrame:
         return out
 
     # -- device bridge -----------------------------------------------------
-    def to_sharded(self, mesh=None, dtype=np.float32, columns=None):
+    def to_sharded(self, mesh=None, dtype=np.float32, columns=None,
+                   shard_features=False):
         """Place the (numeric) columns onto the device mesh as a
         ShardedArray — the frame→array handoff where TPU compute begins.
         Categorical columns must be encoded first (OrdinalEncoder /
@@ -196,7 +197,10 @@ class PartitionedFrame:
         host = np.concatenate([
             p[cols].to_numpy(dtype=dtype) for p in self.partitions
         ], axis=0)
-        return ShardedArray.from_array(host, mesh=mesh, dtype=dtype)
+        # shard_features rides the logical-axis rules (mesh.py): on a
+        # 2-D ("data", "model") mesh the columns tile over "model"
+        return ShardedArray.from_array(host, mesh=mesh, dtype=dtype,
+                                       shard_features=shard_features)
 
 
 def from_pandas(df: pd.DataFrame, npartitions: int = 8) -> PartitionedFrame:

@@ -135,33 +135,149 @@ def data_shard_spec(a, lead: int = 0) -> P:
                + (None,) * (a.ndim - lead - 1)))
 
 
+def parse_mesh_shape(s, n_devices: int):
+    """Parse a ``config.mesh_shape`` string against ``n_devices``.
+
+    Returns ``None`` for "auto"/""/"1d", else ``(D, M)``. A bare "D"
+    normalizes to ``(D, 1)``; M == 1 means the caller must build a plain
+    1-D data mesh over D devices (the trivial model axis COLLAPSES so
+    the 1-D programs stay jaxpr-byte-identical — asserted in
+    perf_smoke). Either factor may be -1 (inferred from ``n_devices``);
+    D*M may undershoot ``n_devices`` (the first D*M devices are used)
+    but never exceed it."""
+    s = str(s or "auto").strip().lower()
+    if s in ("auto", "", "1d"):
+        return None
+    parts = s.split("x")
+    if len(parts) not in (1, 2):
+        raise ValueError(
+            f"mesh_shape {s!r}: expected 'auto', 'D', or 'DxM'"
+        )
+    try:
+        dims = [int(p) for p in parts]
+    except ValueError:
+        raise ValueError(
+            f"mesh_shape {s!r}: expected 'auto', 'D', or 'DxM'"
+        ) from None
+    if len(parts) == 1:
+        dims = dims + [1]
+    d, m = dims
+    if d == -1 and m == -1:
+        raise ValueError(f"mesh_shape {s!r}: only one axis may be -1")
+    if d == -1:
+        if m < 1 or n_devices % m:
+            raise ValueError(
+                f"mesh_shape {s!r}: cannot infer data axis from "
+                f"{n_devices} devices"
+            )
+        d = n_devices // m
+    elif m == -1:
+        if d < 1 or n_devices % d:
+            raise ValueError(
+                f"mesh_shape {s!r}: cannot infer model axis from "
+                f"{n_devices} devices"
+            )
+        m = n_devices // d
+    if d < 1 or m < 1:
+        raise ValueError(f"mesh_shape {s!r}: axes must be >= 1 (or -1)")
+    if d * m > n_devices:
+        raise ValueError(
+            f"mesh_shape {s!r} needs {d * m} devices, have {n_devices}"
+        )
+    return (d, m)
+
+
+# t5x-style logical-axis rules: named LOGICAL array axes map onto mesh
+# axes — batch-like axes shard over "data", feature/embedding axes over
+# "model", anything else replicates. The ONE table `to_sharded` /
+# `ShardedArray.from_array` and `BlockStream._put_sharded` consult, so
+# a future mesh-shape change (or a third axis) lands in one place.
+LOGICAL_AXIS_RULES = (
+    ("batch", DATA_AXIS),
+    ("feature", MODEL_AXIS),
+    ("embed", MODEL_AXIS),
+)
+
+
+def logical_axis_spec(logical_axes, mesh: Mesh) -> P:
+    """PartitionSpec for an array whose axes carry the LOGICAL names in
+    ``logical_axes`` (None entries replicate), resolved through
+    :data:`LOGICAL_AXIS_RULES` against ``mesh``: a rule only engages
+    when its mesh axis exists on ``mesh`` (so "feature" degrades to
+    replicated on a 1-D data mesh and the same call site serves both
+    shapes)."""
+    rules = dict(LOGICAL_AXIS_RULES)
+    names = set(mesh.axis_names)
+    spec = []
+    for name in logical_axes:
+        axis = rules.get(name)
+        spec.append(axis if axis in names else None)
+    return P(*spec)
+
+
 def stream_data_mesh() -> Mesh:
     """The mesh streamed (out-of-core) fits shard over, resolved from
-    ``config.stream_mesh``: 0 = the ambient/default mesh (all local
-    devices — data-parallel streaming engages whenever >1 device is
-    visible), 1 = a single-device mesh (the sharded superblock flavor
-    never engages), N = the first N local devices. Cached per resolved
-    device set so every BlockStream of a fit sees the SAME Mesh object
-    (scan programs are lru-cached with the mesh in their key)."""
+    ``config.stream_mesh`` x ``config.mesh_shape``. ``stream_mesh``
+    restricts the device POOL: 0 = all local devices, 1 = a single
+    device (the sharded superblock flavor never engages), N = the first
+    N local devices. ``mesh_shape`` then SHAPES the pool: "auto"/"D"/
+    "Dx1" give the 1-D data mesh (today's behavior, byte-identical
+    programs), "DxM" a 2-D ("data", "model") mesh over the first D*M
+    pool devices. Cached per resolved (knobs, device set) so every
+    BlockStream of a fit sees the SAME Mesh object (scan programs are
+    lru-cached with the mesh in their key)."""
     from ..config import get_config
 
-    n = int(get_config().stream_mesh)
+    cfg = get_config()
+    n = int(cfg.stream_mesh)
+    shape_s = str(getattr(cfg, "mesh_shape", "auto"))
     if n <= 0:
-        return default_mesh()
-    devices = jax.devices()[: max(min(n, len(jax.devices())), 1)]
-    key = (n, len(devices), tuple(d.id for d in devices))
+        pool = jax.devices()
+    else:
+        pool = jax.devices()[: max(min(n, len(jax.devices())), 1)]
+    dm = parse_mesh_shape(shape_s, len(pool))
+    if dm is None:
+        if n <= 0:
+            return default_mesh()
+        devices = pool
+    elif dm[1] == 1:
+        # trivial model axis: COLLAPSE to the plain 1-D data mesh so the
+        # 1-D scan programs stay jaxpr-byte-identical
+        devices = pool[: dm[0]]
+        if n <= 0 and len(devices) == len(jax.devices()):
+            return default_mesh()
+        dm = None
+    else:
+        devices = pool[: dm[0] * dm[1]]
+    key = (n, shape_s, len(devices), tuple(d.id for d in devices))
     cached = getattr(_state, "stream_meshes", None)
     if cached is None:
         cached = _state.stream_meshes = {}
     mesh = cached.get(key)
     if mesh is None:
-        mesh = cached[key] = device_mesh(devices=devices)
+        if dm is None:
+            mesh = device_mesh(devices=devices)
+        else:
+            mesh = device_mesh(dm, (DATA_AXIS, MODEL_AXIS),
+                               devices=devices)
+        cached[key] = mesh
     return mesh
 
 
 def data_shards(mesh: Mesh) -> int:
     """Number of shards along the data (row) axis."""
     return mesh.shape[DATA_AXIS] if DATA_AXIS in mesh.shape else 1
+
+
+def model_shards(mesh: Mesh) -> int:
+    """Number of shards along the model (feature) axis; 1 on 1-D meshes."""
+    return mesh.shape[MODEL_AXIS] if MODEL_AXIS in mesh.shape else 1
+
+
+def mesh_str(mesh: Mesh) -> str:
+    """Render a mesh as "DxM" — the report CLI / status form (a 1-D
+    data mesh over 4 devices renders "4x1")."""
+    return f"{data_shards(mesh)}x{model_shards(mesh)}"
 
 
 def row_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:

@@ -25,7 +25,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import DATA_AXIS, MODEL_AXIS, data_shards, resolve_mesh
+from .mesh import (
+    DATA_AXIS, MODEL_AXIS, data_shards, logical_axis_spec, resolve_mesh,
+)
 
 
 def _padded_rows(n_rows: int, n_shards: int) -> int:
@@ -135,13 +137,9 @@ class ShardedArray:
         if n_pad != n:
             pad_widths = [(0, n_pad - n)] + [(0, 0)] * (x.ndim - 1)
             x = xp.pad(x, pad_widths)
-        feat = (
-            MODEL_AXIS
-            if shard_features and x.ndim >= 2
-            and mesh.shape.get(MODEL_AXIS, 1) > 1
-            else None
-        )
-        spec = P(*((DATA_AXIS, feat) + (None,) * (x.ndim - 2))[: x.ndim])
+        feat = "feature" if shard_features and x.ndim >= 2 else None
+        axes = (("batch", feat) + (None,) * (x.ndim - 2))[: x.ndim]
+        spec = logical_axis_spec(axes, mesh)
         data = _scatter(x, mesh, spec)
         return cls(data, n, mesh)
 

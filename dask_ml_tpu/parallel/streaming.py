@@ -23,7 +23,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from .mesh import DATA_AXIS, data_shards, resolve_mesh
+from .mesh import (
+    DATA_AXIS, MODEL_AXIS, data_shards, mesh_str, model_shards,
+    resolve_mesh,
+)
 
 
 class SparseBlocks:
@@ -122,6 +125,16 @@ def _slice_dense(a, lo, hi, dtype):
     if sp.issparse(a):
         return _csr_dense(a.tocsr(), lo, hi, dtype)
     return np.asarray(a[lo:hi], dtype=dtype)
+
+
+class StreamBudgetExceeded(ValueError):
+    """A streamed fit's PER-DEVICE staged super-block slab exceeds the
+    simulated ``config.stream_device_byte_budget`` — the typed refusal
+    (sibling of ``DenseBudgetExceeded``) that stands in for a real
+    per-chip HBM OOM on CPU. The fix is a mesh with more shards on the
+    axis that's over budget: a wide-d fit that a 1-D data mesh refuses
+    fits once ``config.mesh_shape`` adds a model axis (X slabs then
+    stage as (rows/D, d/M) tiles — per-device bytes flat in d)."""
 
 
 class Block:
@@ -317,10 +330,13 @@ def resolve_stream_mesh(mesh=None):
     data (they shard over this process's devices only — a global-mesh
     device_put asserts value equality across processes, and the
     cross-process merge is the consumer's explicit psum_host); else
-    ``config.stream_mesh`` picks the local device set (see
-    ``mesh.stream_data_mesh``). The ONE resolution point shared by
-    ``BlockStream`` and ``fit_block_rows`` so block partitions and
-    staging shardings always agree."""
+    ``config.stream_mesh`` x ``config.mesh_shape`` pick the local
+    device set and its 1-D/2-D shape (see ``mesh.stream_data_mesh`` —
+    "Dx1" collapses to the plain 1-D mesh, "DxM" gives the 2-D
+    ("data", "model") mesh). The ONE resolution point shared by
+    ``BlockStream`` and ``fit_block_rows`` so block partitions,
+    staging shardings and the lru'd scan-program mesh keys always
+    agree — every BlockStream of a fit sees the SAME Mesh object."""
     if mesh is not None:
         return mesh
     from . import distributed as dist
@@ -452,17 +468,49 @@ class BlockStream:
         self.rng = np.random.RandomState(seed)
         self.dtype = dtype
         self.n_blocks = int(np.ceil(n / self.block_rows))
+        # 2-D mesh feature tiling (logical-axis rules, mesh.py): on a
+        # ("data", "model") mesh ONLY the X position (arrays[0], dense,
+        # ndim >= 2, d divisible by M — shard_map needs even tiles)
+        # stages as (rows/D, d/M) per-device tiles; y/aux/masks and the
+        # per-shard valid-row counts stay data-only (counts replicate
+        # over "model" for free via P("data", None)). A non-tileable X
+        # records the reason and stages data-only — the 1-D sharded
+        # programs stay correct on a 2-D mesh (their specs name only
+        # "data", so compute is model-replicated).
+        m_shards = model_shards(self.mesh)
+        self.model_tiled = False
+        self.model_tile_reason = None
+        if m_shards > 1:
+            a0 = self.arrays[0]
+            d0_tile = getattr(a0, "shape", (0,))[1] if getattr(
+                a0, "ndim", 1) >= 2 else 0
+            if _is_sparse_source(a0):
+                self.model_tile_reason = "sparse-source"
+            elif getattr(a0, "ndim", 1) != 2:
+                self.model_tile_reason = "x-not-2d"
+            elif d0_tile % m_shards:
+                self.model_tile_reason = (
+                    f"d-not-divisible({d0_tile}%{m_shards})"
+                )
+            else:
+                self.model_tiled = True
+
+        def _feat(i, a):
+            return (MODEL_AXIS if i == 0 and self.model_tiled
+                    else None,) + (None,) * (a.ndim - 2) \
+                if a.ndim >= 2 else ()
+
         self._shardings = tuple(
-            NamedSharding(self.mesh, P(*((DATA_AXIS,) + (None,) * (a.ndim - 1))))
-            for a in self.arrays
+            NamedSharding(self.mesh, P(*((DATA_AXIS,) + _feat(i, a))))
+            for i, a in enumerate(self.arrays)
         )
         self._mask_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
         # super-block stacks shard their ROW axis (axis 1); the block
         # axis is the scan axis and stays unsharded
         self._sb_shardings = tuple(
             NamedSharding(self.mesh,
-                          P(*((None, DATA_AXIS) + (None,) * (a.ndim - 1))))
-            for a in self.arrays
+                          P(*((None, DATA_AXIS) + _feat(i, a))))
+            for i, a in enumerate(self.arrays)
         )
         self._counts_sharding = NamedSharding(self.mesh, P())
         # per-shard valid-row counts of the sharded superblock flavor:
@@ -1107,10 +1155,19 @@ class BlockStream:
         stay byte-identical to the pre-mesh feature)."""
         return max(data_shards(self.mesh), 1)
 
+    def sb_model_shards(self) -> int:
+        """Model-axis shards the X super-blocks actually TILE over —
+        M of the 2-D flavor. 1 on 1-D meshes AND whenever the X
+        position couldn't tile (sparse / non-2-D / d not divisible:
+        see ``model_tile_reason``), so consumers can branch on this
+        one number."""
+        return model_shards(self.mesh) if self.model_tiled else 1
+
     def sb_sharded(self) -> bool:
-        """True when super-blocks stage batch-sharded and consumers
-        should run their shard_map/psum scan flavor."""
-        return self.sb_data_shards() > 1
+        """True when super-blocks stage device-sharded (over "data",
+        "model", or both) and consumers should run their
+        shard_map/psum scan flavor."""
+        return self.sb_data_shards() > 1 or self.sb_model_shards() > 1
 
     def sb_sparse(self) -> bool:
         """True when super-blocks stage as device-resident bucketed-nnz
@@ -1132,6 +1189,40 @@ class BlockStream:
             - np.arange(D, dtype=np.int64)[:, None] * sd,
             0, sd,
         ).astype(np.int32)
+
+    def _check_device_budget(self, k):
+        """Enforce ``config.stream_device_byte_budget`` (0 = off): the
+        bytes ONE device holds for a staged super-block — K blocks x
+        its (block_rows/D) row slab x its (d/M when the X position
+        tiles, else d) feature tile, per array, at the 4-byte staging
+        dtype — must fit the simulated budget, else the fit refuses
+        typed (``StreamBudgetExceeded``) instead of letting a wide-d
+        1-D fit blow past per-chip HBM on real hardware."""
+        from ..config import get_config
+
+        budget = int(get_config().stream_device_byte_budget)
+        if budget <= 0:
+            return
+        D = self.sb_data_shards()
+        M = self.sb_model_shards()
+        per_dev = 0
+        for i, a in enumerate(self.arrays):
+            feat = int(np.prod(
+                getattr(a, "shape", (0,))[1:], dtype=np.int64) or 1)
+            if i == 0 and self.model_tiled:
+                feat = -(-feat // M)
+            per_dev += int(k) * (self.block_rows // D) * feat * 4
+        if per_dev > budget:
+            raise StreamBudgetExceeded(
+                f"staged super-block needs {per_dev} bytes per device "
+                f"(K={k}, block_rows={self.block_rows}, mesh "
+                f"{mesh_str(self.mesh)}), over the simulated "
+                f"stream_device_byte_budget={budget}. Shard the "
+                "over-budget axis: set config.mesh_shape to a 2-D "
+                "'DxM' so X stages as (rows/D, d/M) per-device tiles "
+                "(per-device bytes flat in d), or lower superblock_k / "
+                "stream_block_rows."
+            )
 
     def _put_sharded(self, a, sharding):
         """One batch-sharded ``jax.Array`` from PER-SHARD host slabs,
@@ -1258,12 +1349,17 @@ class BlockStream:
         ring = self._sb_ring(k)
         unroll = superblock_unrolled()
         D = self.sb_data_shards()
-        sharded = D > 1
+        sharded = self.sb_sharded()
+        self._check_device_budget(k)
         stats = {"host_s": 0.0, "put_s": 0.0, "wait_s": 0.0,
                  "consume_s": 0.0, "n_blocks": int(len(order)),
                  "block_rows": int(self.block_rows),
                  "superblock_k": int(k),
                  "sb_shards": int(D),
+                 "sb_model_shards": int(self.sb_model_shards()),
+                 # pass-span mesh tag: the 2-D shape the report CLI /
+                 # /status render as "DxM"
+                 "mesh": mesh_str(self.mesh),
                  "dispatches_per_pass": int(n_sb)}
         t_pass = _time.perf_counter()
         from collections import deque
@@ -1691,6 +1787,8 @@ class BlockStream:
                  "block_rows": int(self.block_rows),
                  "superblock_k": int(k),
                  "sb_shards": int(D),
+                 "sb_model_shards": 1,
+                 "mesh": mesh_str(self.mesh),
                  "dispatches_per_pass": int(n_sb),
                  "sparse_cap": int(cap)}
         t_pass = _time.perf_counter()

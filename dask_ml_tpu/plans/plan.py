@@ -50,24 +50,28 @@ _BUILD_CACHE: dict = {}
 _BUILD_CACHE_MAX = 256
 _tokens = itertools.count(1)
 
-# attribution registry: program name -> {group, ladder, rungs}
-# (which plan family owns a program, which shape ladder feeds it, and
-# which rungs have minted specializations so far)
+# attribution registry: program name -> {group, ladder, rungs, mesh}
+# (which plan family owns a program, which shape ladder feeds it, which
+# rungs have minted specializations so far, and — for the sharded
+# super-block flavors — the "DxM" mesh the program runs over)
 _ATTR: dict = {}
 
 
 def register_attr(name: str, group: str = "plan",
-                  ladder: str | None = None) -> None:
+                  ladder: str | None = None,
+                  mesh: str | None = None) -> None:
     with _lock:
         e = _ATTR.get(name)
         if e is None:
             _ATTR[name] = {"group": group, "ladder": ladder,
-                           "rungs": set()}
+                           "mesh": mesh, "rungs": set()}
         else:
             if group:
                 e["group"] = group
             if ladder:
                 e["ladder"] = ladder
+            if mesh:
+                e["mesh"] = mesh
 
 
 def note_rung(name: str, rung) -> None:
@@ -105,6 +109,11 @@ def annotate_programs(rows) -> None:
         lr = _ladder_rung_str(e)
         if lr:
             row["ladder_rung"] = lr
+        if e.get("mesh"):
+            # sharded super-block programs carry the "DxM" mesh shape
+            # they were built over (ISSUE 18) — the programs-table
+            # mesh column
+            row["mesh"] = e["mesh"]
 
 
 def plans_snapshot() -> list:
@@ -163,6 +172,9 @@ class ProgramPlan:
     key: object = None
     ladder: str | None = None
     group: str = "plan"
+    # "DxM" for sharded super-block programs (rendered by the report
+    # CLI's programs table); None for mesh-free programs
+    mesh: str | None = None
 
     def cache_key(self):
         key = self.key if self.key is not None else self.body
@@ -207,7 +219,8 @@ class ProgramPlan:
         fn = track_program(self.name)(jax.jit(self.body, **kw))
         fn.plan_token = next(_tokens)
         fn.plan_name = self.name
-        register_attr(self.name, group=self.group, ladder=self.ladder)
+        register_attr(self.name, group=self.group, ladder=self.ladder,
+                      mesh=self.mesh)
         record_plan_build(cached=False)
         if use_cache:
             with _lock:
@@ -217,18 +230,21 @@ class ProgramPlan:
         return fn
 
 
-def tracked(name, fn=None, *, group="superblock", ladder=None):
+def tracked(name, fn=None, *, group="superblock", ladder=None,
+            mesh=None):
     """Route a pre-jitted program through the plan layer: registers the
     plan attribution and applies the SAME ``track_program`` wrapper a
     :class:`ProgramPlan` build would — the scan body and its jit flags
     stay exactly the caller's, so the jaxpr is untouched. Usable as a
     decorator (``@tracked("name")``) or a call (``tracked(name, run)``).
+    ``mesh`` ("DxM") tags sharded programs for the report CLI.
     """
     if fn is None:
-        return lambda f: tracked(name, f, group=group, ladder=ladder)
+        return lambda f: tracked(name, f, group=group, ladder=ladder,
+                                 mesh=mesh)
     from ..observability import track_program
 
-    register_attr(name, group=group, ladder=ladder)
+    register_attr(name, group=group, ladder=ladder, mesh=mesh)
     out = track_program(name)(fn)
     out.plan_token = next(_tokens)
     out.plan_name = name

@@ -1,0 +1,113 @@
+"""Wide features on a 2-D ("data", "model") mesh (ISSUE 18).
+
+When d gets wide, the 1-D streamed path stages full (block_rows, d)
+slabs per device — per-chip staging grows linearly in d until it no
+longer fits. `config.mesh_shape = "DxM"` reshapes the streamed pool
+into a 2-D hybrid mesh: each device stages a (rows/D, ceil(d/M))
+feature TILE, the GLM reducers and streamed randomized PCA run their
+feature-sharded flavors (one psum over "model" exactly where the math
+contracts over features), and per-chip staging stays flat in d.
+
+`config.stream_device_byte_budget` makes that capacity story concrete
+off-TPU: with a budget set, the 1-D fit refuses TYPED
+(`StreamBudgetExceeded`, pointing at `mesh_shape`) and the identical
+fit completes on a 2-D mesh. This example walks that refusal-then-lift
+for LogisticRegression and streamed randomized PCA.
+
+Run anywhere: on a TPU VM this uses every chip; on a CPU host set
+XLA_FLAGS=--xla_force_host_platform_device_count=8 to simulate an
+8-device pool.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+N = int(os.environ.get("DASK_ML_TPU_EXAMPLE_N", 65_536))
+D = 512  # wide: the whole point
+
+from dask_ml_tpu import config, observability as obs
+from dask_ml_tpu.linear_model import LogisticRegression
+from dask_ml_tpu.models.pca import PCA
+from dask_ml_tpu.parallel.mesh import mesh_str, model_shards, stream_data_mesh
+from dask_ml_tpu.parallel.streaming import StreamBudgetExceeded
+
+import jax
+
+if len(jax.devices()) < 2:
+    print("needs >= 2 devices for a 2-D mesh "
+          "(set XLA_FLAGS=--xla_force_host_platform_device_count=8); skipping")
+    sys.exit(0)
+
+rng = np.random.RandomState(0)
+# decaying column spectrum: keeps the randomized-SVD range finder
+# well-posed AND gives PCA something to explain (flat Gaussian noise
+# has no preferred subspace)
+scales = (100.0 * 0.8 ** np.arange(D)).astype(np.float32)
+Z = rng.randn(N, D).astype(np.float32)
+X = Z * scales + 1.5
+w = (rng.randn(D) / np.sqrt(D)).astype(np.float32)
+y = (Z @ w + 0.1 * rng.randn(N).astype(np.float32) > 0).astype(np.float32)
+# standardized view for the GLM (same shape, same staging bytes — the
+# budget story below is about geometry, not values)
+_std = X.std(axis=0)
+_std[_std == 0] = 1.0  # tail columns underflow to constant
+Xg = ((X - X.mean(axis=0)) / _std).astype(np.float32)
+
+# Per-device staged super-block bytes are K x block_rows/D x ceil(d/M) x 4.
+# At K=4, block_rows=512, d=512: single-device 1-D stages ~4.2 MB;
+# a "-1x4" mesh stages ~0.5 MB per device. A 2 MB budget sits between.
+BUDGET = 2_000_000
+base = dict(dtype="float32", stream_block_rows=512, superblock_k=4,
+            stream_autotune=False, stream_device_byte_budget=BUDGET)
+
+# -- 1. the 1-D path refuses, typed -----------------------------------------
+try:
+    with config.set(stream_mesh=1, **base):
+        LogisticRegression(solver="lbfgs", max_iter=5).fit(Xg, y)
+    raise SystemExit("expected StreamBudgetExceeded on the 1-D path")
+except StreamBudgetExceeded as e:
+    print(f"1-D refusal (typed): {str(e)[:110]}...")
+
+# -- 2. the same fit completes on the 2-D mesh ------------------------------
+with config.set(mesh_shape="-1x4", **base):
+    mesh = stream_data_mesh()
+    print(f"2-D mesh: {mesh_str(mesh)} "
+          f"({model_shards(mesh)} feature shards per row slab)")
+    clf = LogisticRegression(solver="lbfgs", max_iter=20)
+    clf.fit(Xg, y)
+    acc = clf.score(Xg, y)
+    before = obs.counters_snapshot().get("recompiles", 0)
+    clf.fit(Xg, y)  # refit: warm jit caches only
+    recompiles = obs.counters_snapshot().get("recompiles", 0) - before
+print(f"feature-sharded GLM: acc={acc:.3f}, "
+      f"refit recompiles={recompiles} (contract: 0)")
+assert recompiles == 0
+
+# -- 3. streamed randomized PCA through the same mesh -----------------------
+with config.set(mesh_shape="-1x4", **base):
+    pca = PCA(n_components=8, svd_solver="randomized", random_state=0)
+    pca.fit(X)
+
+# cross-check the top singular values against a resident eigendecomposition
+# of the (cheap, d x d) covariance — parity is the contract, not a demo
+Xc = X - X.mean(axis=0)
+evals = np.linalg.eigvalsh((Xc.T @ Xc).astype(np.float64))[::-1]
+sv_ref = np.sqrt(np.maximum(evals[:8], 0.0))
+rel = np.max(np.abs(pca.singular_values_ - sv_ref) / sv_ref)
+print(f"streamed randomized PCA: evr_sum={pca.explained_variance_ratio_.sum():.4f}, "
+      f"top-8 singular-value rel err vs resident = {rel:.2e}")
+assert rel < 1e-3
+
+# -- 4. where to see it ------------------------------------------------------
+# The report CLI / /status show mesh=DxM on every streamed pass and a
+# `mesh` column on the feature-sharded programs; program names carry the
+# flavor: superblock.glm.*.model_psum, superblock.pca.{moments,range}.*.
+from dask_ml_tpu import plans
+
+names = [r["program"] for r in plans.plans_snapshot()
+         if ".model_psum" in r["program"]]
+print("feature-sharded programs:", ", ".join(sorted(set(names))))

@@ -425,6 +425,118 @@ def main():
             "plans table missing the stacked C-grid solve program"
         )
 
+    # -- 2-D mesh section (ISSUE 18): feature-sharded streaming --------
+    # mesh_shape="2x4" tiles the streamed X slabs as (rows/2, d/4)
+    # per-device blocks; the dispatch-collapse contract must survive
+    # unchanged — EXACTLY ceil(n_blocks/K) dispatches per pass (one per
+    # super-block, never one per shard or per model tile) and zero XLA
+    # compiles after the warming fit. mesh_shape="8x1" must COLLAPSE to
+    # the cached 1-D data mesh so the 1-D reducer cache keys — and with
+    # them the 1-D jaxprs — stay byte-identical.
+    md_dispatches = md_recompiles = md_glm_recompiles = None
+    if len(jax.devices()) >= 8:
+        from dask_ml_tpu.linear_model import LogisticRegression
+        from dask_ml_tpu.models.pca import PCA
+        from dask_ml_tpu.models.solvers.streamed import _sb_reducer
+        from dask_ml_tpu.parallel.mesh import (default_mesh,
+                                               stream_data_mesh)
+
+        with config.set(stream_mesh=0, mesh_shape="8x1"):
+            m81 = stream_data_mesh()
+        with config.set(stream_mesh=0, mesh_shape="auto"):
+            m1d = stream_data_mesh()
+        if not (m81 is m1d and m81 is default_mesh()):
+            failures.append(
+                "mesh_shape='8x1' did not collapse to the cached 1-D "
+                "data mesh object — M=1 must route through the "
+                "untouched 1-D programs"
+            )
+        r81 = _sb_reducer("vg", "logistic", True, 0, mesh=m81)
+        r1d = _sb_reducer("vg", "logistic", True, 0, mesh=m1d)
+        if r81 is not r1d:
+            failures.append(
+                "mesh_shape='8x1' minted a DISTINCT vg reducer — the "
+                "M=1 cache key (and with it the 1-D jaxpr) must be "
+                "byte-identical to the plain data-mesh program"
+            )
+
+        n3, d3 = 8_192, 64
+        X3 = rng.randn(n3, d3).astype(np.float32)
+        with config.set(stream_block_rows=512, stream_autotune=False,
+                        stream_mesh=0, mesh_shape="2x4"):
+            st3 = BlockStream((X3,), block_rows=512)
+            k3 = st3.resolve_superblock_k()
+            b3 = st3.n_blocks
+            if st3.sb_model_shards() != 4 or st3.sb_data_shards() != 2:
+                failures.append(
+                    f"2x4 stream staged at "
+                    f"{st3.sb_data_shards()}x{st3.sb_model_shards()} "
+                    f"(model_tile_reason={st3.model_tile_reason}) — "
+                    "the feature tiling did not engage"
+                )
+            PCA(n_components=8, svd_solver="randomized",
+                random_state=0).fit(X3)             # pass 1: warm
+            obs.counters_reset()
+            PCA(n_components=8, svd_solver="randomized",
+                random_state=0).fit(X3)
+            md_snap = obs.counters_snapshot()
+        md_dispatches = md_snap.get("superblock_dispatches", 0)
+        md_recompiles = md_snap.get("recompiles", 0)
+        # streamed randomized SVD is a FIXED pass plan: 1 moments pass
+        # + (n_iter+1)=3 range passes, each exactly ceil(n_blocks/K)
+        # super-block dispatches — the budget is EXACT
+        exp3 = 4 * math.ceil(b3 / max(k3, 1))
+        if md_dispatches != exp3:
+            failures.append(
+                f"2-D streamed PCA dispatched {md_dispatches} != "
+                f"4*ceil({b3}/{k3})={exp3} — one dispatch per "
+                "super-block per pass, NOT per shard/tile"
+            )
+        if md_recompiles > 0:
+            failures.append(
+                f"{md_recompiles} new XLA compiles after the warming "
+                "fit on the 2-D streamed PCA path"
+            )
+
+        n4, d4 = 8_192, 64
+        X4 = rng.randn(n4, d4).astype(np.float32)
+        y4 = (X4[:, 0] > 0).astype(np.float64)
+        with config.set(stream_block_rows=1024, stream_autotune=False,
+                        stream_mesh=0, mesh_shape="2x4"):
+            st4 = BlockStream((X4, y4.astype(np.float32)),
+                              block_rows=1024)
+            k4 = st4.resolve_superblock_k()
+            b4 = st4.n_blocks
+            LogisticRegression(solver="lbfgs", max_iter=5).fit(X4, y4)
+            obs.counters_reset()
+            LogisticRegression(solver="lbfgs", max_iter=5).fit(X4, y4)
+            md_glm_snap = obs.counters_snapshot()
+        md_glm_recompiles = md_glm_snap.get("recompiles", 0)
+        glm_disp = md_glm_snap.get("superblock_dispatches", 0)
+        per_pass = math.ceil(b4 / max(k4, 1))
+        if glm_disp <= 0 or glm_disp % per_pass:
+            failures.append(
+                f"feature-sharded GLM dispatched {glm_disp} — not a "
+                f"multiple of ceil({b4}/{k4})={per_pass} per pass"
+            )
+        if md_glm_recompiles:
+            failures.append(
+                f"{md_glm_recompiles} new XLA compiles after the "
+                "warming fit on the feature-sharded GLM path"
+            )
+        pl2 = {r["program"] for r in _plans.plans_snapshot()}
+        if not any(p.startswith("superblock.glm.")
+                   and p.endswith(".model_psum") for p in pl2):
+            failures.append(
+                "plans table missing the feature-sharded GLM programs "
+                "(superblock.glm.*.model_psum)"
+            )
+        if not any(p.startswith("superblock.pca.") for p in pl2):
+            failures.append(
+                "plans table missing the streamed PCA programs "
+                "(superblock.pca.*)"
+            )
+
     print(f"perf smoke: n_blocks={n_blocks} K={k} "
           f"dispatches_per_pass={dpp} (budget {budget}) "
           f"recompiles_after_pass1={recompiles} | sharded: "
@@ -437,7 +549,10 @@ def main():
           f"ladder_rungs={sp_rungs} | search: "
           f"rounds={sm.get('rounds')} dispatches={sm.get('dispatches')} "
           f"shards8={None if sh_search is None else sh_search.get('shards')}"
-          f" | plans: cross-client recompiles={pl_recompiles}")
+          f" | plans: cross-client recompiles={pl_recompiles}"
+          f" | mesh2d: pca_dispatches={md_dispatches} "
+          f"pca_recompiles={md_recompiles} "
+          f"glm_recompiles={md_glm_recompiles}")
     if failures:
         for f in failures:
             print(f"PERF SMOKE FAIL: {f}", file=sys.stderr)

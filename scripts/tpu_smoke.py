@@ -875,6 +875,94 @@ def main():
               f"recompiles=0, plans table rows={len(rows)}, "
               f"serving rungs {srow['rungs']}")
 
+    def mesh2d_round15():
+        """ISSUE 18 surfaces: 2-D ("data", "model") hybrid meshes on
+        real chips — hybrid-mesh bring-up, feature-sharded GLM parity
+        vs the 1-D path, streamed randomized PCA parity vs the
+        resident solve, and the Dx1 auto-degrade that keeps
+        single-slice attaches on the untouched 1-D programs. Degrades
+        to a 1-chip (or odd) attach like rounds 8-14."""
+        from dask_ml_tpu import config
+        from dask_ml_tpu.linear_model import LogisticRegression
+        from dask_ml_tpu.models.pca import PCA
+        from dask_ml_tpu.parallel.mesh import (
+            DATA_AXIS, MODEL_AXIS, data_shards, default_mesh,
+            mesh_str, model_shards, stream_data_mesh,
+        )
+
+        n_dev = len(jax.devices())
+        rng = np.random.RandomState(18)
+
+        # Dx1 auto-degrade: a trivial model axis must resolve to the
+        # SAME cached 1-D mesh object — single-slice attaches stay on
+        # the byte-identical 1-D programs
+        with config.set(stream_mesh=0, mesh_shape=f"{n_dev}x1"):
+            m_deg = stream_data_mesh()
+        assert m_deg is default_mesh(), (m_deg, default_mesh())
+        assert model_shards(m_deg) == 1
+
+        if n_dev < 2 or n_dev % 2:
+            print(f"    round-15: {n_dev} chip(s) — 1-D auto-degrade "
+                  "verified; 2-D bring-up needs an even multi-chip "
+                  "attach")
+            return
+
+        # hybrid-mesh bring-up: ("data", "model") axes over the real
+        # chips (multi-slice topologies route through
+        # create_hybrid_device_mesh inside device_mesh's topology
+        # arranging — DCN outer on the data axis, ICI inner)
+        with config.set(stream_mesh=0, mesh_shape="-1x2"):
+            m2 = stream_data_mesh()
+        assert m2.axis_names == (DATA_AXIS, MODEL_AXIS), m2.axis_names
+        assert model_shards(m2) == 2
+        assert data_shards(m2) == n_dev // 2
+        shape = mesh_str(m2)
+
+        # feature-sharded GLM parity vs the 1-D path
+        n, d = 32_768, 64
+        Xg = rng.randn(n, d).astype(np.float32)
+        yg = (Xg[:, 0] > 0).astype(np.float64)
+        fits = {}
+        for label, knobs in (
+            ("1d", dict(stream_mesh=1)),
+            ("2d", dict(stream_mesh=0, mesh_shape="-1x2")),
+        ):
+            with config.set(stream_block_rows=4096,
+                            stream_autotune=False, dtype="float32",
+                            **knobs):
+                fits[label] = LogisticRegression(
+                    solver="lbfgs", max_iter=15).fit(Xg, yg)
+        drift = np.abs(np.asarray(fits["2d"].coef_, np.float64)
+                       - np.asarray(fits["1d"].coef_, np.float64)).max()
+        assert drift <= 5e-4, drift
+
+        # streamed randomized PCA parity vs the resident solve
+        # (decaying spectrum so the range capture is well-posed)
+        u = np.linalg.qr(rng.standard_normal((4096, d)))[0]
+        v = np.linalg.qr(rng.standard_normal((d, d)))[0]
+        sv = 100.0 * (0.7 ** np.arange(d))
+        Xs = ((u * sv) @ v.T
+              + 0.01 * rng.standard_normal((4096, d))
+              + 1.5).astype(np.float32)
+        with config.set(stream_block_rows=512, stream_autotune=False,
+                        dtype="float32", stream_mesh=0,
+                        mesh_shape="-1x2"):
+            stp = PCA(n_components=8, svd_solver="randomized",
+                      random_state=0).fit(Xs)
+        res = PCA(n_components=8, svd_solver="full").fit(Xs)
+        np.testing.assert_allclose(
+            np.asarray(stp.singular_values_),
+            np.asarray(res.singular_values_), rtol=1e-3,
+        )
+        align = np.linalg.svd(
+            np.asarray(stp.components_, np.float64)
+            @ np.asarray(res.components_, np.float64).T,
+            compute_uv=False,
+        )
+        assert align.min() > 1 - 1e-4, align
+        print(f"    round-15: mesh {shape}, GLM 1-D/2-D coef drift "
+              f"{drift:.2e}, streamed PCA parity vs resident OK")
+
     passed = _load_state()
     for name, fn in [
         ("glm solvers x3 families", glms),
@@ -898,6 +986,7 @@ def main():
          sparse_stream_round12),
         ("round-13 streamed-cohort adaptive search", search_round13),
         ("round-14 execution plans (plans/)", plans_round14),
+        ("round-15 2-D hybrid meshes", mesh2d_round15),
     ]:
         results.append(run(name, fn, passed))
 

@@ -1,0 +1,360 @@
+"""ISSUE 18: 2-D ("data", "model") hybrid meshes.
+
+Mesh-resolution edge cases (``config.mesh_shape`` parsing, Dx1/1xM
+degenerate shapes, non-power-of-two pools, explicit-mesh override,
+cached-Mesh identity), feature-sharded GLM pass-level parity vs the
+1-D programs, the typed per-device byte-budget refusal the 2-D mesh
+lifts, and the streamed randomized SVD (PCA / TruncatedSVD) parity
+across mesh shapes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dask_ml_tpu import config
+from dask_ml_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    data_shards,
+    default_mesh,
+    device_mesh,
+    mesh_str,
+    model_shards,
+    parse_mesh_shape,
+    stream_data_mesh,
+)
+from dask_ml_tpu.parallel.streaming import BlockStream, StreamBudgetExceeded
+
+MESHES_2D = ("1x2", "2x2", "2x4")
+
+
+class TestMeshResolution:
+    def test_auto_forms_return_none(self):
+        for s in ("auto", "", "1d", None, "AUTO"):
+            assert parse_mesh_shape(s, 8) is None
+
+    def test_bare_and_dxm_forms(self):
+        assert parse_mesh_shape("8", 8) == (8, 1)
+        assert parse_mesh_shape("4", 8) == (4, 1)
+        assert parse_mesh_shape("2x4", 8) == (2, 4)
+        assert parse_mesh_shape("1x4", 8) == (1, 4)
+        # D*M may undershoot the pool (first D*M devices are used)
+        assert parse_mesh_shape("2x2", 8) == (2, 2)
+
+    def test_inferred_axis(self):
+        assert parse_mesh_shape("-1x2", 8) == (4, 2)
+        assert parse_mesh_shape("4x-1", 8) == (4, 2)
+        assert parse_mesh_shape("-1x2", 6) == (3, 2)
+
+    @pytest.mark.parametrize("bad", [
+        "5x3",      # needs 15 devices, have 8
+        "0x2",      # axes must be >= 1
+        "-1x-1",    # only one axis may be inferred
+        "-1x3",     # 8 % 3 != 0: data axis not inferable
+        "axb",      # not integers
+        "2x3x4",    # too many axes
+    ])
+    def test_rejects_bad_shapes(self, bad):
+        with pytest.raises(ValueError):
+            parse_mesh_shape(bad, 8)
+
+    def test_dx1_collapses_to_cached_default_mesh(self):
+        """A trivial model axis must resolve to the SAME cached 1-D
+        Mesh object as "auto" — the lru'd scan programs key on the mesh,
+        so identity here IS jaxpr byte-identity of the 1-D programs."""
+        with config.set(stream_mesh=0, mesh_shape="8x1"):
+            m81 = stream_data_mesh()
+        with config.set(stream_mesh=0, mesh_shape="auto"):
+            m1d = stream_data_mesh()
+        assert m81 is m1d
+        assert m81 is default_mesh()
+        assert mesh_str(m81) == "8x1"
+        assert model_shards(m81) == 1
+
+    def test_m1_reducer_identity(self):
+        """mesh_shape="8x1" and "auto" must hand the GLM reducer cache
+        the same key — the same compiled program object comes back, so
+        the 1-D jaxprs are byte-identical by construction."""
+        from dask_ml_tpu.models.solvers.streamed import _sb_reducer
+
+        with config.set(stream_mesh=0, mesh_shape="8x1"):
+            m81 = stream_data_mesh()
+        with config.set(stream_mesh=0, mesh_shape="auto"):
+            m1d = stream_data_mesh()
+        r81 = _sb_reducer("vg", "logistic", True, 0, mesh=m81)
+        r1d = _sb_reducer("vg", "logistic", True, 0, mesh=m1d)
+        assert r81 is r1d
+        assert r81.program_name == "superblock.glm.vg.psum"
+
+    def test_1xm_degenerate_shape(self):
+        with config.set(stream_mesh=0, mesh_shape="1x4"):
+            m = stream_data_mesh()
+        assert data_shards(m) == 1
+        assert model_shards(m) == 4
+        assert mesh_str(m) == "1x4"
+
+    def test_non_power_of_two_pool(self):
+        """stream_mesh=6 restricts the pool to 6 devices; "3x2" (and
+        the inferred "-1x2") shape it as a 3x2 hybrid mesh."""
+        for shape in ("3x2", "-1x2"):
+            with config.set(stream_mesh=6, mesh_shape=shape):
+                m = stream_data_mesh()
+            assert data_shards(m) == 3
+            assert model_shards(m) == 2
+            assert m.devices.size == 6
+
+    def test_cached_mesh_identity(self):
+        """Every BlockStream of a fit must see the SAME Mesh object
+        (the scan-program lru keys carry the mesh)."""
+        with config.set(stream_mesh=0, mesh_shape="2x4"):
+            a = stream_data_mesh()
+            b = stream_data_mesh()
+        assert a is b
+
+    def test_explicit_mesh_override_beats_config(self):
+        explicit = device_mesh((2, 2), (DATA_AXIS, MODEL_AXIS),
+                               devices=jax.devices()[:4])
+        X = np.zeros((64, 8), np.float32)
+        with config.set(stream_mesh=0, mesh_shape="2x4"):
+            s = BlockStream((X,), block_rows=16, mesh=explicit)
+        assert s.mesh is explicit
+        assert s.sb_data_shards() == 2
+        assert s.sb_model_shards() == 2
+
+    def test_indivisible_d_degrades_with_reason(self):
+        """d=10 doesn't tile over M=4: the stream stays model-unsharded
+        (replicated X over the model axis) and records why."""
+        X = np.zeros((64, 10), np.float32)
+        with config.set(stream_mesh=0, mesh_shape="2x4"):
+            s = BlockStream((X,), block_rows=16)
+        assert s.sb_model_shards() == 1
+        assert "d-not-divisible" in str(s.model_tile_reason)
+
+
+def _glm_objective(stream, n, d):
+    from dask_ml_tpu.models.solvers.streamed import StreamedObjective
+
+    return StreamedObjective(
+        stream, n, jnp.asarray(0.1, jnp.float32), jnp.ones(d + 1),
+        0.5, "logistic", "l2", True,
+    )
+
+
+class TestFeatureShardedGLM:
+    def _xy(self, n=2300, d=8, seed=0):
+        rng = np.random.RandomState(seed)
+        X = rng.randn(n, d).astype(np.float32)
+        y = (X @ rng.randn(d) > 0).astype(np.float32)
+        return X, y
+
+    @pytest.mark.parametrize("shape", MESHES_2D)
+    def test_pass_level_parity(self, shape):
+        """The feature-sharded objective passes must match the 1-D
+        single-device programs at a FIXED beta to 1e-6 — same math,
+        psums reassociate the sums."""
+        n, d = 2300, 8
+        X, y = self._xy(n, d)
+        beta = np.random.RandomState(3).randn(d + 1)
+        with config.set(stream_block_rows=1024, stream_mesh=1):
+            o = _glm_objective(BlockStream((X, y), block_rows=1024), n, d)
+            base = (*o.value_and_grad(beta),
+                    *o.value_and_grad_and_hess(beta))
+        with config.set(stream_block_rows=1024, stream_mesh=0,
+                        mesh_shape=shape):
+            s = BlockStream((X, y), block_rows=1024)
+            assert s.sb_model_shards() == int(shape.split("x")[1])
+            o2 = _glm_objective(s, n, d)
+            got = (*o2.value_and_grad(beta),
+                   *o2.value_and_grad_and_hess(beta))
+        for a, b in zip(base, got):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6, rtol=1e-6)
+
+    def test_model_psum_program_name(self):
+        from dask_ml_tpu.models.solvers.streamed import _sb_reducer
+
+        with config.set(stream_mesh=0, mesh_shape="2x4"):
+            m = stream_data_mesh()
+        r = _sb_reducer("vg", "logistic", True, 0, mesh=m,
+                        model_shards=4)
+        assert r.program_name == "superblock.glm.vg.model_psum"
+
+    def test_fit_level_parity(self):
+        """A full lbfgs solve accumulates per-pass 1e-6 parity over
+        many iterations — compare the fitted coefs relatively."""
+        from dask_ml_tpu.linear_model import LogisticRegression
+
+        X, y = self._xy(4096, 8, seed=1)
+        fits = {}
+        for label, knobs in (
+            ("1d", dict(stream_mesh=1)),
+            ("2x4", dict(stream_mesh=0, mesh_shape="2x4")),
+        ):
+            with config.set(stream_block_rows=1024, **knobs):
+                fits[label] = LogisticRegression(
+                    solver="lbfgs", max_iter=15
+                ).fit(X.astype(np.float64), y.astype(np.float64))
+        np.testing.assert_allclose(fits["2x4"].coef_, fits["1d"].coef_,
+                                   atol=5e-4, rtol=5e-4)
+
+    def test_budget_refusal_lifted_by_2d_mesh(self):
+        """The wide-d fit a 1-D stage refuses under the simulated
+        per-device byte budget (typed StreamBudgetExceeded) completes
+        once mesh_shape adds the model axis — the X slabs then stage
+        as (rows/D, d/M) per-device tiles."""
+        from dask_ml_tpu.linear_model import LogisticRegression
+
+        rng = np.random.RandomState(7)
+        n, d = 2048, 512
+        X = rng.randn(n, d).astype(np.float64)
+        y = (X[:, 0] > 0).astype(np.float64)
+        budget = 1_000_000    # 1-D stages ~4.2MB/device; 2x4 ~0.5MB
+        with config.set(stream_block_rows=512, stream_mesh=1,
+                        stream_device_byte_budget=budget):
+            with pytest.raises(StreamBudgetExceeded) as ei:
+                LogisticRegression(solver="lbfgs", max_iter=3).fit(X, y)
+            assert "mesh_shape" in str(ei.value)
+        with config.set(stream_block_rows=512, stream_mesh=0,
+                        mesh_shape="2x4",
+                        stream_device_byte_budget=budget):
+            clf = LogisticRegression(solver="lbfgs", max_iter=3).fit(X, y)
+        assert np.asarray(clf.coef_).reshape(-1).shape == (d,)
+
+
+def _spectrum_data(n=4096, d=64, seed=0):
+    """Data with a decaying spectrum so randomized SVD is well-posed."""
+    rng = np.random.default_rng(seed)
+    u = np.linalg.qr(rng.normal(size=(n, d)))[0]
+    v = np.linalg.qr(rng.normal(size=(d, d)))[0]
+    s = 100.0 * (0.7 ** np.arange(d))
+    X = (u * s) @ v.T + 0.01 * rng.normal(size=(n, d))
+    return (X + 1.5).astype(np.float32)
+
+
+class TestStreamedRandomizedPCA:
+    @pytest.mark.parametrize("shape", MESHES_2D)
+    def test_parity_vs_1d_streamed(self, shape):
+        from dask_ml_tpu.models.pca import PCA
+
+        X = _spectrum_data()
+        fits = {}
+        for label, knobs in (
+            ("1d", dict(stream_mesh=1)),
+            (shape, dict(stream_mesh=0, mesh_shape=shape)),
+        ):
+            with config.set(stream_block_rows=512, **knobs):
+                fits[label] = PCA(n_components=8,
+                                  svd_solver="randomized",
+                                  random_state=0).fit(X)
+        a, b = fits[shape], fits["1d"]
+        np.testing.assert_allclose(a.components_, b.components_,
+                                   atol=1e-6)
+        np.testing.assert_allclose(a.singular_values_,
+                                   b.singular_values_, rtol=1e-6)
+        np.testing.assert_allclose(a.mean_, b.mean_, atol=1e-6)
+        np.testing.assert_allclose(a.explained_variance_ratio_,
+                                   b.explained_variance_ratio_,
+                                   atol=1e-6)
+
+    def test_parity_vs_resident(self):
+        from dask_ml_tpu.models.pca import PCA
+
+        X = _spectrum_data()
+        with config.set(stream_block_rows=512, stream_mesh=0,
+                        mesh_shape="2x4"):
+            st = PCA(n_components=8, svd_solver="randomized",
+                     random_state=0).fit(X)
+        res = PCA(n_components=8, svd_solver="full").fit(X)
+        np.testing.assert_allclose(st.singular_values_,
+                                   res.singular_values_, rtol=1e-4)
+        np.testing.assert_allclose(st.explained_variance_ratio_,
+                                   res.explained_variance_ratio_,
+                                   atol=1e-5)
+        # subspace alignment: the principal angles between the streamed
+        # and resident top-8 subspaces must be ~0
+        align = np.linalg.svd(
+            np.asarray(st.components_, np.float64)
+            @ np.asarray(res.components_, np.float64).T,
+            compute_uv=False,
+        )
+        np.testing.assert_allclose(align, 1.0, atol=1e-5)
+
+    def test_transform_matches_resident(self):
+        from dask_ml_tpu.models.pca import PCA
+
+        X = _spectrum_data(n=2048)
+        with config.set(stream_block_rows=512, stream_mesh=0,
+                        mesh_shape="2x4"):
+            st = PCA(n_components=4, svd_solver="randomized",
+                     random_state=0).fit(X)
+            sc_stream = np.asarray(st.transform(X))
+        sc_host = (X - st.mean_) @ np.asarray(st.components_).T
+        np.testing.assert_allclose(sc_stream, sc_host, atol=1e-3)
+
+    def test_wide_auto_routes_randomized(self, monkeypatch):
+        """svd_solver="auto" beyond the Gram width threshold must take
+        the randomized streamed path instead of the d x d Gram."""
+        from dask_ml_tpu.models import streamed_svd
+        from dask_ml_tpu.models.pca import PCA
+
+        monkeypatch.setattr(streamed_svd, "STREAM_GRAM_MAX_D", 32)
+        X = _spectrum_data(n=2048, d=64)
+        with config.set(stream_block_rows=512, stream_mesh=0,
+                        mesh_shape="2x4"):
+            p = PCA(n_components=4, svd_solver="auto",
+                    random_state=0).fit(X)
+        # the randomized route records its fixed pass plan
+        assert p.training_profile_ is not None
+        assert p.components_.shape == (4, 64)
+        res = PCA(n_components=4, svd_solver="full").fit(X)
+        np.testing.assert_allclose(p.singular_values_,
+                                   res.singular_values_, rtol=1e-4)
+
+
+class TestStreamedTruncatedSVD:
+    def test_parity_vs_1d_streamed_and_resident_evr(self):
+        from dask_ml_tpu.models.pca import TruncatedSVD
+
+        X = _spectrum_data()
+        fits = {}
+        for label, knobs in (
+            ("1d", dict(stream_mesh=1)),
+            ("2x4", dict(stream_mesh=0, mesh_shape="2x4")),
+        ):
+            with config.set(stream_block_rows=512, **knobs):
+                fits[label] = TruncatedSVD(
+                    n_components=8, algorithm="randomized",
+                    random_state=0,
+                ).fit(X)
+        np.testing.assert_allclose(fits["2x4"].components_,
+                                   fits["1d"].components_, atol=1e-6)
+        res = TruncatedSVD(n_components=8, algorithm="randomized",
+                           random_state=0).fit(X)
+        np.testing.assert_allclose(
+            fits["2x4"].explained_variance_ratio_,
+            res.explained_variance_ratio_, atol=1e-3,
+        )
+
+    def test_streamed_requires_randomized(self):
+        from dask_ml_tpu.models.pca import TruncatedSVD
+
+        X = _spectrum_data(n=1024)
+        with config.set(stream_block_rows=256, stream_mesh=0,
+                        mesh_shape="2x4"):
+            with pytest.raises(ValueError, match="randomized"):
+                TruncatedSVD(n_components=4, algorithm="tsqr").fit(X)
+
+    def test_streamed_transform_shape(self):
+        from dask_ml_tpu.models.pca import TruncatedSVD
+
+        X = _spectrum_data(n=1024)
+        with config.set(stream_block_rows=256, stream_mesh=0,
+                        mesh_shape="2x4"):
+            tsvd = TruncatedSVD(n_components=4, algorithm="randomized",
+                                random_state=0)
+            sc = np.asarray(tsvd.fit_transform(X))
+        assert sc.shape == (1024, 4)
+        host = X @ np.asarray(tsvd.components_).T
+        np.testing.assert_allclose(sc, host, atol=1e-3)
