@@ -335,6 +335,7 @@ def run():
         _try(_bench_plan_warm_start, jax, on_tpu, n_chips)
         _try(_bench_request_trace, jax, on_tpu, n_chips)
         _try(_bench_federation, jax, on_tpu, n_chips)
+        _try(_bench_fleet_observability, jax, on_tpu, n_chips)
     result["extra_metrics"] = extras
     # every successful metric also APPENDS to BENCH_floors.jsonl (run
     # marker + one kind="bench_metric" record each; the file is never
@@ -2306,6 +2307,162 @@ def _bench_federation(jax, on_tpu, n_chips):
     with MetricsLogger(metrics_file) as _lg:
         for e in entries:
             _lg.log(kind="bench_federation", **e)
+    return entries
+
+
+def _bench_fleet_observability(jax, on_tpu, n_chips):
+    """Fleet observability section (ISSUE 19): what the fleet-scope
+    planes cost, measured.
+
+    - ``federated_scrape_seconds`` — one router poll tick with the
+      metrics federator riding it: both processes' /status docs
+      fetched (the SAME scrape routing uses — no second read), every
+      counter/gauge/histogram folded into the fleet registry. This is
+      the periodic off-path cost of ``obs_fleet_federate=True``.
+    - ``federated_tracing_overhead_ratio`` — the same warmed
+      closed-loop ragged mix through the ROUTER with the whole fleet
+      plane on (trace propagation + per-leg continuation + federation)
+      vs the all-defaults router. Host-side Python against this CPU
+      backend's sub-ms steps is an adversarial denominator (same
+      framing as ``request_trace_overhead_ratio``) — criterion >= 0.97
+      on TPU, >= 0.60 here, floor-sentinel guarded."""
+    import threading as _threading
+    import time
+
+    from dask_ml_tpu import config
+    from dask_ml_tpu.datasets import make_classification
+    from dask_ml_tpu.linear_model import LogisticRegression
+    from dask_ml_tpu.observability import traces_reset
+    from dask_ml_tpu.serving import (
+        BucketLadder,
+        FederatedFleet,
+        FleetServer,
+        LocalEndpoint,
+    )
+
+    d = 32
+    n = 20_000
+    X, y = make_classification(n_samples=n, n_features=d,
+                               n_informative=d // 4, random_state=0)
+    clf = LogisticRegression(solver="lbfgs", max_iter=20).fit(X, y)
+    Xh = X.to_numpy().astype(np.float32)
+
+    rng = np.random.RandomState(23)
+    n_requests = 400
+    sizes = np.maximum(np.exp(
+        rng.uniform(0, np.log(256), size=n_requests)
+    ).astype(int), 1)
+    offs = [int(rng.randint(0, n - s)) for s in sizes]
+    requests = [Xh[i:i + int(s)] for s, i in zip(sizes, offs)]
+    total_rows = int(sizes.sum())
+    n_clients = 8
+    shares = [list(range(c, n_requests, n_clients))
+              for c in range(n_clients)]
+    ladder = BucketLadder(8, 512, 2.0)
+
+    def drive(fed):
+        def client(c):
+            for i in shares[c]:
+                fed.predict(requests[i])
+
+        threads = [_threading.Thread(target=client, args=(c,))
+                   for c in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0
+
+    def build(on):
+        # federation + tracing captured at construction (the trace
+        # gate and worker config are construction-time state)
+        overrides = {"obs_drift": False}
+        if on:
+            overrides.update(obs_trace_sample=1.0, obs_trace_keep=64,
+                             obs_fleet_federate=True)
+        with config.set(**overrides):
+            f0 = FleetServer(clf, name=f"fobs{int(on)}", replicas=1,
+                             ladder=ladder, batch_window_ms=1.0,
+                             timeout_ms=0).warmup().start()
+            f1 = FleetServer(clf, name=f"fobs{int(on)}", replicas=1,
+                             ladder=ladder, batch_window_ms=1.0,
+                             timeout_ms=0).warmup().start()
+            fed = FederatedFleet(
+                [LocalEndpoint(f0, "p0"), LocalEndpoint(f1, "p1")],
+                name=f"fobs{int(on)}", ladder=ladder, poll_s=3600.0,
+            ).start()
+        return fed, (f0, f1)
+
+    fed_off, fleets_off = build(False)
+    fed_on, fleets_on = build(True)
+    try:
+        # the scrape tick, isolated: min over repeats (µs-ms scale)
+        scrapes = []
+        for _ in range(20):
+            t0 = time.perf_counter()
+            fed_on._poll_once()
+            scrapes.append(time.perf_counter() - t0)
+        scrape_s = min(scrapes)
+
+        # interleaved passes, each mode's best (shared-box confound
+        # control, same as the request-trace section)
+        drive(fed_off)                   # warm passes
+        drive(fed_on)
+        t_offs, t_ons = [], []
+        for _ in range(4):
+            t_offs.append(drive(fed_off))
+            t_ons.append(drive(fed_on))
+        off_s, on_s = min(t_offs), min(t_ons)
+    finally:
+        for fed, fleets in ((fed_off, fleets_off), (fed_on, fleets_on)):
+            fed.stop()
+            for f in fleets:
+                try:
+                    f.stop(drain=False)
+                except Exception:
+                    pass
+    traces_reset()                       # no sampler state leaks
+    ratio = off_s / on_s                 # >= 1.0 means no overhead
+    thresh = 0.97 if on_tpu else 0.60
+    common = {
+        "backend": jax.default_backend(),
+        "dtype": "float32",
+        "processes": 2,
+        "n_requests": n_requests,
+        "total_rows": total_rows,
+    }
+    entries = [
+        {
+            **common,
+            "metric": "federated_scrape_seconds",
+            "value": round(scrape_s, 6),
+            "unit": "s",
+            "criterion": "off-path: one poll tick scrapes + merges "
+                         "both processes' full telemetry",
+            "scrapes_s": [round(s, 6) for s in scrapes[:5]],
+        },
+        {
+            **common,
+            "metric": "federated_tracing_overhead_ratio",
+            "value": round(ratio, 4),
+            "unit": "ratio",
+            "criterion": f">= {thresh} (router + 2-leg trace "
+                         "continuation + federation vs all-defaults "
+                         "router; <= 3% on accelerator-scale steps)",
+            "criterion_met": bool(ratio >= thresh),
+            "rows_per_sec_plain": round(total_rows / off_s, 1),
+            "rows_per_sec_observed": round(total_rows / on_s, 1),
+        },
+    ]
+    from dask_ml_tpu.observability import MetricsLogger
+
+    metrics_file = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_metrics.jsonl"
+    )
+    with MetricsLogger(metrics_file) as _lg:
+        for e in entries:
+            _lg.log(kind="bench_fleet_observability", **e)
     return entries
 
 

@@ -296,6 +296,25 @@ class Config:
     # sampled traces retained in memory for /traces, /status and the
     # report CLI (a bounded deque; oldest sampled traces fall off)
     obs_trace_keep: int = 256
+    # cross-process trace continuation (observability/_requests.py +
+    # serving/federation.py): the router's trace id rides federated
+    # submits as an X-Trace-Context header and the receiving process
+    # CONTINUES the same pid-prefixed id through its own stages, so one
+    # federated request joins into one Perfetto timeline. Only consulted
+    # when the trace plane is on (obs_trace_sample > 0); off = every
+    # process mints its own ids, pre-federation behavior
+    obs_trace_propagate: bool = True
+    # fleet metrics federation (observability/fleet.py): a
+    # FederatedFleet router folds every process's scraped counters/
+    # gauges/histograms into one fleet registry exposed on the router's
+    # own /metrics (dask_ml_tpu_fleet_* families) and /status/fleet.
+    # Off by default — no federator is built, no provider registers,
+    # and the router's exposition is byte-identical to pre-fleet
+    obs_fleet_federate: bool = False
+    # minimum seconds between fleet-metrics ingests; 0 = fold on every
+    # federation status poll (the federator RIDES the existing poller —
+    # it never starts a thread or issues its own /status reads)
+    obs_fleet_poll_s: float = 0.0
     # slow-span watchdog (observability/_watchdog.py): any span open past
     # this many seconds dumps all-thread tracebacks + device memory
     # gauges + the open-span stack to the trace sink, without touching

@@ -51,7 +51,15 @@ equivalent is this package (grown from the flat per-step logger in
   fit-progress publication via span-close observers, and a background
   HTTP exporter serving Prometheus ``/metrics``, ``/healthz`` and a
   JSON ``/status`` (open-span stack, report tables, serving windows,
-  watchdog stalls) while the run is still going.
+  watchdog stalls) while the run is still going;
+- ``fleet``     — fleet-scope metrics federation
+  (``config.obs_fleet_federate``): ``MetricsFederator`` rides the
+  federation status poller, folds every process's scraped counters/
+  gauges/histograms into one fleet registry (counters sum, gauges get
+  a ``{process=}`` label, histograms merge bucket-for-bucket), and
+  exposes it on the router's ``/metrics`` (``dask_ml_tpu_fleet_*``
+  families) and ``/status/fleet`` with a fleet-wide SLO burn-rate and
+  latched alerts.
 
 Everything is ambient and zero-overhead when disabled: no
 ``metrics_path``/``trace_dir`` configured means spans are no-ops and no
@@ -111,7 +119,8 @@ from ._programs import (
     programs_snapshot,
     track_program,
 )
-from ._hist import Histogram
+from ._hist import Histogram, merge_snapshots
+from .fleet import SLO_BURN_BUDGET, MetricsFederator
 from .sketch import CategoricalSketch, FeatureSketch, merge_profiles
 from ._spans import (
     NOOP_SPAN,
@@ -150,8 +159,11 @@ __all__ = [
     "CategoricalSketch",
     "FeatureSketch",
     "Histogram",
+    "MetricsFederator",
     "MetricsLogger",
+    "SLO_BURN_BUDGET",
     "merge_profiles",
+    "merge_snapshots",
     "NOOP_SPAN",
     "TelemetryServer",
     "Watchdog",

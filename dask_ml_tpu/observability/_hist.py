@@ -104,6 +104,40 @@ class Histogram:
         contract)."""
         return percentiles_from(self.snapshot(), qs)
 
+    def merge(self, other) -> "Histogram":
+        """Fold another histogram (or a snapshot-shaped dict — e.g. one
+        scraped off a remote process's /status) into this one:
+        bucket-wise count sums plus the sum/count/min/max fields, the
+        ``sketch.merge_profiles`` contract for latency distributions.
+        Exact, not approximate, BECAUSE the boundaries are fixed — two
+        histograms over the same 1-2-5 ladder merge bucket-for-bucket,
+        and the merged quantiles match pooling the raw observations to
+        within one bucket width (asserted by the property test in
+        tests/test_fleet_observability.py). Mismatched boundaries raise:
+        resampling across ladders would silently corrupt quantiles.
+        Returns ``self`` for chaining."""
+        snap = other.snapshot() if isinstance(other, Histogram) else other
+        bounds = tuple(float(b) for b in snap["bounds"])
+        if bounds != self.bounds:
+            raise ValueError(
+                f"cannot merge histograms with different bounds "
+                f"({len(bounds)} vs {len(self.bounds)} edges)"
+            )
+        counts = [int(c) for c in snap["counts"]]
+        if len(counts) != len(self._counts):
+            raise ValueError("snapshot counts length does not match")
+        mn, mx = snap.get("min"), snap.get("max")
+        with self._lock:
+            for i, c in enumerate(counts):
+                self._counts[i] += c
+            self._sum += float(snap["sum"])
+            self._count += int(snap["count"])
+            if mn is not None and float(mn) < self._min:
+                self._min = float(mn)
+            if mx is not None and float(mx) > self._max:
+                self._max = float(mx)
+        return self
+
 
 def percentiles_from(snap: dict, qs=(50, 99)) -> dict:
     """Quantiles from any snapshot-shaped dict (a :meth:`snapshot` or a
@@ -136,6 +170,22 @@ def percentiles_from(snap: dict, qs=(50, 99)) -> dict:
             min(max(value, snap["min"]), snap["max"])
         )
     return out
+
+
+def merge_snapshots(snaps) -> dict | None:
+    """Pool several snapshot-shaped dicts of ONE histogram family into
+    a merged snapshot (the fleet federator's bucket-for-bucket merge as
+    a standalone function, mirroring ``sketch.merge_profiles``). The
+    first snapshot's bounds win; later snapshots with different bounds
+    raise. None when ``snaps`` is empty."""
+    h = None
+    for snap in snaps:
+        if snap is None:
+            continue
+        if h is None:
+            h = Histogram(snap["bounds"])
+        h.merge(snap)
+    return h.snapshot() if h is not None else None
 
 
 def snapshot_delta(cur: dict, prev: dict | None) -> dict:

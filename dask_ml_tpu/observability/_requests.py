@@ -53,6 +53,7 @@ __all__ = [
     "new_trace",
     "replay",
     "tagging",
+    "trace_context",
     "traces_data",
     "traces_reset",
     "tracing_enabled",
@@ -138,6 +139,28 @@ def _pending_tags() -> dict:
     return out
 
 
+@contextlib.contextmanager
+def trace_context(trace_id):
+    """Thread-local pending trace id: traces created inside the block
+    CONTINUE ``trace_id`` instead of minting a fresh one. This is the
+    cross-process continuation primitive — the federation receive side
+    wraps its fleet submit in the router's X-Trace-Context id, so the
+    remote stages land on the SAME pid-prefixed trace the router
+    started (collision-free: the id was minted exactly once, at the
+    router, and no other trace in the receiving process can carry its
+    pid prefix)."""
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = int(trace_id)
+    try:
+        yield
+    finally:
+        _tls.ctx = prev
+
+
+def _pending_ctx():
+    return getattr(_tls, "ctx", None)
+
+
 class _ExemplarHist:
     """A :class:`Histogram` whose buckets each remember the most recent
     trace id that landed there — the exemplar a scraped quantile links
@@ -172,7 +195,8 @@ class RequestTrace:
                  "tags", "threads", "_finished")
 
     def __init__(self, method, n_rows, t_admit=None):
-        self.trace_id = next(_trace_ids)
+        ctx = _pending_ctx()
+        self.trace_id = next(_trace_ids) if ctx is None else int(ctx)
         self.method = str(method)
         self.n_rows = int(n_rows)
         self.t_unix = time.time()

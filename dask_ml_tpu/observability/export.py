@@ -151,6 +151,23 @@ def to_chrome_trace(records) -> dict:
             "args": {name: value},
         })
 
+    # cross-process trace joins: federation propagates one trace id
+    # through every process a request touches (router + worker, plus
+    # reroute survivors), so SEVERAL req_trace records can share an id.
+    # Order each id's legs by admit time and chain the flow: the very
+    # first leg starts ("s"), middles step ("t"), the very last
+    # terminates ("f") — one arrow threading router lane -> worker lane
+    # -> survivor lane on the Perfetto timeline.
+    req_groups = {}
+    for r in records:
+        if r.get("req_trace") and isinstance(r.get("trace_id"), int):
+            req_groups.setdefault(r["trace_id"], []).append(r)
+    flow_pos = {}
+    for rs in req_groups.values():
+        rs.sort(key=lambda r: _abs_time(r, origins))
+        for i, r in enumerate(rs):
+            flow_pos[id(r)] = (i == 0, i == len(rs) - 1, len(rs))
+
     for r in sorted(records, key=lambda r: _abs_time(r, origins)):
         t = ts(r)
         if r.get("drift"):
@@ -222,17 +239,23 @@ def to_chrome_trace(records) -> dict:
                     "dur": round(max(d_us, 0.0), 3),
                     "cat": "request", "args": args,
                 })
-            if isinstance(rid, int) and len(order) > 1:
-                events.append({
-                    "name": label, "ph": "s", "id": rid,
-                    "cat": "request", "pid": 1, "tid": tid_of(adm),
-                    "ts": round(t, 3),
-                })
-                events.append({
-                    "name": label, "ph": "f", "bp": "e", "id": rid,
-                    "cat": "request", "pid": 1, "tid": tid_of(wrk),
+            first, last, n_legs = flow_pos.get(id(r), (True, True, 1))
+            if isinstance(rid, int) and (len(order) > 1 or n_legs > 1):
+                start = {
+                    "name": label, "ph": "s" if first else "t",
+                    "id": rid, "cat": "request", "pid": 1,
+                    "tid": tid_of(adm), "ts": round(t, 3),
+                }
+                end = {
+                    "name": label, "ph": "f" if last else "t",
+                    "id": rid, "cat": "request", "pid": 1,
+                    "tid": tid_of(wrk),
                     "ts": round(t + float(st[order[-1]]) * 1e6, 3),
-                })
+                }
+                if last:
+                    end["bp"] = "e"
+                events.append(start)
+                events.append(end)
             continue
         if "span" in r:
             dur = float(r.get("wall_s", 0.0)) * 1e6

@@ -1,0 +1,287 @@
+"""Fleet-scope metrics federation: one registry over N fleet processes.
+
+PR 17's :class:`~dask_ml_tpu.serving.federation.FederatedFleet` routes
+requests over N fleet processes, each exposing its OWN ``/metrics`` /
+``/status`` — the dask.distributed dashboard question ("what is the
+fleet's p99 right now?") had no single answer. This module is that
+answer: a :class:`MetricsFederator` RIDES the federation status poller
+(it never starts a thread and never issues its own /status reads — the
+PR 6 windowed-cursor lesson: a second reader of a consume-on-read
+surface double-counts deltas, so the poller owns the one scrape per
+interval and hands the cached doc to both consumers) and folds every
+process's scraped telemetry into one fleet view:
+
+- **counters sum** — process-cumulative counters add across the fleet
+  (``dask_ml_tpu_fleet_serving_requests_total`` = the sum of every
+  process's ``serving_requests``);
+- **gauges get a ``{process=}`` label** — last-value signals (queue
+  depth, replica health, fit progress) keep per-process identity;
+- **histograms merge bucket-for-bucket** — every serving histogram
+  shares the fixed 1-2-5 ``_hist.DEFAULT_BOUNDS`` ladder, so the fleet
+  distribution is the EXACT bucket-wise sum (:meth:`Histogram.merge`)
+  and fleet quantiles match pooling the raw observations to within one
+  bucket width.
+
+The merged families render on the ROUTER's own ``/metrics`` under a
+``dask_ml_tpu_fleet_`` prefix (so they can never collide with — or
+double-count against — the router's local families) plus a JSON block
+on ``/status`` / ``/status/fleet``, via the provider hook the live
+exporter exposes (``live.register_fleet_provider``). Dead processes'
+series are DROPPED on the next ingest, never latched: each ingest
+replaces the whole per-process doc set, so a killed process's gauges
+vanish from the next scrape instead of freezing at their last value.
+
+Fleet SLO burn-rate: with ``config.serving_slo_ms`` set, each process
+counts ``serving_slo_violations``; the federator reads the fleet-wide
+violation fraction per ingest window against the
+:data:`SLO_BURN_BUDGET` error budget (the classic 1% — 99% of requests
+inside the SLO). A window burning faster than budget (rate > 1) LATCHES
+an alert: the alert ring survives the burn subsiding, because the
+operator who looks an hour later must still see that it happened.
+
+Zero-overhead contract: ``config.obs_fleet_federate`` off (the
+default) builds no federator, registers no provider, and leaves the
+router's exposition byte-identical; on, scraping stays pure host dicts
+— no jax import, no XLA compile, no device sync anywhere here.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from ._hist import Histogram
+
+__all__ = ["MetricsFederator", "SLO_BURN_BUDGET"]
+
+# fleet error budget: the violation fraction at which burn rate reads
+# 1.0 — the classic 99%-of-requests-inside-SLO target. A knob would be
+# ceremony until a second budget exists; the constant is the contract.
+SLO_BURN_BUDGET = 0.01
+
+# alerts kept after they fire (latched: subsiding burn never clears
+# them — only a fresh process / explicit reset does)
+_ALERT_KEEP = 8
+
+
+def _numeric(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+class MetricsFederator:
+    """The fleet registry: ingests per-process ``/status`` docs (cached
+    by the federation poller — ONE scrape per process per interval) and
+    renders the merged fleet families.
+
+    ``ingest(snapshots)`` takes ``[(process_id, doc_or_None), ...]``
+    where ``doc`` is the process's full /status JSON (None = the
+    process is dead this interval; its series drop immediately). The
+    live exporter calls :meth:`render_lines` (Prometheus text lines
+    appended to the router's /metrics) and :meth:`fleet_block` (the
+    ``/status/fleet`` JSON) through the provider registration.
+    """
+
+    def __init__(self, name="model", slo_ms=0.0, min_interval_s=0.0,
+                 budget=SLO_BURN_BUDGET):
+        self.name = str(name)
+        self._slo_ms = float(slo_ms)
+        self._min_interval = float(min_interval_s)
+        self._budget = float(budget)
+        self._lock = threading.Lock()
+        self._docs: dict[str, dict] = {}
+        self._t_ingest = 0.0            # monotonic, throttle clock
+        self._t_unix = None             # wall clock of last ingest
+        self._scrape_s = None
+        self._prev = None               # (violations, requests) totals
+        self._burn = 0.0
+        self._alerts: deque = deque(maxlen=_ALERT_KEEP)
+
+    # -- ingest (rides the federation poller) -----------------------------
+    def ingest(self, snapshots, scrape_s=None) -> bool:
+        """Fold one poll interval's cached docs into the fleet view.
+        Returns False when throttled by ``config.obs_fleet_poll_s`` —
+        dead processes still drop immediately on a throttled tick (a
+        stale latched series is exactly the failure mode this plane
+        exists to kill)."""
+        now = time.monotonic()
+        with self._lock:
+            if self._min_interval > 0 and self._t_ingest \
+                    and now - self._t_ingest < self._min_interval:
+                for pid, doc in snapshots:
+                    if doc is None:
+                        self._docs.pop(str(pid), None)
+                return False
+            self._t_ingest = now
+            self._t_unix = time.time()
+            if scrape_s is not None:
+                self._scrape_s = float(scrape_s)
+            # full replacement, not update: a process absent from this
+            # interval's snapshot list (retired endpoint) drops too
+            self._docs = {str(pid): doc for pid, doc in snapshots
+                          if doc is not None}
+            viol = req = 0
+            for doc in self._docs.values():
+                ctr = doc.get("counters") or {}
+                v, r = ctr.get("serving_slo_violations"), \
+                    ctr.get("serving_requests")
+                if _numeric(v):
+                    viol += int(v)
+                if _numeric(r):
+                    req += int(r)
+            if self._prev is not None:
+                # deltas clamped at 0: a process death makes the fleet
+                # totals non-monotonic, which is attrition, not recovery
+                dv = max(viol - self._prev[0], 0)
+                dr = max(req - self._prev[1], 0)
+                self._burn = (dv / dr) / self._budget if dr > 0 else 0.0
+                if self._burn > 1.0:
+                    self._alerts.append({
+                        "t_unix": round(self._t_unix, 3),
+                        "burn_rate": round(self._burn, 4),
+                        "violations": dv,
+                        "requests": dr,
+                        "budget": self._budget,
+                    })
+            self._prev = (viol, req)
+        return True
+
+    # -- merged views ------------------------------------------------------
+    def _merged_locked(self):
+        """(counters, gauges-by-family, hists-by-key) over the live
+        docs. Caller holds ``_lock``; everything returned is fresh
+        host data (no shared mutable state escapes)."""
+        counters: dict[str, float] = {}
+        gauges: dict[str, list] = {}
+        hists: dict[tuple, Histogram] = {}
+        for pid, doc in sorted(self._docs.items()):
+            for k, v in (doc.get("counters") or {}).items():
+                if _numeric(v):
+                    counters[str(k)] = counters.get(str(k), 0) + v
+            telem = doc.get("telemetry") or {}
+            for name, labels, v in telem.get("gauges") or ():
+                if not _numeric(v):
+                    continue
+                ls = tuple((str(k), str(val)) for k, val in labels)
+                gauges.setdefault(str(name), []).append(
+                    (ls + (("process", pid),), float(v))
+                )
+            for name, labels, snap in telem.get("histograms") or ():
+                key = (str(name),
+                       tuple((str(k), str(val)) for k, val in labels))
+                h = hists.get(key)
+                try:
+                    if h is None:
+                        hists[key] = h = Histogram(snap["bounds"])
+                    h.merge(snap)
+                except (ValueError, KeyError, TypeError):
+                    # mismatched ladders / malformed doc: skip the
+                    # series; a scrape must never 500 over one process
+                    continue
+        return counters, gauges, hists
+
+    def render_lines(self) -> list:
+        """Prometheus exposition lines for the merged fleet families,
+        every family under ``dask_ml_tpu_fleet_`` (one TYPE line per
+        family; a histogram family shadows a same-named gauge family,
+        the live exporter's own rule)."""
+        from .live import _PREFIX, _fmt, _labels_str, _merge_label, _san
+
+        with self._lock:
+            counters, gauges, hists = self._merged_locked()
+            n_procs = len(self._docs)
+            burn = self._burn
+            n_alerts = len(self._alerts)
+            scrape_s = self._scrape_s
+        pre = f"{_PREFIX}fleet_"
+        lines = []
+        for name in sorted(counters):
+            n = f"{pre}{_san(name)}_total"
+            lines.append(f"# TYPE {n} counter")
+            lines.append(f"{n} {_fmt(counters[name])}")
+        hist_fams = {_san(name) for name, _ in hists}
+        gauge_fams: dict[str, list] = {}
+        for name, series in sorted(gauges.items()):
+            if _san(name) not in hist_fams:
+                gauge_fams[_san(name)] = series
+        # the federator's own health gauges join the same family map so
+        # a scraped gauge can never mint a duplicate TYPE line
+        gauge_fams.setdefault("processes", []).append(((), n_procs))
+        gauge_fams.setdefault("slo_burn_rate", []).append(((), burn))
+        gauge_fams.setdefault("slo_alerts", []).append(((), n_alerts))
+        if scrape_s is not None:
+            gauge_fams.setdefault("scrape_seconds", []).append(
+                ((), scrape_s))
+        for name, series in gauge_fams.items():
+            n = f"{pre}{name}"
+            lines.append(f"# TYPE {n} gauge")
+            for labels, v in series:
+                lines.append(f"{n}{_labels_str(labels)} {_fmt(v)}")
+        hist_by_fam: dict[str, list] = {}
+        for (name, labels) in sorted(hists):
+            hist_by_fam.setdefault(_san(name), []).append(
+                (labels, hists[(name, labels)]))
+        for fam, series in hist_by_fam.items():
+            n = f"{pre}{fam}"
+            lines.append(f"# TYPE {n} histogram")
+            for labels, h in series:
+                snap = h.snapshot()
+                cum = 0
+                for i, bound in enumerate(snap["bounds"]):
+                    cum += snap["counts"][i]
+                    lines.append(
+                        f"{n}_bucket"
+                        f"{_merge_label(labels, 'le', _fmt(bound))} {cum}"
+                    )
+                cum += snap["counts"][-1]
+                lines.append(
+                    f"{n}_bucket"
+                    f"{_merge_label(labels, 'le', '+Inf')} {cum}"
+                )
+                ls = _labels_str(labels)
+                lines.append(f"{n}_sum{ls} {_fmt(snap['sum'])}")
+                lines.append(f"{n}_count{ls} {snap['count']}")
+        return lines
+
+    def fleet_block(self) -> dict:
+        """The ``/status/fleet`` JSON: scraped processes, summed
+        counters, merged histogram quantiles, and the SLO burn view
+        with its latched alerts."""
+        from .live import _labels_str
+
+        with self._lock:
+            counters, _, hists = self._merged_locked()
+            pids = sorted(self._docs)
+            burn = self._burn
+            alerts = list(self._alerts)
+            prev = self._prev
+            scrape_s = self._scrape_s
+            t_unix = self._t_unix
+        hblock = {}
+        for (name, labels), h in sorted(hists.items()):
+            pct = h.percentiles((50, 99))
+            hblock[f"{name}{_labels_str(labels)}"] = {
+                "count": h.count,
+                "sum": round(h.sum, 6),
+                "p50": None if pct["p50"] != pct["p50"]
+                else round(pct["p50"], 6),
+                "p99": None if pct["p99"] != pct["p99"]
+                else round(pct["p99"], 6),
+            }
+        return {
+            "federation": self.name,
+            "processes": pids,
+            "n_scraped": len(pids),
+            "counters": counters,
+            "histograms": hblock,
+            "slo": {
+                "slo_ms": self._slo_ms,
+                "budget": self._budget,
+                "violations": prev[0] if prev else 0,
+                "requests": prev[1] if prev else 0,
+                "burn_rate": round(burn, 4),
+                "alerts": alerts,
+            },
+            "scrape_seconds": scrape_s,
+            "t_scrape_unix": round(t_unix, 3) if t_unix else None,
+        }

@@ -65,8 +65,9 @@ __all__ = [
     "telemetry_server", "live_publishing", "gauge_set", "gauges_snapshot",
     "histogram", "histograms_snapshot", "drop_labeled_series",
     "render_prometheus",
-    "status_data", "publish_progress", "note_stall", "register_server",
-    "unregister_server", "register_registry",
+    "status_data", "fleet_status_data", "publish_progress", "note_stall",
+    "register_server", "unregister_server", "register_registry",
+    "register_fleet_provider", "unregister_fleet_provider",
 ]
 
 _PREFIX = "dask_ml_tpu_"
@@ -153,6 +154,43 @@ def unregister_server(srv) -> None:
         _server_set().discard(srv)
     except Exception:
         pass
+
+
+# fleet-metrics providers (observability/fleet.MetricsFederator,
+# registered by a FederatedFleet router with obs_fleet_federate on):
+# each contributes merged dask_ml_tpu_fleet_* exposition lines to
+# /metrics and a JSON block to /status + /status/fleet. Strong refs on
+# purpose — the fed's stop() unregisters; a weak set could drop the
+# provider mid-scrape
+_fleet_providers: list = []
+
+
+def register_fleet_provider(provider) -> None:
+    """A MetricsFederator (or anything with ``render_lines()`` +
+    ``fleet_block()``) joins the router's own exposition."""
+    with _lock:
+        if provider not in _fleet_providers:
+            _fleet_providers.append(provider)
+
+
+def unregister_fleet_provider(provider) -> None:
+    with _lock:
+        try:
+            _fleet_providers.remove(provider)
+        except ValueError:
+            pass
+
+
+def fleet_status_data() -> dict:
+    """The combined ``/status/fleet`` block ({} when no federator is
+    registered — federation of telemetry is off by default)."""
+    out = {}
+    for p in list(_fleet_providers):
+        try:
+            out.update(p.fleet_block())
+        except Exception:
+            continue
+    return out
 
 
 def _admit_series_locked(name: str, labels: tuple) -> bool:
@@ -251,6 +289,7 @@ def metrics_reset() -> None:
         _dropped_series.clear()
         _recent_spans.clear()
         _recent_stalls.clear()
+        del _fleet_providers[:]
 
 
 # -- publishers --------------------------------------------------------------
@@ -409,6 +448,14 @@ def render_prometheus() -> str:
             ls = _labels_str(labels)
             lines.append(f"{n}_sum{ls} {_fmt(snap['sum'])}")
             lines.append(f"{n}_count{ls} {snap['count']}")
+    # fleet-merged families (dask_ml_tpu_fleet_*, a disjoint namespace
+    # — one TYPE line per family holds across the whole page) from any
+    # registered federator; a provider error must never 500 the scrape
+    for p in list(_fleet_providers):
+        try:
+            lines.extend(p.render_lines())
+        except Exception:
+            continue
     up = f"{_PREFIX}uptime_seconds"
     lines.append(f"# TYPE {up} gauge")
     lines.append(f"{up} {_fmt(time.time() - _T0)}")
@@ -494,6 +541,22 @@ def status_data() -> dict:
         reliability_block = _rel_status()
     except Exception:
         reliability_block = {}
+    # the structured telemetry block the fleet federator merges from:
+    # gauges and RAW histogram buckets as [name, labels, payload]
+    # triples (the display "gauges"/"histograms" blocks bake labels
+    # into string keys — fine to read, lossy to re-parse). Bounds ride
+    # each histogram so the bucket-for-bucket merge can refuse a
+    # mismatched ladder instead of corrupting quantiles.
+    telem_g = [[n, [list(kv) for kv in ls], v]
+               for (n, ls), v in sorted(gauges_snapshot().items())]
+    telem_h = []
+    for (name, labels), h in sorted(histograms_snapshot().items()):
+        snap = h.snapshot()
+        telem_h.append([name, [list(kv) for kv in labels], {
+            "bounds": list(snap["bounds"]), "counts": snap["counts"],
+            "sum": snap["sum"], "count": snap["count"],
+            "min": snap["min"], "max": snap["max"],
+        }])
     out = {
         "pid": os.getpid(),
         "t_unix": round(now, 3),
@@ -503,6 +566,7 @@ def status_data() -> dict:
         "gauges": {f"{n}{_labels_str(ls)}": v
                    for (n, ls), v in gauges_snapshot().items()},
         "histograms": hists,
+        "telemetry": {"gauges": telem_g, "histograms": telem_h},
         "serving": serving,
         "registry": registry,
         "drift": drift_block,
@@ -516,6 +580,9 @@ def status_data() -> dict:
         out["device_memory"] = device_memory_gauges()
     except Exception:
         out["device_memory"] = {}
+    fleet = fleet_status_data()
+    if fleet:
+        out["fleet"] = fleet
     return out
 
 
@@ -594,6 +661,16 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                      + "\n").encode(),
                     "application/json",
                 )
+            elif path == "/status/fleet":
+                # the fleet-scope view alone ({} until a federating
+                # router registers): merged counters/quantiles + the
+                # SLO burn block, without the full /status payload
+                self._reply(
+                    200,
+                    (json.dumps(fleet_status_data(),
+                                default=_json_default) + "\n").encode(),
+                    "application/json",
+                )
             elif path == "/status":
                 # default=: span attrs can carry numpy scalars (a fit's
                 # n_iter etc.) — degrade them to floats/strings instead
@@ -608,7 +685,7 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 self._reply(
                     200,
                     b"dask_ml_tpu live telemetry: "
-                    b"/metrics /status /traces /healthz\n",
+                    b"/metrics /status /status/fleet /traces /healthz\n",
                     "text/plain; charset=utf-8",
                 )
             else:

@@ -8,6 +8,9 @@ Usage::
     python -m dask_ml_tpu.observability.report trace.jsonl --perfetto out.json
     python -m dask_ml_tpu.observability.report --merge a.jsonl b.jsonl ...
     python -m dask_ml_tpu.observability.report trace.jsonl --slowest 20
+    python -m dask_ml_tpu.observability.report --watch http://host:9100
+    python -m dask_ml_tpu.observability.report --watch URL --interval 5
+    python -m dask_ml_tpu.observability.report --watch URL --once
 
 Reads the records the subsystem emits — span records (``span`` field),
 per-step solver/search records (``component`` field), stream-pass
@@ -25,12 +28,21 @@ machine-readable JSON object; ``--perfetto`` converts the span tree to
 Chrome-trace JSON for ``ui.perfetto.dev`` (see ``export.py``). The
 point (ISSUE 1/4): a recorded round's JSONL answers "where did this
 fit spend its time, FLOPs and HBM" without re-running anything.
+
+``--watch URL`` flips the CLI from post-hoc to LIVE: it polls a live
+telemetry server's ``/status`` (whose ``report`` block is already
+``report_data``-shaped) and ``/traces`` every ``--interval`` seconds
+(default 2) and re-renders the same tables in place — programs,
+serving windows, fleet federation, request traces — the top(1) of a
+serving process. ``--once`` prints a single frame and exits (CI).
 """
 
 from __future__ import annotations
 
 import json
 import sys
+import time
+import urllib.request
 
 # the metric each component's convergence trajectory is read from, in
 # preference order (first key present in its step records wins)
@@ -530,10 +542,19 @@ def build_report(records, path="<records>", slowest=10):
     """The full report as one string (the CLI prints it; tests assert on
     it). ``slowest`` caps the traces table at the N slowest sampled
     traces (``report ... --slowest N``)."""
-    data = report_data(records)
-    lines = [f"run report: {path}  ({len(records)} records)", ""]
+    return render_report(report_data(records), path=path,
+                         slowest=slowest)
+
+
+def render_report(data, path="<records>", slowest=10):
+    """Render a ``report_data``-shaped dict as the report tables — the
+    shared back half of :func:`build_report` (post-hoc JSONL) and the
+    ``--watch`` live mode (a scraped ``/status`` ``report`` block is the
+    same shape, so the live view and the CLI agree by construction)."""
+    lines = [f"run report: {path}  ({data.get('records') or 0} "
+             f"records)", ""]
     span_rows = []
-    for row in data["spans"]:
+    for row in data.get("spans") or []:
         span_rows.append((
             row["span"], row["count"], _fmt_seconds(row["wall_s"]),
             _fmt_seconds(row["sync_s"]),
@@ -546,11 +567,11 @@ def build_report(records, path="<records>", slowest=10):
                      "mfu"),
                     span_rows)
     comp_rows = [(c["component"], c["records"], c["steps"],
-                  c["convergence"]) for c in data["components"]]
+                  c["convergence"]) for c in data.get("components") or []]
     lines += _table("per-step telemetry",
                     ("component", "records", "steps", "convergence"),
                     comp_rows)
-    st = data["streaming"]
+    st = data.get("streaming")
     if st:
         lines += _table(
             "streaming overlap",
@@ -563,7 +584,7 @@ def build_report(records, path="<records>", slowest=10):
               _fmt_seconds(st["put_s"]), _fmt_seconds(st["wait_s"]),
               _fmt_seconds(st["consume_s"]))],
         )
-    dr = data["drift"]
+    dr = data.get("drift") or {"scores": [], "canaries": []}
     if dr["scores"]:
         lines += _table(
             "drift (train vs serve / window vs window)",
@@ -617,9 +638,9 @@ def build_report(records, path="<records>", slowest=10):
               ", ".join(f"{k}:{v}" for k, v in
                         sorted(cap["by_method"].items())))],
         )
-    progs = data["programs"]
+    progs = data.get("programs") or []
     if progs:
-        peak = data["peak"]
+        peak = data.get("peak")
         total_peak = (peak["flop_per_s_per_chip"] * peak["n_chips"]
                       if peak else None)
         # per-program exec_s is host-side DISPATCH time: honest on the
@@ -685,7 +706,7 @@ def build_report(records, path="<records>", slowest=10):
               p.get("rungs"), p.get("warmups"), p.get("warm_hits"))
              for p in plans],
         )
-    stalls = data["watchdog_stalls"]
+    stalls = data.get("watchdog_stalls") or []
     if stalls:
         lines += _table(
             "watchdog stalls",
@@ -701,7 +722,7 @@ def build_report(records, path="<records>", slowest=10):
             ("counter", "total"),
             [(r["counter"], r["total"]) for r in rel],
         )
-    ctr = data["counters"]
+    ctr = data.get("counters") or {}
     if ctr:
         rows = []
         for k in sorted(ctr):
@@ -718,6 +739,80 @@ def build_report(records, path="<records>", slowest=10):
     return "\n".join(lines).rstrip() + "\n"
 
 
+# -- live watch mode (report --watch URL) ------------------------------------
+
+def _fetch_json(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _watch_frame(url, slowest=10, timeout=5.0):
+    """One rendered frame of the live view: a /status header plus
+    serving + fleet tables, then the shared report tables over the
+    scraped ``report`` block — with the traces table re-pointed at the
+    ``/traces`` document (the recent-span ring behind ``report`` never
+    holds req_trace records; the trace plane keeps its own ring)."""
+    doc = _fetch_json(f"{url}/status", timeout=timeout)
+    try:
+        tdoc = _fetch_json(f"{url}/traces", timeout=timeout)
+    except Exception:
+        tdoc = None
+    lines = [
+        f"live: {url}  pid={doc.get('pid')}  "
+        f"uptime={float(doc.get('uptime_s') or 0.0):.1f}s  "
+        f"open_spans={len(doc.get('open_spans') or [])}  "
+        f"({time.strftime('%H:%M:%S')})",
+        "",
+    ]
+    srv_rows = [
+        (s.get("fleet") or s.get("model") or "-",
+         s.get("healthy_replicas", s.get("replicas", "-")),
+         s.get("queue_rows", "-"), s.get("version", "-"))
+        for s in doc.get("serving") or []
+    ]
+    lines += _table("serving",
+                    ("fleet", "healthy", "queue_rows", "version"),
+                    srv_rows)
+    fl = doc.get("fleet")
+    if fl:
+        slo = fl.get("slo") or {}
+        lines += _table(
+            "fleet federation",
+            ("federation", "processes", "requests", "violations",
+             "burn_rate", "alerts", "scrape"),
+            [(fl.get("federation"), fl.get("n_scraped"),
+              slo.get("requests"), slo.get("violations"),
+              slo.get("burn_rate"), len(slo.get("alerts") or []),
+              _fmt_ms(fl.get("scrape_seconds")))],
+        )
+    data = dict(doc.get("report") or {})
+    if tdoc and tdoc.get("traces"):
+        data["traces"] = summarize_traces(tdoc["traces"])
+    lines.append(render_report(data, path=url, slowest=slowest))
+    return "\n".join(lines)
+
+
+def watch(url, interval=2.0, once=False, slowest=10):
+    """Poll a live telemetry server and re-render the report in place —
+    the top(1) of a serving process. ``once`` renders a single frame
+    with no screen clear and returns (CI / scripting mode)."""
+    url = str(url).rstrip("/")
+    while True:
+        ok = True
+        try:
+            frame = _watch_frame(url, slowest=slowest)
+        except Exception as e:
+            ok = False
+            frame = f"live: {url}  (unreachable: {e})"
+        if once:
+            sys.stdout.write(frame.rstrip() + "\n")
+            return 0 if ok else 1
+        # ANSI clear + home: re-render in place, no curses dependency
+        sys.stdout.write("\x1b[2J\x1b[H" + frame.rstrip() + "\n")
+        sys.stdout.flush()
+        time.sleep(max(float(interval), 0.1))
+
+
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else list(argv)
     if not argv or argv[0] in ("-h", "--help"):
@@ -727,6 +822,9 @@ def main(argv=None):
     merge = False
     perfetto_out = None
     slowest = 10
+    watch_url = None
+    interval = 2.0
+    once = False
     paths = []
     i = 0
     while i < len(argv):
@@ -735,6 +833,27 @@ def main(argv=None):
             as_json = True
         elif a == "--merge":
             merge = True
+        elif a == "--watch":
+            if i + 1 >= len(argv):
+                print("error: --watch needs a live telemetry URL",
+                      file=sys.stderr)
+                return 2
+            i += 1
+            watch_url = argv[i]
+        elif a == "--interval":
+            if i + 1 >= len(argv):
+                print("error: --interval needs seconds",
+                      file=sys.stderr)
+                return 2
+            i += 1
+            try:
+                interval = float(argv[i])
+            except ValueError:
+                print(f"error: --interval needs a number, got "
+                      f"{argv[i]!r}", file=sys.stderr)
+                return 2
+        elif a == "--once":
+            once = True
         elif a == "--perfetto":
             if i + 1 >= len(argv):
                 print("error: --perfetto needs an output path",
@@ -756,6 +875,12 @@ def main(argv=None):
         else:
             paths.append(a)
         i += 1
+    if watch_url is not None:
+        try:
+            return watch(watch_url, interval=interval, once=once,
+                         slowest=slowest)
+        except KeyboardInterrupt:
+            return 0
     if not paths:
         print("error: no input JSONL files", file=sys.stderr)
         return 2

@@ -45,6 +45,8 @@ already trust with code execution (a pickle IS code).
 
 from __future__ import annotations
 
+import contextlib
+import dataclasses
 import http.client
 import io
 import json
@@ -97,6 +99,19 @@ def _npy_load(body: bytes):
     return np.load(io.BytesIO(body), allow_pickle=False)
 
 
+def _fleet_entry(doc: dict, name: str, process_id: str) -> dict:
+    """Extract one fleet's serving entry from a full /status doc —
+    shared by :meth:`HttpEndpoint.status` and the router's poller (the
+    poller fetches the DOC once and extracts locally, so the metrics
+    federator rides the same scrape)."""
+    for entry in doc.get("serving", ()):
+        if entry.get("fleet") == name:
+            return entry
+    raise ProcessDown(
+        f"{process_id}: no fleet {name!r} on /status"
+    )
+
+
 # -- endpoints ---------------------------------------------------------------
 
 class FleetEndpoint:
@@ -112,10 +127,22 @@ class FleetEndpoint:
         replica health). Raises :class:`ProcessDown` when unreachable."""
         raise NotImplementedError
 
-    def submit(self, X, method="predict", rerouted_from=None):
+    def status_doc(self) -> dict:
+        """The process's FULL /status document (serving block plus
+        counters/telemetry) — fetched ONCE per poll interval so the
+        routing poller and the metrics federator share a single scrape
+        (a second reader of the windowed-quantile cursors would
+        double-consume the deltas). Raises :class:`ProcessDown`."""
+        return {"serving": [self.status()]}
+
+    def submit(self, X, method="predict", rerouted_from=None,
+               trace_ctx=None):
         """BLOCKING: place one request and return its result array.
         ``rerouted_from`` names the process this request failed over
-        from — the receiving fleet tags the survivor's trace with it."""
+        from — the receiving fleet tags the survivor's trace with it.
+        ``trace_ctx`` carries the router's trace id so the remote
+        process CONTINUES the same trace (pid-prefixed ids are
+        collision-free fleet-wide)."""
         raise NotImplementedError
 
     def apply_publish(self, estimator, version, seq, tag=None,
@@ -152,18 +179,35 @@ class LocalEndpoint(FleetEndpoint):
         except Exception as exc:
             raise ProcessDown(f"{self.process_id}: {exc}") from exc
 
-    def submit(self, X, method="predict", rerouted_from=None):
+    def status_doc(self) -> dict:
+        # an in-process endpoint shares THIS process's registry —
+        # shipping its counters/telemetry to the federator would
+        # double-count them against the router's own /metrics, so the
+        # doc carries only the serving block
+        return {"serving": [self.status()], "counters": {},
+                "telemetry": {"gauges": [], "histograms": []}}
+
+    def submit(self, X, method="predict", rerouted_from=None,
+               trace_ctx=None):
         import concurrent.futures as cf
 
         from ..config import get_config
         from ..observability import _requests as rtrace
 
-        timeout_s = float(get_config().serving_federation_timeout_s)
+        cfg = get_config()
+        timeout_s = float(cfg.serving_federation_timeout_s)
         try:
-            if rerouted_from is not None:
-                with rtrace.tagging(rerouted_from_process=rerouted_from):
-                    fut = self.fleet.submit(X, method=method)
-            else:
+            with contextlib.ExitStack() as stack:
+                if rerouted_from is not None:
+                    stack.enter_context(rtrace.tagging(
+                        rerouted_from_process=rerouted_from))
+                if trace_ctx is not None \
+                        and bool(cfg.obs_trace_propagate):
+                    # the in-process twin of the X-Trace-Context
+                    # header: the fleet's _admit (synchronous, on this
+                    # thread) mints its trace with the ROUTER's id
+                    stack.enter_context(
+                        rtrace.trace_context(trace_ctx))
                 fut = self.fleet.submit(X, method=method)
             return fut.result(timeout_s if timeout_s > 0 else None)
         except (ServerClosed, NoHealthyReplicas) as exc:
@@ -220,26 +264,30 @@ class HttpEndpoint(FleetEndpoint):
             # connection (inference is idempotent, re-issue is safe)
             raise ProcessDown(f"{self.process_id}: {exc}") from exc
 
-    def status(self) -> dict:
+    def status_doc(self) -> dict:
         try:
             with urllib.request.urlopen(f"{self.base_url}/status",
                                         timeout=self.timeout_s) as resp:
-                data = json.loads(resp.read().decode())
+                return json.loads(resp.read().decode())
         except (urllib.error.URLError, http.client.HTTPException,
                 ConnectionError, OSError, TimeoutError,
                 ValueError) as exc:
             raise ProcessDown(f"{self.process_id}: {exc}") from exc
-        for entry in data.get("serving", ()):
-            if entry.get("fleet") == self.name:
-                return entry
-        raise ProcessDown(
-            f"{self.process_id}: no fleet {self.name!r} on /status"
-        )
 
-    def submit(self, X, method="predict", rerouted_from=None):
+    def status(self) -> dict:
+        return _fleet_entry(self.status_doc(), self.name,
+                            self.process_id)
+
+    def submit(self, X, method="predict", rerouted_from=None,
+               trace_ctx=None):
+        from ..config import get_config
+
         headers = {"Content-Type": "application/x-npy"}
         if rerouted_from is not None:
             headers["X-Fed-Reroute"] = str(rerouted_from)
+        if trace_ctx is not None \
+                and bool(get_config().obs_trace_propagate):
+            headers["X-Trace-Context"] = str(int(trace_ctx))
         code, body, rhead = self._post(method, _npy_bytes(X), headers)
         if code == 200:
             return _npy_load(body)
@@ -356,18 +404,30 @@ def handle_http(path, headers, body):
         return (400, f"bad npy body: {exc}\n".encode(),
                 "text/plain; charset=utf-8", {})
     rerouted = headers.get("X-Fed-Reroute")
-    try:
-        if rerouted:
-            # the survivor's trace records the process this request
-            # failed over FROM (thread-local pending tag, picked up by
-            # the replica's _admit)
-            with rtrace.tagging(rerouted_from_process=rerouted):
-                fut = fleet.submit(X, method=op)
-        else:
-            fut = fleet.submit(X, method=op)
-        from ..config import get_config
+    from ..config import get_config
 
-        timeout_s = float(get_config().serving_federation_timeout_s)
+    cfg = get_config()
+    trace_ctx = None
+    if bool(cfg.obs_trace_propagate):
+        try:
+            trace_ctx = int(headers.get("X-Trace-Context", ""))
+        except (TypeError, ValueError):
+            trace_ctx = None
+    try:
+        with contextlib.ExitStack() as stack:
+            if rerouted:
+                # the survivor's trace records the process this request
+                # failed over FROM (thread-local pending tag, picked up
+                # by the replica's _admit)
+                stack.enter_context(rtrace.tagging(
+                    rerouted_from_process=rerouted))
+            if trace_ctx is not None:
+                # continue the ROUTER's trace: _admit runs on this
+                # thread and mints the trace with the propagated id, so
+                # the request is ONE trace across the process boundary
+                stack.enter_context(rtrace.trace_context(trace_ctx))
+            fut = fleet.submit(X, method=op)
+        timeout_s = float(cfg.serving_federation_timeout_s)
         result = fut.result(timeout_s if timeout_s > 0 else None)
     except SloShed as exc:
         return (429, f"{exc}\n".encode(), "text/plain; charset=utf-8",
@@ -393,13 +453,15 @@ def handle_http(path, headers, body):
 # -- the router --------------------------------------------------------------
 
 class _ProcessState:
-    __slots__ = ("endpoint", "alive", "stats", "t_status", "t_dead")
+    __slots__ = ("endpoint", "alive", "stats", "doc", "t_status",
+                 "t_dead")
 
     def __init__(self, endpoint):
         self.endpoint = endpoint
         self.alive = True       # optimistic: first poll corrects it
         self.stats = None
-        self.t_status = 0.0
+        self.doc = None         # last full /status doc (one scrape
+        self.t_status = 0.0     # feeds routing AND the federator)
         self.t_dead = 0.0
 
 
@@ -456,6 +518,18 @@ class FederatedFleet:
         self._stop = threading.Event()
         self._poller = None
         self._pool = None
+        # fleet metrics federation rides the status poller (never its
+        # own thread, never its own scrape); off by default — disabled
+        # builds nothing and registers nothing (zero-overhead contract)
+        self._federator = None
+        if bool(cfg.obs_fleet_federate):
+            from ..observability.fleet import MetricsFederator
+
+            self._federator = MetricsFederator(
+                name=self.name,
+                slo_ms=float(cfg.serving_slo_ms),
+                min_interval_s=float(cfg.obs_fleet_poll_s),
+            )
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -467,6 +541,10 @@ class FederatedFleet:
                 thread_name_prefix="fed-submit",
             )
         self._stop.clear()
+        if self._federator is not None:
+            from ..observability import live
+
+            live.register_fleet_provider(self._federator)
         self._poll_once()
         if self._poller is None:
             self._poller = threading.Thread(
@@ -483,6 +561,10 @@ class FederatedFleet:
         if self._pool is not None:
             self._pool.shutdown(wait=True)
             self._pool = None
+        if self._federator is not None:
+            from ..observability import live
+
+            live.unregister_fleet_provider(self._federator)
         for p in self._procs:
             try:
                 p.endpoint.close()
@@ -506,28 +588,45 @@ class FederatedFleet:
 
     def _poll_once(self):
         now = time.monotonic()
+        t0 = time.perf_counter()
+        snapshots = []
         for p in self._procs:
+            pid = p.endpoint.process_id
             if not p.alive and now - p.t_dead < self._retry_s:
-                continue  # back off re-probing a known-dead process
+                # back off re-probing a known-dead process (its fleet
+                # series still DROP this interval, never latch)
+                snapshots.append((pid, None))
+                continue
             try:
-                stats = p.endpoint.status()
+                # ONE scrape per process per interval: the full doc
+                # feeds routing (serving entry, extracted here) and the
+                # metrics federator (counters + telemetry) — a second
+                # GET would double-consume the windowed-quantile
+                # cursors behind srv.stats()
+                doc = p.endpoint.status_doc()
+                stats = _fleet_entry(doc, self.name, pid)
             except ProcessDown:
                 self._mark_dead(p)
+                snapshots.append((pid, None))
                 continue
             with self._lock:
                 back = not p.alive
                 p.alive = True
                 p.stats = stats
+                p.doc = doc
                 p.t_status = time.monotonic()
-            pid = p.endpoint.process_id
             smetrics.set_process_gauges(
                 pid, healthy=True,
                 replicas=stats.get("healthy_replicas"),
             )
+            snapshots.append((pid, doc))
             if back:
                 # a recovered process rejoins routing; its registry
                 # re-converges on the next publish fan-out
                 pass
+        if self._federator is not None:
+            self._federator.ingest(
+                snapshots, scrape_s=time.perf_counter() - t0)
 
     def _mark_dead(self, p):
         with self._lock:
@@ -565,9 +664,7 @@ class FederatedFleet:
                                    t[2].endpoint.process_id))
         return [p for _, _, p in scored]
 
-    def _run_request(self, X, method):
-        X = np.asarray(X, np.float32)
-        n_rows = 1 if X.ndim == 1 else int(X.shape[0])
+    def _route(self, X, method, n_rows, tr=None):
         ranked = self._ranked(method, n_rows)
         if not ranked:
             raise NoLiveProcesses(
@@ -576,9 +673,16 @@ class FederatedFleet:
         last_exc = None
         rerouted_from = None
         for p in ranked:
+            if tr is not None:
+                # one dispatch stamp per placement attempt: a rerouted
+                # request's router trace telescopes every leg
+                tr.stamp("dispatch")
+                tr.tag(process=p.endpoint.process_id)
             try:
-                return p.endpoint.submit(X, method=method,
-                                         rerouted_from=rerouted_from)
+                return p.endpoint.submit(
+                    X, method=method, rerouted_from=rerouted_from,
+                    trace_ctx=tr.trace_id if tr is not None else None,
+                )
             except ProcessDown as exc:
                 # the process died under this request (or refused it as
                 # closed): inference is idempotent, so the WHOLE request
@@ -588,10 +692,14 @@ class FederatedFleet:
                 self._mark_dead(p)
                 smetrics.record_process_reroute()
                 rerouted_from = p.endpoint.process_id
+                if tr is not None:
+                    tr.tag(rerouted_from_process=rerouted_from)
             except ServerOverloaded as exc:
                 last_exc = exc
                 smetrics.record_process_reroute()
                 rerouted_from = p.endpoint.process_id
+                if tr is not None:
+                    tr.tag(rerouted_from_process=rerouted_from)
             # SloShed / RequestTimeout propagate: admission refused the
             # request deliberately (re-issuing would double-spend its
             # budget), and a timeout already burned it
@@ -602,13 +710,53 @@ class FederatedFleet:
             ) from last_exc
         raise last_exc
 
+    def _run_request(self, X, method, tr=None, cfg=None):
+        if tr is None:
+            return self._route(X, method,
+                               1 if X.ndim == 1 else int(X.shape[0]))
+        from .. import config
+
+        n_rows = 1 if X.ndim == 1 else int(X.shape[0])
+        # config overrides are thread-local: re-apply the SUBMIT
+        # caller's config on this pool thread (the ModelServer worker
+        # idiom) so tr.finish() samples/keeps per the caller's knobs
+        with config.set(**dataclasses.asdict(cfg)):
+            try:
+                result = self._route(X, method, n_rows, tr=tr)
+            except SloShed:
+                tr.tag(slo_shed=True)
+                tr.finish("slo_shed")
+                raise
+            except RequestTimeout:
+                tr.finish("timeout")
+                raise
+            except Exception:
+                tr.finish("error")
+                raise
+            tr.finish("ok")
+            return result
+
     def submit(self, X, method="predict"):
         """Admit one request to the federation: returns a Future
         resolving to the result array (routing, failover and reroute
-        tagging happen on the router's worker thread)."""
+        tagging happen on the router's worker thread). With request
+        tracing on, the router mints the trace HERE (caller thread, so
+        thread-local tag/config context applies) and every process the
+        request touches continues the same trace id."""
         if self._pool is None:
             raise ServerClosed("FederatedFleet is not started")
-        return self._pool.submit(self._run_request, X, method)
+        from ..observability import _requests as rtrace
+
+        X = np.asarray(X, np.float32)
+        tr = cfg = None
+        if rtrace.tracing_enabled():
+            from ..config import get_config
+
+            tr = rtrace.new_trace(
+                method, 1 if X.ndim == 1 else int(X.shape[0]))
+            tr.tag(federation=self.name)
+            cfg = get_config()
+        return self._pool.submit(self._run_request, X, method, tr, cfg)
 
     def _call(self, X, method):
         return self.submit(X, method=method).result()

@@ -1,0 +1,108 @@
+"""Fleet-scope observability: one trace per request across processes,
+one /metrics page for the whole fleet, one live terminal view.
+
+`examples/13_request_traces.py` traced requests INSIDE one process and
+`examples/14_federation.py` routed across processes — this example is
+their join (ISSUE 19):
+
+- **trace propagation** — the router mints a request trace and every
+  process the request touches CONTINUES the same pid-prefixed id
+  (``X-Trace-Context`` over HTTP, thread-local context in-process), so
+  the Perfetto export draws one arrow from the router's admit through
+  the worker's queue/pack/execute/demux stages;
+- ``MetricsFederator``  — rides the federation status poller (the SAME
+  ``/status`` scrape that feeds routing — no second fetch), folds every
+  process's counters/gauges/histograms into fleet-wide
+  ``dask_ml_tpu_fleet_*`` families on the router's ``/metrics``
+  (counters sum, gauges get a ``{process=}`` label, latency histograms
+  merge bucket-for-bucket) plus a ``/status/fleet`` JSON block with an
+  SLO burn-rate and latched alerts;
+- ``report --watch``    — ``python -m dask_ml_tpu.observability.report
+  --watch http://router:9100`` re-renders the serving/fleet/trace
+  tables in place while the run is live (``--once`` for CI).
+
+Everything is host-side and off by default: ``obs_fleet_federate=False``
+builds no federator, and the serving jaxprs are byte-identical either
+way (asserted in ``tests/test_fleet_observability.py``).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from dask_ml_tpu import config
+from dask_ml_tpu.datasets import make_classification
+from dask_ml_tpu.linear_model import LogisticRegression
+from dask_ml_tpu.observability import _requests as rtrace
+from dask_ml_tpu.observability import report as report_cli
+from dask_ml_tpu.observability.live import TelemetryServer, render_prometheus
+from dask_ml_tpu.serving import (
+    BucketLadder,
+    FederatedFleet,
+    FleetServer,
+    LocalEndpoint,
+)
+
+n = int(os.environ.get("DASK_ML_TPU_EXAMPLE_N", 20_000))
+X, y = make_classification(n_samples=n, n_features=16, n_informative=8,
+                           random_state=0)
+clf = LogisticRegression(solver="lbfgs", max_iter=30).fit(X, y)
+Xh = X.to_numpy().astype(np.float32)
+ladder = BucketLadder(8, 256, 2.0)
+
+# -- a 2-"process" fleet with tracing + federation ON ------------------------
+#    (LocalEndpoints are the virtual-process transport — against real
+#    remote processes these are "http://host:port" strings and the
+#    trace id rides the X-Trace-Context header)
+with config.set(obs_trace_sample=1.0, obs_fleet_federate=True):
+    f0 = FleetServer(clf, name="fobs", replicas=1, ladder=ladder,
+                     batch_window_ms=1.0, timeout_ms=0).warmup().start()
+    f1 = FleetServer(clf, name="fobs", replicas=1, ladder=ladder,
+                     batch_window_ms=1.0, timeout_ms=0).warmup().start()
+    ts = TelemetryServer(port=0).start()
+    with FederatedFleet([LocalEndpoint(f0, "p0"), LocalEndpoint(f1, "p1")],
+                        name="fobs", ladder=ladder, poll_s=0.2) as fed:
+        for i in range(8):
+            fed.predict(Xh[i * 16:(i + 1) * 16])
+
+        # -- one request, one trace, two lanes -----------------------------
+        recs = rtrace.traces_data()["traces"]
+        router = [r for r in recs if r.get("federation") == "fobs"]
+        rt = router[0]
+        legs = [r for r in recs
+                if r["trace_id"] == rt["trace_id"] and r is not rt]
+        print(f"trace {rt['trace_id']}: router "
+              f"{sorted(rt['stages'])} -> {rt['process']} "
+              f"{sorted(legs[0]['stages'])}")
+        assert {"admit", "queue_pop", "execute_done",
+                "complete"} <= set(legs[0]["stages"])
+
+        # -- the federated exposition --------------------------------------
+        fed._poll_once()                 # (the poller does this on its own)
+        fleet_lines = [ln for ln in render_prometheus().splitlines()
+                       if ln.startswith("dask_ml_tpu_fleet_")
+                       and "_bucket" not in ln]
+        print("router /metrics fleet families:")
+        for ln in fleet_lines[:8]:
+            print(f"  {ln}")
+        assert any(ln.startswith("dask_ml_tpu_fleet_processes 2")
+                   for ln in fleet_lines)
+
+        blk = fed._federator.fleet_block()
+        print(f"/status/fleet: {blk['n_scraped']} processes scraped, "
+              f"slo burn {blk['slo']['burn_rate']:.2f}x budget, "
+              f"{len(blk['slo']['alerts'])} latched alerts")
+
+        # -- the live terminal view (--once: one frame, CI-checkable) ------
+        print("--- report --watch --once " + "-" * 34)
+        rc = report_cli.main(["--watch", ts.url, "--once"])
+        assert rc == 0
+
+    ts.stop()
+    f0.stop(drain=False)
+    f1.stop(drain=False)
+
+print("fleet observability example done")

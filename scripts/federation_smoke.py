@@ -11,6 +11,13 @@ currently-preferred process mid-traffic with
   registry pins the control registry's CURRENT version id;
 - ZERO post-warmup XLA compiles in the survivor across the whole run
   (routing, failover and the fanned-out hot-swap are all shape-stable);
+- ONE trace across the process boundary (ISSUE 19): a routed request's
+  trace id shows up in BOTH the router's and the chosen worker's
+  ``/traces`` (the ``X-Trace-Context`` header), the worker leg carries
+  the full stage set and telescopes inside the router's window;
+- the router's federated ``/metrics`` aggregate
+  (``dask_ml_tpu_fleet_serving_requests_total``) exactly equals the sum
+  of the live per-process ``/status`` counter scrapes;
 
 and, in-parent, a replayed synthetic burst against a 1-replica fleet
 whose top-bucket window predicts SLO pressure must fire a plans-warm
@@ -51,11 +58,14 @@ X, y = make_classification(n_samples=600, n_features=12,
                            n_informative=6, random_state=0)
 a = LogisticRegression(solver="lbfgs", max_iter=30).fit(X, y)
 
-# trace plane ON at a production-like sample rate: reroute-tagged
-# traces are ALWAYS kept (the tail sampler's contract), while ordinary
-# completions mostly are not — so the parent's reroute-audit trace
-# cannot be evicted from the bounded keep ring by the traffic behind it
-with config.set(obs_trace_sample=0.01):
+# trace plane ON: the parent drives the sample rate (1.0 for the
+# cross-process trace-join audit — every worker leg is kept; the env
+# also raises the keep ring so neither the reroute-audit trace nor the
+# joined leg is evicted by the kept traffic behind it). The 0.01
+# default keeps the standalone run production-like: reroute-tagged
+# traces are ALWAYS kept (the tail sampler's contract)
+sample = float(os.environ.get("FED_SMOKE_TRACE_SAMPLE", "0.01"))
+with config.set(obs_trace_sample=sample):
     fleet = FleetServer(a, name="fedclf", replicas=2,
                         ladder=BucketLadder(8, 128, 2.0),
                         batch_window_ms=1.0, timeout_ms=0).warmup()
@@ -103,9 +113,11 @@ def _wait_fleet(base, child, deadline):
 def _federation_section(out):
     import numpy as np
 
-    from dask_ml_tpu import observability as obs
+    from dask_ml_tpu import config, observability as obs
     from dask_ml_tpu.datasets import make_classification
     from dask_ml_tpu.linear_model import LogisticRegression
+    from dask_ml_tpu.observability import _requests as rtrace
+    from dask_ml_tpu.observability.live import TelemetryServer
     from dask_ml_tpu.serving import (
         BucketLadder,
         FederatedFleet,
@@ -131,13 +143,16 @@ def _federation_section(out):
         subprocess.Popen(
             [sys.executable, "-c", CHILD],
             env={**os.environ, "JAX_PLATFORMS": "cpu",
-                 "DASK_ML_TPU_OBS_HTTP_PORT": str(p)},
+                 "DASK_ML_TPU_OBS_HTTP_PORT": str(p),
+                 "FED_SMOKE_TRACE_SAMPLE": "1.0",
+                 "DASK_ML_TPU_OBS_TRACE_KEEP": "4096"},
             cwd=here, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True,
         )
         for p in ports
     ]
     deadline = time.time() + 180
+    ts = None
     try:
         for base, child in zip(bases, children):
             _wait_fleet(base, child, deadline)
@@ -145,9 +160,14 @@ def _federation_section(out):
         eps = [HttpEndpoint(bases[i], name="fedclf", process_id=f"p{i}",
                             timeout_s=30.0) for i in (0, 1)]
         c0 = obs.counters_snapshot()
-        with FederatedFleet(eps, name="fedclf",
-                            ladder=BucketLadder(8, 128, 2.0),
-                            poll_s=0.25, retry_s=60.0) as fed:
+        # the ROUTER's own telemetry surface: its /metrics carries the
+        # federated dask_ml_tpu_fleet_* families (the MetricsFederator
+        # rides the status poller), its /traces the router-side trace
+        ts = TelemetryServer(port=0).start()
+        with config.set(obs_fleet_federate=True), FederatedFleet(
+                eps, name="fedclf",
+                ladder=BucketLadder(8, 128, 2.0),
+                poll_s=0.25, retry_s=60.0) as fed:
             # warm probes through BOTH processes, then align version
             # numbering: control v1 pins over each child's
             # construction-time v1 (idempotent overwrite)
@@ -163,6 +183,42 @@ def _federation_section(out):
                 .get("recompiles", 0)
                 for base in bases
             ]
+
+            # cross-process trace join: ONE traced foreground request,
+            # then its id must appear on BOTH sides of the HTTP hop —
+            # the router's /traces and the chosen child's — with the
+            # full worker stage set telescoping inside the router's
+            # window (done pre-kill: the chosen process must still be
+            # alive to serve its /traces)
+            with config.set(obs_trace_sample=1.0):
+                got = fed.predict(Xh[:64])
+            assert np.array_equal(got, preds[1][:64])
+            routed = [r for r in rtrace.traces_data()["traces"]
+                      if r.get("federation") == "fedclf"]
+            assert len(routed) == 1, routed
+            rt = routed[0]
+            rid = rt["trace_id"]
+            assert rt["outcome"] == "ok", rt
+            pdoc = _get_json(ts.url + "/traces")
+            assert any(t["trace_id"] == rid
+                       for t in pdoc.get("traces", ())), \
+                "router /traces misses the routed trace"
+            chosen = int(rt["process"][1])
+            wdoc = _get_json(bases[chosen] + "/traces")
+            legs = [t for t in wdoc.get("traces", ())
+                    if t["trace_id"] == rid]
+            assert len(legs) == 1, \
+                f"worker /traces misses trace {rid}"
+            leg = legs[0]
+            assert set(leg["stages"]) >= {
+                "admit", "queue_pop", "pack", "dispatch",
+                "execute_done", "demux", "complete"}, leg
+            assert leg["e2e_s"] <= rt["e2e_s"] + 1e-3, (leg, rt)
+            assert sum(leg["durations"].values()) <= \
+                leg["e2e_s"] + 1e-3, leg
+            out.update(trace_id=rid, trace_worker=f"p{chosen}",
+                       trace_router_e2e_s=rt["e2e_s"],
+                       trace_worker_e2e_s=leg["e2e_s"])
 
             N_CLIENTS = 3
             # per-thread slots, summed after join (no racy +=)
@@ -257,13 +313,33 @@ def _federation_section(out):
                       and t.get("outcome") == "ok"]
             assert tagged, "no survivor trace carries the reroute tag"
 
+            # metrics federation: with traffic quiesced, the router's
+            # federated counter aggregate must EQUAL the sum of the
+            # live processes' own /status scrapes (the dead child's
+            # series dropped — it contributes nothing)
+            live_reqs = sdoc["counters"].get("serving_requests", 0)
+            fed._poll_once()
+            page = urllib.request.urlopen(
+                ts.url + "/metrics", timeout=5.0).read().decode()
+            fleet_reqs = None
+            for line in page.splitlines():
+                if line.startswith(
+                        "dask_ml_tpu_fleet_serving_requests_total "):
+                    fleet_reqs = int(float(line.split()[1]))
+            assert fleet_reqs == live_reqs, (fleet_reqs, live_reqs)
+            fleet_doc = _get_json(ts.url + "/status/fleet")
+            assert fleet_doc["processes"] == [f"p{survivor}"], fleet_doc
+
             out.update(
+                fleet_requests_total=fleet_reqs,
                 requests=n_done, reroutes=reroutes,
                 failovers=failovers, recompiles=recompiles,
                 published=v2, survivor=f"p{survivor}",
                 rerouted_traced=len(tagged),
             )
     finally:
+        if ts is not None:
+            ts.stop()
         for child in children:
             if child.poll() is None:
                 child.terminate()

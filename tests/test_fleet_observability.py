@@ -1,0 +1,743 @@
+"""Fleet-scope observability (ISSUE 19): cross-process trace
+propagation (``X-Trace-Context`` + ``rtrace.trace_context``), live
+metrics federation (``observability/fleet.MetricsFederator`` riding the
+federation status poller), the ``/status/fleet`` surface, Perfetto
+cross-process flow chains, and ``report --watch``.
+
+The load-bearing assertions: a federated request is ONE trace across
+the process boundary (router and worker records share the router's
+pid-prefixed id, reroute legs chain through the same id with
+``rerouted_from_process`` naming the corpse), fleet histograms merge
+bucket-for-bucket so merged quantiles match pooling the raw
+observations, the federator shares the poller's single /status scrape
+per interval (the PR 6 double-consume lesson), dead processes' series
+DROP rather than latch, the federated exposition stays grammar-clean
+(one TYPE line per family), and federation off — the default — builds
+nothing, registers nothing, and starts no thread.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dask_ml_tpu import config
+from dask_ml_tpu.observability import _requests as rtrace
+from dask_ml_tpu.observability import live
+from dask_ml_tpu.observability._hist import (
+    Histogram,
+    merge_snapshots,
+    percentiles_from,
+)
+from dask_ml_tpu.observability.fleet import MetricsFederator
+from dask_ml_tpu.serving import (
+    BucketLadder,
+    FederatedFleet,
+    FleetServer,
+    HttpEndpoint,
+    LocalEndpoint,
+    ProcessDown,
+)
+from dask_ml_tpu.serving.federation import FleetEndpoint
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    """One fitted model + host rows (the serving fixture)."""
+    from dask_ml_tpu.datasets import make_classification
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    X, y = make_classification(
+        n_samples=400, n_features=10, n_informative=5, random_state=0
+    )
+    clf = LogisticRegression(solver="lbfgs", max_iter=25).fit(X, y)
+    return clf, X.to_numpy().astype(np.float32)
+
+
+@pytest.fixture(autouse=True)
+def _trace_isolation():
+    rtrace.traces_reset()
+    yield
+    rtrace.traces_reset()
+
+
+def _ladder():
+    return BucketLadder(8, 64, 2.0)
+
+
+def _pair(clf, name="fobs"):
+    f1 = FleetServer(clf, name=name, replicas=1, ladder=_ladder(),
+                     batch_window_ms=1.0).warmup().start()
+    f2 = FleetServer(clf, name=name, replicas=1, ladder=_ladder(),
+                     batch_window_ms=1.0).warmup().start()
+    fed = FederatedFleet(
+        [LocalEndpoint(f1, "p0"), LocalEndpoint(f2, "p1")],
+        name=name, ladder=_ladder(),
+    ).start()
+    return f1, f2, fed
+
+
+# -- trace-context propagation ----------------------------------------------
+
+def test_trace_context_continues_and_restores():
+    """Traces minted inside trace_context REUSE the given id; outside
+    they mint fresh pid-prefixed ids; nesting restores the outer id."""
+    with rtrace.trace_context(424242):
+        assert rtrace.RequestTrace("predict", 1).trace_id == 424242
+        with rtrace.trace_context(777):
+            assert rtrace.RequestTrace("predict", 1).trace_id == 777
+        assert rtrace.RequestTrace("predict", 1).trace_id == 424242
+    fresh = rtrace.RequestTrace("predict", 1).trace_id
+    assert fresh != 424242 and (fresh >> 24) > 0
+
+
+def test_trace_context_is_thread_local():
+    """Rank threads must not leak continuation ids into each other —
+    the property the virtual-rank harness and the fed pool rely on."""
+    from dask_ml_tpu.parallel.distributed import run_virtual_processes
+
+    def rank_trace(rank):
+        with rtrace.trace_context(1000 + rank):
+            return rtrace.RequestTrace("predict", 1).trace_id
+
+    ids = run_virtual_processes(rank_trace, world=2)
+    assert ids == [1000, 1001]
+    assert rtrace._pending_ctx() is None
+
+
+def test_local_endpoint_joins_router_and_worker_traces(fitted):
+    """A federated request is ONE trace: the router's record (admit/
+    dispatch/complete, tagged with the chosen process) and the worker
+    fleet's full-stage record share the router's id, and the worker's
+    window telescopes inside the router's."""
+    clf, Xh = fitted
+    with config.set(obs_trace_sample=1.0):
+        f1, f2, fed = _pair(clf)
+        try:
+            fed.predict(Xh[:8])
+        finally:
+            fed.stop()
+            f1.stop(drain=False)
+            f2.stop(drain=False)
+    recs = rtrace.traces_data()["traces"]
+    router = [r for r in recs if r.get("federation") == "fobs"]
+    assert len(router) == 1, recs
+    rt = router[0]
+    assert rt["outcome"] == "ok"
+    assert rt.get("process") in ("p0", "p1")
+    assert set(rt["stages"]) >= {"admit", "dispatch", "complete"}
+    workers = [r for r in recs if r["trace_id"] == rt["trace_id"]
+               and r.get("federation") != "fobs"]
+    assert len(workers) == 1, recs
+    wk = workers[0]
+    # the worker leg ran the full pipeline and telescopes: its stage
+    # durations sum to its e2e, which fits inside the router's window
+    assert set(wk["stages"]) >= {"admit", "queue_pop", "complete"}
+    assert sum(wk["durations"].values()) == pytest.approx(
+        wk["e2e_s"], abs=5e-5)
+    assert wk["e2e_s"] <= rt["e2e_s"] + 1e-4
+
+
+def test_trace_propagate_toggle_mints_fresh_worker_ids(fitted):
+    """obs_trace_propagate=False keeps the plane on but severs the
+    continuation: router and worker record DIFFERENT ids."""
+    clf, Xh = fitted
+    with config.set(obs_trace_sample=1.0, obs_trace_propagate=False):
+        f1, f2, fed = _pair(clf, name="fobs-off")
+        try:
+            fed.predict(Xh[:8])
+        finally:
+            fed.stop()
+            f1.stop(drain=False)
+            f2.stop(drain=False)
+    recs = rtrace.traces_data()["traces"]
+    assert len(recs) == 2, recs
+    assert len({r["trace_id"] for r in recs}) == 2
+
+
+def test_http_endpoint_continues_trace_over_wire(fitted):
+    """X-Trace-Context across a REAL HTTP hop: the receiving process's
+    handler re-enters the router's trace id around its fleet submit."""
+    from dask_ml_tpu.observability.live import TelemetryServer
+
+    clf, Xh = fitted
+    ts = TelemetryServer(port=0).start()
+    with config.set(obs_trace_sample=1.0):
+        # built INSIDE the config block: the serving fleet captures its
+        # trace gate (and its workers' config) at construction — the
+        # real remote process enables sampling via its own env/config
+        fleet = FleetServer(clf, name="fobs-http", replicas=1,
+                            ladder=_ladder(), batch_window_ms=1.0) \
+            .warmup().start()
+        try:
+            ep = HttpEndpoint(ts.url, name="fobs-http",
+                              process_id="h0", timeout_s=30.0)
+            fed = FederatedFleet([ep], name="fobs-http",
+                                 ladder=_ladder()).start()
+            try:
+                fed.predict(Xh[:8])
+            finally:
+                fed.stop()
+        finally:
+            fleet.stop()
+            ts.stop()
+    recs = rtrace.traces_data()["traces"]
+    router = [r for r in recs if r.get("federation") == "fobs-http"]
+    assert len(router) == 1, recs
+    rid = router[0]["trace_id"]
+    workers = [r for r in recs if r["trace_id"] == rid
+               and r.get("federation") != "fobs-http"]
+    assert len(workers) == 1, recs
+    assert "queue_pop" in workers[0]["stages"]
+
+
+class _DyingEndpoint(FleetEndpoint):
+    """Ranks as a live process, dies on every submit — the router must
+    reroute and chain the trace through the survivor."""
+
+    def __init__(self, process_id, fleet_name):
+        self.process_id = str(process_id)
+        self.fleet_name = str(fleet_name)
+
+    def status(self):
+        # rank FIRST: no queue, instant predicted completion
+        return {"fleet": self.fleet_name, "queue_rows": 0,
+                "replicas": [{"exec_s": {"predict:64":
+                                         {"count": 50, "p50_s": 1e-6,
+                                          "p90_s": 1e-6}}}],
+                "healthy_replicas": 1}
+
+    def status_doc(self):
+        return {"serving": [self.status()], "counters": {},
+                "telemetry": {"gauges": [], "histograms": []}}
+
+    def submit(self, X, method="predict", rerouted_from=None,
+               trace_ctx=None):
+        raise ProcessDown(f"{self.process_id}: killed mid-flight")
+
+
+def test_killed_process_reroute_chains_parent_trace(fitted):
+    """A process dying mid-flight yields ONE joined trace: the router's
+    record carries ``rerouted_from_process`` naming the corpse, and the
+    SURVIVOR's full-stage record continues the same id with the same
+    reroute tag (the X-Fed-Reroute + X-Trace-Context pair)."""
+    clf, Xh = fitted
+    with config.set(obs_trace_sample=1.0):
+        f1 = FleetServer(clf, name="fobs-kill", replicas=1,
+                         ladder=_ladder(), batch_window_ms=1.0) \
+            .warmup().start()
+        try:
+            fed = FederatedFleet(
+                [_DyingEndpoint("corpse", "fobs-kill"),
+                 LocalEndpoint(f1, "survivor")],
+                name="fobs-kill", ladder=_ladder(),
+            ).start()
+            try:
+                out = fed.predict(Xh[:8])
+                assert out.shape[0] == 8
+            finally:
+                fed.stop()
+        finally:
+            f1.stop(drain=False)
+    recs = rtrace.traces_data()["traces"]
+    router = [r for r in recs if r.get("federation") == "fobs-kill"]
+    assert len(router) == 1, recs
+    rt = router[0]
+    assert rt["outcome"] == "ok"
+    assert rt.get("rerouted_from_process") == "corpse"
+    assert rt.get("process") == "survivor"
+    legs = [r for r in recs if r["trace_id"] == rt["trace_id"]
+            and r.get("federation") != "fobs-kill"]
+    assert len(legs) == 1, recs
+    assert legs[0].get("rerouted_from_process") == "corpse"
+
+
+# -- histogram merge ---------------------------------------------------------
+
+def test_histogram_merge_exact_sums():
+    a, b = Histogram(), Histogram()
+    for v in (1e-4, 0.003, 0.02, 0.7):
+        a.observe(v)
+    for v in (0.005, 5.0):
+        b.observe(v)
+    m = Histogram().merge(a).merge(b.snapshot())  # object AND dict
+    assert m.count == 6
+    assert m.sum == pytest.approx(a.sum + b.sum)
+    snap = m.snapshot()
+    assert snap["min"] == pytest.approx(1e-4)
+    assert snap["max"] == pytest.approx(5.0)
+    pooled = Histogram()
+    for v in (1e-4, 0.003, 0.02, 0.7, 0.005, 5.0):
+        pooled.observe(v)
+    assert snap["counts"] == pooled.snapshot()["counts"]
+
+
+def test_histogram_merge_bounds_mismatch_raises():
+    a = Histogram((0.1, 1.0))
+    with pytest.raises(ValueError):
+        a.merge(Histogram((0.1, 2.0)))
+    with pytest.raises(ValueError):
+        a.merge(Histogram())
+
+
+def test_merged_percentiles_match_pooled_within_bucket_width():
+    """Property: for random observations split over 3 'processes', the
+    merged quantiles equal the pooled-histogram quantiles EXACTLY
+    (fixed bounds => bucket-for-bucket), and both sit within one
+    1-2-5 bucket width of the true sample quantile."""
+    rng = np.random.default_rng(7)
+    for _ in range(5):
+        obs = rng.lognormal(mean=-4.0, sigma=1.5, size=300)
+        parts = np.array_split(obs, 3)
+        hists = []
+        pooled = Histogram()
+        for part in parts:
+            h = Histogram()
+            for v in part:
+                h.observe(float(v))
+                pooled.observe(float(v))
+            hists.append(h.snapshot())
+        merged = merge_snapshots(hists)
+        assert merged["counts"] == pooled.snapshot()["counts"]
+        mp = percentiles_from(merged, (50, 99))
+        pp = pooled.percentiles((50, 99))
+        for q in ("p50", "p99"):
+            assert mp[q] == pytest.approx(pp[q])
+            exact = float(np.percentile(obs, int(q[1:])))
+            # one bucket width on the 1-2-5 ladder: factor <= 2.5,
+            # clamped estimates can only tighten it
+            assert mp[q] <= exact * 2.5 + 1e-12
+            assert mp[q] >= exact / 2.5 - 1e-12
+
+
+def test_merge_snapshots_none_tolerant_and_empty():
+    assert merge_snapshots([]) is None
+    assert merge_snapshots([None, None]) is None
+    h = Histogram()
+    h.observe(0.01)
+    out = merge_snapshots([None, h.snapshot(), None])
+    assert out["count"] == 1
+
+
+# -- the federator -----------------------------------------------------------
+
+def _doc(requests=0, violations=0, queue=0.0, obs=()):
+    h = Histogram()
+    for v in obs:
+        h.observe(v)
+    return {
+        "counters": {"serving_requests": requests,
+                     "serving_slo_violations": violations},
+        "telemetry": {
+            "gauges": [["serving_queue_rows", [], float(queue)]],
+            "histograms": [["serving_latency_seconds",
+                            [["method", "predict"]], h.snapshot()]],
+        },
+    }
+
+
+def test_federator_counters_sum_gauges_labeled_hists_merge():
+    fed = MetricsFederator(name="m")
+    assert fed.ingest([("p0", _doc(10, 1, 3.0, (0.01, 0.02))),
+                       ("p1", _doc(5, 0, 1.0, (0.5,)))],
+                      scrape_s=0.002)
+    txt = "\n".join(fed.render_lines())
+    assert "dask_ml_tpu_fleet_serving_requests_total 15" in txt
+    assert "dask_ml_tpu_fleet_serving_slo_violations_total 1" in txt
+    assert ('dask_ml_tpu_fleet_serving_queue_rows{process="p0"} 3'
+            in txt)
+    assert ('dask_ml_tpu_fleet_serving_queue_rows{process="p1"} 1'
+            in txt)
+    # the merged histogram holds all three observations
+    assert ('dask_ml_tpu_fleet_serving_latency_seconds_count'
+            '{method="predict"} 3') in txt
+    blk = fed.fleet_block()
+    assert blk["n_scraped"] == 2 and blk["processes"] == ["p0", "p1"]
+    key = 'serving_latency_seconds{method="predict"}'
+    assert blk["histograms"][key]["count"] == 3
+    assert blk["scrape_seconds"] == pytest.approx(0.002)
+
+
+def test_federator_dead_series_dropped_not_latched():
+    fed = MetricsFederator(name="m")
+    fed.ingest([("p0", _doc(1, queue=2.0)), ("p1", _doc(1, queue=5.0))])
+    assert 'process="p1"' in "\n".join(fed.render_lines())
+    # p1 dies: its doc is None this interval — every p1 series vanishes
+    fed.ingest([("p0", _doc(2, queue=2.0)), ("p1", None)])
+    txt = "\n".join(fed.render_lines())
+    assert 'process="p1"' not in txt
+    assert fed.fleet_block()["processes"] == ["p0"]
+    # a process absent from the snapshot list entirely (retired
+    # endpoint) drops too
+    fed.ingest([("p1", _doc(9, queue=1.0))])
+    assert fed.fleet_block()["processes"] == ["p1"]
+
+
+def test_federator_throttle_still_drops_dead(monkeypatch):
+    """obs_fleet_poll_s throttles the merge work but a dead process's
+    series still drop on the throttled tick (never latch)."""
+    fed = MetricsFederator(name="m", min_interval_s=3600.0)
+    assert fed.ingest([("p0", _doc(1)), ("p1", _doc(1))])
+    assert fed.ingest([("p0", _doc(2)), ("p1", None)]) is False
+    assert fed.fleet_block()["processes"] == ["p0"]
+
+
+def test_federated_exposition_grammar_one_type_per_family(fitted):
+    """The router's full /metrics page with the federator registered:
+    every sample line belongs to exactly one declared family, no family
+    declares TYPE twice, and every fleet family is namespaced."""
+    fed = MetricsFederator(name="m")
+    fed.ingest([("p0", _doc(10, 1, 3.0, (0.01,))),
+                ("p1", _doc(5, 0, 1.0, (0.5,)))], scrape_s=0.001)
+    live.register_fleet_provider(fed)
+    try:
+        page = live.render_prometheus()
+    finally:
+        live.unregister_fleet_provider(fed)
+    types = {}
+    for ln in page.splitlines():
+        if ln.startswith("# TYPE "):
+            _, _, fam, kind = ln.split()
+            assert fam not in types, f"duplicate TYPE for {fam}"
+            types[fam] = kind
+    assert types['dask_ml_tpu_fleet_serving_requests_total'] == \
+        "counter"
+    assert types["dask_ml_tpu_fleet_processes"] == "gauge"
+    assert types["dask_ml_tpu_fleet_serving_latency_seconds"] == \
+        "histogram"
+    for ln in page.splitlines():
+        if not ln or ln.startswith("#"):
+            continue
+        name = ln.split("{")[0].split(" ")[0]
+        fam = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[:-len(suffix)] in types:
+                fam = name[:-len(suffix)]
+                break
+        assert fam in types, f"sample {name} has no TYPE line"
+
+
+def test_slo_burn_rate_latches_alerts():
+    """A window burning past the budget latches an alert that SURVIVES
+    the burn subsiding; the burn gauge itself recovers."""
+    fed = MetricsFederator(name="m", slo_ms=50.0)
+    fed.ingest([("p0", _doc(100, 0))])
+    assert fed.fleet_block()["slo"]["burn_rate"] == 0.0
+    # 10 violations over 100 requests = 10% >> the 1% budget
+    fed.ingest([("p0", _doc(200, 10))])
+    blk = fed.fleet_block()["slo"]
+    assert blk["burn_rate"] == pytest.approx(10.0)
+    assert len(blk["alerts"]) == 1
+    assert blk["alerts"][0]["violations"] == 10
+    # burn subsides: gauge drops, the latched alert stays
+    fed.ingest([("p0", _doc(300, 10))])
+    blk = fed.fleet_block()["slo"]
+    assert blk["burn_rate"] == 0.0
+    assert len(blk["alerts"]) == 1
+
+
+def test_status_fleet_http_surface():
+    """/status/fleet serves the registered federator's block; /status
+    embeds the same block under "fleet"; no federator => {} / absent."""
+    from dask_ml_tpu.observability.live import TelemetryServer
+
+    def get(url):
+        with urllib.request.urlopen(url, timeout=5.0) as resp:
+            return json.loads(resp.read().decode())
+
+    ts = TelemetryServer(port=0).start()
+    try:
+        assert get(f"{ts.url}/status/fleet") == {}
+        assert "fleet" not in get(f"{ts.url}/status")
+        fed = MetricsFederator(name="m")
+        fed.ingest([("p0", _doc(3))])
+        live.register_fleet_provider(fed)
+        try:
+            doc = get(f"{ts.url}/status/fleet")
+            assert doc["n_scraped"] == 1
+            assert doc["counters"]["serving_requests"] == 3
+            assert get(f"{ts.url}/status")["fleet"]["n_scraped"] == 1
+        finally:
+            live.unregister_fleet_provider(fed)
+    finally:
+        ts.stop()
+
+
+# -- the poller shares ONE scrape with the federator -------------------------
+
+class _CountingEndpoint(FleetEndpoint):
+    def __init__(self, process_id):
+        self.process_id = str(process_id)
+        self.doc_calls = 0
+        self.status_calls = 0
+
+    def status_doc(self):
+        self.doc_calls += 1
+        return {"serving": [{"fleet": "cnt", "queue_rows": 0,
+                             "replicas": [], "healthy_replicas": 1}],
+                "counters": {"serving_requests": 7,
+                             "serving_slo_violations": 0},
+                "telemetry": {"gauges": [], "histograms": []}}
+
+    def status(self):
+        self.status_calls += 1
+        return self.status_doc()["serving"][0]
+
+
+def test_poller_single_scrape_feeds_routing_and_federator():
+    """The PR 6 lesson applied fleet-wide: one status_doc fetch per
+    process per poll interval feeds BOTH the routing stats and the
+    metrics federator — the federator never issues its own read."""
+    ep = _CountingEndpoint("p0")
+    with config.set(obs_fleet_federate=True):
+        fed = FederatedFleet([ep], name="cnt", ladder=_ladder())
+    assert fed._federator is not None
+    fed._poll_once()
+    assert ep.doc_calls == 1
+    assert ep.status_calls == 0
+    assert fed._federator.fleet_block()["counters"][
+        "serving_requests"] == 7
+    fed._poll_once()
+    assert ep.doc_calls == 2
+
+
+# -- zero-overhead contract --------------------------------------------------
+
+def test_federation_off_by_default_builds_nothing(fitted):
+    """The default config builds no federator, registers no provider,
+    and leaves the router's exposition byte-identical — the fleet plane
+    costs nothing unless asked for."""
+    clf, _ = fitted
+    before = live.render_prometheus()
+    f1 = FleetServer(clf, name="fobs-zero", replicas=1,
+                     ladder=_ladder(), batch_window_ms=1.0).start()
+    try:
+        fed = FederatedFleet([LocalEndpoint(f1, "p0")],
+                             name="fobs-zero", ladder=_ladder())
+        assert fed._federator is None
+        with fed:
+            assert not live._fleet_providers
+            assert "fleet_" not in live.render_prometheus()
+    finally:
+        f1.stop(drain=False)
+    assert "dask_ml_tpu_fleet_" not in before
+
+
+def test_federator_rides_poller_no_new_threads(fitted):
+    """Federation ON adds zero threads: the thread census before and
+    after start() differs only by the poller + submit pool the
+    federation already owned (no federator thread exists to find)."""
+    clf, _ = fitted
+    f1 = FleetServer(clf, name="fobs-thr", replicas=1,
+                     ladder=_ladder(), batch_window_ms=1.0).start()
+    try:
+        with config.set(obs_fleet_federate=True):
+            fed = FederatedFleet([LocalEndpoint(f1, "p0")],
+                                 name="fobs-thr", ladder=_ladder())
+        names_before = {t.name for t in threading.enumerate()}
+        with fed:
+            new = {t.name for t in threading.enumerate()} \
+                - names_before
+            assert all(n.startswith(("fed-poller", "fed-submit"))
+                       for n in new), new
+    finally:
+        f1.stop(drain=False)
+
+
+# -- Perfetto cross-process flow chains --------------------------------------
+
+def test_export_flow_chain_joins_processes():
+    """Three legs of one trace across two pids (router, corpse leg,
+    survivor) chain as s -> t -> f flow events on pid-prefixed lanes —
+    one arrow threading the whole federated request."""
+    from dask_ml_tpu.observability.export import to_chrome_trace
+
+    rid = (77 << 24) | 5
+    records = [
+        # router leg (pid 77)
+        {"req_trace": True, "trace_id": rid, "pid": 77,
+         "method": "predict", "n_rows": 8, "t_unix": 100.0,
+         "e2e_s": 0.05, "outcome": "ok",
+         "stages": {"admit": 0.0, "dispatch": 0.001, "complete": 0.05},
+         "durations": {}, "threads": {"admit": "MainThread"}},
+        # worker leg on the survivor (pid 99)
+        {"req_trace": True, "trace_id": rid, "pid": 99,
+         "method": "predict", "n_rows": 8, "t_unix": 100.002,
+         "e2e_s": 0.04, "outcome": "ok",
+         "rerouted_from_process": "p0",
+         "stages": {"admit": 0.0, "queue_pop": 0.001, "pack": 0.002,
+                    "dispatch": 0.003, "execute_done": 0.03,
+                    "demux": 0.035, "complete": 0.04},
+         "durations": {}, "threads": {"admit": "http",
+                                      "worker": "w0"}},
+        # an unrelated single-leg trace keeps its s/f pair
+        {"req_trace": True, "trace_id": (77 << 24) | 9, "pid": 77,
+         "method": "predict", "n_rows": 1, "t_unix": 101.0,
+         "e2e_s": 0.01, "outcome": "ok",
+         "stages": {"admit": 0.0, "complete": 0.01},
+         "durations": {}, "threads": {"admit": "MainThread"}},
+    ]
+    trace = to_chrome_trace(records)
+    flows = [e for e in trace["traceEvents"]
+             if e.get("cat") == "request" and e["ph"] in "stf"
+             and e["id"] == rid]
+    phases = [e["ph"] for e in flows]
+    assert phases.count("s") == 1
+    assert phases.count("f") == 1
+    assert phases.count("t") == 2  # first leg's end + second leg's start
+    assert [e["ph"] for e in flows[:1]] == ["s"]
+    assert flows[-1]["ph"] == "f" and flows[-1]["bp"] == "e"
+    # multi-process laning: the two legs live on pid-prefixed lanes
+    lanes = [e["args"]["name"]
+             for e in trace["traceEvents"] if e["ph"] == "M"]
+    assert any(v.startswith("pid77.") for v in lanes)
+    assert any(v.startswith("pid99.") for v in lanes)
+    # the single-stage single leg would have no slices, but the other
+    # single-leg trace still emits its own s/f pair
+    other = [e for e in trace["traceEvents"]
+             if e.get("cat") == "request" and e.get("ph") in "stf"
+             and e.get("id") == ((77 << 24) | 9)]
+    assert [e["ph"] for e in other] == ["s", "f"]
+
+
+# -- report --watch ----------------------------------------------------------
+
+def test_report_watch_once_renders_frame(capsys):
+    """`report --watch URL --once` renders one live frame off /status +
+    /traces and exits 0 — the CI-checkable slice of the watch loop."""
+    from dask_ml_tpu.observability import report as report_cli
+    from dask_ml_tpu.observability.live import TelemetryServer
+
+    fed = MetricsFederator(name="m")
+    fed.ingest([("p0", _doc(3, 1))])
+    live.register_fleet_provider(fed)
+    ts = TelemetryServer(port=0).start()
+    try:
+        rc = report_cli.main(["--watch", ts.url, "--once"])
+    finally:
+        ts.stop()
+        live.unregister_fleet_provider(fed)
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert f"live: {ts.url}" in out
+    assert "fleet federation" in out
+    assert "run report:" in out
+    assert "\x1b[2J" not in out  # --once never clears the screen
+
+
+def test_report_watch_once_unreachable_is_nonzero(capsys):
+    from dask_ml_tpu.observability import report as report_cli
+
+    rc = report_cli.main(["--watch", "http://127.0.0.1:9",
+                          "--once", "--interval", "0.2"])
+    assert rc == 1
+    assert "unreachable" in capsys.readouterr().out
+
+
+# -- real process boundary ---------------------------------------------------
+
+_CHILD = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+# the child process OPTS INTO tracing via its own env-level config —
+# propagation joins ids, each process owns its sampling knob
+os.environ["DASK_ML_TPU_OBS_TRACE_SAMPLE"] = "1.0"
+port = int(sys.argv[1])
+from dask_ml_tpu.datasets import make_classification
+from dask_ml_tpu.linear_model import LogisticRegression
+from dask_ml_tpu.serving import BucketLadder, FleetServer
+from dask_ml_tpu.observability.live import TelemetryServer
+X, y = make_classification(n_samples=200, n_features=10,
+                           n_informative=5, random_state=0)
+clf = LogisticRegression(solver="lbfgs", max_iter=10).fit(X, y)
+fleet = FleetServer(clf, name="fedtrace", replicas=1,
+                    ladder=BucketLadder(8, 64, 2.0),
+                    batch_window_ms=1.0).warmup().start()
+ts = TelemetryServer(port=port).start()
+print("FED_READY", port, flush=True)
+time.sleep(180)
+"""
+
+
+@pytest.mark.slow
+def test_trace_joins_across_real_process_boundary():
+    """Two REAL child processes each serving the fleet over HTTP: the
+    parent's routed request produces a router trace whose id appears in
+    the CHOSEN child's own /traces surface with the full worker-stage
+    set — X-Trace-Context surviving an actual process boundary."""
+    import os
+    import subprocess
+    import sys
+
+    from tests._mp_capability import REPO, free_port
+
+    ports = [free_port(), free_port()]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _CHILD.format(repo=REPO), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        for port in ports
+    ]
+
+    def get(url, timeout=5.0):
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.read().decode()
+
+    try:
+        import time as _time
+
+        deadline = _time.monotonic() + 120.0
+        for port in ports:
+            while True:
+                try:
+                    if "ok" in get(f"http://127.0.0.1:{port}/healthz"):
+                        break
+                except OSError:
+                    if _time.monotonic() > deadline:
+                        outs = [p.communicate(timeout=5)[0]
+                                if p.poll() is not None else "(alive)"
+                                for p in procs]
+                        raise AssertionError(
+                            f"children never came up: {outs}")
+                    _time.sleep(0.25)
+
+        eps = [HttpEndpoint(f"http://127.0.0.1:{port}",
+                            name="fedtrace", process_id=f"c{i}",
+                            timeout_s=30.0)
+               for i, port in enumerate(ports)]
+        rng = np.random.default_rng(0)
+        X8 = rng.normal(size=(8, 10)).astype(np.float32)
+        with config.set(obs_trace_sample=1.0):
+            fed = FederatedFleet(eps, name="fedtrace",
+                                 ladder=_ladder()).start()
+            try:
+                out = fed.predict(X8)
+                assert out.shape[0] == 8
+            finally:
+                fed.stop()
+
+        router = [r for r in rtrace.traces_data()["traces"]
+                  if r.get("federation") == "fedtrace"]
+        assert len(router) == 1, router
+        rt = router[0]
+        assert rt["outcome"] == "ok"
+        chosen = rt["process"]
+        port = ports[int(chosen[1:])]
+        tdoc = json.loads(get(f"http://127.0.0.1:{port}/traces"))
+        legs = [t for t in tdoc["traces"]
+                if t["trace_id"] == rt["trace_id"]]
+        assert len(legs) == 1, tdoc["traces"]
+        assert set(legs[0]["stages"]) >= {"admit", "queue_pop",
+                                          "complete"}
+        assert legs[0]["pid"] != os.getpid()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            p.wait(timeout=10)

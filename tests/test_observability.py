@@ -450,6 +450,77 @@ def test_trace_plane_adds_nothing_when_disabled():
     assert serve_jaxpr() == baseline
 
 
+def test_fleet_plane_adds_nothing_when_disabled():
+    """ISSUE 19 extension of the zero-overhead contract: the fleet
+    observability plane (trace propagation + metrics federation) is
+    host-side bookkeeping riding threads the federation already owns —
+    a federated lifecycle with federation ON leaves the serving entry
+    point's jaxpr byte-identical and compiles nothing new, and the
+    default (federation OFF) builds no federator, registers no
+    provider, and spawns no extra thread."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from dask_ml_tpu.observability import live
+    from dask_ml_tpu.serving import (
+        BucketLadder,
+        FederatedFleet,
+        FleetServer,
+        LocalEndpoint,
+    )
+    from dask_ml_tpu.wrappers import _linear_core
+
+    def serve_jaxpr():
+        core = _linear_core("classify", multi=False)
+        p = {"W": jnp.zeros((1, 6)), "b": jnp.zeros(1)}
+        return str(jax.make_jaxpr(core)(p, jnp.zeros((8, 6))))
+
+    from dask_ml_tpu.datasets import make_classification
+    from dask_ml_tpu.linear_model import LogisticRegression
+
+    X, y = make_classification(
+        n_samples=300, n_features=6, n_informative=4, random_state=0
+    )
+    clf = LogisticRegression(solver="lbfgs", max_iter=20).fit(X, y)
+    Xh = X.to_numpy().astype(np.float32)
+
+    baseline = serve_jaxpr()
+    ladder = BucketLadder(8, 64, 2.0)
+    fleet = FleetServer(clf, name="zf", replicas=1, ladder=ladder,
+                        batch_window_ms=1.0).warmup().start()
+    try:
+        before = obs.counters_snapshot().get("recompiles", 0)
+        # federation + propagation ON: everything stays on the host
+        with config.set(obs_fleet_federate=True, obs_trace_sample=1.0):
+            with FederatedFleet([LocalEndpoint(fleet, "p0")],
+                                name="zf", ladder=ladder) as fed:
+                assert fed._federator is not None
+                fed._poll_once()
+                fed.predict(Xh[:8])
+                assert serve_jaxpr() == baseline
+        assert obs.counters_snapshot().get("recompiles", 0) == before
+        # the default: no federator object, no provider registration,
+        # no fleet_ families on /metrics, and no thread beyond the
+        # poller + submit pool the federation owns anyway
+        names_before = {t.name for t in threading.enumerate()}
+        with FederatedFleet([LocalEndpoint(fleet, "p0")],
+                            name="zf", ladder=ladder) as fed:
+            assert fed._federator is None
+            assert not live._fleet_providers
+            assert "dask_ml_tpu_fleet_" not in live.render_prometheus()
+            new = {t.name for t in threading.enumerate()} - names_before
+            assert all(n.startswith(("fed-poller", "fed-submit"))
+                       for n in new), new
+        assert serve_jaxpr() == baseline
+    finally:
+        fleet.stop(drain=False)
+        from dask_ml_tpu.observability import _requests as rtrace
+
+        rtrace.traces_reset()
+
+
 def test_jit_callbacks_probe_resettable(monkeypatch):
     from dask_ml_tpu.observability import _metrics
 
