@@ -320,6 +320,36 @@ class Config:
     # gauges + the open-span stack to the trace sink, without touching
     # the fit. 0 = disabled (no thread, nothing armed)
     watchdog_timeout_s: float = 0.0
+    # alert rules engine (observability/alerts.py): ","/";"-separated
+    # declarative rules evaluated over the live host-side registry, e.g.
+    # "serving_slo_violations:rate>5/60s, drift_score_max:gauge>0.2,
+    # fit_eta_seconds:gauge>1800" — counter rate-over-window and gauge
+    # threshold forms (ops > < >= <=). The special value "builtin" arms
+    # only the built-in rules (watchdog stalls, post-warmup recompiles,
+    # fleet SLO burn > 1.0 — always included once the engine is armed).
+    # "" + incident_dir unset = no engine, no ticker thread (the
+    # zero-overhead default)
+    obs_alert_rules: str = ""
+    # alert-engine evaluation cadence: seconds between ticker passes
+    # over the counter/gauge snapshots (pure host dicts, zero device
+    # syncs per tick)
+    obs_alert_interval_s: float = 5.0
+    # black-box incident capture (observability/incidents.py): any alert
+    # transition to firing (plus watchdog stalls and reliability typed
+    # errors) writes one rate-limited JSON bundle here — open-span
+    # stack, recent span/trace rings, counter/gauge/histogram
+    # snapshots, programs table, device memory gauges, armed fault
+    # plan, config fingerprint — atomically (tmp + fsync + rename).
+    # Setting it arms the alert engine's built-in rules even with
+    # obs_alert_rules unset. "" = capture disabled (no bundle dir)
+    incident_dir: str = ""
+    # incident bundles retained under incident_dir: past the cap the
+    # oldest bundles are evicted after each capture
+    incident_keep: int = 16
+    # capture a bounded jax.profiler trace window into the incident dir
+    # on each incident (real device traces on TPU; documented
+    # no-op-with-reason off-TPU — see incidents.deep_profile)
+    obs_profile_on_incident: bool = False
     # checkpoint directory for adaptive searches ("" = disabled)
     checkpoint_dir: str = ""
     # -- serving (dask_ml_tpu/serving/) ----------------------------------

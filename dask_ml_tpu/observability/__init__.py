@@ -59,7 +59,20 @@ equivalent is this package (grown from the flat per-step logger in
   a ``{process=}`` label, histograms merge bucket-for-bucket), and
   exposes it on the router's ``/metrics`` (``dask_ml_tpu_fleet_*``
   families) and ``/status/fleet`` with a fleet-wide SLO burn-rate and
-  latched alerts.
+  latched alerts;
+- ``alerts``    — the alert rules engine (``config.obs_alert_rules``):
+  declarative counter-rate/gauge-threshold rules plus built-ins
+  (watchdog stalls, post-warmup recompiles, fleet SLO burn, drift,
+  typed errors) evaluated by one ticker over the live registry, with
+  firing/resolved state machines, ``alerts_firing{rule=}`` gauges, the
+  ``/alerts`` endpoint, and the crossing ledger the drift/fleet latches
+  route through;
+- ``incidents`` — black-box incident capture (``config.incident_dir``):
+  every firing transition freezes one rate-limited, bounded, atomic
+  JSON bundle (open spans, counter/gauge/histogram snapshots, programs,
+  device memory, fault plan, config fingerprint), plus on-demand deep
+  profiling (``POST /profile?seconds=N``; jax.profiler windows on TPU,
+  no-op-with-reason off it).
 
 Everything is ambient and zero-overhead when disabled: no
 ``metrics_path``/``trace_dir`` configured means spans are no-ops and no
@@ -138,6 +151,22 @@ from ._requests import (
     traces_reset,
 )
 from ._watchdog import Watchdog, watchdog, watchdog_active
+from .alerts import (
+    AlertEngine,
+    AlertRule,
+    AlertRuleError,
+    alerts_data,
+    ensure_engine,
+    note_event,
+    parse_rules,
+    stop_engine,
+)
+from .incidents import (
+    capture_incident,
+    deep_profile,
+    incidents_data,
+    load_bundles,
+)
 from .live import (
     TelemetryServer,
     ensure_telemetry,
@@ -156,6 +185,9 @@ from .live import (
 install_recompile_tracking()
 
 __all__ = [
+    "AlertEngine",
+    "AlertRule",
+    "AlertRuleError",
     "CategoricalSketch",
     "FeatureSketch",
     "Histogram",
@@ -169,6 +201,10 @@ __all__ = [
     "Watchdog",
     "active_logger",
     "add_span_observer",
+    "alerts_data",
+    "capture_incident",
+    "deep_profile",
+    "ensure_engine",
     "ensure_telemetry",
     "gauge_set",
     "live_publishing",
@@ -188,10 +224,14 @@ __all__ = [
     "emit_jit_step",
     "fit_logger",
     "install_recompile_tracking",
+    "incidents_data",
     "jit_callbacks_supported",
+    "load_bundles",
     "load_capture",
     "log_counters",
     "log_programs",
+    "note_event",
+    "parse_rules",
     "replay",
     "traces_data",
     "traces_reset",
@@ -226,6 +266,7 @@ __all__ = [
     "reset_jit_callbacks_probe",
     "span",
     "start_profiler_server",
+    "stop_engine",
     "timed",
     "track_program",
     "watchdog",

@@ -179,6 +179,18 @@ class Watchdog:
         if counters_enabled():
             counter_add("watchdog_stalls", 1)
         try:
+            # the incident plane: one stall = one builtin:watchdog_stall
+            # event (fires the rule + black-box capture when armed;
+            # one deque append otherwise)
+            from . import alerts as _alerts
+
+            _alerts.note_event("watchdog_stall", value=age, meta={
+                "span": stalled["span"], "thread": stalled["thread"],
+                "timeout_s": self.timeout_s,
+            })
+        except Exception:
+            pass
+        try:
             # feed the live plane's /status stall ring (stacks elided
             # there; the full dump still goes to the trace sink below)
             from .live import note_stall

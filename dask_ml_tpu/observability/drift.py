@@ -412,6 +412,15 @@ def record_canary(model, v_old, v_new, method, old_out, new_out) -> dict:
                         or verdict["max_quantile_shift"] > threshold)
     if rec["alert"]:
         record_drift_alert()
+        # one crossing = one event: the alert engine's builtin:drift
+        # rule fires off THIS call alone (never also polled), so the
+        # counter above and the engine can't double-count
+        from . import alerts as _alerts
+
+        _alerts.note_event("drift", value=verdict["disagreement"], meta={
+            "pair": "canary", "model": str(model),
+            "version_from": int(v_old), "version_to": int(v_new),
+        })
     with _lock:
         _canaries.append(rec)
         del _canaries[:-_CANARY_KEEP]
@@ -503,6 +512,7 @@ def _score_key(key, pairs, rows, threshold, now):
                "max_psi": None, "max_ks": None, "alerts": 0}
     scored = [(kind, score_pair(ref, cur)) for kind, ref, cur in pairs]
     new_alerts = 0
+    crossings = []
     with _lock:
         for kind, scores in scored:
             psis = [p for p, _ in scores if not math.isnan(p)]
@@ -522,6 +532,7 @@ def _score_key(key, pairs, rows, threshold, now):
                     _alerted.add(latch)
                     summary["alerts"] += 1
                     new_alerts += 1
+                    crossings.append((kind, f, p))
                 elif not alert:
                     _alerted.discard(latch)
                 records.append({
@@ -534,6 +545,17 @@ def _score_key(key, pairs, rows, threshold, now):
         _last_scores[key] = summary
     for _ in range(new_alerts):
         record_drift_alert()
+    # the same below→above latch drives the alert engine: the _alerted
+    # set is the single dedupe source, so a crossing mints exactly one
+    # event (builtin:drift is event-only — it is never also polled)
+    if crossings:
+        from . import alerts as _alerts
+
+        for kind, f, p in crossings:
+            _alerts.note_event("drift", value=p, meta={
+                "pair": kind, "model": model, "version": version,
+                "method": method, "feature": f"f{f}",
+            })
     return records
 
 

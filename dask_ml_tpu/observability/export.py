@@ -16,7 +16,9 @@ format, viewable in ``ui.perfetto.dev`` (or ``chrome://tracing``):
 - per-step solver records contribute ``<component>.<metric>`` counter
   tracks (loss / inertia / residual trajectories on the timeline);
 - watchdog stall records become instant ("i") events so a stall dump is
-  visible at the moment it fired;
+  visible at the moment it fired; alert-firing transitions and incident
+  captures (``observability/alerts.py``/``incidents.py``) lane the same
+  way, so "what was running when the pager went off" is one glance;
 - sampled request traces (``req_trace`` records) become per-stage "X"
   slices — queue wait on the admission thread's lane, pack/execute/
   demux on the worker's — linked by flow events ("s"/"f") sharing the
@@ -204,6 +206,28 @@ def to_chrome_trace(records) -> dict:
                 "ts": round(t, 3),
                 "args": {"age_s": r.get("age_s"),
                          "timeout_s": r.get("timeout_s")},
+            })
+            continue
+        if r.get("alert") and not r.get("drift"):
+            # rules-engine transitions (ISSUE 20): firing instants land
+            # on the timeline; resolved transitions stay out (the
+            # firing mark plus span context already tells the story)
+            if r.get("state") == "firing":
+                events.append({
+                    "name": f"alert firing: {r.get('rule', '?')}",
+                    "ph": "i", "s": "g", "pid": 1,
+                    "tid": tid_of(lane_of(r)), "ts": round(t, 3),
+                    "args": {"metric": r.get("metric"),
+                             "value": r.get("value")},
+                })
+            continue
+        if r.get("incident"):
+            # black-box captures: the moment a bundle was frozen
+            events.append({
+                "name": f"incident: {r.get('reason', '?')}",
+                "ph": "i", "s": "g", "pid": 1,
+                "tid": tid_of(lane_of(r)), "ts": round(t, 3),
+                "args": {"path": r.get("path"), "rule": r.get("rule")},
             })
             continue
         if r.get("req_trace"):

@@ -136,13 +136,20 @@ class MetricsFederator:
                 dr = max(req - self._prev[1], 0)
                 self._burn = (dv / dr) / self._budget if dr > 0 else 0.0
                 if self._burn > 1.0:
-                    self._alerts.append({
-                        "t_unix": round(self._t_unix, 3),
-                        "burn_rate": round(self._burn, 4),
-                        "violations": dv,
-                        "requests": dr,
-                        "budget": self._budget,
-                    })
+                    # ONE creation point (ISSUE 20): the crossing is
+                    # minted by the alert engine's ledger — at most one
+                    # builtin:fleet_slo_burn firing per crossing — and
+                    # the SAME record keeps this latched ring alive as
+                    # the legacy fleet-block surface
+                    from . import alerts as _obs_alerts
+
+                    self._alerts.append(_obs_alerts.note_event(
+                        "fleet_slo_burn", value=self._burn, meta={
+                            "burn_rate": round(self._burn, 4),
+                            "violations": dv,
+                            "requests": dr,
+                            "budget": self._budget,
+                        }))
             self._prev = (viol, req)
         return True
 

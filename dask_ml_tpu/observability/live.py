@@ -499,6 +499,25 @@ def status_data() -> dict:
         plrows = None
     if plrows:
         records.append({"plans": plrows})
+    # the incident plane (ISSUE 20): alert rules + captured bundles —
+    # blocks for operators, synthetic records for the shared report
+    # aggregator (one serialization path for live and post-hoc views)
+    try:
+        from . import alerts as _alerts
+
+        alerts_block = _alerts.alerts_data()
+    except Exception:
+        alerts_block = {}
+    try:
+        from . import incidents as _incidents
+
+        incidents_block = _incidents.incidents_data()
+    except Exception:
+        incidents_block = {}
+    if alerts_block.get("rules") or alerts_block.get("events"):
+        records.append({"alerts": alerts_block})
+    if incidents_block.get("captured"):
+        records.append({"incidents": incidents_block["captured"]})
     hists = {}
     for (name, labels), h in histograms_snapshot().items():
         key = f"{name}{_labels_str(labels)}"
@@ -572,6 +591,8 @@ def status_data() -> dict:
         "drift": drift_block,
         "reliability": reliability_block,
         "watchdog_stalls": stalls,
+        "alerts": alerts_block,
+        "incidents": incidents_block,
         "report": report_data(records),
     }
     try:
@@ -619,7 +640,25 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         # do_GET so scrapers stay read-only.
         path = self.path.split("?", 1)[0].rstrip("/")
         try:
-            if path.startswith("/fleet/"):
+            if path == "/profile":
+                # on-demand deep profiling: a bounded jax.profiler
+                # window into config.incident_dir (real device traces
+                # on TPU; no-op-with-reason off-TPU). POST, not GET —
+                # it changes on-disk state and blocks for the window.
+                from urllib.parse import parse_qs, urlparse
+
+                from . import incidents as _incidents
+
+                q = parse_qs(urlparse(self.path).query)
+                seconds = (q.get("seconds") or ["5"])[0]
+                out = _incidents.deep_profile(seconds)
+                self._reply(
+                    200 if out.get("profiled") else 400,
+                    (json.dumps(out, default=_json_default)
+                     + "\n").encode(),
+                    "application/json",
+                )
+            elif path.startswith("/fleet/"):
                 from ..serving import federation
 
                 n = int(self.headers.get("Content-Length", 0) or 0)
@@ -661,6 +700,18 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                      + "\n").encode(),
                     "application/json",
                 )
+            elif path == "/alerts":
+                # the alert engine's view alone: rule rows with
+                # firing/resolved state, the transition ring, and the
+                # crossing ledger — what a pager/autoscaler polls
+                from . import alerts as _alerts
+
+                self._reply(
+                    200,
+                    (json.dumps(_alerts.alerts_data(),
+                                default=_json_default) + "\n").encode(),
+                    "application/json",
+                )
             elif path == "/status/fleet":
                 # the fleet-scope view alone ({} until a federating
                 # router registers): merged counters/quantiles + the
@@ -685,7 +736,8 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 self._reply(
                     200,
                     b"dask_ml_tpu live telemetry: "
-                    b"/metrics /status /status/fleet /traces /healthz\n",
+                    b"/metrics /status /status/fleet /traces /alerts "
+                    b"/healthz (POST /profile?seconds=N)\n",
                     "text/plain; charset=utf-8",
                 )
             else:
@@ -798,6 +850,19 @@ def ensure_telemetry() -> TelemetryServer | None:
     ``_BIND_RETRY_S`` before the next attempt, and NEVER raises into
     the fit."""
     global _singleton
+    # the alert engine shares these entry points but arms on its OWN
+    # knobs (obs_alert_rules / incident_dir) — rules work without an
+    # HTTP port. One None check + one config read when disarmed; a bad
+    # rule spec raises its typed error HERE, in the arming caller,
+    # never silently inside a daemon.
+    from . import alerts as _alerts
+
+    try:
+        _alerts.ensure_engine()
+    except _alerts.AlertRuleError:
+        raise
+    except Exception:
+        pass
     if _singleton is not None:
         return _singleton
     from ..config import get_config

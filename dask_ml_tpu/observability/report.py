@@ -11,6 +11,8 @@ Usage::
     python -m dask_ml_tpu.observability.report --watch http://host:9100
     python -m dask_ml_tpu.observability.report --watch URL --interval 5
     python -m dask_ml_tpu.observability.report --watch URL --once
+    python -m dask_ml_tpu.observability.report trace.jsonl --incidents DIR
+    python -m dask_ml_tpu.observability.report --incidents DIR
 
 Reads the records the subsystem emits — span records (``span`` field),
 per-step solver/search records (``component`` field), stream-pass
@@ -35,6 +37,14 @@ telemetry server's ``/status`` (whose ``report`` block is already
 (default 2) and re-renders the same tables in place — programs,
 serving windows, fleet federation, request traces — the top(1) of a
 serving process. ``--once`` prints a single frame and exits (CI).
+
+``--incidents DIR`` renders the black-box bundles the incident plane
+captured under ``config.incident_dir`` (``observability/incidents.py``)
+as an offline table — alone, or after the per-file report tables; with
+``--json`` the bundles ride the same object as ``incident_bundles``.
+The ``alert`` transition records the rules engine emits and the
+``incident`` capture records aggregate into ``alerts``/``incidents``
+tables alongside everything above.
 """
 
 from __future__ import annotations
@@ -480,6 +490,72 @@ def reliability_summary(records):
     return rows
 
 
+def summarize_alerts(records):
+    """The run's alert-engine state: the LAST ``alerts`` snapshot block
+    (a /status scrape's synthetic record), else rule rows aggregated
+    from the JSONL ``alert`` transition records the engine emits —
+    last-transition-wins per rule, ``fired`` counting firing
+    transitions."""
+    for r in reversed(records):
+        if isinstance(r.get("alerts"), dict):
+            return r["alerts"]
+    rules = {}
+    for r in records:
+        if not r.get("alert") or not r.get("rule"):
+            continue
+        row = rules.setdefault(r["rule"], {
+            "rule": r["rule"], "kind": r.get("kind"),
+            "metric": r.get("metric"), "state": "ok",
+            "value": None, "since": None, "fired": 0,
+        })
+        firing = r.get("state") == "firing"
+        row["state"] = "firing" if firing else "ok"
+        row["value"] = r.get("value")
+        row["since"] = r.get("t_unix")
+        if firing:
+            row["fired"] += 1
+    rows = sorted(rules.values(), key=lambda x: x["rule"])
+    return {
+        "armed": bool(rows),
+        "rules": rows,
+        "firing": [x["rule"] for x in rows if x["state"] == "firing"],
+    }
+
+
+def summarize_incidents(records):
+    """Captured incident bundles: the LAST ``incidents`` snapshot
+    record (a /status scrape), else the JSONL ``incident`` capture
+    records in order."""
+    for r in reversed(records):
+        if isinstance(r.get("incidents"), list):
+            return r["incidents"]
+    return [{"path": r.get("path"), "reason": r.get("reason"),
+             "rule": r.get("rule"), "t_unix": r.get("t_unix")}
+            for r in records if r.get("incident")]
+
+
+def summarize_bundles(bundles):
+    """Table rows for on-disk bundles (``report --incidents <dir>``):
+    the capture identity plus how much context each bundle froze."""
+    rows = []
+    for b in bundles:
+        if b.get("error"):
+            rows.append({"t_unix": None, "reason": b["error"],
+                         "rule": None, "open_spans": None,
+                         "counters": None, "programs": None,
+                         "path": b.get("path")})
+            continue
+        rows.append({
+            "t_unix": b.get("t_unix"), "reason": b.get("reason"),
+            "rule": b.get("rule"),
+            "open_spans": len(b.get("open_spans") or []),
+            "counters": len(b.get("counters") or {}),
+            "programs": len(b.get("programs") or []),
+            "path": b.get("path"),
+        })
+    return rows
+
+
 def report_data(records):
     """The full report as one JSON-ready dict (the ``--json`` output;
     ``build_report`` renders the same content as tables)."""
@@ -509,6 +585,8 @@ def report_data(records):
         "programs": final_programs(records),
         "plans": final_plans(records),
         "peak": peak,
+        "alerts": summarize_alerts(records),
+        "incidents": summarize_incidents(records),
         "watchdog_stalls": [
             {"span": s, "thread": t, "age_s": a, "threads_dumped": n}
             for s, t, a, n in watchdog_stalls(records)
@@ -706,6 +784,26 @@ def render_report(data, path="<records>", slowest=10):
               p.get("rungs"), p.get("warmups"), p.get("warm_hits"))
              for p in plans],
         )
+    al = data.get("alerts") or {}
+    if al.get("rules"):
+        lines += _table(
+            "alerts (rules engine)",
+            ("rule", "kind", "state", "value", "fired"),
+            [(a.get("rule"), a.get("kind"), a.get("state"),
+              a.get("value") if a.get("value") is not None else "-",
+              a.get("fired", 0)) for a in al["rules"]],
+        )
+    inc = data.get("incidents") or []
+    if inc:
+        lines += _table(
+            "incidents (black-box bundles)",
+            ("time", "reason", "rule", "path"),
+            [(time.strftime("%H:%M:%S",
+                            time.localtime(c["t_unix"]))
+              if c.get("t_unix") else "-",
+              c.get("reason"), c.get("rule") or "-", c.get("path"))
+             for c in inc],
+        )
     stalls = data.get("watchdog_stalls") or []
     if stalls:
         lines += _table(
@@ -739,6 +837,24 @@ def render_report(data, path="<records>", slowest=10):
     return "\n".join(lines).rstrip() + "\n"
 
 
+def _render_bundle_table(bundle_rows, incidents_dir):
+    """The offline-bundles table as one printable string."""
+    lines = _table(
+        f"incident bundles ({incidents_dir})",
+        ("time", "reason", "rule", "open_spans", "counters",
+         "programs", "path"),
+        [(time.strftime("%H:%M:%S", time.localtime(b["t_unix"]))
+          if b.get("t_unix") else "-",
+          b.get("reason"), b.get("rule") or "-",
+          b.get("open_spans") if b.get("open_spans") is not None
+          else "-",
+          b.get("counters") if b.get("counters") is not None else "-",
+          b.get("programs") if b.get("programs") is not None else "-",
+          b.get("path")) for b in bundle_rows],
+    ) or [f"incident bundles ({incidents_dir}): none found", ""]
+    return "\n".join(lines).rstrip() + "\n"
+
+
 # -- live watch mode (report --watch URL) ------------------------------------
 
 def _fetch_json(url, timeout=5.0):
@@ -764,6 +880,11 @@ def _watch_frame(url, slowest=10, timeout=5.0):
         f"({time.strftime('%H:%M:%S')})",
         "",
     ]
+    # firing alerts belong in the header: an operator watching a live
+    # process must see "FIRING" before any table
+    firing = (doc.get("alerts") or {}).get("firing") or []
+    if firing:
+        lines[0] += f"  FIRING={','.join(firing)}"
     srv_rows = [
         (s.get("fleet") or s.get("model") or "-",
          s.get("healthy_replicas", s.get("replicas", "-")),
@@ -825,6 +946,7 @@ def main(argv=None):
     watch_url = None
     interval = 2.0
     once = False
+    incidents_dir = None
     paths = []
     i = 0
     while i < len(argv):
@@ -854,6 +976,13 @@ def main(argv=None):
                 return 2
         elif a == "--once":
             once = True
+        elif a == "--incidents":
+            if i + 1 >= len(argv):
+                print("error: --incidents needs a bundle directory",
+                      file=sys.stderr)
+                return 2
+            i += 1
+            incidents_dir = argv[i]
         elif a == "--perfetto":
             if i + 1 >= len(argv):
                 print("error: --perfetto needs an output path",
@@ -881,9 +1010,24 @@ def main(argv=None):
                          slowest=slowest)
         except KeyboardInterrupt:
             return 0
+    # offline incident bundles (report [trace.jsonl] --incidents DIR):
+    # rendered after the per-file reports, or alone with no inputs
+    bundle_rows = None
+    if incidents_dir is not None:
+        from .incidents import load_bundles
+
+        bundle_rows = summarize_bundles(load_bundles(incidents_dir))
     if not paths:
-        print("error: no input JSONL files", file=sys.stderr)
-        return 2
+        if bundle_rows is None:
+            print("error: no input JSONL files", file=sys.stderr)
+            return 2
+        if as_json:
+            sys.stdout.write(json.dumps(
+                {"incident_bundles": bundle_rows}) + "\n")
+        else:
+            sys.stdout.write(_render_bundle_table(bundle_rows,
+                                                  incidents_dir))
+        return 0
     if perfetto_out is not None and len(paths) > 1 and not merge:
         # one output path per invocation: silently overwriting it per
         # input would keep only the last file's trace (--merge folds
@@ -923,10 +1067,15 @@ def main(argv=None):
             data = report_data(merged)
             data["path"] = label
             data["merged_files"] = len(lists)
+            if bundle_rows is not None:
+                data["incident_bundles"] = bundle_rows
             sys.stdout.write(json.dumps(data) + "\n")
         elif perfetto_out is None:
             sys.stdout.write(build_report(merged, path=label,
                                           slowest=slowest))
+            if bundle_rows is not None:
+                sys.stdout.write(_render_bundle_table(bundle_rows,
+                                                      incidents_dir))
         return rc
     for path in paths:
         try:
@@ -953,10 +1102,15 @@ def main(argv=None):
         if as_json:
             data = report_data(records)
             data["path"] = path
+            if bundle_rows is not None:
+                data["incident_bundles"] = bundle_rows
             sys.stdout.write(json.dumps(data) + "\n")
         elif perfetto_out is None:
             sys.stdout.write(build_report(records, path=path,
                                           slowest=slowest))
+    if bundle_rows is not None and not as_json and perfetto_out is None:
+        sys.stdout.write(_render_bundle_table(bundle_rows,
+                                              incidents_dir))
     return rc
 
 

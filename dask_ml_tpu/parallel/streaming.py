@@ -825,10 +825,19 @@ class BlockStream:
                 raise
             except OSError as exc:
                 if attempt >= self._io_retries:
-                    raise _flt.StreamIORetriesExhausted(
+                    err = _flt.StreamIORetriesExhausted(
                         f"{what} still failing after {attempt + 1} "
                         f"attempt(s): {exc}"
-                    ) from exc
+                    )
+                    try:
+                        # opt-in incident hook (typed error, one
+                        # module-global check when disarmed)
+                        from ..observability import alerts as _obs_alerts
+
+                        _obs_alerts.note_error(err, "stream_io")
+                    except Exception:
+                        pass
+                    raise err from exc
                 record_stream_retry()
                 _time.sleep(min(0.02 * (2 ** attempt), 1.0))
                 attempt += 1

@@ -1169,6 +1169,14 @@ class ModelServer:
             for _ in batch:   # per REQUEST, matching the timeout path
                 smetrics.record_drop("error")
             self._tag_fault(batch, exc)
+            try:
+                # opt-in incident hook: a failed batch is a typed error
+                # (one module-global check when the plane is disarmed)
+                from ..observability import alerts as _obs_alerts
+
+                _obs_alerts.note_error(exc, "serving_execute")
+            except Exception:
+                pass
             fail_requests(batch, ServingError(
                 f"batch execution failed: {type(exc).__name__}: {exc}"
             ), outcome="error")

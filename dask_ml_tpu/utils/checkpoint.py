@@ -120,16 +120,20 @@ def restore_pytree(path, like=None):
         raise
 
 
-def save_host(path, obj):
+def save_host(path, obj, dump=None):
     """Pickle host-side state (search history, sklearn models) —
     atomically: temp sibling, flush+fsync, rename. A kill mid-save
-    leaves the previous file intact, never a truncated pickle."""
+    leaves the previous file intact, never a truncated pickle.
+
+    ``dump`` swaps the serializer: a ``dump(obj, fileobj)`` callable
+    writing to a binary file (the incident plane passes a JSON dumper
+    here so bundles ride the same atomic-publish contract)."""
     path = os.path.abspath(path)
     os.makedirs(os.path.dirname(path), exist_ok=True)
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
         with open(tmp, "wb") as f:
-            pickle.dump(obj, f)
+            (dump or pickle.dump)(obj, f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)

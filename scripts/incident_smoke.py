@@ -1,0 +1,312 @@
+"""Incident-plane verify gate (ISSUE 20): an injected SLO breach in a
+SUBPROCESS serving fleet must close the detect -> snapshot -> artifact
+loop.
+
+The parent launches a child that fits a model, starts a ModelServer
+(live exporter on a free port), warms it up, THEN arms the alert
+engine (`serving_slo_violations:rate>2/2s` + incident capture) — so
+warmup compiles can never count — and drives a breach through an armed
+``fault_plan`` (``serving_execute:hang@...``) while holding a span
+open. The parent asserts:
+
+- ``/alerts`` shows the SLO rule transitioning firing -> resolved once
+  the breach subsides (hysteresis: two clean ticks);
+- EXACTLY ONE rate-limited incident bundle lands under the incident
+  dir, containing the open-span stack (the breach span), non-empty
+  counter + histogram snapshots, and the programs table;
+- a second capture attempt inside the rate-limit window returns None
+  and bumps ``incidents_rate_limited_total``;
+- ZERO post-warmup XLA compiles (the child compares the ``recompiles``
+  counter across the breach, and ``builtin:recompiles`` never fires);
+- ``POST /profile`` answers the documented no-op-with-reason off-TPU;
+- a SEPARATE child SIGKILLed mid-capture-loop never publishes a
+  truncated bundle (the save_host atomic-publish contract): every
+  ``incident_*.json`` on disk parses.
+
+Prints one JSON line: {"ok": true, "bundles": 1, ...}.
+Run: ``python scripts/incident_smoke.py`` (exit 0 = gate holds).
+"""
+
+import json
+import os
+import re
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CHILD = r"""
+import json, os, time
+import numpy as np
+from dask_ml_tpu import config
+from dask_ml_tpu.datasets import make_classification
+from dask_ml_tpu.linear_model import LogisticRegression
+from dask_ml_tpu.observability import alerts, incidents, span
+from dask_ml_tpu.observability._counters import counters_snapshot
+from dask_ml_tpu.serving import BucketLadder, ModelServer
+
+IDIR = os.environ["INCIDENT_SMOKE_DIR"]
+RESULT = os.environ["INCIDENT_SMOKE_RESULT"]
+
+Xs, ys = make_classification(
+    n_samples=300, n_features=6, n_informative=4, random_state=0
+)
+clf = LogisticRegression(solver="lbfgs", max_iter=20).fit(Xs, ys)
+Xh = Xs.to_numpy().astype(np.float32)
+
+# the fault plan and SLO are captured at SERVER CONSTRUCTION (the
+# worker thread re-applies the creator's config): invocations 0-2 of
+# serving_execute run clean, 3-8 hang 0.2s each — far past the 50ms SLO
+with config.set(serving_slo_ms=50.0,
+                fault_plan="serving_execute:hang@3*6/0.2"):
+    with ModelServer(clf, ladder=BucketLadder(8, 64, 2.0)) as srv:
+        srv.warmup()
+        for i in range(3):          # clean phase: invocations 0-2
+            srv.submit(Xh[: 4 + i]).result(30)
+        # arm the plane AFTER warmup + clean traffic: the recompiles
+        # baseline sample excludes every warmup compile by construction
+        with config.set(
+            obs_alert_rules="serving_slo_violations:rate>2/2s",
+            incident_dir=IDIR,
+            obs_alert_interval_s=0.2,
+        ):
+            assert alerts.ensure_engine() is not None
+            time.sleep(0.5)         # ticker takes its baseline samples
+            compiles_base = counters_snapshot().get("recompiles", 0)
+            with span("incident_smoke.breach"):
+                for i in range(6):  # invocations 3-8: the breach
+                    srv.submit(Xh[: 4 + i]).result(30)
+                # hold the span open across >=2 tick intervals so the
+                # firing-triggered capture freezes it mid-breach
+                time.sleep(1.0)
+            for i in range(4):      # clean again: the rule must resolve
+                srv.submit(Xh[: 4 + i]).result(30)
+            compiles_end = counters_snapshot().get("recompiles", 0)
+            # second capture inside the 30s rate-limit window: must be
+            # refused (None) and counted, not written
+            second = incidents.capture_incident("smoke-second-attempt")
+            tmp = RESULT + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"compiles_base": compiles_base,
+                           "compiles_end": compiles_end,
+                           "second_capture": second}, f)
+            os.replace(tmp, RESULT)
+            # linger armed: the parent still needs /alerts to show the
+            # resolve transition and /profile to answer
+            time.sleep(float(os.environ.get("INCIDENT_SMOKE_LINGER",
+                                            "60")))
+"""
+
+KILL_CHILD = r"""
+import os, time
+from dask_ml_tpu import config
+from dask_ml_tpu.observability import incidents
+
+with config.set(incident_dir=os.environ["INCIDENT_SMOKE_DIR"],
+                incident_keep=8):
+    # first bundle lands before READY so the parent's SIGKILL always
+    # interrupts a LATER write, never an empty dir
+    incidents.capture_incident("kill-test-first", force=True)
+    print("READY", flush=True)
+    while True:
+        incidents.capture_incident("kill-test", force=True)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get(url, timeout=3.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode()
+
+
+def _post(url, timeout=5.0):
+    req = urllib.request.Request(url, data=b"", method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _wait_dead_or(child, deadline, what):
+    if child.poll() is not None or time.time() > deadline:
+        if child.poll() is None:
+            child.kill()
+            child.wait(10)
+        raise RuntimeError(
+            f"child exited or deadline passed before {what}: "
+            + child.stderr.read().decode()[-2000:]
+        )
+    time.sleep(0.05)
+
+
+def main():
+    out = {"ok": False}
+    port = _free_port()
+    workdir = tempfile.mkdtemp(prefix="incident_smoke_")
+    idir = os.path.join(workdir, "incidents")
+    result_path = os.path.join(workdir, "child_result.json")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "DASK_ML_TPU_OBS_HTTP_PORT": str(port),
+           # the bundle must freeze a NON-EMPTY programs table
+           "DASK_ML_TPU_OBS_PROGRAMS": "1",
+           "INCIDENT_SMOKE_DIR": idir,
+           "INCIDENT_SMOKE_RESULT": result_path}
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    child = subprocess.Popen(
+        [sys.executable, "-c", CHILD], env=env, cwd=repo,
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+    )
+    base = f"http://127.0.0.1:{port}"
+    deadline = time.time() + 150
+    try:
+        # 1) exporter up
+        while True:
+            try:
+                status, body = _get(base + "/healthz")
+                assert status == 200 and body == "ok\n"
+                break
+            except AssertionError:
+                raise
+            except Exception:
+                _wait_dead_or(child, deadline, "/healthz answered")
+        # 2) the SLO rule fires on /alerts during the injected breach
+        rule_name = None
+        while True:
+            try:
+                _, body = _get(base + "/alerts")
+                doc = json.loads(body)
+                firing = [r for r in doc.get("firing", [])
+                          if "serving_slo_violations" in r]
+                if firing:
+                    rule_name = firing[0]
+                    break
+            except (OSError, ValueError):
+                pass
+            _wait_dead_or(child, deadline, "/alerts showed firing")
+        # 3) ... and resolves once the breach subsides (hysteresis)
+        while True:
+            try:
+                _, body = _get(base + "/alerts")
+                doc = json.loads(body)
+                states = [t.get("state") for t in
+                          doc.get("transitions", [])
+                          if t.get("rule") == rule_name]
+                if "resolved" in states and rule_name \
+                        not in doc.get("firing", []):
+                    break
+            except (OSError, ValueError):
+                pass
+            _wait_dead_or(child, deadline, "/alerts showed resolved")
+        assert "firing" in states, states
+        # post-warmup recompile tripwire never fired
+        fired_rules = {t.get("rule") for t in doc.get("transitions", [])}
+        assert "builtin:recompiles" not in fired_rules, fired_rules
+        # 4) child-side verdicts: zero post-warmup compiles, second
+        #    capture refused by the rate limit
+        while not os.path.exists(result_path):
+            _wait_dead_or(child, deadline, "child wrote its result")
+        with open(result_path) as f:
+            res = json.load(f)
+        assert res["compiles_base"] == res["compiles_end"], res
+        assert res["second_capture"] is None, res
+        # 5) EXACTLY ONE bundle, holding the promised context
+        bundles = sorted(n for n in os.listdir(idir)
+                         if n.startswith("incident_")
+                         and n.endswith(".json"))
+        assert len(bundles) == 1, bundles
+        with open(os.path.join(idir, bundles[0])) as f:
+            bundle = json.load(f)
+        assert bundle["reason"] == f"alert:{rule_name}", bundle["reason"]
+        open_names = {s.get("span") for s in bundle["open_spans"]}
+        assert "incident_smoke.breach" in open_names, open_names
+        assert bundle["counters"].get("serving_slo_violations"), \
+            "no slo violations in the frozen counter snapshot"
+        assert isinstance(bundle["histograms"], dict) \
+            and bundle["histograms"], "empty histogram snapshot"
+        assert isinstance(bundle["programs"], list) \
+            and bundle["programs"], "empty programs table"
+        assert bundle["config"]["fingerprint"], "missing config print"
+        # 6) the capture/rate-limit counters made /metrics
+        _, text = _get(base + "/metrics")
+        for fam, low in (("incidents_captured", 1),
+                         ("incidents_rate_limited", 1),
+                         ("alerts_fired", 1)):
+            m = re.search(rf"^dask_ml_tpu_{fam}_total (\d+)", text,
+                          re.MULTILINE)
+            assert m and int(m.group(1)) >= low, (fam, text[-500:])
+        # 7) POST /profile: documented no-op-with-reason off-TPU
+        code, body = _post(base + "/profile?seconds=1")
+        pdoc = json.loads(body)
+        assert code == 400 and pdoc["profiled"] is False \
+            and "TPU" in pdoc.get("reason", ""), (code, pdoc)
+        out.update(
+            bundles=len(bundles), rule=rule_name,
+            open_spans=len(bundle["open_spans"]),
+            programs=len(bundle["programs"]),
+            profile_reason=pdoc["reason"][:60],
+        )
+    except Exception as exc:
+        out["error"] = f"{type(exc).__name__}: {exc}"
+        print(json.dumps(out))
+        child.terminate()
+        return 1
+    finally:
+        child.terminate()
+        try:
+            child.wait(10)
+        except Exception:
+            child.kill()
+
+    # 8) atomic-publish contract: SIGKILL a child mid-capture-loop,
+    #    then every PUBLISHED bundle must still parse
+    kdir = os.path.join(workdir, "kill_incidents")
+    kenv = {**os.environ, "JAX_PLATFORMS": "cpu",
+            "INCIDENT_SMOKE_DIR": kdir}
+    kchild = subprocess.Popen(
+        [sys.executable, "-c", KILL_CHILD], env=kenv, cwd=repo,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    try:
+        line = kchild.stdout.readline().decode()
+        assert line.strip() == "READY", (line,
+                                         kchild.stderr.read()
+                                         .decode()[-2000:])
+        time.sleep(1.0)             # let the capture loop spin
+        os.kill(kchild.pid, signal.SIGKILL)
+        kchild.wait(10)
+        published = [n for n in os.listdir(kdir)
+                     if n.startswith("incident_")
+                     and n.endswith(".json")]
+        assert published, "kill child published no bundles"
+        for n in published:
+            with open(os.path.join(kdir, n)) as f:
+                b = json.load(f)    # truncated JSON raises here
+            assert b.get("incident") == 1, n
+        out.update(ok=True, killed_bundles=len(published), port=port)
+    except Exception as exc:
+        out["error"] = f"{type(exc).__name__}: {exc}"
+    finally:
+        if kchild.poll() is None:
+            kchild.kill()
+        shutil.rmtree(workdir, ignore_errors=True)
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1042,6 +1042,79 @@ def main():
               "traces all joined cross-process, federated /metrics "
               "OK, recompiles=0")
 
+    def incidents_round17():
+        """ISSUE 20 surfaces: the incident plane on real chips — a
+        firing alert rule freezes one atomic bundle (open spans +
+        registry snapshots + device memory of the actual TPUs), the
+        engine's ticker pays ZERO XLA compiles, and
+        ``incidents.deep_profile`` runs a REAL ``jax.profiler`` window
+        into the incident dir on TPU (the no-op-with-reason contract
+        is asserted off-TPU instead)."""
+        import tempfile
+        import time as _time
+
+        from dask_ml_tpu import config, observability as obs
+        from dask_ml_tpu.observability import alerts, incidents
+        from dask_ml_tpu.observability.live import gauge_set
+
+        workdir = tempfile.mkdtemp(prefix="tpu_smoke_incidents_")
+        idir = os.path.join(workdir, "incidents")
+        alerts.reset()
+        incidents.reset()
+        try:
+            with config.set(
+                obs_alert_rules="smoke17_depth:gauge>10",
+                incident_dir=idir, obs_alert_interval_s=0.1,
+                trace_dir=os.path.join(workdir, "trace"),
+            ):
+                assert alerts.ensure_engine() is not None
+                c0 = obs.counters_snapshot().get("recompiles", 0)
+                with obs.span("tpu_smoke.incident17"):
+                    gauge_set("smoke17_depth", 99.0)
+                    deadline = _time.time() + 15
+                    while not (os.path.isdir(idir) and any(
+                            f.startswith("incident_")
+                            and f.endswith(".json")
+                            for f in os.listdir(idir))):
+                        assert _time.time() < deadline, "no bundle"
+                        _time.sleep(0.05)
+                assert "smoke17_depth:gauge>10.0" \
+                    in alerts.alerts_data()["firing"]
+                compiles = obs.counters_snapshot() \
+                    .get("recompiles", 0) - c0
+                assert compiles == 0, compiles
+                bundle = incidents.load_bundles(idir)[0]
+                assert bundle["reason"] == \
+                    "alert:smoke17_depth:gauge>10.0", bundle["reason"]
+                assert any(s["span"] == "tpu_smoke.incident17"
+                           for s in bundle["open_spans"])
+                assert bundle["config"]["fingerprint"]
+                # device_memory froze the REAL per-chip gauges here
+                devmem = bundle["device_memory"]
+                assert isinstance(devmem, dict), devmem
+
+                out = incidents.deep_profile(seconds=1)
+                if jax.default_backend() == "tpu":
+                    assert out["profiled"] is True, out
+                    trace_files = [
+                        os.path.join(dp, f)
+                        for dp, _dn, fns in os.walk(out["log_dir"])
+                        for f in fns
+                    ]
+                    assert trace_files, "profiler window wrote nothing"
+                    profiled = (f"{out['seconds']}s window, "
+                                f"{len(trace_files)} trace files")
+                else:
+                    assert out["profiled"] is False \
+                        and "TPU" in out["reason"], out
+                    profiled = "no-op off-TPU (reason documented)"
+        finally:
+            alerts.reset()
+            incidents.reset()
+        print(f"    round-17: alert fired -> 1 bundle "
+              f"(open span + device memory frozen), recompiles=0, "
+              f"deep profile: {profiled}")
+
     passed = _load_state()
     for name, fn in [
         ("glm solvers x3 families", glms),
@@ -1067,6 +1140,7 @@ def main():
         ("round-14 execution plans (plans/)", plans_round14),
         ("round-15 2-D hybrid meshes", mesh2d_round15),
         ("round-16 fleet observability", fleet_obs_round16),
+        ("round-17 incident plane", incidents_round17),
     ]:
         results.append(run(name, fn, passed))
 

@@ -127,6 +127,18 @@ if ! timeout -k 10 500 python scripts/federation_smoke.py; then
     exit 1
 fi
 
+# -- incident gate (ISSUE 20): a subprocess fleet with an injected
+# fault_plan SLO breach must close detect -> snapshot -> artifact:
+# /alerts transitions firing -> resolved, EXACTLY ONE rate-limited
+# incident bundle lands (open spans + counter/histogram snapshots +
+# programs table), zero post-warmup XLA compiles, POST /profile answers
+# the off-TPU no-op-with-reason, and a SIGKILL mid-capture-loop never
+# publishes a truncated bundle (the save_host atomic-publish contract).
+if ! timeout -k 10 500 python scripts/incident_smoke.py; then
+    echo "VERIFY FAIL: incident gate (alerts / capture / profiling)"
+    exit 1
+fi
+
 # -- serving suite (fast, targeted): the online-inference subsystem gates
 # the same as lint — a broken server should fail verify in ~1min, before
 # the full tier-1 wait. timeout-wrapped like tier-1: a hung serving
